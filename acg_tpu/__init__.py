@@ -1,0 +1,28 @@
+"""acg-tpu: TPU-native distributed conjugate gradient solvers.
+
+A brand-new TPU-first implementation of the capabilities of aCG
+(GPU-accelerated CG solvers for SPD sparse systems, SC'25): classic CG and
+Ghysels-Vanroose pipelined CG over partitioned symmetric CSR matrices, with
+halo exchange and dot-product allreduce expressed as XLA collectives /
+Pallas remote DMA over a TPU device mesh.
+
+Layering (mirrors the reference's layer map, SURVEY.md section 1, rebuilt
+TPU-first rather than ported):
+
+  L0  acg_tpu.errors, acg_tpu.io.mtxfile, acg_tpu.utils.*   (foundation)
+  L1  acg_tpu.graph, acg_tpu.partition                      (partitioning)
+  L2  acg_tpu.parallel.comm                                 (collectives)
+  L3  acg_tpu.parallel.halo                                 (halo exchange)
+  L4  acg_tpu.matrix, acg_tpu.vector                        (sparse linalg)
+  L5  acg_tpu.solvers.*                                     (CG solvers)
+  L6  acg_tpu.tools.*                                       (offline tools)
+  L7  acg_tpu.cli                                           (driver)
+
+This module intentionally does NOT import jax at top level so that pure
+host-side preprocessing (I/O, partitioning) stays importable and fast in
+contexts without an accelerator runtime.
+"""
+
+__version__ = "0.1.0"
+
+from acg_tpu.errors import AcgError, ErrorCode  # noqa: F401

@@ -1,0 +1,7 @@
+"""``python -m acg_tpu`` runs the acg-tpu CLI driver."""
+
+import sys
+
+from acg_tpu.cli import main
+
+sys.exit(main())

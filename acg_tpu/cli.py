@@ -1,0 +1,282 @@
+"""The ``acg-tpu`` CLI driver.
+
+Rebuilds the reference's driver ``cuda/acg-cuda.c`` (SURVEY.md component
+#22): the same 11-stage pipeline -- read matrix, partition, scatter, build
+right-hand side (optionally a manufactured solution verified against an
+independent host SpMV), initialise the device solver, dispatch on
+``--solver``, print the statistics block to stderr, and write the solution
+(and optionally the part-to-part communication matrix) as Matrix Market.
+
+Flag names follow ``cuda/acg-cuda.c:321-377``.  Differences, by design:
+  * ``--comm none|xla|dma`` replaces ``none|mpi|nccl|nvshmem``: on TPU the
+    transport choice is XLA collectives vs Pallas remote DMA; ``mpi``,
+    ``nccl`` and ``nvshmem`` are accepted as aliases of ``xla``/``dma`` for
+    drop-in script compatibility.
+  * ``--nparts`` selects the mesh size (the reference gets this from the
+    MPI launcher).
+  * ``--dtype f64|f32|bf16`` exposes the TPU precision trade-off; ``f64``
+    reproduces the reference's strictly-double semantics.
+  * solver names ``acg-device`` / ``acg-pipelined-device`` are accepted and
+    run the same compiled whole-solve programs as ``acg`` /
+    ``acg-pipelined``: XLA's execution model is already the monolithic
+    device-initiated variant (SURVEY.md section 7).
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+import time
+
+import numpy as np
+
+
+def make_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(
+        prog="acg-tpu",
+        description="TPU-accelerated conjugate gradient solver for symmetric "
+                    "positive definite linear systems Ax=b.",
+        epilog="Report bugs to the acg-tpu repository.")
+    p.add_argument("A", help="matrix in Matrix Market format (.mtx, .mtx.gz, binary)")
+    p.add_argument("b", nargs="?", default=None, help="right-hand side vector (default: ones)")
+    p.add_argument("x0", nargs="?", default=None, help="initial guess (default: zeros)")
+    p.add_argument("--solver", default="acg",
+                   choices=["acg", "acg-pipelined", "acg-device",
+                            "acg-pipelined-device", "host", "petsc"],
+                   help="solver variant (default: acg)")
+    p.add_argument("--comm", default="xla",
+                   choices=["none", "xla", "dma", "mpi", "nccl", "nvshmem"],
+                   help="halo transport: xla collectives or pallas dma "
+                        "(mpi/nccl alias xla, nvshmem aliases dma)")
+    p.add_argument("--nparts", type=int, default=0,
+                   help="mesh size / number of subdomains (default: all devices; "
+                        "0 with --comm none means 1)")
+    p.add_argument("--partition", metavar="FILE", default=None,
+                   help="read row partition vector from FILE (mtxpartition output)")
+    p.add_argument("--partition-binary", action="store_true",
+                   help="partition vector file is in binary Matrix Market format")
+    p.add_argument("--binary", action="store_true",
+                   help="matrix/vector files are in binary Matrix Market format")
+    p.add_argument("--max-iterations", type=int, default=100, metavar="N",
+                   help="maximum number of iterations (default: 100)")
+    p.add_argument("--residual-atol", type=float, default=0.0, metavar="TOL",
+                   help="stop when the residual norm is below TOL")
+    p.add_argument("--residual-rtol", type=float, default=1e-9, metavar="TOL",
+                   help="stop when the relative residual is below TOL (default: 1e-9)")
+    p.add_argument("--diff-atol", type=float, default=0.0, metavar="TOL",
+                   help="stop when the difference in solution iterates is below TOL")
+    p.add_argument("--diff-rtol", type=float, default=0.0, metavar="TOL",
+                   help="stop on relative difference in solution iterates")
+    p.add_argument("--epsilon", type=float, default=0.0,
+                   help="diagonal shift: solve (A + epsilon*I)x = b")
+    p.add_argument("--warmup", type=int, default=10, metavar="N",
+                   help="warmup solves before the timed solve (default: 10)")
+    p.add_argument("--manufactured-solution", action="store_true",
+                   help="use a random unit-norm solution and b = A*xsol; "
+                        "report error norms")
+    p.add_argument("--output-comm-matrix", action="store_true",
+                   help="write the part-to-part communication volume matrix "
+                        "to stdout as Matrix Market")
+    p.add_argument("--dtype", default="f64", choices=["f64", "f32", "bf16"],
+                   help="device arithmetic precision (default: f64)")
+    p.add_argument("--seed", type=int, default=42,
+                   help="random seed for partitioning and manufactured solutions")
+    p.add_argument("--numfmt", default="%.17g", metavar="FMT",
+                   help="printf-style format for numeric output")
+    p.add_argument("-q", "--quiet", action="store_true",
+                   help="do not write the solution vector to stdout")
+    p.add_argument("-v", "--verbose", action="count", default=0,
+                   help="print stage timings to stderr")
+    p.add_argument("--version", action="version", version="acg-tpu 0.1.0")
+    return p
+
+
+def _log(args, msg, t0=None):
+    if args.verbose:
+        if t0 is not None:
+            sys.stderr.write(f"{msg} done in {time.perf_counter() - t0:.6f} seconds\n")
+        else:
+            sys.stderr.write(msg + "\n")
+
+
+def _validate_numfmt(fmt: str) -> str:
+    """The role of the reference's fmtspec parser (``acg/fmtspec.c``):
+    reject formats that are not a single floating-point conversion."""
+    try:
+        _ = fmt % 1.0
+    except (TypeError, ValueError) as e:
+        raise SystemExit(f"acg-tpu: invalid --numfmt {fmt!r}: {e}")
+    if fmt.count("%") != 1:
+        raise SystemExit(f"acg-tpu: invalid --numfmt {fmt!r}: need exactly one conversion")
+    return fmt
+
+
+def main(argv=None) -> int:
+    args = make_parser().parse_args(argv)
+    _validate_numfmt(args.numfmt)
+    try:
+        return _main(args)
+    except OSError as e:
+        sys.stderr.write(f"acg-tpu: {e}\n")
+        return 1
+
+
+def _main(args) -> int:
+
+    # stage 0: runtime init (the MPI/NCCL/NVSHMEM init stage)
+    import os
+
+    import jax
+    # honour JAX_PLATFORMS even when a platform plugin overrides it
+    plat = os.environ.get("JAX_PLATFORMS")
+    if plat:
+        jax.config.update("jax_platforms", plat)
+    if args.dtype == "f64":
+        jax.config.update("jax_enable_x64", True)
+    import jax.numpy as jnp
+    from acg_tpu.errors import AcgError, NotConvergedError
+    from acg_tpu.graph import comm_matrix, partition_matrix
+    from acg_tpu.io.mtxfile import MtxFile, read_mtx, write_mtx, vector_mtx
+    from acg_tpu.matrix import SymCsrMatrix
+    from acg_tpu.ops.spmv import device_matrix_from_csr
+    from acg_tpu.parallel.dist import DistCGSolver, DistributedProblem
+    from acg_tpu.partition import partition_rows
+    from acg_tpu.solvers import HostCGSolver, StoppingCriteria
+    from acg_tpu.solvers.jax_cg import JaxCGSolver
+
+    dtype = {"f64": jnp.float64, "f32": jnp.float32, "bf16": jnp.bfloat16}[args.dtype]
+    comm = {"mpi": "xla", "nccl": "xla", "nvshmem": "dma"}.get(args.comm, args.comm)
+    if comm == "dma":
+        raise SystemExit("acg-tpu: --comm dma (pallas remote-DMA halo) is "
+                         "not implemented yet in this build; use --comm xla")
+
+    # stage 1: read the matrix
+    t0 = time.perf_counter()
+    _log(args, f"reading matrix from {args.A}")
+    try:
+        mtx = read_mtx(args.A, binary=args.binary)
+    except AcgError as e:
+        sys.stderr.write(f"acg-tpu: {args.A}: {e}\n")
+        return 1
+    _log(args, "read matrix:", t0)
+
+    # stage 2a: assemble symmetric CSR
+    t0 = time.perf_counter()
+    A = SymCsrMatrix.from_mtx(mtx)
+    csr = A.to_csr(epsilon=args.epsilon)
+    _log(args, "assemble symmetric CSR:", t0)
+
+    n = A.nrows
+
+    # stage 2b/2c: partition rows and build subdomains
+    nparts = args.nparts
+    if comm == "none":
+        nparts = nparts or 1
+    else:
+        nparts = nparts or len(jax.devices())
+    t0 = time.perf_counter()
+    if args.partition:
+        try:
+            pmtx = read_mtx(args.partition, binary=args.partition_binary)
+        except AcgError as e:
+            sys.stderr.write(f"acg-tpu: {args.partition}: {e}\n")
+            return 1
+        part = np.asarray(pmtx.vals, dtype=np.int64).reshape(-1)
+        if part.size != n:
+            raise SystemExit(f"acg-tpu: partition vector has {part.size} "
+                             f"entries, matrix has {n} rows")
+        if part.min() == 1 and part.max() == nparts:
+            part = part - 1  # tolerate 1-based partition vectors
+        part = part.astype(np.int32)
+        if part.max() >= nparts:
+            nparts = int(part.max()) + 1
+    else:
+        part = partition_rows(csr, nparts, seed=args.seed)
+    _log(args, f"partition rows into {nparts} parts:", t0)
+
+    # stage 4: right-hand side and initial guess
+    rng = np.random.default_rng(args.seed)
+    xsol = None
+    if args.manufactured_solution:
+        # random unit-norm solution; b = A*xsol via the independent host
+        # SpMV (cuda/acg-cuda.c:1969-2140)
+        xsol = rng.standard_normal(n)
+        xsol /= np.linalg.norm(xsol)
+        b = A.dsymv(xsol, epsilon=args.epsilon)
+    elif args.b:
+        bmtx = read_mtx(args.b, binary=args.binary)
+        b = np.asarray(bmtx.vals, dtype=np.float64).reshape(-1)
+        if b.size != n:
+            raise SystemExit(f"acg-tpu: b has {b.size} entries, need {n}")
+    else:
+        b = np.ones(n)
+    if args.x0:
+        xmtx = read_mtx(args.x0, binary=args.binary)
+        x0 = np.asarray(xmtx.vals, dtype=np.float64).reshape(-1)
+    else:
+        x0 = None
+
+    criteria = StoppingCriteria(
+        maxits=args.max_iterations,
+        residual_atol=args.residual_atol, residual_rtol=args.residual_rtol,
+        diff_atol=args.diff_atol, diff_rtol=args.diff_rtol)
+
+    # stages 6b-8: build solver and solve
+    t0 = time.perf_counter()
+    pipelined = "pipelined" in args.solver
+    comm_mtx_out = None
+    try:
+        if args.solver == "host":
+            solver = HostCGSolver(csr)
+            x = solver.solve(b, x0=x0, criteria=criteria)
+        elif args.solver == "petsc":
+            raise SystemExit("acg-tpu: --solver petsc: PETSc is not available "
+                             "in this build; use --solver host as the "
+                             "reference baseline")
+        elif comm == "none" or nparts == 1:
+            dev = device_matrix_from_csr(csr, dtype=dtype)
+            solver = JaxCGSolver(dev, pipelined=pipelined)
+            x = solver.solve(b, x0=x0, criteria=criteria, warmup=args.warmup)
+        else:
+            subs = partition_matrix(csr, part, nparts)
+            if args.output_comm_matrix:
+                comm_mtx_out = comm_matrix(subs, nparts)
+            prob = DistributedProblem.build(csr, part, nparts, dtype=dtype,
+                                            subs=subs)
+            solver = DistCGSolver(prob, pipelined=pipelined)
+            x = solver.solve(b, x0_global=x0, criteria=criteria,
+                             warmup=args.warmup)
+    except NotConvergedError as e:
+        sys.stderr.write(f"acg-tpu: {e}\n")
+        solver.stats.fwrite(sys.stderr)
+        return 1
+    except AcgError as e:
+        sys.stderr.write(f"acg-tpu: {e}\n")
+        return 1
+    _log(args, "solve:", t0)
+
+    # stage 9: statistics block (grep-compatible with the reference)
+    solver.stats.fwrite(sys.stderr)
+
+    # stage 9b: manufactured-solution error norms
+    if xsol is not None:
+        err0 = np.linalg.norm((x0 if x0 is not None else np.zeros(n)) - xsol)
+        err = np.linalg.norm(x - xsol)
+        sys.stderr.write(f"initial error 2-norm: {err0:.15g}\n")
+        sys.stderr.write(f"error 2-norm: {err:.15g}\n")
+
+    # stage 2d/10: communication matrix and solution output
+    if comm_mtx_out is not None:
+        nz = np.nonzero(comm_mtx_out)
+        write_mtx(sys.stdout.buffer, MtxFile(
+            object="matrix", format="coordinate", field="integer",
+            symmetry="general", nrows=nparts, ncols=nparts, nnz=len(nz[0]),
+            rowidx=nz[0], colidx=nz[1], vals=comm_mtx_out[nz]),
+            numfmt="%d")
+    if not args.quiet:
+        write_mtx(sys.stdout.buffer, vector_mtx(x), numfmt=args.numfmt)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

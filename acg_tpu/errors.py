@@ -1,0 +1,104 @@
+"""Error codes and exceptions for acg-tpu.
+
+Rebuilds the role of the reference's error layer (``acg/error.h:54-103``,
+``acg/error.c:62-142``): a single enum spanning every subsystem, a string
+conversion, floating-point-exception reporting, and collective error
+agreement so all participants fail together.  The TPU build folds these
+into Python exceptions carrying an :class:`ErrorCode`; the FP-exception
+check inspects computed arrays for NaN/Inf instead of ``fetestexcept``
+(device-side traps are not observable from XLA).
+"""
+
+from __future__ import annotations
+
+import enum
+
+import numpy as np
+
+
+class ErrorCode(enum.IntEnum):
+    """Error codes, structurally equivalent to ``ACG_ERR_*`` (error.h:54-103)."""
+
+    SUCCESS = 0
+    ERRNO = 1
+    EOF = 2
+    LINE_TOO_LONG = 3
+    INVALID_FORMAT = 4
+    INVALID_VALUE = 5
+    OVERFLOW = 6
+    INDEX_OUT_OF_BOUNDS = 7
+    NOT_SUPPORTED = 8
+    NOT_CONVERGED = 9
+    INVALID_PARTITION = 10
+    FEXCEPT = 11
+    JAX = 12
+    PALLAS = 13
+    MESH = 14
+    METIS = 15
+    MPI = 16
+
+
+_ERRSTR = {
+    ErrorCode.SUCCESS: "success",
+    ErrorCode.ERRNO: "system error",
+    ErrorCode.EOF: "unexpected end of file",
+    ErrorCode.LINE_TOO_LONG: "line exceeds maximum length",
+    ErrorCode.INVALID_FORMAT: "invalid file format",
+    ErrorCode.INVALID_VALUE: "invalid value",
+    ErrorCode.OVERFLOW: "integer overflow",
+    ErrorCode.INDEX_OUT_OF_BOUNDS: "index out of bounds",
+    ErrorCode.NOT_SUPPORTED: "operation not supported",
+    ErrorCode.NOT_CONVERGED: "solver did not converge",
+    ErrorCode.INVALID_PARTITION: "invalid partition",
+    ErrorCode.FEXCEPT: "floating-point exception",
+    ErrorCode.JAX: "JAX runtime error",
+    ErrorCode.PALLAS: "Pallas kernel error",
+    ErrorCode.MESH: "device mesh error",
+    ErrorCode.METIS: "graph partitioner error",
+    ErrorCode.MPI: "distributed runtime error",
+}
+
+
+def errcodestr(code: ErrorCode) -> str:
+    """Human-readable description of an error code (cf. ``acgerrcodestr``)."""
+    return _ERRSTR.get(code, "unknown error")
+
+
+class AcgError(Exception):
+    """Exception carrying an :class:`ErrorCode` and optional detail."""
+
+    def __init__(self, code: ErrorCode, detail: str = ""):
+        self.code = ErrorCode(code)
+        msg = errcodestr(self.code)
+        if detail:
+            msg = f"{msg}: {detail}"
+        super().__init__(msg)
+
+
+class NotConvergedError(AcgError):
+    """Raised when a solver fails to meet its stopping criteria."""
+
+    def __init__(self, detail: str = ""):
+        super().__init__(ErrorCode.NOT_CONVERGED, detail)
+
+
+def fexcept_str(*arrays) -> str:
+    """Report floating-point exceptions observable in computed arrays.
+
+    The reference decodes ``fetestexcept`` flags into a string appended to
+    the solver report (``error.c:62-142``, printed at ``cgcuda.c:1971``).
+    XLA does not expose trap flags, so we report the observable outcomes:
+    NaN / Inf in the arrays produced by the solve.
+    """
+    flags = []
+    for a in arrays:
+        a = np.asarray(a)
+        if np.isnan(a).any():
+            flags.append("invalid (NaN)")
+            break
+    for a in arrays:
+        a = np.asarray(a)
+        if np.isinf(a).any():
+            flags.append("overflow (Inf)")
+            break
+    return ", ".join(flags) if flags else "none"

@@ -1,0 +1,1 @@
+from acg_tpu.io.mtxfile import MtxFile, read_mtx, write_mtx  # noqa: F401

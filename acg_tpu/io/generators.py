@@ -1,0 +1,63 @@
+"""Model-problem generators: 2D/3D Poisson finite-difference matrices.
+
+Rebuilds (and extends to 3D) the reference's ``matrices_generator/poisson.py``
+(5-point 2D Poisson on an n x n grid).  Returns COO triplets of the FULL
+symmetric matrix; callers needing one-triangle storage filter ``r <= c``.
+The benchmark protocol (BASELINE.md) uses 2D n=2048 and 3D up to 512^3.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from acg_tpu.io.mtxfile import IDX_DTYPE, MtxFile
+
+
+def poisson2d_coo(n: int, dtype=np.float64):
+    """5-point 2D Poisson stencil on an n x n grid -> full COO (N = n*n)."""
+    idx = np.arange(n * n, dtype=IDX_DTYPE)
+    i, j = idx // n, idx % n
+    rows = [idx]
+    cols = [idx]
+    vals = [np.full(n * n, 4.0, dtype=dtype)]
+    for di, dj in ((-1, 0), (1, 0), (0, -1), (0, 1)):
+        ii, jj = i + di, j + dj
+        ok = (ii >= 0) & (ii < n) & (jj >= 0) & (jj < n)
+        rows.append(idx[ok])
+        cols.append((ii * n + jj)[ok])
+        vals.append(np.full(ok.sum(), -1.0, dtype=dtype))
+    return np.concatenate(rows), np.concatenate(cols), np.concatenate(vals), n * n
+
+
+def poisson3d_coo(n: int, dtype=np.float64):
+    """7-point 3D Poisson stencil on an n^3 grid -> full COO (N = n^3)."""
+    N = n * n * n
+    idx = np.arange(N, dtype=IDX_DTYPE)
+    i, j, k = idx // (n * n), (idx // n) % n, idx % n
+    rows = [idx]
+    cols = [idx]
+    vals = [np.full(N, 6.0, dtype=dtype)]
+    for di, dj, dk in ((-1, 0, 0), (1, 0, 0), (0, -1, 0), (0, 1, 0), (0, 0, -1), (0, 0, 1)):
+        ii, jj, kk = i + di, j + dj, k + dk
+        ok = (ii >= 0) & (ii < n) & (jj >= 0) & (jj < n) & (kk >= 0) & (kk < n)
+        rows.append(idx[ok])
+        cols.append(((ii * n + jj) * n + kk)[ok])
+        vals.append(np.full(ok.sum(), -1.0, dtype=dtype))
+    return np.concatenate(rows), np.concatenate(cols), np.concatenate(vals), N
+
+
+def poisson_mtx(n: int, dim: int = 2) -> MtxFile:
+    """Poisson matrix as a symmetric (lower-triangle) MtxFile."""
+    if dim == 2:
+        r, c, v, N = poisson2d_coo(n)
+    elif dim == 3:
+        r, c, v, N = poisson3d_coo(n)
+    else:
+        raise ValueError(f"dim must be 2 or 3, got {dim}")
+    keep = r >= c  # store lower triangle once, symmetry declared in header
+    order = np.lexsort((c[keep], r[keep]))
+    return MtxFile(object="matrix", format="coordinate", field="real",
+                   symmetry="symmetric", nrows=N, ncols=N, nnz=int(keep.sum()),
+                   rowidx=r[keep][order], colidx=c[keep][order],
+                   vals=v[keep][order],
+                   comments=[f"% acg-tpu poisson{dim}d n={n}"])

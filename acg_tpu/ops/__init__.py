@@ -1,0 +1,2 @@
+from acg_tpu.ops.spmv import (DeviceMatrix, DiaMatrix, EllMatrix, CooMatrix,  # noqa: F401
+                              spmv, device_matrix_from_csr)

@@ -1,0 +1,2 @@
+from acg_tpu.parallel.mesh import solve_mesh  # noqa: F401
+from acg_tpu.parallel.dist import DistributedProblem, DistCGSolver  # noqa: F401

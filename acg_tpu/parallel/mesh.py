@@ -1,0 +1,37 @@
+"""Device mesh construction for the solve.
+
+The reference's communicator setup (``acgcomm_init_*``, rank = part id,
+``cuda/acg-cuda.c:1036``) maps on TPU to a 1-D `jax.sharding.Mesh` whose
+single axis enumerates subdomains: part p lives on mesh coordinate p.  The
+mesh takes the role of the communicator; XLA inserts the collectives
+(SURVEY.md section 2, "Distributed communication backend").
+
+Multi-host topologies (the ICI/DCN split) need no code change here: the
+caller passes the global device list and JAX's standard multi-controller
+runtime shards the same program.
+"""
+
+from __future__ import annotations
+
+import jax
+import numpy as np
+from jax.sharding import Mesh
+
+PARTS_AXIS = "parts"
+
+
+def solve_mesh(nparts: int | None = None, devices=None) -> Mesh:
+    """A 1-D mesh of ``nparts`` devices with axis name ``parts``.
+
+    With ``nparts`` greater than the device count this raises -- the
+    reference equivalent is launching more MPI ranks than GPUs, which it
+    also treats as a configuration error.
+    """
+    if devices is None:
+        devices = jax.devices()
+    if nparts is None:
+        nparts = len(devices)
+    if nparts > len(devices):
+        raise ValueError(
+            f"need {nparts} devices for {nparts} parts, have {len(devices)}")
+    return Mesh(np.array(devices[:nparts]), (PARTS_AXIS,))

@@ -1,0 +1,135 @@
+"""Host reference CG solver (numpy, float64).
+
+Rebuilds the reference's textbook host solver ``acg/cg.c`` (SURVEY.md
+component #16): the correctness oracle for the accelerated paths, with all
+four stopping criteria, per-op time/flop/byte statistics, and the same
+update order as ``acgsolver_solve`` (``cg.c:198-407``):
+
+    r0 = b - A x0;  p = r;  gamma = (r,r)
+    repeat:  t = A p
+             alpha = gamma / (p,t)
+             x += alpha p;  r -= alpha t
+             gamma' = (r,r);  beta = gamma'/gamma;  p = r + beta p
+
+Convergence is tested on ||r|| (and optionally ||alpha p|| for the
+difference-in-iterates criteria) every iteration, as in ``cg.c:318-368``.
+"""
+
+from __future__ import annotations
+
+import time
+
+import numpy as np
+import scipy.sparse as sp
+
+from acg_tpu.errors import NotConvergedError
+from acg_tpu.matrix import SymCsrMatrix
+from acg_tpu.solvers.stats import SolverStats, StoppingCriteria
+
+
+class HostCGSolver:
+    """Serial host CG over a :class:`SymCsrMatrix` (the ``acgsolver`` role)."""
+
+    def __init__(self, A: SymCsrMatrix | sp.spmatrix, epsilon: float = 0.0):
+        if isinstance(A, SymCsrMatrix):
+            self.A = A.to_csr(epsilon)
+        else:
+            self.A = sp.csr_matrix(A)
+            if epsilon:
+                self.A = (self.A + epsilon * sp.eye(self.A.shape[0], format="csr")).tocsr()
+        self.n = self.A.shape[0]
+        self.nnz_full = self.A.nnz
+        self.stats = SolverStats(unknowns=self.n)
+
+    def _op(self, name, t, n_bytes, flops):
+        self.stats.ops[name].add(1, t, n_bytes)
+        self.stats.nflops += flops
+
+    def solve(self, b: np.ndarray, x0: np.ndarray | None = None,
+              criteria: StoppingCriteria | None = None,
+              raise_on_divergence: bool = True) -> np.ndarray:
+        crit = criteria or StoppingCriteria()
+        st = self.stats
+        st.criteria = crit
+        A, n = self.A, self.n
+        b = np.asarray(b, dtype=np.float64)
+        x = np.array(x0, dtype=np.float64, copy=True) if x0 is not None else np.zeros(n)
+        dbl = 8
+
+        tstart = time.perf_counter()
+        st.bnrm2 = float(np.linalg.norm(b))
+        st.x0nrm2 = float(np.linalg.norm(x))
+
+        t0 = time.perf_counter()
+        r = b - A @ x
+        self._op("gemv", time.perf_counter() - t0,
+                 self.nnz_full * (dbl + 4) + 2 * n * dbl, 3.0 * self.nnz_full)
+        p = r.copy()
+        self._op("copy", 0.0, 2 * n * dbl, 0.0)
+
+        t0 = time.perf_counter()
+        gamma = float(r @ r)
+        self._op("nrm2", time.perf_counter() - t0, n * dbl, 2.0 * n)
+        st.r0nrm2 = st.rnrm2 = float(np.sqrt(gamma))
+        st.dxnrm2 = np.inf
+
+        res_tol = max(crit.residual_atol,
+                      crit.residual_rtol * st.r0nrm2)
+        st.niterations = 0
+        st.nsolves += 1
+        converged = (not crit.unbounded) and self._test(crit, st, res_tol)
+        k = 0
+        while not converged and k < crit.maxits:
+            t0 = time.perf_counter()
+            t = A @ p
+            self._op("gemv", time.perf_counter() - t0,
+                     self.nnz_full * (dbl + 4) + 2 * n * dbl, 3.0 * self.nnz_full)
+
+            t0 = time.perf_counter()
+            pdott = float(p @ t)
+            self._op("dot", time.perf_counter() - t0, 2 * n * dbl, 2.0 * n)
+            alpha = gamma / pdott
+
+            t0 = time.perf_counter()
+            x += alpha * p
+            r -= alpha * t
+            self._op("axpy", time.perf_counter() - t0, 3 * n * dbl, 2.0 * n)
+            self._op("axpy", 0.0, 3 * n * dbl, 2.0 * n)
+
+            t0 = time.perf_counter()
+            gamma_next = float(r @ r)
+            self._op("nrm2", time.perf_counter() - t0, n * dbl, 2.0 * n)
+            beta = gamma_next / gamma
+            gamma = gamma_next
+            if crit.needs_diff:
+                # ||x_{k+1} - x_k|| = |alpha| * ||p_k|| (the pre-update p)
+                st.dxnrm2 = abs(alpha) * float(np.linalg.norm(p))
+
+            t0 = time.perf_counter()
+            p = r + beta * p
+            self._op("axpy", time.perf_counter() - t0, 3 * n * dbl, 2.0 * n)
+
+            k += 1
+            st.niterations = k
+            st.ntotaliterations += 1
+            st.rnrm2 = float(np.sqrt(gamma))
+            if not crit.unbounded:
+                converged = self._test(crit, st, res_tol)
+
+        st.tsolve += time.perf_counter() - tstart
+        st.converged = converged or crit.unbounded
+        st.fexcept_arrays = [x, r]
+        if not st.converged and raise_on_divergence:
+            raise NotConvergedError(
+                f"{k} iterations, residual {st.rnrm2:.3e} > {res_tol:.3e}")
+        return x
+
+    @staticmethod
+    def _test(crit: StoppingCriteria, st: SolverStats, res_tol: float) -> bool:
+        if res_tol > 0 and st.rnrm2 < res_tol:
+            return True
+        if crit.diff_atol > 0 and st.dxnrm2 < crit.diff_atol:
+            return True
+        if crit.diff_rtol > 0 and st.dxnrm2 < crit.diff_rtol * max(st.x0nrm2, 1e-300):
+            return True
+        return False

@@ -1,0 +1,252 @@
+"""Single-device CG solvers as compiled XLA programs.
+
+The reference implements four execution models of CG (host/device x
+classic/pipelined, ``acg/cgcuda.c``, ``acg/cg-kernels-cuda.cu``).  On TPU
+these collapse into compiled whole-solve programs (SURVEY.md section 7):
+XLA's execution model *is* the reference's monolithic persistent-kernel
+variant (``acgsolvercuda_cg_kernel``, ``cg-kernels-cuda.cu:627-970``) --
+one program per solve, `lax.while_loop` for the iteration, scalars resident
+on device (the reference keeps alpha/beta/||r||^2 in device memory for the
+same reason, ``cgcuda.c:465-486``), and the convergence test a device-side
+predicate (``cg-kernels-cuda.cu:948-957``).
+
+Two algorithms:
+
+* :func:`solve_cg` -- classic CG (`acgsolver_solve` recurrences).
+* :func:`solve_cg_pipelined` -- Ghysels-Vanroose pipelined CG with the
+  fused 6-vector update of the reference's cooperative kernel
+  (``cg-kernels-cuda.cu:187-269``): beta = gamma/gamma_prev, alpha =
+  gamma/(delta - beta*gamma/alpha_prev), z=q+beta z, t=w+beta t, p=r+beta p,
+  x+=alpha p, r-=alpha t, w-=alpha z, with gamma_prev=alpha_prev=inf on the
+  first iteration (``cgcuda.c:1553-1560``).  On a single chip the pipelined
+  variant exists for parity and numerics; its payoff (one fused allreduce)
+  appears on the mesh.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import functools
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from acg_tpu.errors import NotConvergedError
+from acg_tpu.ops.spmv import DeviceMatrix, spmv, spmv_flops
+from acg_tpu.solvers.stats import (SolverStats, StoppingCriteria,
+                                   cg_flops_per_iteration)
+
+
+@functools.partial(jax.tree_util.register_dataclass,
+                   data_fields=["x", "niterations", "rnrm2", "r0nrm2",
+                                "bnrm2", "x0nrm2", "dxnrm2", "converged"],
+                   meta_fields=[])
+@dataclasses.dataclass
+class CGResult:
+    """Device-resident solve result (one host transfer at the end)."""
+
+    x: jax.Array
+    niterations: jax.Array
+    rnrm2: jax.Array
+    r0nrm2: jax.Array
+    bnrm2: jax.Array
+    x0nrm2: jax.Array
+    dxnrm2: jax.Array
+    converged: jax.Array
+
+
+def _tolerances(crit: StoppingCriteria, r0nrm2, x0nrm2, dtype):
+    """Device-side residual/diff thresholds; 0 disables (cf. cg.c:844-848)."""
+    res_tol = jnp.maximum(jnp.asarray(crit.residual_atol, dtype),
+                          jnp.asarray(crit.residual_rtol, dtype) * r0nrm2)
+    diff_tol = jnp.maximum(jnp.asarray(crit.diff_atol, dtype),
+                           jnp.asarray(crit.diff_rtol, dtype) * x0nrm2)
+    return res_tol, diff_tol
+
+
+def _converged(rnrm2sqr, dxnrm2sqr, res_tol, diff_tol):
+    ok = jnp.asarray(False)
+    ok = ok | jnp.where(res_tol > 0, rnrm2sqr < res_tol * res_tol, False)
+    ok = ok | jnp.where(diff_tol > 0, dxnrm2sqr < diff_tol * diff_tol, False)
+    return ok
+
+
+@functools.partial(jax.jit, static_argnames=("maxits", "unbounded", "needs_diff"))
+def _cg_program(A: DeviceMatrix, b, x0, res_atol, res_rtol, diff_atol,
+                diff_rtol, maxits: int, unbounded: bool, needs_diff: bool):
+    """Whole classic-CG solve as one XLA program."""
+    dtype = b.dtype
+    bnrm2 = jnp.linalg.norm(b)
+    x0nrm2 = jnp.linalg.norm(x0)
+    r = b - spmv(A, x0)
+    p = r
+    gamma = jnp.dot(r, r)
+    r0nrm2 = jnp.sqrt(gamma)
+    res_tol = jnp.maximum(res_atol, res_rtol * r0nrm2)
+    diff_tol = jnp.maximum(diff_atol, diff_rtol * x0nrm2)
+
+    def body(carry):
+        k, x, r, p, gamma, dxsqr, done = carry
+        t = spmv(A, p)
+        pdott = jnp.dot(p, t)
+        alpha = gamma / pdott
+        x = x + alpha * p
+        r = r - alpha * t
+        gamma_next = jnp.dot(r, r)
+        beta = gamma_next / gamma
+        p_next = r + beta * p
+        if needs_diff:
+            dxsqr = alpha * alpha * jnp.dot(p, p)
+        done = _converged(gamma_next, dxsqr, res_tol, diff_tol)
+        return k + 1, x, r, p_next, gamma_next, dxsqr, done
+
+    init = (jnp.int32(0), x0, r, p, gamma,
+            jnp.asarray(jnp.inf, dtype), jnp.asarray(False))
+    if unbounded:
+        # no tolerances: run exactly maxits iterations (benchmark mode);
+        # fori_loop lets XLA drop the convergence predicate entirely.
+        def fbody(_, carry):
+            return body(carry)
+        k, x, r, p, gamma, dxsqr, done = jax.lax.fori_loop(0, maxits, fbody, init)
+        done = jnp.asarray(True)
+    else:
+        init_done = _converged(gamma, jnp.asarray(jnp.inf, dtype), res_tol, diff_tol)
+        init = init[:6] + (init_done,)
+
+        def cond(carry):
+            k, *_, done = carry
+            return (~done) & (k < maxits)
+
+        k, x, r, p, gamma, dxsqr, done = jax.lax.while_loop(cond, body, init)
+    return CGResult(x=x, niterations=k, rnrm2=jnp.sqrt(gamma),
+                    r0nrm2=r0nrm2, bnrm2=bnrm2, x0nrm2=x0nrm2,
+                    dxnrm2=jnp.sqrt(dxsqr), converged=done)
+
+
+@functools.partial(jax.jit, static_argnames=("maxits", "unbounded", "needs_diff"))
+def _cg_pipelined_program(A: DeviceMatrix, b, x0, res_atol, res_rtol,
+                          diff_atol, diff_rtol, maxits: int, unbounded: bool,
+                          needs_diff: bool):
+    """Whole pipelined-CG (Ghysels-Vanroose) solve as one XLA program."""
+    dtype = b.dtype
+    bnrm2 = jnp.linalg.norm(b)
+    x0nrm2 = jnp.linalg.norm(x0)
+    r = b - spmv(A, x0)
+    w = spmv(A, r)
+    r0nrm2 = jnp.linalg.norm(r)
+    res_tol = jnp.maximum(res_atol, res_rtol * r0nrm2)
+    diff_tol = jnp.maximum(diff_atol, diff_rtol * x0nrm2)
+    inf = jnp.asarray(jnp.inf, dtype)
+    zeros = jnp.zeros_like(b)
+
+    def body(carry):
+        k, x, r, w, p, t, z, gamma_prev, alpha_prev, dxsqr, done = carry
+        # both reductions of the iteration, fused (one allreduce on a mesh)
+        gamma = jnp.dot(r, r)
+        delta = jnp.dot(w, r)
+        # SpMV overlaps the allreduce in the reference (cgcuda.c:1750-1790);
+        # under XLA the scheduler owns that overlap.
+        q = spmv(A, w)
+        beta = gamma / gamma_prev               # inf -> 0 on first iteration
+        alpha = gamma / (delta - beta * (gamma / alpha_prev))
+        z = q + beta * z
+        t = w + beta * t
+        p = r + beta * p
+        x = x + alpha * p
+        r = r - alpha * t
+        w = w - alpha * z
+        if needs_diff:
+            dxsqr = alpha * alpha * jnp.dot(p, p)
+        done = _converged(jnp.dot(r, r), dxsqr, res_tol, diff_tol)
+        return (k + 1, x, r, w, p, t, z, gamma, alpha, dxsqr, done)
+
+    init = (jnp.int32(0), x0, r, w, zeros, zeros, zeros, inf, inf, inf,
+            jnp.asarray(False))
+    if unbounded:
+        def fbody(_, carry):
+            return body(carry)
+        out = jax.lax.fori_loop(0, maxits, fbody, init)
+        done = jnp.asarray(True)
+    else:
+        init_done = _converged(jnp.dot(r, r), inf, res_tol, diff_tol)
+        init = init[:10] + (init_done,)
+
+        def cond(carry):
+            return (~carry[-1]) & (carry[0] < maxits)
+
+        out = jax.lax.while_loop(cond, body, init)
+        done = out[-1]
+    k, x, r = out[0], out[1], out[2]
+    dxsqr = out[9]
+    rnrm2 = jnp.linalg.norm(r)
+    return CGResult(x=x, niterations=k, rnrm2=rnrm2, r0nrm2=r0nrm2,
+                    bnrm2=bnrm2, x0nrm2=x0nrm2, dxnrm2=jnp.sqrt(dxsqr),
+                    converged=done)
+
+
+class JaxCGSolver:
+    """Single-device CG solver over a :class:`DeviceMatrix`.
+
+    The role of ``acgsolvercuda_init/solvempi/solve_pipelined`` with
+    commsize==1 (``cgcuda.c:143-332,403-1917``): keeps the matrix and
+    workspace device-resident across solves and accumulates statistics.
+    """
+
+    def __init__(self, A: DeviceMatrix, pipelined: bool = False):
+        self.A = A
+        self.pipelined = pipelined
+        self.stats = SolverStats(unknowns=A.nrows)
+        self._spmv_flops = spmv_flops(A)
+
+    def solve(self, b, x0=None, criteria: StoppingCriteria | None = None,
+              raise_on_divergence: bool = True, warmup: int = 0) -> np.ndarray:
+        crit = criteria or StoppingCriteria()
+        st = self.stats
+        st.criteria = crit
+        dtype = self.A.data.dtype if hasattr(self.A, "data") else self.A.vals.dtype
+        b = jnp.asarray(b, dtype=dtype)
+        x0 = jnp.zeros_like(b) if x0 is None else jnp.asarray(x0, dtype=dtype)
+        program = _cg_pipelined_program if self.pipelined else _cg_program
+        args = (self.A, b, x0,
+                jnp.asarray(crit.residual_atol, dtype),
+                jnp.asarray(crit.residual_rtol, dtype),
+                jnp.asarray(crit.diff_atol, dtype),
+                jnp.asarray(crit.diff_rtol, dtype))
+        kwargs = dict(maxits=crit.maxits, unbounded=crit.unbounded,
+                      needs_diff=crit.needs_diff)
+        # warmup solves outside the timed region (the reference warms up
+        # each op class before timing, cgcuda.c:612-710)
+        for _ in range(max(warmup, 0)):
+            program(*args, **kwargs).x.block_until_ready()
+        t0 = time.perf_counter()
+        res = program(*args, **kwargs)
+        res.x.block_until_ready()
+        st.tsolve += time.perf_counter() - t0
+
+        niter = int(res.niterations)
+        st.nsolves += 1
+        st.niterations = niter
+        st.ntotaliterations += niter
+        st.bnrm2 = float(res.bnrm2)
+        st.x0nrm2 = float(res.x0nrm2)
+        st.r0nrm2 = float(res.r0nrm2)
+        st.rnrm2 = float(res.rnrm2)
+        st.dxnrm2 = float(res.dxnrm2)
+        st.converged = bool(res.converged) or crit.unbounded
+        n = self.A.nrows
+        per_it = cg_flops_per_iteration(self._spmv_flops / 3.0, n,
+                                        self.pipelined)
+        st.nflops += per_it * niter + self._spmv_flops + 2.0 * n
+        dbl = np.dtype(dtype).itemsize
+        st.ops["gemv"].add(niter + 1, 0.0,
+                           int((self._spmv_flops / 3.0) * (dbl + 4) + 2 * n * dbl) * (niter + 1))
+        st.ops["dot"].add(2 * niter, 0.0, 2 * n * dbl * 2 * niter)
+        st.ops["axpy"].add(3 * niter, 0.0, 3 * n * dbl * 3 * niter)
+        x = np.asarray(res.x)
+        st.fexcept_arrays = [x]
+        if not st.converged and raise_on_divergence:
+            raise NotConvergedError(
+                f"{niter} iterations, residual {st.rnrm2:.3e}")
+        return x

@@ -1,0 +1,44 @@
+"""Text-to-binary Matrix Market converter (the reference's ``mtx2bin``).
+
+Converts a text or gzipped ``.mtx`` file to the raw-binary form (same
+header text; data section as consecutive rowidx/colidx/vals arrays,
+``mtx2bin/mtx2bin.c:538-547``) for fast re-reading at scale -- the de facto
+checkpoint of the preprocessing pipeline (SURVEY.md section 5).
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+import time
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(
+        prog="acg-tpu-mtx2bin",
+        description="Convert a Matrix Market file to binary form.")
+    p.add_argument("input", help="text or gzipped .mtx file")
+    p.add_argument("output", nargs="?", default=None,
+                   help="output path (default: stdout)")
+    p.add_argument("-v", "--verbose", action="count", default=0)
+    args = p.parse_args(argv)
+
+    from acg_tpu.io.mtxfile import read_mtx, write_mtx
+
+    t0 = time.perf_counter()
+    mtx = read_mtx(args.input)
+    if args.verbose:
+        sys.stderr.write(f"read: {time.perf_counter() - t0:.6f} s "
+                         f"({mtx.nrows}x{mtx.ncols}, {mtx.nnz} nnz)\n")
+    t0 = time.perf_counter()
+    if args.output:
+        write_mtx(args.output, mtx, binary=True)
+    else:
+        write_mtx(sys.stdout.buffer, mtx, binary=True)
+    if args.verbose:
+        sys.stderr.write(f"write: {time.perf_counter() - t0:.6f} s\n")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,70 @@
+"""Dense vectors with trailing ghost entries (host-side, numpy).
+
+Rebuilds the reference's ``acg/vector.c`` (SURVEY.md component #9): a dense
+vector whose last ``num_ghost`` entries mirror remote data and are excluded
+from reductions (``vector.h:152-160``), BLAS-1 operations with analytic
+flop/byte accounting, and the sparse gather (``usga``) used to extract
+partition-conforming subvectors.  MPI send/recv/scatter variants collapse
+into plain slicing here because the TPU build is single-controller.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import numpy as np
+
+
+@dataclasses.dataclass
+class PVector:
+    """A vector of ``size`` entries of which the trailing ``num_ghost`` are
+    ghost copies of remote entries (excluded from dot products and norms)."""
+
+    data: np.ndarray
+    num_ghost: int = 0
+
+    @classmethod
+    def zeros(cls, n: int, num_ghost: int = 0, dtype=np.float64) -> "PVector":
+        return cls(np.zeros(n + num_ghost, dtype=dtype), num_ghost)
+
+    @property
+    def size(self) -> int:
+        return self.data.size
+
+    @property
+    def num_owned(self) -> int:
+        return self.data.size - self.num_ghost
+
+    @property
+    def owned(self) -> np.ndarray:
+        """View of the non-ghost entries (reductions operate on this)."""
+        return self.data[: self.num_owned]
+
+    # BLAS-1, ghost-aware (cf. vector.h:335-415)
+    def dot(self, other: "PVector") -> float:
+        return float(np.dot(self.owned, other.owned))
+
+    def nrm2(self) -> float:
+        return float(np.linalg.norm(self.owned))
+
+    def axpy(self, alpha: float, x: "PVector") -> None:
+        self.owned += alpha * x.owned
+
+    def aypx(self, alpha: float, x: "PVector") -> None:
+        """y = alpha*y + x (the reference's ``daypx``)."""
+        np.multiply(self.owned, alpha, out=self.owned)
+        self.owned += x.owned
+
+    def scal(self, alpha: float) -> None:
+        self.owned *= alpha
+
+    def copy_from(self, x: "PVector") -> None:
+        np.copyto(self.data, x.data)
+
+    def gather(self, idx: np.ndarray) -> np.ndarray:
+        """Sparse gather of entries at ``idx`` (the reference's ``usga``)."""
+        return self.data[idx]
+
+    def scatter_into(self, idx: np.ndarray, values: np.ndarray) -> None:
+        """Sparse scatter (the reference's ``ussc``); used to unpack halos."""
+        self.data[idx] = values

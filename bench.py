@@ -1,0 +1,74 @@
+"""Benchmark harness: CG iterations/second on the reference workload.
+
+Protocol (BASELINE.md, from the reference's scripts): 2D Poisson 5-point,
+n=2048 (N=4,194,304 unknowns, ~2.09e7 stored nonzeros), classic CG,
+1000 iterations, warmup before timing, metric = iterations/second
+("total solver time" for a fixed iteration count).  Runs on whatever
+accelerator JAX exposes (one TPU chip under the driver).
+
+Prints ONE JSON line:
+  {"metric": ..., "value": N, "unit": "iters/s", "vs_baseline": N}
+
+``vs_baseline`` divides by an analytic roofline for one H100 running the
+reference's CUDA solver on the same workload (HBM-bound: ~600 MB of
+traffic per iteration at 3.35 TB/s with ~80% efficiency -> ~4500 iters/s).
+The reference repo publishes no measured numbers (BASELINE.md); this
+analytic stand-in is documented there and replaced when measured numbers
+exist.
+"""
+
+from __future__ import annotations
+
+import json
+import sys
+import time
+
+N_SIDE = 2048
+MAXITS = 1000
+WARMUP_ITS = 50
+
+# Analytic H100 baseline for vs_baseline (see module docstring / BASELINE.md)
+H100_BASELINE_ITERS_PER_SEC = 4500.0
+
+
+def main() -> int:
+    import jax
+    import jax.numpy as jnp
+
+    from acg_tpu.io.generators import poisson2d_coo
+    from acg_tpu.ops.spmv import device_matrix_from_csr
+    from acg_tpu.matrix import SymCsrMatrix
+    from acg_tpu.solvers.jax_cg import JaxCGSolver
+    from acg_tpu.solvers.stats import StoppingCriteria
+
+    t0 = time.perf_counter()
+    r, c, v, N = poisson2d_coo(N_SIDE)
+    csr = SymCsrMatrix.from_coo(N, r, c, v).to_csr()
+    A = device_matrix_from_csr(csr, dtype=jnp.float32)  # DIA for stencils
+    print(f"# setup: N={N} nnz={csr.nnz} in {time.perf_counter() - t0:.1f}s "
+          f"on {jax.devices()[0].platform}", file=sys.stderr)
+
+    solver = JaxCGSolver(A)
+    b = jnp.ones(N, dtype=jnp.float32)
+    # warmup: compile + a short run (the reference warms up every op class)
+    solver.solve(b, criteria=StoppingCriteria(maxits=WARMUP_ITS))
+    solver.stats.tsolve = 0.0
+
+    solver.solve(b, criteria=StoppingCriteria(maxits=MAXITS))
+    tsolve = solver.stats.tsolve
+    iters_per_sec = MAXITS / tsolve
+    print(f"# total solver time: {tsolve:.6f} seconds "
+          f"({solver.stats.nflops * 1e-9 / tsolve:.1f} Gflop/s)",
+          file=sys.stderr)
+
+    print(json.dumps({
+        "metric": "cg_iters_per_sec_poisson2d_n2048_f32",
+        "value": round(iters_per_sec, 2),
+        "unit": "iters/s",
+        "vs_baseline": round(iters_per_sec / H100_BASELINE_ITERS_PER_SEC, 4),
+    }))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

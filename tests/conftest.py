@@ -1,0 +1,22 @@
+"""Test configuration: run JAX on a virtual 8-device CPU mesh.
+
+This is the TPU build's analog of the reference's np=1,2,4,8 single-node
+testing (SURVEY.md section 4): the same partitioned solve paths run over
+XLA's host-platform device simulation so distributed code is exercised in
+CI without TPU hardware.  float64 is enabled to match the reference's
+strictly-FP64 semantics for correctness tests.
+"""
+
+import os
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8").strip()
+os.environ.setdefault("JAX_ENABLE_X64", "1")
+
+import jax  # noqa: E402
+
+jax.config.update("jax_enable_x64", True)
+jax.config.update("jax_platforms", "cpu")

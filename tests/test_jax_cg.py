@@ -1,0 +1,139 @@
+"""Single-device JAX CG solvers vs the host oracle (reference: cgcuda.c)."""
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+from acg_tpu.io.generators import poisson_mtx
+from acg_tpu.matrix import SymCsrMatrix
+from acg_tpu.ops.spmv import (CooMatrix, EllMatrix, device_matrix_from_csr,
+                              spmv)
+from acg_tpu.solvers import HostCGSolver, StoppingCriteria
+from acg_tpu.solvers.jax_cg import JaxCGSolver
+
+
+@pytest.fixture(scope="module")
+def poisson16():
+    m = poisson_mtx(16, dim=2)
+    return SymCsrMatrix.from_mtx(m)
+
+
+def test_spmv_formats_match_scipy(poisson16):
+    csr = poisson16.to_csr()
+    x = np.random.default_rng(0).standard_normal(csr.shape[0])
+    want = csr @ x
+    for fmt in ("ell", "coo", "dia"):
+        A = device_matrix_from_csr(csr, dtype=jnp.float64, format=fmt)
+        got = np.asarray(spmv(A, jnp.asarray(x)))
+        np.testing.assert_allclose(got, want, rtol=1e-13)
+
+
+def test_spmv_dia_nonsymmetric_band():
+    """DIA with asymmetric offsets (incl. out-of-band clipping at edges)."""
+    import scipy.sparse as sp
+    n = 50
+    A = sp.diags([np.arange(1, n - 1, dtype=float), np.full(n, 4.0),
+                  -np.ones(n - 3)], [-2, 0, 3]).tocsr()
+    x = np.random.default_rng(1).standard_normal(n)
+    from acg_tpu.ops.spmv import dia_from_csr
+    D = dia_from_csr(A, dtype=jnp.float64)
+    assert D.offsets == (-2, 0, 3)
+    np.testing.assert_allclose(np.asarray(spmv(D, jnp.asarray(x))), A @ x,
+                               rtol=1e-13)
+
+
+def test_format_auto_choice(poisson16):
+    from acg_tpu.ops.spmv import DiaMatrix
+    csr = poisson16.to_csr()
+    A = device_matrix_from_csr(csr, format="auto")
+    assert isinstance(A, DiaMatrix)  # stencil: 5 diagonals -> DIA
+    # scrambled rows destroy the diagonal structure -> ELL
+    rng = np.random.default_rng(0)
+    perm = rng.permutation(csr.shape[0])
+    import scipy.sparse as sp
+    Pm = sp.eye(csr.shape[0], format="csr")[perm]
+    scrambled = (Pm @ csr @ Pm.T).tocsr()
+    B = device_matrix_from_csr(scrambled, format="auto")
+    assert isinstance(B, EllMatrix)
+    import scipy.sparse as sp
+    # arrow matrix: one dense row -> ELL would waste n*K
+    n = 200
+    arrow = sp.lil_matrix((n, n))
+    arrow[0, :] = 1.0
+    arrow[:, 0] = 1.0
+    arrow.setdiag(n)
+    B = device_matrix_from_csr(arrow.tocsr(), format="auto")
+    assert isinstance(B, CooMatrix)
+
+
+@pytest.mark.parametrize("pipelined", [False, True])
+@pytest.mark.parametrize("fmt", ["ell", "coo"])
+def test_jax_cg_matches_host(poisson16, pipelined, fmt):
+    csr = poisson16.to_csr()
+    rng = np.random.default_rng(7)
+    xsol = rng.standard_normal(csr.shape[0])
+    xsol /= np.linalg.norm(xsol)
+    b = csr @ xsol
+    crit = StoppingCriteria(maxits=2000, residual_rtol=1e-10)
+
+    host = HostCGSolver(poisson16)
+    xh = host.solve(b, criteria=crit)
+
+    A = device_matrix_from_csr(csr, dtype=jnp.float64, format=fmt)
+    solver = JaxCGSolver(A, pipelined=pipelined)
+    xd = solver.solve(b, criteria=crit)
+
+    assert np.linalg.norm(xd - xsol) < 1e-7
+    assert np.linalg.norm(xd - xh) < 1e-7
+    st = solver.stats
+    assert st.converged
+    assert st.rnrm2 < 1e-10 * st.r0nrm2 * 1.001
+    # classic and pipelined should converge in a similar iteration count
+    assert abs(st.niterations - host.stats.niterations) <= 3
+
+
+@pytest.mark.parametrize("pipelined", [False, True])
+def test_jax_cg_maxits_only(poisson16, pipelined):
+    csr = poisson16.to_csr()
+    A = device_matrix_from_csr(csr, dtype=jnp.float64)
+    solver = JaxCGSolver(A, pipelined=pipelined)
+    solver.solve(np.ones(csr.shape[0]), criteria=StoppingCriteria(maxits=13))
+    assert solver.stats.niterations == 13
+    assert solver.stats.converged
+
+
+def test_jax_cg_float32(poisson16):
+    """f32 path (the TPU-native dtype) still reaches a loose tolerance."""
+    csr = poisson16.to_csr()
+    rng = np.random.default_rng(3)
+    xsol = rng.standard_normal(csr.shape[0])
+    xsol /= np.linalg.norm(xsol)
+    b = (csr @ xsol).astype(np.float32)
+    A = device_matrix_from_csr(csr, dtype=jnp.float32)
+    solver = JaxCGSolver(A)
+    x = solver.solve(b, criteria=StoppingCriteria(maxits=3000, residual_rtol=1e-4))
+    assert solver.stats.converged
+    assert np.linalg.norm(x - xsol) < 1e-2
+
+
+def test_jax_cg_diff_criterion(poisson16):
+    csr = poisson16.to_csr()
+    A = device_matrix_from_csr(csr, dtype=jnp.float64)
+    solver = JaxCGSolver(A)
+    solver.solve(np.ones(csr.shape[0]),
+                 criteria=StoppingCriteria(maxits=5000, diff_atol=1e-9))
+    assert solver.stats.converged
+    assert solver.stats.dxnrm2 < 1e-9
+
+
+def test_stats_flops_positive(poisson16):
+    csr = poisson16.to_csr()
+    A = device_matrix_from_csr(csr, dtype=jnp.float64)
+    solver = JaxCGSolver(A)
+    solver.solve(np.ones(csr.shape[0]),
+                 criteria=StoppingCriteria(maxits=50, residual_rtol=1e-6))
+    st = solver.stats
+    assert st.nflops > 0 and st.tsolve > 0
+    text = st.fwrite()
+    assert "total solver time: " in text

@@ -1,0 +1,124 @@
+"""SymCsrMatrix invariants and host reference CG vs scipy/numpy oracles."""
+
+import numpy as np
+import pytest
+import scipy.sparse as sp
+
+from acg_tpu.io.generators import poisson2d_coo, poisson_mtx
+from acg_tpu.matrix import SymCsrMatrix
+from acg_tpu.errors import NotConvergedError
+from acg_tpu.solvers import HostCGSolver, StoppingCriteria
+
+
+def rand_spd(n, seed=0, density=0.2):
+    rng = np.random.default_rng(seed)
+    B = sp.random(n, n, density=density, random_state=rng).toarray()
+    A = B @ B.T + n * np.eye(n)
+    return A
+
+
+def test_from_coo_full_vs_triangle():
+    A = rand_spd(12, 1)
+    Asp = sp.coo_matrix(A)
+    full = SymCsrMatrix.from_coo(12, Asp.row, Asp.col, Asp.data)
+    up = sp.triu(sp.coo_matrix(A)).tocoo()
+    tri = SymCsrMatrix.from_coo(12, up.row, up.col, up.data)
+    np.testing.assert_allclose(full.to_csr().toarray(), A, rtol=1e-14)
+    np.testing.assert_allclose(tri.to_csr().toarray(), A, rtol=1e-14)
+    # packed storage stores upper triangle only
+    assert (full.pcolidx >= np.repeat(np.arange(12), np.diff(full.prowptr))).all()
+    assert full.pnnz == tri.pnnz
+
+
+def test_packed_nnz_full():
+    m = poisson_mtx(5, dim=2)
+    A = SymCsrMatrix.from_mtx(m)
+    assert A.nnz_full == A.to_csr().nnz
+
+
+def test_epsilon_shift():
+    m = poisson_mtx(4, dim=2)
+    A = SymCsrMatrix.from_mtx(m)
+    d0 = A.to_csr().diagonal()
+    d1 = A.to_csr(epsilon=0.5).diagonal()
+    np.testing.assert_allclose(d1 - d0, 0.5)
+
+
+def test_dsymv_matches_dense():
+    m = poisson_mtx(6, dim=2)
+    A = SymCsrMatrix.from_mtx(m)
+    x = np.random.default_rng(2).standard_normal(36)
+    np.testing.assert_allclose(A.dsymv(x), A.to_csr().toarray() @ x, rtol=1e-14)
+
+
+def test_host_cg_small_dense():
+    A = rand_spd(20, 3)
+    xsol = np.random.default_rng(4).standard_normal(20)
+    b = A @ xsol
+    solver = HostCGSolver(sp.csr_matrix(A))
+    x = solver.solve(b, criteria=StoppingCriteria(maxits=200, residual_rtol=1e-12))
+    np.testing.assert_allclose(x, xsol, rtol=1e-8)
+    st = solver.stats
+    assert st.converged and st.niterations > 0
+    assert st.rnrm2 < 1e-12 * st.r0nrm2 * 1.0000001
+    assert st.nflops > 0 and st.tsolve > 0
+
+
+def test_host_cg_poisson_manufactured():
+    """The reference's primary verification: random unit-norm xsol,
+    b = A xsol, check final error norm (cuda/acg-cuda.c:1969-2385)."""
+    m = poisson_mtx(16, dim=2)
+    A = SymCsrMatrix.from_mtx(m)
+    rng = np.random.default_rng(5)
+    xsol = rng.standard_normal(A.nrows)
+    xsol /= np.linalg.norm(xsol)
+    b = A.dsymv(xsol)
+    solver = HostCGSolver(A)
+    x = solver.solve(b, criteria=StoppingCriteria(maxits=2000, residual_rtol=1e-10))
+    assert np.linalg.norm(x - xsol) < 1e-7
+
+
+def test_host_cg_not_converged():
+    m = poisson_mtx(8, dim=2)
+    A = SymCsrMatrix.from_mtx(m)
+    b = np.ones(A.nrows)
+    solver = HostCGSolver(A)
+    with pytest.raises(NotConvergedError):
+        solver.solve(b, criteria=StoppingCriteria(maxits=2, residual_rtol=1e-14))
+
+
+def test_host_cg_maxits_only():
+    """With all tolerances zero the solver runs exactly maxits iterations
+    and reports success (the reference's benchmark mode)."""
+    m = poisson_mtx(8, dim=2)
+    A = SymCsrMatrix.from_mtx(m)
+    b = np.ones(A.nrows)
+    solver = HostCGSolver(A)
+    solver.solve(b, criteria=StoppingCriteria(maxits=7))
+    assert solver.stats.niterations == 7
+    assert solver.stats.converged
+
+
+def test_stats_report_format():
+    m = poisson_mtx(8, dim=2)
+    A = SymCsrMatrix.from_mtx(m)
+    solver = HostCGSolver(A)
+    solver.solve(np.ones(A.nrows), criteria=StoppingCriteria(maxits=500, residual_rtol=1e-8))
+    text = solver.stats.fwrite()
+    # the reference's analysis scripts grep for this exact phrase
+    assert "total solver time: " in text
+    assert "performance breakdown:" in text
+    for label in ("gemv:", "dot:", "nrm2:", "axpy:", "copy:",
+                  "MPI_Allreduce:", "MPI_HaloExchange:"):
+        assert label in text
+    assert "floating-point exceptions: none" in text
+
+
+def test_diff_stopping_criteria():
+    m = poisson_mtx(8, dim=2)
+    A = SymCsrMatrix.from_mtx(m)
+    b = np.ones(A.nrows)
+    solver = HostCGSolver(A)
+    solver.solve(b, criteria=StoppingCriteria(maxits=1000, diff_atol=1e-10))
+    assert solver.stats.converged
+    assert solver.stats.dxnrm2 < 1e-10

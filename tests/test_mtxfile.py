@@ -1,0 +1,136 @@
+"""Round-trip and format tests for Matrix Market I/O (reference: mtxfile.c)."""
+
+import gzip
+
+import numpy as np
+import pytest
+
+from acg_tpu.errors import AcgError
+from acg_tpu.io.generators import poisson2d_coo, poisson3d_coo, poisson_mtx
+from acg_tpu.io.mtxfile import MtxFile, read_mtx, write_mtx, vector_mtx
+
+
+def small_mtx():
+    return MtxFile(object="matrix", format="coordinate", field="real",
+                   symmetry="general", nrows=3, ncols=3, nnz=5,
+                   rowidx=np.array([0, 0, 1, 2, 2]),
+                   colidx=np.array([0, 1, 1, 0, 2]),
+                   vals=np.array([1.0, 2.5, -3.0, 4.0, 1e-12]))
+
+
+def test_text_roundtrip(tmp_path):
+    m = small_mtx()
+    path = tmp_path / "a.mtx"
+    write_mtx(path, m)
+    m2 = read_mtx(path)
+    assert (m2.nrows, m2.ncols, m2.nnz) == (3, 3, 5)
+    assert m2.symmetry == "general"
+    np.testing.assert_array_equal(m2.rowidx, m.rowidx)
+    np.testing.assert_array_equal(m2.colidx, m.colidx)
+    np.testing.assert_allclose(m2.vals, m.vals, rtol=0, atol=0)
+
+
+def test_binary_roundtrip(tmp_path):
+    m = small_mtx()
+    path = tmp_path / "a.bin.mtx"
+    write_mtx(path, m, binary=True)
+    m2 = read_mtx(path, binary=True)
+    np.testing.assert_array_equal(m2.rowidx, m.rowidx)
+    np.testing.assert_array_equal(m2.colidx, m.colidx)
+    np.testing.assert_array_equal(m2.vals, m.vals)  # bitwise for binary
+
+
+def test_binary_layout_matches_reference(tmp_path):
+    """Data section must be rowidx[],colidx[],vals[] as raw int64/double,
+    1-based (mtxfile.c:1492-1497), so reference binaries interoperate."""
+    m = small_mtx()
+    path = tmp_path / "a.bin.mtx"
+    write_mtx(path, m, binary=True)
+    raw = path.read_bytes()
+    header_end = raw.index(b"3 3 5\n") + len(b"3 3 5\n")
+    data = raw[header_end:]
+    assert len(data) == 5 * 8 * 3
+    rows = np.frombuffer(data[:40], dtype=np.int64)
+    np.testing.assert_array_equal(rows, m.rowidx + 1)
+    vals = np.frombuffer(data[80:], dtype=np.float64)
+    np.testing.assert_array_equal(vals, m.vals)
+
+
+def test_gzip_autodetect(tmp_path):
+    m = small_mtx()
+    plain = tmp_path / "a.mtx"
+    write_mtx(plain, m)
+    gz = tmp_path / "a.mtx.gz"
+    gz.write_bytes(gzip.compress(plain.read_bytes()))
+    m2 = read_mtx(gz)
+    np.testing.assert_allclose(m2.vals, m.vals)
+
+
+def test_pattern_and_vector(tmp_path):
+    m = MtxFile(object="matrix", format="coordinate", field="pattern",
+                symmetry="general", nrows=2, ncols=2, nnz=2,
+                rowidx=np.array([0, 1]), colidx=np.array([1, 0]))
+    p = tmp_path / "p.mtx"
+    write_mtx(p, m)
+    m2 = read_mtx(p)
+    assert m2.field == "pattern" and m2.vals is None
+    r, c, v = m2.to_coo()
+    np.testing.assert_array_equal(v, [1.0, 1.0])
+
+    x = np.linspace(0, 1, 7)
+    vpath = tmp_path / "x.mtx"
+    write_mtx(vpath, vector_mtx(x))
+    x2 = read_mtx(vpath)
+    assert x2.format == "array"
+    np.testing.assert_allclose(x2.vals, x, atol=1e-16)
+
+
+def test_scipy_interop(tmp_path):
+    """Files written by scipy.io.mmwrite (as the reference's generator does)
+    must read back identically."""
+    import scipy.io as sio
+    import scipy.sparse as sp
+    rng = np.random.default_rng(0)
+    A = sp.random(10, 10, density=0.3, random_state=rng, format="coo")
+    A = (A + A.T).tocoo()  # symmetric; mmwrite will detect and fold
+    path = tmp_path / "s.mtx"
+    sio.mmwrite(str(path), A)
+    m = read_mtx(path)
+    from acg_tpu.matrix import SymCsrMatrix
+    ours = SymCsrMatrix.from_mtx(m).to_csr().toarray()
+    np.testing.assert_allclose(ours, A.toarray(), rtol=1e-14)
+
+
+def test_bad_header(tmp_path):
+    path = tmp_path / "bad.mtx"
+    path.write_text("%%NotMatrixMarket matrix coordinate real general\n1 1 0\n")
+    with pytest.raises(AcgError):
+        read_mtx(path)
+
+
+def test_index_bounds(tmp_path):
+    path = tmp_path / "oob.mtx"
+    path.write_text("%%MatrixMarket matrix coordinate real general\n2 2 1\n3 1 1.0\n")
+    with pytest.raises(AcgError):
+        read_mtx(path)
+
+
+def test_poisson_generators():
+    r, c, v, N = poisson2d_coo(4)
+    assert N == 16
+    import scipy.sparse as sp
+    A = sp.coo_matrix((v, (r, c)), shape=(N, N)).toarray()
+    np.testing.assert_allclose(A, A.T)
+    # row sums: interior rows sum to 0, boundary rows positive
+    assert A.sum() > 0
+    assert np.linalg.eigvalsh(A).min() > 0  # SPD
+
+    r, c, v, N = poisson3d_coo(3)
+    assert N == 27
+    A = sp.coo_matrix((v, (r, c)), shape=(N, N)).toarray()
+    np.testing.assert_allclose(A, A.T)
+    assert np.linalg.eigvalsh(A).min() > 0
+
+    m = poisson_mtx(4, dim=2)
+    assert m.symmetry == "symmetric"
+    assert (m.rowidx >= m.colidx).all()
