@@ -1,0 +1,318 @@
+"""ctypes bindings for the native host core (``native/libacg_core.so``).
+
+The reference's host layers are native C (SURVEY.md section 2); ours are
+C++ behind this module.  Every binding has a pure-numpy fallback in the
+package (``io.mtxfile``, ``matrix``, ``graph``), selected automatically
+when the shared library is absent or ``ACG_TPU_DISABLE_NATIVE=1``.  On
+first import the library is built with ``make -C native`` if the checkout
+contains the sources but no binary.
+
+All wrappers take/return numpy arrays; int64 indices throughout (reference
+``acgidx_t`` at IDXSIZE=64).
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+
+import numpy as np
+
+_I64 = ctypes.POINTER(ctypes.c_int64)
+_I32 = ctypes.POINTER(ctypes.c_int32)
+_F64 = ctypes.POINTER(ctypes.c_double)
+
+_REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+_NATIVE_DIR = os.path.join(_REPO_ROOT, "native")
+_LIB_PATH = os.path.join(_NATIVE_DIR, "libacg_core.so")
+
+_lib = None
+
+
+_FAIL_STAMP = os.path.join(_NATIVE_DIR, ".build_failed")
+
+
+def _try_build() -> bool:
+    """Build once per checkout; a failure stamp prevents every subsequent
+    process from re-running make, and the .so is linked to a temp name and
+    atomically renamed so concurrent importers never dlopen a half-linked
+    file."""
+    if not os.path.exists(os.path.join(_NATIVE_DIR, "Makefile")):
+        return False
+    if os.path.exists(_FAIL_STAMP):
+        return False
+    tmp = _LIB_PATH + f".build.{os.getpid()}"
+    try:
+        subprocess.run(["make", "-C", _NATIVE_DIR, f"LIB={os.path.basename(tmp)}"],
+                       check=True, capture_output=True, timeout=180)
+        os.replace(tmp, _LIB_PATH)
+        return True
+    except (OSError, subprocess.SubprocessError):
+        try:
+            with open(_FAIL_STAMP, "w") as f:
+                f.write("native build failed; delete this file to retry\n")
+        except OSError:
+            pass
+        return False
+    finally:
+        if os.path.exists(tmp):
+            try:
+                os.remove(tmp)
+            except OSError:
+                pass
+
+
+def _load():
+    global _lib
+    if os.environ.get("ACG_TPU_DISABLE_NATIVE"):
+        return None
+    if not os.path.exists(_LIB_PATH) and not _try_build():
+        return None
+    try:
+        lib = ctypes.CDLL(_LIB_PATH)
+    except OSError:
+        return None
+    c = ctypes.c_int64
+    lib.acg_core_abi_version.restype = ctypes.c_int32
+    if lib.acg_core_abi_version() != 1:
+        return None
+    lib.acg_radixsort_i64.argtypes = [c, _I64, _I64]
+    lib.acg_radixargsort_i64.argtypes = [c, _I64, _I64]
+    lib.acg_prefixsum_exclusive_i64.argtypes = [c, _I64]
+    lib.acg_mtx_parse_coord.restype = c
+    lib.acg_mtx_parse_coord.argtypes = [
+        ctypes.c_char_p, c, c, c, c, ctypes.c_int32, _I64, _I64, _F64]
+    lib.acg_mtx_parse_array.restype = c
+    lib.acg_mtx_parse_array.argtypes = [ctypes.c_char_p, c, c, _F64]
+    lib.acg_mtx_format_coord.restype = c
+    lib.acg_mtx_format_coord.argtypes = [
+        c, _I64, _I64, _F64, ctypes.c_char_p, ctypes.c_char_p, c]
+    lib.acg_mtx_format_array.restype = c
+    lib.acg_mtx_format_array.argtypes = [
+        c, _F64, ctypes.c_char_p, ctypes.c_char_p, c]
+    lib.acg_sym_csr_count.restype = c
+    lib.acg_sym_csr_count.argtypes = [c, c, _I64, _I64, _I64, _I64, _I32]
+    lib.acg_sym_csr_fill.restype = c
+    lib.acg_sym_csr_fill.argtypes = [c, c, c, _I64, _I64, _F64,
+                                     ctypes.c_int32, _I64, _I64, _F64]
+    lib.acg_sym_csr_expand.restype = c
+    lib.acg_sym_csr_expand.argtypes = [c, _I64, _I64, _F64,
+                                       ctypes.c_double, _I64, _I64, _F64, c]
+    lib.acg_graph_partition_run.restype = ctypes.c_void_p
+    lib.acg_graph_partition_run.argtypes = [c, _I64, _I64, _I32,
+                                            ctypes.c_int32]
+    lib.acg_pr_counts.argtypes = [ctypes.c_void_p, _I64, _I64, _I64, _I64]
+    lib.acg_pr_fill.argtypes = [ctypes.c_void_p, _I64, _I32, _I32, _I64,
+                                _I64]
+    lib.acg_pr_free.argtypes = [ctypes.c_void_p]
+    _lib = lib
+    return lib
+
+
+_lib = _load()
+
+
+def available() -> bool:
+    return _lib is not None
+
+
+def _ptr(a: np.ndarray, ctype):
+    return a.ctypes.data_as(ctype) if a.size else None
+
+
+def _i64(a) -> np.ndarray:
+    return np.ascontiguousarray(a, dtype=np.int64)
+
+
+# ---- sort / scan ---------------------------------------------------------
+
+def radixsort(keys: np.ndarray, return_perm: bool = True):
+    """Sort int64 keys ascending (stable); optionally return the argsort."""
+    keys = _i64(keys).copy()
+    n = keys.size
+    perm = np.empty(n, dtype=np.int64) if return_perm else None
+    _lib.acg_radixsort_i64(n, _ptr(keys, _I64),
+                           _ptr(perm, _I64) if return_perm else None)
+    return (keys, perm) if return_perm else keys
+
+
+def argsort(keys: np.ndarray) -> np.ndarray:
+    keys = _i64(keys)
+    perm = np.empty(keys.size, dtype=np.int64)
+    _lib.acg_radixargsort_i64(keys.size, _ptr(keys, _I64), _ptr(perm, _I64))
+    return perm
+
+
+def prefixsum_exclusive(a: np.ndarray) -> np.ndarray:
+    """[a0, a1, ...] -> [0, a0, a0+a1, ..., total] (n+1 entries)."""
+    a = _i64(a)
+    out = np.empty(a.size + 1, dtype=np.int64)
+    out[: a.size] = a
+    out[a.size] = 0
+    _lib.acg_prefixsum_exclusive_i64(a.size, _ptr(out, _I64))
+    return out
+
+
+# ---- Matrix Market data sections ----------------------------------------
+
+class NativeParseError(Exception):
+    def __init__(self, code: int):
+        super().__init__(f"native parse error {code}")
+        self.code = int(code)
+
+
+def parse_coord(buf: bytes, nnz: int, nrows: int, ncols: int,
+                with_vals: bool):
+    """Parse coordinate data lines; returns (rowidx, colidx, vals|None),
+    0-based and bounds-checked."""
+    rowidx = np.empty(nnz, dtype=np.int64)
+    colidx = np.empty(nnz, dtype=np.int64)
+    vals = np.empty(nnz, dtype=np.float64) if with_vals else None
+    rc = _lib.acg_mtx_parse_coord(
+        buf, len(buf), nnz, nrows, ncols, 1 if with_vals else 0,
+        _ptr(rowidx, _I64), _ptr(colidx, _I64),
+        _ptr(vals, _F64) if with_vals else None)
+    if rc < 0:
+        raise NativeParseError(rc)
+    return rowidx, colidx, vals
+
+
+def parse_array(buf: bytes, n: int) -> np.ndarray:
+    vals = np.empty(n, dtype=np.float64)
+    rc = _lib.acg_mtx_parse_array(buf, len(buf), n, _ptr(vals, _F64))
+    if rc < 0:
+        raise NativeParseError(rc)
+    return vals
+
+
+import re
+
+_FLOAT_FMT = re.compile(r"^[^%]*%[-+ #0-9.]*[eEfFgG][^%]*$")
+
+
+def _fmt_width(fmt: str) -> int:
+    """Upper-bound the printed width of one value under ``fmt`` by probing
+    extreme doubles (overflow is caught by the C side and surfaces as a
+    NativeParseError, so a too-small probe only costs a fallback).  Only
+    float conversions are supported: the C side passes a double vararg, so
+    %d-style formats must take the Python fallback."""
+    if not _FLOAT_FMT.match(fmt):
+        raise NativeParseError(-1)
+    probes = (1.7976931348623157e308, -2.2250738585072014e-308,
+              -1.2345678901234567e-5, float("inf"))
+    return max(len(fmt % v) for v in probes) + 4
+
+
+def format_coord(rowidx, colidx, vals, fmt: str = "%.17g") -> bytes:
+    rowidx = _i64(rowidx)
+    colidx = _i64(colidx)
+    nnz = rowidx.size
+    vals = None if vals is None else np.ascontiguousarray(vals, np.float64)
+    idxw = (len(str(int(rowidx.max()) + 1)) + len(str(int(colidx.max()) + 1))
+            if nnz else 2)
+    est = idxw + 3 + (_fmt_width(fmt) if vals is not None else 0)
+    cap = nnz * est + 128
+    out = ctypes.create_string_buffer(cap)
+    rc = _lib.acg_mtx_format_coord(
+        nnz, _ptr(rowidx, _I64), _ptr(colidx, _I64),
+        _ptr(vals, _F64) if vals is not None else None,
+        fmt.encode(), out, cap)
+    if rc < 0:
+        raise NativeParseError(rc)
+    return out.raw[:rc]
+
+
+def format_array(vals, fmt: str = "%.17g") -> bytes:
+    vals = np.ascontiguousarray(vals, np.float64).reshape(-1)
+    cap = vals.size * (_fmt_width(fmt) + 2) + 128
+    out = ctypes.create_string_buffer(cap)
+    rc = _lib.acg_mtx_format_array(vals.size, _ptr(vals, _F64),
+                                   fmt.encode(), out, cap)
+    if rc < 0:
+        raise NativeParseError(rc)
+    return out.raw[:rc]
+
+
+# ---- symmetric CSR assembly ---------------------------------------------
+
+def sym_csr_from_coo(nrows: int, rowidx, colidx, vals):
+    """COO -> packed-upper CSR (prowptr, pcolidx, pa); duplicates summed,
+    mirrored full-storage input halved (SymCsrMatrix.from_coo semantics)."""
+    rowidx = _i64(rowidx)
+    colidx = _i64(colidx)
+    vals = None if vals is None else np.ascontiguousarray(vals, np.float64)
+    nnz = rowidx.size
+    workkeys = np.empty(nnz, dtype=np.int64)
+    workperm = np.empty(nnz, dtype=np.int64)
+    mirrored = np.zeros(1, dtype=np.int32)
+    pnnz = _lib.acg_sym_csr_count(nrows, nnz, _ptr(rowidx, _I64),
+                                  _ptr(colidx, _I64), _ptr(workkeys, _I64),
+                                  _ptr(workperm, _I64), _ptr(mirrored, _I32))
+    if pnnz < 0:
+        raise NativeParseError(pnnz)
+    prowptr = np.empty(nrows + 1, dtype=np.int64)
+    pcolidx = np.empty(pnnz, dtype=np.int64)
+    pa = np.empty(pnnz, dtype=np.float64)
+    if vals is None:
+        vals = np.ones(nnz, dtype=np.float64)
+    rc = _lib.acg_sym_csr_fill(nrows, nnz, pnnz, _ptr(workkeys, _I64),
+                               _ptr(workperm, _I64), _ptr(vals, _F64),
+                               int(mirrored[0]), _ptr(prowptr, _I64),
+                               _ptr(pcolidx, _I64), _ptr(pa, _F64))
+    if rc < 0:
+        raise NativeParseError(rc)
+    return prowptr, pcolidx, pa
+
+
+def sym_csr_expand(nrows: int, prowptr, pcolidx, pa, epsilon: float = 0.0):
+    """Packed upper CSR -> full-storage CSR (+ epsilon*I), sorted columns."""
+    prowptr = _i64(prowptr)
+    pcolidx = _i64(pcolidx)
+    pa = np.ascontiguousarray(pa, np.float64)
+    cap = 2 * pcolidx.size + (nrows if epsilon else 0)
+    frowptr = np.empty(nrows + 1, dtype=np.int64)
+    fcolidx = np.empty(max(cap, 1), dtype=np.int64)
+    fa = np.empty(max(cap, 1), dtype=np.float64)
+    rc = _lib.acg_sym_csr_expand(nrows, _ptr(prowptr, _I64),
+                                 _ptr(pcolidx, _I64), _ptr(pa, _F64),
+                                 float(epsilon), _ptr(frowptr, _I64),
+                                 _ptr(fcolidx, _I64), _ptr(fa, _F64), cap)
+    if rc < 0:
+        raise NativeParseError(rc)
+    return frowptr, fcolidx[:rc].copy(), fa[:rc].copy()
+
+
+# ---- graph partitioning --------------------------------------------------
+
+def graph_partition(nrows: int, frowptr, fcolidx, part, nparts: int):
+    """One-pass subdomain construction.  Returns a dict of per-part counts
+    and ragged arrays (see native/src/acg_core.h acg_pr_fill layout)."""
+    frowptr = _i64(frowptr)
+    fcolidx = _i64(fcolidx)
+    part = np.ascontiguousarray(part, dtype=np.int32)
+    handle = _lib.acg_graph_partition_run(
+        nrows, _ptr(frowptr, _I64), _ptr(fcolidx, _I64), _ptr(part, _I32),
+        nparts)
+    if not handle:
+        raise NativeParseError(-3)
+    try:
+        nowned = np.empty(nparts, dtype=np.int64)
+        ninterior = np.empty(nparts, dtype=np.int64)
+        nghost = np.empty(nparts, dtype=np.int64)
+        nsend = np.empty(nparts, dtype=np.int64)
+        _lib.acg_pr_counts(handle, _ptr(nowned, _I64), _ptr(ninterior, _I64),
+                           _ptr(nghost, _I64), _ptr(nsend, _I64))
+        global_ids = np.empty(int((nowned + nghost).sum()), dtype=np.int64)
+        ghost_owner = np.empty(int(nghost.sum()), dtype=np.int32)
+        send_part = np.empty(int(nsend.sum()), dtype=np.int32)
+        send_gid = np.empty(int(nsend.sum()), dtype=np.int64)
+        send_lidx = np.empty(int(nsend.sum()), dtype=np.int64)
+        _lib.acg_pr_fill(handle, _ptr(global_ids, _I64),
+                         _ptr(ghost_owner, _I32), _ptr(send_part, _I32),
+                         _ptr(send_gid, _I64), _ptr(send_lidx, _I64))
+    finally:
+        _lib.acg_pr_free(handle)
+    return dict(nowned=nowned, ninterior=ninterior, nghost=nghost,
+                nsend=nsend, global_ids=global_ids, ghost_owner=ghost_owner,
+                send_part=send_part, send_gid=send_gid, send_lidx=send_lidx)

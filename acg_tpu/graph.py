@@ -116,9 +116,63 @@ def partition_graph_nodes(full_csr: sp.csr_matrix, part: np.ndarray,
 
     The role of ``acggraph_partition`` (``graph.c:813-1452``): interface
     extraction, interior/border/ghost reordering, neighbour lists, and halo
-    plan derivation (``graph.c:1898-1981``), in vectorised whole-graph
-    passes rather than per-rank loops.
+    plan derivation (``graph.c:1898-1981``).  Dispatches to the native
+    one-pass C++ partitioner (``native/src/graph.cpp``, O(nnz) independent
+    of nparts) when available, else vectorised numpy whole-graph passes
+    (O(n * nparts)).
     """
+    from acg_tpu import _native
+    if _native.available():
+        try:
+            return _partition_graph_nodes_native(full_csr, part, nparts)
+        except _native.NativeParseError:
+            pass  # fall through to the numpy path for the error message
+    return _partition_graph_nodes_numpy(full_csr, part, nparts)
+
+
+def _partition_graph_nodes_native(full_csr, part, nparts) -> list[Subdomain]:
+    from acg_tpu import _native
+    n = full_csr.shape[0]
+    part = np.asarray(part)
+    if part.size != n:
+        raise AcgError(ErrorCode.INVALID_PARTITION,
+                       f"partition vector has {part.size} entries, matrix has {n} rows")
+    if n and (part.min() < 0 or part.max() >= nparts):
+        raise AcgError(ErrorCode.INVALID_PARTITION,
+                       f"part ids outside [0, {nparts})")
+    res = _native.graph_partition(n, np.asarray(full_csr.indptr, IDX_DTYPE),
+                                  np.asarray(full_csr.indices, IDX_DTYPE),
+                                  part, nparts)
+    gid_off = np.concatenate([[0], np.cumsum(res["nowned"] + res["nghost"])])
+    ghost_off = np.concatenate([[0], np.cumsum(res["nghost"])])
+    send_off = np.concatenate([[0], np.cumsum(res["nsend"])])
+    subdomains = []
+    for p in range(nparts):
+        nowned = int(res["nowned"][p])
+        nghost = int(res["nghost"][p])
+        global_ids = res["global_ids"][gid_off[p]:gid_off[p + 1]]
+        ghost_owner = res["ghost_owner"][ghost_off[p]:ghost_off[p + 1]]
+        sp_p = res["send_part"][send_off[p]:send_off[p + 1]]
+        send_idx = res["send_lidx"][send_off[p]:send_off[p + 1]]
+        send_parts, send_counts = np.unique(sp_p, return_counts=True)
+        send_ptr = np.concatenate([[0], np.cumsum(send_counts)]).astype(IDX_DTYPE)
+        recv_parts, recv_counts = np.unique(ghost_owner, return_counts=True)
+        recv_ptr = np.concatenate([[0], np.cumsum(recv_counts)]).astype(IDX_DTYPE)
+        recv_idx = np.arange(nowned, nowned + nghost, dtype=IDX_DTYPE)
+        halo = HaloPlan(send_parts=send_parts.astype(np.int32),
+                        send_counts=send_counts.astype(IDX_DTYPE),
+                        send_ptr=send_ptr, send_idx=send_idx,
+                        recv_parts=recv_parts.astype(np.int32),
+                        recv_counts=recv_counts.astype(IDX_DTYPE),
+                        recv_ptr=recv_ptr, recv_idx=recv_idx)
+        subdomains.append(Subdomain(
+            part=p, ninterior=int(res["ninterior"][p]),
+            nborder=nowned - int(res["ninterior"][p]), nghost=nghost,
+            global_ids=global_ids, ghost_owner=ghost_owner, halo=halo))
+    return subdomains
+
+
+def _partition_graph_nodes_numpy(full_csr, part, nparts) -> list[Subdomain]:
     n = full_csr.shape[0]
     part = np.asarray(part)
     if part.size != n:

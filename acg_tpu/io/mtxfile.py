@@ -172,20 +172,39 @@ def _read_mtx_stream(f, binary: bool) -> MtxFile:
                 vals = np.frombuffer(f.read(np.dtype(vdt).itemsize * nnz), dtype=vdt).copy()
                 if vals.size != nnz:
                     raise AcgError(ErrorCode.EOF, "binary vals truncated")
+            if nnz > 0 and (rowidx.min() < 0 or rowidx.max() >= nrows
+                            or colidx.min() < 0 or colidx.max() >= ncols):
+                raise AcgError(ErrorCode.INDEX_OUT_OF_BOUNDS,
+                               "mtx indices out of range")
         else:
-            ncolumns = 2 if field == "pattern" else 3
-            data = np.loadtxt(f, dtype=np.float64, ndmin=2, max_rows=nnz) if nnz > 0 else np.zeros((0, ncolumns))
-            if data.shape[0] != nnz or (nnz > 0 and data.shape[1] < ncolumns):
-                raise AcgError(ErrorCode.INVALID_FORMAT, f"expected {nnz} x {ncolumns} data entries, got {data.shape}")
-            rowidx = data[:, 0].astype(IDX_DTYPE) - 1
-            colidx = data[:, 1].astype(IDX_DTYPE) - 1
-            if field == "real":
-                vals = np.ascontiguousarray(data[:, 2])
-            elif field == "integer":
-                vals = data[:, 2].astype(np.int32)
-        if nnz > 0 and rowidx is not None:
-            if rowidx.min() < 0 or rowidx.max() >= nrows or colidx.min() < 0 or colidx.max() >= ncols:
-                raise AcgError(ErrorCode.INDEX_OUT_OF_BOUNDS, "mtx indices out of range")
+            from acg_tpu import _native
+            if _native.available() and nnz > 0:
+                try:
+                    rowidx, colidx, vals = _native.parse_coord(
+                        f.read(), nnz, nrows, ncols, field != "pattern")
+                except _native.NativeParseError as e:
+                    code = {-2: ErrorCode.EOF,
+                            -3: ErrorCode.INDEX_OUT_OF_BOUNDS}.get(
+                        e.code, ErrorCode.INVALID_FORMAT)
+                    raise AcgError(code, "bad coordinate data section")
+                if field == "integer":
+                    vals = vals.astype(np.int32)
+            else:
+                ncolumns = 2 if field == "pattern" else 3
+                data = np.loadtxt(f, dtype=np.float64, ndmin=2, max_rows=nnz) if nnz > 0 else np.zeros((0, ncolumns))
+                if data.shape[0] != nnz or (nnz > 0 and data.shape[1] < ncolumns):
+                    raise AcgError(ErrorCode.INVALID_FORMAT, f"expected {nnz} x {ncolumns} data entries, got {data.shape}")
+                rowidx = data[:, 0].astype(IDX_DTYPE) - 1
+                colidx = data[:, 1].astype(IDX_DTYPE) - 1
+                if field == "real":
+                    vals = np.ascontiguousarray(data[:, 2])
+                elif field == "integer":
+                    vals = data[:, 2].astype(np.int32)
+                # (the native parser bounds-checks inline)
+                if nnz > 0 and (rowidx.min() < 0 or rowidx.max() >= nrows
+                                or colidx.min() < 0 or colidx.max() >= ncols):
+                    raise AcgError(ErrorCode.INDEX_OUT_OF_BOUNDS,
+                                   "mtx indices out of range")
     else:  # array
         if binary:
             vdt = np.float64 if field == "real" else np.int32
@@ -193,9 +212,17 @@ def _read_mtx_stream(f, binary: bool) -> MtxFile:
             if vals.size != nnz:
                 raise AcgError(ErrorCode.EOF, "binary array vals truncated")
         else:
-            vals = np.loadtxt(f, dtype=np.float64, ndmin=1, max_rows=nnz).reshape(-1)
-            if vals.size != nnz:
-                raise AcgError(ErrorCode.INVALID_FORMAT, f"expected {nnz} array entries, got {vals.size}")
+            from acg_tpu import _native
+            if _native.available() and nnz > 0:
+                try:
+                    vals = _native.parse_array(f.read(), nnz)
+                except _native.NativeParseError as e:
+                    code = ErrorCode.EOF if e.code == -2 else ErrorCode.INVALID_FORMAT
+                    raise AcgError(code, "bad array data section")
+            else:
+                vals = np.loadtxt(f, dtype=np.float64, ndmin=1, max_rows=nnz).reshape(-1)
+                if vals.size != nnz:
+                    raise AcgError(ErrorCode.INVALID_FORMAT, f"expected {nnz} array entries, got {vals.size}")
             if field == "integer":
                 vals = vals.astype(np.int32)
 
@@ -245,6 +272,16 @@ def _write_mtx_stream(f, mtx: MtxFile, binary: bool, numfmt: str) -> None:
             if mtx.vals is not None:
                 f.write(_binary_vals(mtx).tobytes())
         else:
+            from acg_tpu import _native
+            vals64 = (None if mtx.vals is None
+                      else np.ascontiguousarray(mtx.vals, np.float64))
+            if _native.available() and mtx.nnz > 0:
+                try:
+                    f.write(_native.format_coord(mtx.rowidx, mtx.colidx,
+                                                 vals64, numfmt))
+                    return
+                except _native.NativeParseError:
+                    pass  # exotic numfmt width: python fallback below
             r = np.asarray(mtx.rowidx) + 1
             c = np.asarray(mtx.colidx) + 1
             if mtx.vals is not None:
@@ -264,6 +301,13 @@ def _write_mtx_stream(f, mtx: MtxFile, binary: bool, numfmt: str) -> None:
             f.write(_binary_vals(mtx).tobytes())
         else:
             vals = np.asarray(mtx.vals).reshape(-1)
+            from acg_tpu import _native
+            if _native.available() and vals.size:
+                try:
+                    f.write(_native.format_array(vals, numfmt))
+                    return
+                except _native.NativeParseError:
+                    pass
             f.write(("\n".join(numfmt % v for v in vals) + "\n").encode())
 
 

@@ -65,6 +65,17 @@ class SymCsrMatrix:
         rowidx = np.asarray(rowidx, dtype=IDX_DTYPE)
         colidx = np.asarray(colidx, dtype=IDX_DTYPE)
         vals = np.asarray(vals, dtype=np.float64)
+        from acg_tpu import _native
+        if _native.available() and rowidx.size:
+            try:
+                pr, pc, pa = _native.sym_csr_from_coo(nrows, rowidx, colidx,
+                                                      vals)
+                return cls(nrows=nrows, prowptr=pr, pcolidx=pc, pa=pa)
+            except _native.NativeParseError as e:
+                if e.code == -3:
+                    raise AcgError(ErrorCode.INDEX_OUT_OF_BOUNDS,
+                                   "COO indices out of range")
+                # key overflow for huge nrows: numpy path below
         # map everything to the upper triangle
         r = np.minimum(rowidx, colidx)
         c = np.maximum(rowidx, colidx)
@@ -103,6 +114,16 @@ class SymCsrMatrix:
 
         Equivalent of ``acgsymcsrmatrix_dsymv_init`` (``symcsrmatrix.c:760``).
         """
+        from acg_tpu import _native
+        if _native.available() and self.pnnz:
+            fr, fc, fa = _native.sym_csr_expand(self.nrows, self.prowptr,
+                                                self.pcolidx, self.pa,
+                                                epsilon)
+            idt = (np.int32 if self.nrows < 2**31 and fr[-1] < 2**31
+                   else np.int64)
+            return sp.csr_matrix((fa, fc.astype(idt, copy=False),
+                                  fr.astype(idt, copy=False)),
+                                 shape=(self.nrows, self.nrows))
         upper = sp.csr_matrix((self.pa, self.pcolidx, self.prowptr),
                               shape=(self.nrows, self.nrows))
         strict = sp.triu(upper, k=1)

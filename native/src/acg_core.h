@@ -1,0 +1,144 @@
+/* acg_core: native host core for the acg-tpu framework.
+ *
+ * C ABI mirror of the reference's native host layers (SURVEY.md section 2):
+ * Matrix Market data-section parsing/formatting (acg/mtxfile.c, component
+ * #1), LSD radix sort (acg/sort.c, #2), prefix sums (acg/prefixsum.c, #3),
+ * symmetric CSR assembly (acg/symcsrmatrix.c, #8), and the one-pass graph
+ * partitioner (acg/graph.c, #6).  All functions are exported with C linkage
+ * so Python binds them through ctypes; arrays are caller-allocated numpy
+ * buffers.  Index type is int64 throughout (reference acgidx_t at
+ * IDXSIZE=64, config.h:59-95).
+ *
+ * Error protocol: functions returning int64 return a nonnegative count on
+ * success and a negative ACG_NATIVE_ERR_* code on failure.
+ */
+
+#ifndef ACG_CORE_H
+#define ACG_CORE_H
+
+#include <stdint.h>
+
+#ifdef __cplusplus
+extern "C" {
+#endif
+
+#define ACG_NATIVE_ERR_INVALID_FORMAT (-1)
+#define ACG_NATIVE_ERR_EOF (-2)
+#define ACG_NATIVE_ERR_OUT_OF_BOUNDS (-3)
+#define ACG_NATIVE_ERR_OVERFLOW (-4)
+
+/* ---- version / capability probe ---- */
+int32_t acg_core_abi_version(void);
+
+/* ---- sort.cpp: LSD radix sort (reference acg/sort.c) ---- */
+
+/* Sort keys ascending in place; if perm != NULL it receives the applied
+ * permutation (perm[i] = original position of the i-th smallest key),
+ * starting from identity.  Stable. */
+void acg_radixsort_i64(int64_t n, int64_t *keys, int64_t *perm);
+
+/* Stable counting/radix argsort without modifying keys. */
+void acg_radixargsort_i64(int64_t n, const int64_t *keys, int64_t *perm);
+
+/* ---- prefixsum.cpp (reference acg/prefixsum.c) ---- */
+
+/* In-place exclusive scan: a[i] <- sum of original a[0..i-1]; a has n+1
+ * entries, a[n] receives the total. */
+void acg_prefixsum_exclusive_i64(int64_t n, int64_t *a);
+
+/* ---- mtxparse.cpp: Matrix Market data sections (reference acg/mtxfile.c,
+ *      parse_acgidx_t/parse_double loops at mtxfile.c:706-728) ---- */
+
+/* Parse nnz "row col [val]" coordinate lines from buf[0..len).  Indices are
+ * converted from 1-based to 0-based and bounds-checked against
+ * nrows/ncols.  vals may be NULL for pattern fields.  OpenMP-parallel
+ * (newline pre-scan then per-chunk parse).  Returns entries parsed. */
+int64_t acg_mtx_parse_coord(const char *buf, int64_t len, int64_t nnz,
+                            int64_t nrows, int64_t ncols, int32_t with_vals,
+                            int64_t *rowidx, int64_t *colidx, double *vals);
+
+/* Parse n whitespace-separated real numbers (array format data section). */
+int64_t acg_mtx_parse_array(const char *buf, int64_t len, int64_t n,
+                            double *vals);
+
+/* Format nnz coordinate lines "r+1 c+1 fmt(v)\n" into out (capacity cap
+ * bytes).  fmt is a single printf double conversion, pre-validated by the
+ * caller.  vals may be NULL (pattern).  Returns bytes written, or
+ * ACG_NATIVE_ERR_OVERFLOW if cap is too small. */
+int64_t acg_mtx_format_coord(int64_t nnz, const int64_t *rowidx,
+                             const int64_t *colidx, const double *vals,
+                             const char *fmt, char *out, int64_t cap);
+
+/* Format n "fmt(v)\n" array lines. */
+int64_t acg_mtx_format_array(int64_t n, const double *vals, const char *fmt,
+                             char *out, int64_t cap);
+
+/* ---- csr.cpp: symmetric CSR assembly (reference acg/symcsrmatrix.c,
+ *      acgsymcsrmatrix_init_* + dsymv_init) ---- */
+
+/* Pass 1 of packed-upper assembly: given COO triplets of a symmetric
+ * matrix (either one triangle or both), compute the packed-upper nonzero
+ * count after mapping every entry to (min,max) and deduplicating.
+ * Fills work[nnz] with the sort keys (r*nrows+c, sorted) for reuse by
+ * pass 2.  Also reports whether both strict triangles were present
+ * (*mirrored = 1) -- then off-diagonal duplicate sums are halved in pass 2,
+ * matching SymCsrMatrix.from_coo.  Returns pnnz. */
+int64_t acg_sym_csr_count(int64_t nrows, int64_t nnz, const int64_t *rowidx,
+                          const int64_t *colidx, int64_t *workkeys,
+                          int64_t *workperm, int32_t *mirrored);
+
+/* Pass 2: fill prowptr (nrows+1), pcolidx (pnnz), pa (pnnz) from the
+ * workkeys/workperm produced by pass 1 and the original vals. */
+int64_t acg_sym_csr_fill(int64_t nrows, int64_t nnz, int64_t pnnz,
+                         const int64_t *workkeys, const int64_t *workperm,
+                         const double *vals, int32_t mirrored,
+                         int64_t *prowptr, int64_t *pcolidx, double *pa);
+
+/* Expand packed-upper CSR to full-storage CSR with optional diagonal shift
+ * (A + epsilon*I).  Caller sizes frowptr to nrows+1 and fcolidx/fa to
+ * 2*pnnz - ndiag + (nrows if epsilon adds missing diagonals; passing
+ * cap lets the function verify).  Rows come out with sorted columns.
+ * Returns full nnz. */
+int64_t acg_sym_csr_expand(int64_t nrows, const int64_t *prowptr,
+                           const int64_t *pcolidx, const double *pa,
+                           double epsilon, int64_t *frowptr, int64_t *fcolidx,
+                           double *fa, int64_t cap);
+
+/* ---- graph.cpp: one-pass subdomain construction (reference acg/graph.c
+ *      acggraph_partition, graph.c:813-1452).  Opaque-handle protocol:
+ *      run once, query counts, copy out ragged arrays, free. ---- */
+
+typedef struct acg_partition_result acg_partition_result;
+
+/* Partition the sparsity pattern (full-storage CSR) by the given part
+ * vector.  Returns NULL on invalid input (part ids outside [0, nparts)). */
+acg_partition_result *acg_graph_partition_run(int64_t nrows,
+                                              const int64_t *frowptr,
+                                              const int64_t *fcolidx,
+                                              const int32_t *part,
+                                              int32_t nparts);
+
+/* Per-part counts; each output array has nparts entries. */
+void acg_pr_counts(const acg_partition_result *res, int64_t *nowned,
+                   int64_t *ninterior, int64_t *nghost, int64_t *nsend);
+
+/* Copy out the ragged per-part arrays.  Layout (offsets are the prefix
+ * sums of the counts above, computed by the caller):
+ *   global_ids: per part [interior | border | ghost] global node ids,
+ *     interior and border ascending, ghosts grouped by owner part then id;
+ *   ghost_owner: owning part per ghost slot;
+ *   send_part/send_gid/send_lidx: halo send list sorted by (destination,
+ *     global id) -- the reference's (recipient, node-tag) radix order
+ *     (halo.c:61-241); send_lidx is each node's local (subdomain) index.
+ */
+void acg_pr_fill(const acg_partition_result *res, int64_t *global_ids,
+                 int32_t *ghost_owner, int32_t *send_part, int64_t *send_gid,
+                 int64_t *send_lidx);
+
+void acg_pr_free(acg_partition_result *res);
+
+#ifdef __cplusplus
+}
+#endif
+
+#endif /* ACG_CORE_H */

@@ -1,0 +1,137 @@
+/* Symmetric CSR assembly: COO -> packed upper triangle -> full storage.
+ *
+ * The role of the reference's acgsymcsrmatrix_init_* (COO to packed-upper
+ * CSR with radix-sort dedupe, acg/symcsrmatrix.c) and
+ * acgsymcsrmatrix_dsymv_init (full-storage expansion with the --epsilon
+ * diagonal shift, symcsrmatrix.c:760-862).  Semantics match
+ * acg_tpu.matrix.SymCsrMatrix.from_coo / to_csr exactly: entries are
+ * mapped to (min,max), duplicates summed, and when both strict triangles
+ * were present in the input every off-diagonal sum is halved (full-storage
+ * input stores each symmetric entry twice). */
+
+#include "acg_core.h"
+
+#include <vector>
+
+namespace {
+/* nrows*nrows must stay below 2^63 for the sort key packing */
+const int64_t kMaxKeyRows = 3037000499LL;
+}
+
+extern "C" {
+
+int64_t acg_sym_csr_count(int64_t nrows, int64_t nnz, const int64_t *rowidx,
+                          const int64_t *colidx, int64_t *workkeys,
+                          int64_t *workperm, int32_t *mirrored) {
+    if (nrows > kMaxKeyRows) return ACG_NATIVE_ERR_OVERFLOW;
+    bool has_lower = false, has_upper = false;
+    for (int64_t i = 0; i < nnz; i++) {
+        int64_t r = rowidx[i], c = colidx[i];
+        if (r < 0 || r >= nrows || c < 0 || c >= nrows)
+            return ACG_NATIVE_ERR_OUT_OF_BOUNDS;
+        has_lower |= r > c;
+        has_upper |= r < c;
+        int64_t lo = r < c ? r : c, hi = r < c ? c : r;
+        workkeys[i] = lo * nrows + hi;
+    }
+    *mirrored = (has_lower && has_upper) ? 1 : 0;
+    acg_radixsort_i64(nnz, workkeys, workperm);
+    int64_t pnnz = 0;
+    for (int64_t i = 0; i < nnz; i++)
+        if (i == 0 || workkeys[i] != workkeys[i - 1]) pnnz++;
+    return pnnz;
+}
+
+int64_t acg_sym_csr_fill(int64_t nrows, int64_t nnz, int64_t pnnz,
+                         const int64_t *workkeys, const int64_t *workperm,
+                         const double *vals, int32_t mirrored,
+                         int64_t *prowptr, int64_t *pcolidx, double *pa) {
+    for (int64_t r = 0; r <= nrows; r++) prowptr[r] = 0;
+    int64_t k = -1;
+    for (int64_t i = 0; i < nnz; i++) {
+        double v = vals ? vals[workperm[i]] : 1.0;
+        if (i == 0 || workkeys[i] != workkeys[i - 1]) {
+            k++;
+            int64_t r = workkeys[i] / nrows, c = workkeys[i] % nrows;
+            pcolidx[k] = c;
+            pa[k] = v;
+            prowptr[r + 1]++;
+        } else {
+            pa[k] += v;
+        }
+    }
+    if (k + 1 != pnnz) return ACG_NATIVE_ERR_INVALID_FORMAT;
+    if (mirrored) {
+        /* full-storage input: off-diagonal sums were counted twice */
+        int64_t j = 0;
+        for (int64_t r = 0; r < nrows; r++) {
+            int64_t cnt = prowptr[r + 1];
+            for (int64_t i = 0; i < cnt; i++, j++)
+                if (pcolidx[j] != r) pa[j] *= 0.5;
+        }
+    }
+    /* counts sit at prowptr[1..nrows]; the inclusive scan turns them into
+     * row pointers (prowptr[r] = entries in rows < r) */
+    int64_t sum = 0;
+    for (int64_t r = 0; r <= nrows; r++) {
+        sum += prowptr[r];
+        prowptr[r] = sum;
+    }
+    return pnnz;
+}
+
+int64_t acg_sym_csr_expand(int64_t nrows, const int64_t *prowptr,
+                           const int64_t *pcolidx, const double *pa,
+                           double epsilon, int64_t *frowptr, int64_t *fcolidx,
+                           double *fa, int64_t cap) {
+    /* count per-row lengths of the full matrix */
+    std::vector<int64_t> len(nrows, 0);
+    std::vector<uint8_t> hasdiag(nrows, 0);
+    for (int64_t r = 0; r < nrows; r++) {
+        for (int64_t j = prowptr[r]; j < prowptr[r + 1]; j++) {
+            int64_t c = pcolidx[j];
+            if (c < r || c >= nrows) return ACG_NATIVE_ERR_INVALID_FORMAT;
+            len[r]++;
+            if (c == r) hasdiag[r] = 1;
+            else len[c]++;  /* mirror */
+        }
+    }
+    if (epsilon != 0.0)
+        for (int64_t r = 0; r < nrows; r++)
+            if (!hasdiag[r]) len[r]++;
+    int64_t total = 0;
+    for (int64_t r = 0; r < nrows; r++) {
+        frowptr[r] = total;
+        total += len[r];
+    }
+    frowptr[nrows] = total;
+    if (total > cap) return ACG_NATIVE_ERR_OVERFLOW;
+
+    /* fill with sorted columns: processing rows in ascending order, row
+     * i's strictly-lower entries (mirrors from rows < i) land before its
+     * diagonal, which lands before its strictly-upper entries. */
+    std::vector<int64_t> cursor(frowptr, frowptr + nrows);
+    for (int64_t r = 0; r < nrows; r++) {
+        int64_t j = prowptr[r];
+        int64_t rowend = prowptr[r + 1];
+        /* diagonal (packed rows are sorted, so it is first if present) */
+        if (j < rowend && pcolidx[j] == r) {
+            fcolidx[cursor[r]] = r;
+            fa[cursor[r]++] = pa[j] + epsilon;
+            j++;
+        } else if (epsilon != 0.0) {
+            fcolidx[cursor[r]] = r;
+            fa[cursor[r]++] = epsilon;
+        }
+        for (; j < rowend; j++) {
+            int64_t c = pcolidx[j];
+            fcolidx[cursor[r]] = c;
+            fa[cursor[r]++] = pa[j];
+            fcolidx[cursor[c]] = r;   /* mirror into row c (c > r) */
+            fa[cursor[c]++] = pa[j];
+        }
+    }
+    return total;
+}
+
+}  // extern "C"

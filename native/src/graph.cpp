@@ -1,0 +1,184 @@
+/* One-pass distributed-graph partitioning.
+ *
+ * The role of the reference's acggraph_partition (acg/graph.c:813-1452):
+ * given the full-storage sparsity pattern and a partition vector, build
+ * every part's subdomain layout -- nodes reordered interior -> border ->
+ * ghost, ghosts grouped by owner, and halo send lists sorted by
+ * (recipient, node id), the reference's (recipient, node-tag) radix order
+ * (halo.c:61-241).  Unlike the reference's per-rank construction, all
+ * parts are built in one whole-graph pass over the edges plus two radix
+ * sorts of the cut-edge set: O(nnz + ncut log-radix) total, independent of
+ * nparts (the numpy fallback in acg_tpu.graph is O(n * nparts)). */
+
+#include "acg_core.h"
+
+#include <cstring>
+#include <vector>
+
+struct acg_partition_result {
+    int32_t nparts;
+    std::vector<int64_t> nowned, ninterior, nghost, nsend;
+    std::vector<int64_t> global_ids;   /* ragged: per part [int|bord|ghost] */
+    std::vector<int32_t> ghost_owner;  /* ragged: per part, per ghost slot */
+    std::vector<int32_t> send_part;    /* ragged: per part send list dest */
+    std::vector<int64_t> send_gid;     /* ragged: per part send list node */
+    std::vector<int64_t> send_lidx;    /* ragged: send node local index */
+};
+
+namespace {
+
+int64_t dedup_sorted(std::vector<int64_t> &keys) {
+    int64_t m = 0;
+    for (size_t i = 0; i < keys.size(); i++)
+        if (i == 0 || keys[i] != keys[i - 1]) keys[m++] = keys[i];
+    keys.resize(m);
+    return m;
+}
+
+}  // namespace
+
+extern "C" {
+
+acg_partition_result *acg_graph_partition_run(int64_t nrows,
+                                              const int64_t *frowptr,
+                                              const int64_t *fcolidx,
+                                              const int32_t *part,
+                                              int32_t nparts) {
+    if (nparts <= 0) return nullptr;
+    /* key packing ((p*nparts)+q)*nrows + node must fit in int64 */
+    if (nrows > 0 &&
+        static_cast<int64_t>(nparts) * nparts >
+            (INT64_MAX / (nrows + 1)))
+        return nullptr;
+    for (int64_t u = 0; u < nrows; u++)
+        if (part[u] < 0 || part[u] >= nparts) return nullptr;
+
+    auto *res = new acg_partition_result;
+    res->nparts = nparts;
+    res->nowned.assign(nparts, 0);
+    res->ninterior.assign(nparts, 0);
+    res->nghost.assign(nparts, 0);
+    res->nsend.assign(nparts, 0);
+
+    /* pass 1: border flags + cut-edge keys */
+    std::vector<uint8_t> is_border(nrows, 0);
+    std::vector<int64_t> ghost_keys;  /* (p, q, v): v ghost of p, owner q */
+    std::vector<int64_t> send_keys;   /* (p, q, u): p sends u to q */
+    for (int64_t u = 0; u < nrows; u++) {
+        int64_t p = part[u];
+        for (int64_t j = frowptr[u]; j < frowptr[u + 1]; j++) {
+            int64_t v = fcolidx[j];
+            if (v < 0 || v >= nrows) { delete res; return nullptr; }
+            int64_t q = part[v];
+            if (p != q) {
+                is_border[u] = 1;
+                ghost_keys.push_back((p * nparts + q) * nrows + v);
+                send_keys.push_back((p * nparts + q) * nrows + u);
+            }
+        }
+    }
+    acg_radixsort_i64(static_cast<int64_t>(ghost_keys.size()),
+                      ghost_keys.data(), nullptr);
+    acg_radixsort_i64(static_cast<int64_t>(send_keys.size()),
+                      send_keys.data(), nullptr);
+    dedup_sorted(ghost_keys);
+    dedup_sorted(send_keys);
+
+    /* counts */
+    std::vector<int64_t> nborder(nparts, 0);
+    for (int64_t u = 0; u < nrows; u++) {
+        res->nowned[part[u]]++;
+        if (is_border[u]) nborder[part[u]]++;
+    }
+    for (int32_t p = 0; p < nparts; p++)
+        res->ninterior[p] = res->nowned[p] - nborder[p];
+    for (int64_t key : ghost_keys)
+        res->nghost[key / (nrows * nparts)]++;
+    for (int64_t key : send_keys)
+        res->nsend[key / (nrows * nparts)]++;
+
+    /* offsets for the ragged outputs */
+    std::vector<int64_t> gid_off(nparts + 1, 0), ghost_off(nparts + 1, 0),
+        send_off(nparts + 1, 0);
+    for (int32_t p = 0; p < nparts; p++) {
+        gid_off[p + 1] = gid_off[p] + res->nowned[p] + res->nghost[p];
+        ghost_off[p + 1] = ghost_off[p] + res->nghost[p];
+        send_off[p + 1] = send_off[p] + res->nsend[p];
+    }
+    res->global_ids.resize(gid_off[nparts]);
+    res->ghost_owner.resize(ghost_off[nparts]);
+    res->send_part.resize(send_off[nparts]);
+    res->send_gid.resize(send_off[nparts]);
+    res->send_lidx.resize(send_off[nparts]);
+
+    /* owned nodes: one ascending sweep fills interior and border sections
+     * of every part in ascending-global-id order */
+    std::vector<int64_t> int_cur(nparts), bord_cur(nparts);
+    for (int32_t p = 0; p < nparts; p++) {
+        int_cur[p] = gid_off[p];
+        bord_cur[p] = gid_off[p] + res->ninterior[p];
+    }
+    std::vector<int64_t> local_of(nrows);
+    for (int64_t u = 0; u < nrows; u++) {
+        int32_t p = part[u];
+        int64_t slot = is_border[u] ? bord_cur[p]++ : int_cur[p]++;
+        res->global_ids[slot] = u;
+        local_of[u] = slot - gid_off[p];
+    }
+    /* ghosts: already sorted by (p, owner q, global id) */
+    {
+        std::vector<int64_t> cur(nparts);
+        for (int32_t p = 0; p < nparts; p++) cur[p] = 0;
+        for (int64_t key : ghost_keys) {
+            int64_t p = key / (nrows * nparts);
+            int64_t q = (key / nrows) % nparts;
+            int64_t v = key % nrows;
+            int64_t slot = cur[p]++;
+            res->global_ids[gid_off[p] + res->nowned[p] + slot] = v;
+            res->ghost_owner[ghost_off[p] + slot] = static_cast<int32_t>(q);
+        }
+    }
+    /* send lists: sorted by (p, recipient q, global id) */
+    {
+        std::vector<int64_t> cur(nparts);
+        for (int32_t p = 0; p < nparts; p++) cur[p] = 0;
+        for (int64_t key : send_keys) {
+            int64_t p = key / (nrows * nparts);
+            int64_t q = (key / nrows) % nparts;
+            int64_t u = key % nrows;
+            int64_t slot = send_off[p] + cur[p]++;
+            res->send_part[slot] = static_cast<int32_t>(q);
+            res->send_gid[slot] = u;
+            res->send_lidx[slot] = local_of[u];
+        }
+    }
+    return res;
+}
+
+void acg_pr_counts(const acg_partition_result *res, int64_t *nowned,
+                   int64_t *ninterior, int64_t *nghost, int64_t *nsend) {
+    size_t n = static_cast<size_t>(res->nparts);
+    std::memcpy(nowned, res->nowned.data(), n * sizeof(int64_t));
+    std::memcpy(ninterior, res->ninterior.data(), n * sizeof(int64_t));
+    std::memcpy(nghost, res->nghost.data(), n * sizeof(int64_t));
+    std::memcpy(nsend, res->nsend.data(), n * sizeof(int64_t));
+}
+
+void acg_pr_fill(const acg_partition_result *res, int64_t *global_ids,
+                 int32_t *ghost_owner, int32_t *send_part, int64_t *send_gid,
+                 int64_t *send_lidx) {
+    std::memcpy(global_ids, res->global_ids.data(),
+                res->global_ids.size() * sizeof(int64_t));
+    std::memcpy(ghost_owner, res->ghost_owner.data(),
+                res->ghost_owner.size() * sizeof(int32_t));
+    std::memcpy(send_part, res->send_part.data(),
+                res->send_part.size() * sizeof(int32_t));
+    std::memcpy(send_gid, res->send_gid.data(),
+                res->send_gid.size() * sizeof(int64_t));
+    std::memcpy(send_lidx, res->send_lidx.data(),
+                res->send_lidx.size() * sizeof(int64_t));
+}
+
+void acg_pr_free(acg_partition_result *res) { delete res; }
+
+}  // extern "C"

@@ -1,0 +1,87 @@
+/* LSD radix sort, 8-bit digits (reference: acg/sort.c acgradixsort*_int64_t
+ * and the pair variants returning permutations, sort.h:82-432). */
+
+#include "acg_core.h"
+
+#include <cstring>
+#include <vector>
+
+namespace {
+
+/* One radix pass over 8-bit digit `shift`; returns false if the pass is a
+ * no-op (all keys share the digit), letting callers skip the copy. */
+template <typename K>
+bool radix_pass(int64_t n, const K *keys_in, K *keys_out,
+                const int64_t *perm_in, int64_t *perm_out, int shift) {
+    int64_t count[256] = {0};
+    for (int64_t i = 0; i < n; i++)
+        count[(keys_in[i] >> shift) & 0xff]++;
+    for (int d = 0; d < 256; d++)
+        if (count[d] == n) return false;
+    int64_t offset = 0;
+    int64_t start[256];
+    for (int d = 0; d < 256; d++) {
+        start[d] = offset;
+        offset += count[d];
+    }
+    for (int64_t i = 0; i < n; i++) {
+        int64_t pos = start[(keys_in[i] >> shift) & 0xff]++;
+        keys_out[pos] = keys_in[i];
+        if (perm_in) perm_out[pos] = perm_in[i];
+    }
+    return true;
+}
+
+void radixsort_u64(int64_t n, uint64_t *keys, int64_t *perm) {
+    std::vector<uint64_t> kbuf(n);
+    std::vector<int64_t> pbuf(perm ? n : 0);
+    uint64_t *ka = keys, *kb = kbuf.data();
+    int64_t *pa = perm, *pb = perm ? pbuf.data() : nullptr;
+    for (int shift = 0; shift < 64; shift += 8) {
+        if (radix_pass(n, ka, kb, pa, pb, shift)) {
+            std::swap(ka, kb);
+            std::swap(pa, pb);
+        }
+    }
+    if (ka != keys) {
+        std::memcpy(keys, ka, sizeof(uint64_t) * n);
+        if (perm) std::memcpy(perm, pa, sizeof(int64_t) * n);
+    } else if (perm && pa != perm) {
+        std::memcpy(perm, pa, sizeof(int64_t) * n);
+    }
+}
+
+}  // namespace
+
+extern "C" {
+
+int32_t acg_core_abi_version(void) { return 1; }
+
+void acg_radixsort_i64(int64_t n, int64_t *keys, int64_t *perm) {
+    if (n <= 0) return;
+    if (perm)
+        for (int64_t i = 0; i < n; i++) perm[i] = i;
+    /* flip the sign bit so signed order matches unsigned radix order */
+    uint64_t *u = reinterpret_cast<uint64_t *>(keys);
+    for (int64_t i = 0; i < n; i++) u[i] ^= 0x8000000000000000ull;
+    radixsort_u64(n, u, perm);
+    for (int64_t i = 0; i < n; i++) u[i] ^= 0x8000000000000000ull;
+}
+
+void acg_radixargsort_i64(int64_t n, const int64_t *keys, int64_t *perm) {
+    if (n <= 0) return;
+    std::vector<int64_t> copy(keys, keys + n);
+    acg_radixsort_i64(n, copy.data(), perm);
+}
+
+void acg_prefixsum_exclusive_i64(int64_t n, int64_t *a) {
+    int64_t sum = 0;
+    for (int64_t i = 0; i < n; i++) {
+        int64_t v = a[i];
+        a[i] = sum;
+        sum += v;
+    }
+    a[n] = sum;
+}
+
+}  // extern "C"

@@ -1,0 +1,207 @@
+"""Native C++ core (libacg_core) vs the pure-Python fallbacks.
+
+Every binding in acg_tpu._native has a numpy twin; these tests pin the two
+implementations to each other and to scipy oracles, the same
+cross-implementation strategy the reference uses between its host and GPU
+solvers (SURVEY.md section 4).
+"""
+
+import numpy as np
+import pytest
+import scipy.sparse as sp
+
+from acg_tpu import _native as nat
+
+pytestmark = pytest.mark.skipif(not nat.available(),
+                                reason="native library not built")
+
+
+# ---- sort / scan ---------------------------------------------------------
+
+def test_radixsort_matches_numpy():
+    rng = np.random.default_rng(0)
+    for n in (0, 1, 2, 1000, 65537):
+        k = rng.integers(-2**62, 2**62, n)
+        sk, perm = nat.radixsort(k)
+        assert (sk == np.sort(k)).all()
+        assert (k[perm] == sk).all()
+
+
+def test_radixsort_stable():
+    rng = np.random.default_rng(1)
+    k = rng.integers(0, 7, 5000)
+    assert (nat.argsort(k) == np.argsort(k, kind="stable")).all()
+
+
+def test_radixsort_extremes():
+    k = np.array([2**62, -2**62, 0, -1, 1, np.iinfo(np.int64).max,
+                  np.iinfo(np.int64).min])
+    sk, _ = nat.radixsort(k)
+    assert (sk == np.sort(k)).all()
+
+
+def test_prefixsum():
+    a = np.array([3, 0, 5, 1])
+    assert (nat.prefixsum_exclusive(a) == [0, 3, 3, 8, 9]).all()
+    assert (nat.prefixsum_exclusive(np.array([], dtype=np.int64)) == [0]).all()
+
+
+# ---- Matrix Market parse / format ---------------------------------------
+
+def test_parse_coord_basic():
+    buf = b"1 1 2.5\n2 1 -3e-2\n\n   3 2 1e10  \n"
+    r, c, v = nat.parse_coord(buf, 3, 3, 3, True)
+    assert (r == [0, 1, 2]).all() and (c == [0, 0, 1]).all()
+    assert np.allclose(v, [2.5, -0.03, 1e10])
+
+
+def test_parse_coord_pattern():
+    r, c, v = nat.parse_coord(b"1 2\n2 3\n", 2, 3, 3, False)
+    assert v is None and (r == [0, 1]).all() and (c == [1, 2]).all()
+
+
+def test_parse_coord_errors():
+    with pytest.raises(nat.NativeParseError):  # truncated
+        nat.parse_coord(b"1 1 2.5\n", 2, 3, 3, True)
+    with pytest.raises(nat.NativeParseError):  # out of bounds
+        nat.parse_coord(b"4 1 2.5\n", 1, 3, 3, True)
+    with pytest.raises(nat.NativeParseError):  # garbage
+        nat.parse_coord(b"a b c\n", 1, 3, 3, True)
+    with pytest.raises(nat.NativeParseError):  # trailing garbage on value
+        nat.parse_coord(b"1 1 3junk\n", 1, 3, 3, True)
+    with pytest.raises(nat.NativeParseError):  # extra token
+        nat.parse_coord(b"1 1 3.0 4.0\n", 1, 3, 3, True)
+
+
+def test_parse_format_roundtrip_random():
+    rng = np.random.default_rng(2)
+    n = 10000
+    r = rng.integers(0, 4096, n)
+    c = rng.integers(0, 4096, n)
+    v = rng.standard_normal(n) * 10.0 ** rng.integers(-300, 300, n)
+    buf = nat.format_coord(r, c, v)
+    r2, c2, v2 = nat.parse_coord(buf, n, 4096, 4096, True)
+    assert (r2 == r).all() and (c2 == c).all()
+    assert (v2 == v).all(), "%.17g round-trip must be exact"
+
+
+def test_format_array_roundtrip():
+    v = np.array([0.1, -1e308, 2.5e-308, 0.0, -0.0])
+    assert (nat.parse_array(nat.format_array(v), v.size) == v).all()
+
+
+def test_format_rejects_int_conversion():
+    with pytest.raises(nat.NativeParseError):
+        nat.format_array(np.ones(3), "%d")
+
+
+def test_parse_array_multiple_per_line():
+    assert (nat.parse_array(b"1.0 2.0 3.0\n4.0\n", 4) == [1, 2, 3, 4]).all()
+
+
+# ---- symmetric CSR assembly ---------------------------------------------
+
+def _random_spd_coo(n, seed, full):
+    rng = np.random.default_rng(seed)
+    A = sp.random(n, n, density=0.08, random_state=seed)
+    A = (A + A.T).tocsr()
+    A.setdiag(np.arange(1, n + 1).astype(float))
+    A = A.tocsr()
+    A.sum_duplicates()
+    M = A if full else sp.triu(A).tocsr()
+    coo = M.tocoo()
+    return A, coo
+
+
+@pytest.mark.parametrize("full", [True, False])
+def test_sym_csr_from_coo(full):
+    A, coo = _random_spd_coo(64, 3, full)
+    pr, pc, pa = nat.sym_csr_from_coo(64, coo.row, coo.col, coo.data)
+    U = sp.triu(A).tocsr()
+    U.sort_indices()
+    assert (pr == U.indptr).all()
+    assert (pc == U.indices).all()
+    assert np.allclose(pa, U.data)
+
+
+def test_sym_csr_duplicates_summed():
+    # same entry twice in the same triangle sums (not halved)
+    r = np.array([0, 0, 1])
+    c = np.array([1, 1, 1])
+    v = np.array([2.0, 3.0, 1.0])
+    pr, pc, pa = nat.sym_csr_from_coo(2, r, c, v)
+    assert np.allclose(pa, [5.0, 1.0])
+
+
+@pytest.mark.parametrize("epsilon", [0.0, 0.25])
+def test_sym_csr_expand(epsilon):
+    A, coo = _random_spd_coo(50, 4, full=False)
+    # drop some diagonal entries so epsilon has missing rows to create
+    keep = ~((coo.row == coo.col) & (coo.row % 7 == 0))
+    pr, pc, pa = nat.sym_csr_from_coo(50, coo.row[keep], coo.col[keep],
+                                      coo.data[keep])
+    fr, fc, fa = nat.sym_csr_expand(50, pr, pc, pa, epsilon)
+    up = sp.csr_matrix((pa, pc, pr), shape=(50, 50))
+    ref = (up + sp.triu(up, k=1).T).tocsr()
+    if epsilon:
+        ref = (ref + epsilon * sp.eye(50, format="csr")).tocsr()
+    ref.sort_indices()
+    assert (fr == ref.indptr).all()
+    assert (fc == ref.indices).all()
+    assert np.allclose(fa, ref.data)
+
+
+# ---- one-pass graph partitioner -----------------------------------------
+
+def test_graph_partition_matches_numpy():
+    from acg_tpu.graph import (_partition_graph_nodes_native,
+                               _partition_graph_nodes_numpy)
+    from acg_tpu.io.generators import poisson2d_coo
+    from acg_tpu.matrix import SymCsrMatrix
+
+    r, c, v, N = poisson2d_coo(24)
+    csr = SymCsrMatrix.from_coo(N, r, c, v).to_csr()
+    rng = np.random.default_rng(5)
+    for nparts in (1, 2, 5, 8):
+        part = rng.integers(0, nparts, N).astype(np.int32)
+        subs_n = _partition_graph_nodes_native(csr, part, nparts)
+        subs_p = _partition_graph_nodes_numpy(csr, part, nparts)
+        for sn, sp_ in zip(subs_n, subs_p):
+            assert sn.ninterior == sp_.ninterior
+            assert sn.nborder == sp_.nborder
+            assert sn.nghost == sp_.nghost
+            assert (sn.global_ids == sp_.global_ids).all()
+            assert (sn.ghost_owner == sp_.ghost_owner).all()
+            hn, hp = sn.halo, sp_.halo
+            assert (hn.send_parts == hp.send_parts).all()
+            assert (hn.send_counts == hp.send_counts).all()
+            assert (hn.send_idx == hp.send_idx).all()
+            assert (hn.recv_parts == hp.recv_parts).all()
+            assert (hn.recv_idx == hp.recv_idx).all()
+
+
+def test_mtxfile_native_vs_python_read(tmp_path):
+    """End-to-end file read must be identical with and without native."""
+    import subprocess
+    import sys
+    import os
+    from acg_tpu.io.generators import poisson2d_coo
+    from acg_tpu.io.mtxfile import MtxFile, read_mtx, write_mtx
+
+    r, c, v, N = poisson2d_coo(12)
+    path = tmp_path / "p.mtx"
+    write_mtx(path, MtxFile(object="matrix", format="coordinate",
+                            field="real", symmetry="general", nrows=N,
+                            ncols=N, nnz=r.size, rowidx=r, colidx=c, vals=v))
+    m1 = read_mtx(path)
+    env = dict(os.environ, ACG_TPU_DISABLE_NATIVE="1")
+    out = subprocess.run(
+        [sys.executable, "-c",
+         "import numpy as np;from acg_tpu.io.mtxfile import read_mtx;"
+         f"m=read_mtx({str(path)!r});"
+         "print(int(m.rowidx.sum()), int(m.colidx.sum()), float(m.vals.sum()))"],
+        capture_output=True, text=True, env=env, check=True)
+    rs, cs, vs = out.stdout.split()
+    assert int(rs) == int(m1.rowidx.sum())
+    assert int(cs) == int(m1.colidx.sum())
+    assert float(vs) == float(m1.vals.sum())
