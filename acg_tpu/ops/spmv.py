@@ -77,17 +77,27 @@ class CooMatrix:
                    meta_fields=["offsets", "nrows", "ncols_padded"])
 @dataclasses.dataclass
 class DiaMatrix:
-    """Diagonal (DIA) storage: ``data[d, i] = A[i, i + offsets[d]]``.
+    """Diagonal (DIA) storage: ``data[d][i] = A[i, i + offsets[d]]``.
 
     SpMV is a sum of elementwise products against statically-shifted views
     of x -- fully vectorised on the VPU, no gathers.  ``offsets`` is a
     static tuple so each shift compiles to a static slice.
+
+    ``data`` is a tuple of separate (nrows,) planes rather than one
+    (ndiags, nrows) array: 1-D jit parameters keep their trivial layout,
+    while a 2-D parameter was measured 2-3x slower inside the solve loop
+    on TPU (XLA cannot re-lay-out runtime parameters the way it does
+    compile-time constants).
     """
 
-    data: jax.Array        # (ndiags, nrows) float
+    data: tuple            # ndiags x (nrows,) float planes
     offsets: tuple         # (ndiags,) static ints, ascending
     nrows: int
     ncols_padded: int
+
+    @property
+    def dtype(self):
+        return self.data[0].dtype
 
 
 DeviceMatrix = Union[EllMatrix, CooMatrix, DiaMatrix]
@@ -102,7 +112,8 @@ def dia_from_csr(csr, dtype=jnp.float32) -> DiaMatrix:
     data = np.zeros((offsets.size, nrows), dtype=np.float64)
     dmap = np.searchsorted(offsets, diag)
     data[dmap, coo.row] = coo.data
-    return DiaMatrix(data=jnp.asarray(data, dtype=dtype),
+    return DiaMatrix(data=tuple(jnp.asarray(data[d], dtype=dtype)
+                                for d in range(offsets.size)),
                      offsets=tuple(int(o) for o in offsets),
                      nrows=nrows, ncols_padded=ncols)
 
@@ -195,8 +206,8 @@ def spmv(A: DeviceMatrix, x: jax.Array) -> jax.Array:
         R = max(0, max(A.offsets) + A.nrows - x.shape[0])
         xp = jnp.pad(x, (L, R))
         y = jnp.zeros((A.nrows,), dtype=x.dtype)
-        for d, off in enumerate(A.offsets):
-            y = y + A.data[d] * jax.lax.dynamic_slice(xp, (L + off,), (A.nrows,))
+        for plane, off in zip(A.data, A.offsets):
+            y = y + plane * jax.lax.dynamic_slice(xp, (L + off,), (A.nrows,))
         return y
     if isinstance(A, EllMatrix):
         # K gathers of n elements each; XLA fuses the multiply-accumulate.
@@ -210,7 +221,9 @@ def spmv(A: DeviceMatrix, x: jax.Array) -> jax.Array:
 
 def spmv_flops(A: DeviceMatrix) -> float:
     """Analytic flops per SpMV, reference convention (3 per stored nz)."""
-    if isinstance(A, (EllMatrix, DiaMatrix)):
+    if isinstance(A, DiaMatrix):
+        nnz = float(sum(np.count_nonzero(np.asarray(p)) for p in A.data))
+    elif isinstance(A, EllMatrix):
         nnz = float(np.count_nonzero(np.asarray(A.data)))
     else:
         nnz = float(A.vals.size)

@@ -41,6 +41,7 @@ from acg_tpu.graph import Subdomain, partition_matrix, scatter_vector
 from acg_tpu.ops.spmv import ell_planes_from_csr
 from acg_tpu.parallel.halo import DeviceHaloPlan, build_device_halo, halo_exchange
 from acg_tpu.parallel.mesh import PARTS_AXIS, solve_mesh
+from acg_tpu.solvers.jax_cg import _iterate
 from acg_tpu.solvers.stats import (SolverStats, StoppingCriteria,
                                    cg_flops_per_iteration)
 
@@ -152,6 +153,7 @@ class DistCGSolver:
             # shard_map keeps the sharded parts axis as a leading size-1 dim
             ld, lc, gd, gc, sidx, gsrc, b, x0 = (
                 a[0] for a in (ld, lc, gd, gc, sidx, gsrc, b, x0))
+            maxits = maxits.astype(jnp.int32)
             dtype = b.dtype
             res_atol, res_rtol, diff_atol, diff_rtol = tols
 
@@ -167,16 +169,21 @@ class DistCGSolver:
             diff_tol = jnp.maximum(diff_atol, diff_rtol * x0nrm2)
             inf = jnp.asarray(jnp.inf, dtype)
 
-            def converged(rsqr, dxsqr):
-                ok = jnp.where(res_tol > 0, rsqr < res_tol * res_tol, False)
-                return ok | jnp.where(diff_tol > 0,
-                                      dxsqr < diff_tol * diff_tol, False)
+            # Loop structure and convergence logic shared with the
+            # single-device solver (jax_cg._iterate / _converged): gamma is
+            # psum'd, so `done` is identical on every shard and the while
+            # predicates agree across the mesh.
+            def run_iter(iter_body, init_state, gamma_of, dx_of,
+                         init_gamma=None):
+                return _iterate(iter_body, init_state, gamma_of, maxits,
+                                res_tol, diff_tol, dx_of, unbounded,
+                                init_gamma=init_gamma)
 
             if not pipelined:
-                p = r
-
-                def body(carry):
-                    k, x, r, p, gamma, dxsqr, done = carry
+                # dxsqr joins the carry only under a diff criterion (extra
+                # loop-carried scalars measurably slow the TPU loop)
+                def body(state):
+                    x, r, p, gamma = state[:4]
                     t = spmv(p)
                     pdott = psum(jnp.dot(p, t))
                     alpha = gamma / pdott
@@ -186,29 +193,23 @@ class DistCGSolver:
                     beta = gamma_next / gamma
                     p_next = r + beta * p
                     if needs_diff:
-                        dxsqr = alpha * alpha * psum(jnp.dot(p, p))
-                    done = converged(gamma_next, dxsqr)
-                    return k + 1, x, r, p_next, gamma_next, dxsqr, done
+                        return (x, r, p_next, gamma_next,
+                                alpha * alpha * psum(jnp.dot(p, p)))
+                    return (x, r, p_next, gamma_next)
 
-                init = (jnp.int32(0), x0, r, p, gamma, inf,
-                        converged(gamma, inf))
-                if unbounded:
-                    out = lax.fori_loop(0, maxits,
-                                        lambda _, c: body(c), init)
-                    done = jnp.asarray(True)
-                else:
-                    out = lax.while_loop(
-                        lambda c: (~c[-1]) & (c[0] < maxits), body, init)
-                    done = out[-1]
-                k, x, r_fin, _, gamma_fin, dxsqr = out[:6]
+                init_state = (x0, r, r, gamma) + ((inf,) if needs_diff else ())
+                k, state, done = run_iter(
+                    body, init_state, lambda s: s[3],
+                    (lambda s: s[4]) if needs_diff else (lambda s: inf))
+                x, r_fin, gamma_fin = state[0], state[1], state[3]
+                dxsqr = state[4] if needs_diff else inf
                 rnrm2 = jnp.sqrt(gamma_fin)
             else:
                 w = spmv(r)
                 zeros = jnp.zeros_like(b)
 
-                def body(carry):
-                    (k, x, r, w, p, t, z, gamma_prev, alpha_prev,
-                     dxsqr, done) = carry
+                def body(state):
+                    x, r, w, p, t, z, gamma_prev, alpha_prev = state[:8]
                     # the pipelined variant's single fused allreduce:
                     # both scalars in one psum (cgcuda.c:1730-1737)
                     pair = psum(jnp.stack([jnp.dot(r, r), jnp.dot(w, r)]))
@@ -223,23 +224,20 @@ class DistCGSolver:
                     r = r - alpha * t
                     w = w - alpha * z
                     if needs_diff:
-                        dxsqr = alpha * alpha * psum(jnp.dot(p, p))
-                    done = converged(psum(jnp.dot(r, r)), dxsqr)
-                    return (k + 1, x, r, w, p, t, z, gamma, alpha,
-                            dxsqr, done)
+                        return (x, r, w, p, t, z, gamma, alpha,
+                                alpha * alpha * psum(jnp.dot(p, p)))
+                    return (x, r, w, p, t, z, gamma, alpha)
 
-                init = (jnp.int32(0), x0, r, w, zeros, zeros, zeros,
-                        inf, inf, inf, converged(gamma, inf))
-                if unbounded:
-                    out = lax.fori_loop(0, maxits,
-                                        lambda _, c: body(c), init)
-                    done = jnp.asarray(True)
-                else:
-                    out = lax.while_loop(
-                        lambda c: (~c[-1]) & (c[0] < maxits), body, init)
-                    done = out[-1]
-                k, x, r_fin = out[0], out[1], out[2]
-                dxsqr = out[9]
+                # stale-gamma convergence test (see jax_cg): s[6] is the
+                # psum'd ||r||^2 from before the update
+                init_state = (x0, r, w, zeros, zeros, zeros, inf, inf) + (
+                    (inf,) if needs_diff else ())
+                k, state, done = run_iter(
+                    body, init_state, lambda s: s[6],
+                    (lambda s: s[8]) if needs_diff else (lambda s: inf),
+                    init_gamma=gamma)
+                x, r_fin = state[0], state[1]
+                dxsqr = state[8] if needs_diff else inf
                 rnrm2 = jnp.sqrt(psum(jnp.dot(r_fin, r_fin)))
 
             dxnrm2 = jnp.sqrt(dxsqr)
@@ -249,19 +247,19 @@ class DistCGSolver:
         rspec = P()
         in_specs = (pspec, pspec, pspec, pspec, pspec, pspec,  # matrix+halo
                     pspec, pspec,                              # b, x0
-                    rspec)                                     # tolerances
+                    rspec, rspec)                              # tols, maxits
         out_specs = (pspec,) + (rspec,) * 7
 
         @functools.partial(jax.jit,
-                           static_argnames=("maxits", "unbounded", "needs_diff"))
+                           static_argnames=("unbounded", "needs_diff"))
         def program(ld, lc, gd, gc, sidx, gsrc, b, x0, tols, maxits,
                     unbounded, needs_diff):
             return jax.shard_map(
-                functools.partial(shard_body, maxits=maxits,
+                functools.partial(shard_body,
                                   unbounded=unbounded, needs_diff=needs_diff),
                 mesh=self.mesh, in_specs=in_specs, out_specs=out_specs,
                 check_vma=False,
-            )(ld, lc, gd, gc, sidx, gsrc, b, x0, tols)
+            )(ld, lc, gd, gc, sidx, gsrc, b, x0, tols, maxits)
 
         return program
 
@@ -289,9 +287,9 @@ class DistCGSolver:
         gsrc = put(prob.halo.ghost_src)
         tols = jnp.asarray([crit.residual_atol, crit.residual_rtol,
                             crit.diff_atol, crit.diff_rtol], dtype=dtype)
-        kwargs = dict(maxits=crit.maxits, unbounded=crit.unbounded,
-                      needs_diff=crit.needs_diff)
-        args = (ld, lc, gd, gc, sidx, gsrc, b, x0, tols)
+        kwargs = dict(unbounded=crit.unbounded, needs_diff=crit.needs_diff)
+        args = (ld, lc, gd, gc, sidx, gsrc, b, x0, tols,
+                jnp.int32(crit.maxits))
         for _ in range(max(warmup, 0)):
             self._program(*args, **kwargs)[0].block_until_ready()
         t0 = time.perf_counter()

@@ -89,8 +89,9 @@ def test_jax_cg_matches_host(poisson16, pipelined, fmt):
     st = solver.stats
     assert st.converged
     assert st.rnrm2 < 1e-10 * st.r0nrm2 * 1.001
-    # classic and pipelined should converge in a similar iteration count
-    assert abs(st.niterations - host.stats.niterations) <= 3
+    # similar iteration count (+1: the pipelined variant's convergence
+    # test is one iteration stale, like the reference's deferred test)
+    assert abs(st.niterations - host.stats.niterations) <= 4
 
 
 @pytest.mark.parametrize("pipelined", [False, True])
@@ -137,3 +138,19 @@ def test_stats_flops_positive(poisson16):
     assert st.nflops > 0 and st.tsolve > 0
     text = st.fwrite()
     assert "total solver time: " in text
+
+
+@pytest.mark.parametrize("pipelined", [False, True])
+def test_jax_cg_zero_rhs_converges_immediately(poisson16, pipelined):
+    """b = 0 with x0 = 0 is converged at entry: the solver must return x0
+    in 0 iterations, not divide 0/0 in the first pipelined update."""
+    csr = poisson16.to_csr()
+    A = device_matrix_from_csr(csr, dtype=jnp.float64)
+    solver = JaxCGSolver(A, pipelined=pipelined)
+    b = np.zeros(csr.shape[0])
+    x = solver.solve(b, criteria=StoppingCriteria(maxits=50, residual_rtol=1e-8,
+                                                  residual_atol=1e-30))
+    assert np.all(np.isfinite(x))
+    assert np.all(x == 0.0)
+    assert solver.stats.niterations == 0
+    assert solver.stats.converged
