@@ -146,9 +146,6 @@ def _main(args) -> int:
 
     dtype = {"f64": jnp.float64, "f32": jnp.float32, "bf16": jnp.bfloat16}[args.dtype]
     comm = {"mpi": "xla", "nccl": "xla", "nvshmem": "dma"}.get(args.comm, args.comm)
-    if comm == "dma":
-        raise SystemExit("acg-tpu: --comm dma (pallas remote-DMA halo) is "
-                         "not implemented yet in this build; use --comm xla")
 
     # stage 1: read the matrix
     t0 = time.perf_counter()
@@ -243,7 +240,7 @@ def _main(args) -> int:
                 comm_mtx_out = comm_matrix(subs, nparts)
             prob = DistributedProblem.build(csr, part, nparts, dtype=dtype,
                                             subs=subs)
-            solver = DistCGSolver(prob, pipelined=pipelined)
+            solver = DistCGSolver(prob, pipelined=pipelined, comm=comm)
             x = solver.solve(b, x0_global=x0, criteria=criteria,
                              warmup=args.warmup)
     except NotConvergedError as e:

@@ -40,6 +40,7 @@ from acg_tpu.errors import NotConvergedError
 from acg_tpu.graph import Subdomain, partition_matrix, scatter_vector
 from acg_tpu.ops.spmv import ell_planes_from_csr
 from acg_tpu.parallel.halo import DeviceHaloPlan, build_device_halo, halo_exchange
+from acg_tpu.parallel.halo_dma import halo_exchange_dma
 from acg_tpu.parallel.mesh import PARTS_AXIS, solve_mesh
 from acg_tpu.solvers.jax_cg import _iterate
 from acg_tpu.solvers.stats import (SolverStats, StoppingCriteria,
@@ -109,6 +110,18 @@ class DistributedProblem:
             out[p, : s.nowned] = x[: s.nowned]
         return out
 
+    def neighbor_counts(self):
+        """(send_counts, recv_counts), each (nparts, nparts) int32:
+        ``send_counts[p, q]`` = entries p sends to q.  Gates the puts in
+        the DMA transport (the reference's per-neighbour sendcounts,
+        ``halo.h:72-186``)."""
+        scnt = np.zeros((self.nparts, self.nparts), dtype=np.int32)
+        for p, s in enumerate(self.subs):
+            h = s.halo
+            for q, cnt in zip(h.send_parts, h.send_counts):
+                scnt[p, int(q)] = int(cnt)
+        return scnt, scnt.T.copy()
+
     def gather(self, stacked: np.ndarray) -> np.ndarray:
         out = np.zeros(self.n, dtype=np.asarray(stacked).dtype)
         for p, s in enumerate(self.subs):
@@ -117,15 +130,25 @@ class DistributedProblem:
 
 
 class DistCGSolver:
-    """Whole-solve SPMD CG program over a 1-D mesh of ``nparts`` devices."""
+    """Whole-solve SPMD CG program over a 1-D mesh of ``nparts`` devices.
+
+    ``comm`` selects the halo transport (the reference's ``--comm``
+    choice, ``cuda/acg-cuda.c:321-377``): ``"xla"`` = `lax.all_to_all`
+    collectives (the NCCL/MPI analog), ``"dma"`` = Pallas one-sided
+    remote copies (the NVSHMEM analog, halo_dma.py).
+    """
 
     def __init__(self, problem: DistributedProblem, pipelined: bool = False,
-                 mesh: Mesh | None = None):
+                 mesh: Mesh | None = None, comm: str = "xla"):
+        if comm not in ("xla", "dma"):
+            raise ValueError(f"unknown halo transport {comm!r}")
         self.problem = problem
         self.pipelined = pipelined
+        self.comm = comm
         self.mesh = mesh if mesh is not None else solve_mesh(problem.nparts)
         self.stats = SolverStats(unknowns=problem.n)
         self._sharding = NamedSharding(self.mesh, P(PARTS_AXIS))
+        self._interpret = self.mesh.devices.flat[0].platform != "tpu"
         self._program = self._compile()
 
     # -- program construction ---------------------------------------------
@@ -136,29 +159,39 @@ class DistCGSolver:
         pipelined = self.pipelined
         axis = PARTS_AXIS
 
-        def dist_spmv(x_loc, ld, lc, gd, gc, sidx, gsrc):
+        comm = self.comm
+        interpret = self._interpret
+
+        def dist_spmv(x_loc, ld, lc, gd, gc, sidx, gsrc, gval, scnt, rcnt):
             """halo(x) || local SpMV, then off-diagonal SpMV -- 3.2's
             overlap pattern, scheduled by XLA instead of streams."""
             y = _ell_mv(ld, lc, x_loc)
             if halo.has_ghosts:
-                ghost = halo_exchange(x_loc, sidx, gsrc, axis)
+                if comm == "dma":
+                    ghost = halo_exchange_dma(x_loc, sidx, gsrc, gval,
+                                              scnt, rcnt,
+                                              axis, interpret=interpret)
+                else:
+                    ghost = halo_exchange(x_loc, sidx, gsrc, axis)
                 y = y + _ell_mv(gd, gc, ghost)
             return y
 
         def psum(v):
             return lax.psum(v, axis)
 
-        def shard_body(ld, lc, gd, gc, sidx, gsrc, b, x0, tols, maxits,
-                       unbounded, needs_diff):
+        def shard_body(ld, lc, gd, gc, sidx, gsrc, gval, scnt, rcnt, b, x0,
+                       tols, maxits, unbounded, needs_diff):
             # shard_map keeps the sharded parts axis as a leading size-1 dim
-            ld, lc, gd, gc, sidx, gsrc, b, x0 = (
-                a[0] for a in (ld, lc, gd, gc, sidx, gsrc, b, x0))
+            ld, lc, gd, gc, sidx, gsrc, gval, scnt, rcnt, b, x0 = (
+                a[0] for a in (ld, lc, gd, gc, sidx, gsrc, gval, scnt, rcnt,
+                               b, x0))
             maxits = maxits.astype(jnp.int32)
             dtype = b.dtype
             res_atol, res_rtol, diff_atol, diff_rtol = tols
 
             def spmv(x):
-                return dist_spmv(x, ld, lc, gd, gc, sidx, gsrc)
+                return dist_spmv(x, ld, lc, gd, gc, sidx, gsrc, gval, scnt,
+                                 rcnt)
 
             bnrm2 = jnp.sqrt(psum(jnp.dot(b, b)))
             x0nrm2 = jnp.sqrt(psum(jnp.dot(x0, x0)))
@@ -246,20 +279,22 @@ class DistCGSolver:
         pspec = P(PARTS_AXIS)
         rspec = P()
         in_specs = (pspec, pspec, pspec, pspec, pspec, pspec,  # matrix+halo
+                    pspec, pspec, pspec,                       # gval, counts
                     pspec, pspec,                              # b, x0
                     rspec, rspec)                              # tols, maxits
         out_specs = (pspec,) + (rspec,) * 7
 
         @functools.partial(jax.jit,
                            static_argnames=("unbounded", "needs_diff"))
-        def program(ld, lc, gd, gc, sidx, gsrc, b, x0, tols, maxits,
-                    unbounded, needs_diff):
+        def program(ld, lc, gd, gc, sidx, gsrc, gval, scnt, rcnt, b, x0,
+                    tols, maxits, unbounded, needs_diff):
             return jax.shard_map(
                 functools.partial(shard_body,
                                   unbounded=unbounded, needs_diff=needs_diff),
                 mesh=self.mesh, in_specs=in_specs, out_specs=out_specs,
                 check_vma=False,
-            )(ld, lc, gd, gc, sidx, gsrc, b, x0, tols, maxits)
+            )(ld, lc, gd, gc, sidx, gsrc, gval, scnt, rcnt, b, x0, tols,
+              maxits)
 
         return program
 
@@ -285,10 +320,14 @@ class DistCGSolver:
         gc = put(prob.ghost_cols)
         sidx = put(prob.halo.send_idx)
         gsrc = put(prob.halo.ghost_src)
+        gval = put(prob.halo.ghost_valid)
+        scnt_np, rcnt_np = prob.neighbor_counts()
+        scnt = put(scnt_np)
+        rcnt = put(rcnt_np)
         tols = jnp.asarray([crit.residual_atol, crit.residual_rtol,
                             crit.diff_atol, crit.diff_rtol], dtype=dtype)
         kwargs = dict(unbounded=crit.unbounded, needs_diff=crit.needs_diff)
-        args = (ld, lc, gd, gc, sidx, gsrc, b, x0, tols,
+        args = (ld, lc, gd, gc, sidx, gsrc, gval, scnt, rcnt, b, x0, tols,
                 jnp.int32(crit.maxits))
         for _ in range(max(warmup, 0)):
             self._program(*args, **kwargs)[0].block_until_ready()

@@ -35,7 +35,7 @@ from acg_tpu.parallel.mesh import PARTS_AXIS
 
 
 @functools.partial(jax.tree_util.register_dataclass,
-                   data_fields=["send_idx", "ghost_src"],
+                   data_fields=["send_idx", "ghost_src", "ghost_valid"],
                    meta_fields=["maxcnt", "nmax_ghost", "nparts"])
 @dataclasses.dataclass
 class DeviceHaloPlan:
@@ -45,10 +45,15 @@ class DeviceHaloPlan:
     sends to part q (padded with index 0; padding values are never read on
     the receive side).  ``ghost_src[p, g]`` indexes the flattened received
     plane (nparts * maxcnt) to fill ghost slot g of part p.
+    ``ghost_valid[p, g]`` is 0 for padding slots beyond part p's real
+    ghost count: their ghost_src of 0 would read a receive-plane row that
+    the DMA transport may never have written (uninitialised device
+    memory), so the unpack masks them to zero.
     """
 
-    send_idx: jax.Array   # (nparts, nparts, maxcnt) int32
-    ghost_src: jax.Array  # (nparts, nmax_ghost) int32
+    send_idx: jax.Array     # (nparts, nparts, maxcnt) int32
+    ghost_src: jax.Array    # (nparts, nmax_ghost) int32
+    ghost_valid: jax.Array  # (nparts, nmax_ghost) bool
     maxcnt: int
     nmax_ghost: int
     nparts: int
@@ -65,7 +70,9 @@ def build_device_halo(subs: list[Subdomain]) -> DeviceHaloPlan:
     nmax_ghost = max((s.nghost for s in subs), default=0)
     send_idx = np.zeros((nparts, nparts, max(maxcnt, 1)), dtype=np.int32)
     ghost_src = np.zeros((nparts, max(nmax_ghost, 1)), dtype=np.int32)
+    ghost_valid = np.zeros((nparts, max(nmax_ghost, 1)), dtype=bool)
     for p, s in enumerate(subs):
+        ghost_valid[p, : s.nghost] = True
         h = s.halo
         for j, q in enumerate(h.send_parts):
             w = h.send_idx[h.send_ptr[j]:h.send_ptr[j + 1]]
@@ -77,6 +84,7 @@ def build_device_halo(subs: list[Subdomain]) -> DeviceHaloPlan:
             ghost_src[p, lo:hi] = int(q) * max(maxcnt, 1) + np.arange(hi - lo)
     return DeviceHaloPlan(send_idx=jax.numpy.asarray(send_idx),
                           ghost_src=jax.numpy.asarray(ghost_src),
+                          ghost_valid=jax.numpy.asarray(ghost_valid),
                           maxcnt=maxcnt, nmax_ghost=nmax_ghost, nparts=nparts)
 
 

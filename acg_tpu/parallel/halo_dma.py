@@ -1,0 +1,180 @@
+"""Device-initiated halo exchange: Pallas one-sided remote DMA over ICI.
+
+The TPU-native analog of the reference's NVSHMEM transport (SURVEY.md
+components #11/#14): where the reference's monolithic kernel issues
+``nvshmemx_double_put_signal_nbi_block`` per neighbour and spins on
+``signal_wait_until`` flags (``cg-kernels-cuda.cu:713-776``,
+``halo.cu:181-242``), this kernel issues ``pltpu.make_async_remote_copy``
+per neighbour (a put that signals the receiver's DMA semaphore) and waits
+on the matching semaphores.
+
+Structure: nparts-1 rotation rounds; in round s every shard puts its
+window for shard ``me+s`` and receives from shard ``me-s`` -- the
+systolic all-to-all schedule that keeps traffic on ICI neighbours first.
+Pallas interpret mode (CPU meshes, tests) additionally *requires* this
+uniformity: it emulates remote DMA with collectives that pair DMA ops
+across devices in issue order, so any per-shard divergence in the op
+sequence -- different ordering, or count-gated skips that are not
+globally uniform per round -- deadlocks or mis-routes.
+
+Synchronisation details:
+  * One scalar send and one scalar recv DMA semaphore are shared by all
+    rounds.  Every put moves exactly ``maxcnt`` elements (windows are
+    padded to the mesh-wide maximum, like the reference's NVSHMEM
+    symmetric buffers, ``halo.c:883-887``), so the shared-semaphore
+    waits are exact regardless of completion order.
+  * On real TPUs, puts and waits are gated by the per-neighbour counts
+    (only real neighbours communicate -- the reference's per-neighbour
+    ``sendcounts``, ``halo.h:72-186``).  Interpret mode must issue a
+    globally uniform op sequence, so there the exchange is dense; the
+    gating arithmetic itself is still covered on CPU by a
+    ring-structured test whose gate pattern is uniform per round.
+  * On real TPUs a neighbourhood barrier at kernel entry reproduces the
+    reference's ``readytoreceive`` handshake (``halo.c:957-967``): a TPU
+    core runs its program in order, so a neighbour entering this kernel
+    proves it has consumed the previous exchange's buffers.  Interpret
+    mode has no barrier primitive and skips it (its DMA emulation
+    rendezvouses on fresh buffers, so the hazard does not exist there).
+  * Receive-plane rows of non-neighbours are never written; the unpack
+    masks padding ghost slots (``ghost_valid``) so those uninitialised
+    rows are never observed.
+
+Selected by ``--comm dma`` (the reference's ``--comm nvshmem``); the
+default ``--comm xla`` transport is the `lax.all_to_all` in
+:mod:`acg_tpu.parallel.halo`.  Pack/unpack stay XLA gathers outside the
+kernel, exactly as the reference keeps its pack kernels separate from the
+transport (``halo.cu:41-107``).
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from acg_tpu.parallel.mesh import PARTS_AXIS
+
+
+def _exchange_kernel(axis, use_barrier, gate_by_counts, scnt_ref, rcnt_ref,
+                     sendbuf_ref, recvbuf_ref, send_sem, recv_sem):
+    """Per-shard kernel: neighbourhood barrier, start every gated put
+    (nbi-style, all in flight at once), then wait for sends and
+    receives."""
+    me = lax.axis_index(axis)
+    nparts = lax.axis_size(axis)  # static mesh size
+
+    def want_send(q):
+        if gate_by_counts:
+            return scnt_ref[q] > 0
+        return jnp.asarray(True)
+
+    def want_recv(q):
+        if gate_by_counts:
+            return rcnt_ref[q] > 0
+        return jnp.asarray(True)
+
+    if use_barrier:
+        # readytoreceive handshake with the neighbourhood (halo.c:957-967)
+        barrier = pltpu.get_barrier_semaphore()
+        nneighbors = jnp.int32(0)
+        for s in range(1, nparts):
+            q = (me + s) % nparts
+            is_neighbor = want_send(q) | want_recv(q)
+
+            @pl.when(is_neighbor)
+            def _(q=q):
+                pltpu.semaphore_signal(
+                    barrier, inc=1, device_id=q,
+                    device_id_type=pltpu.DeviceIdType.LOGICAL)
+            nneighbors = nneighbors + jnp.where(is_neighbor, 1, 0)
+        pltpu.semaphore_wait(barrier, nneighbors)
+
+    def put_descriptor(peer, src_row, dst_row):
+        # put-with-signal (cg-kernels-cuda.cu:734-746): the window lands
+        # in the peer's recvbuf row and signals the peer's recv semaphore
+        return pltpu.make_async_remote_copy(
+            src_ref=sendbuf_ref.at[src_row],
+            dst_ref=recvbuf_ref.at[dst_row],
+            send_sem=send_sem,
+            recv_sem=recv_sem,
+            device_id=peer,
+            device_id_type=pltpu.DeviceIdType.LOGICAL)
+
+    # start all puts before waiting on any (the reference's _nbi puts,
+    # cg-kernels-cuda.cu:734-746): distinct source and destination rows,
+    # so every transfer is independent and overlaps on the wire
+    for s in range(1, nparts):
+        dst = (me + s) % nparts
+
+        @pl.when(want_send(dst))
+        def _(dst=dst):
+            put_descriptor(dst, dst, me).start()
+
+    for s in range(1, nparts):
+        dst = (me + s) % nparts
+        src = (me - s + nparts) % nparts
+
+        @pl.when(want_send(dst))
+        def _(dst=dst):
+            put_descriptor(dst, dst, me).wait_send()
+
+        @pl.when(want_recv(src))
+        def _(src=src):
+            # signal_wait_until analog: src's put into my row `src`
+            put_descriptor(src, src, src).wait_recv()
+
+
+@functools.partial(jax.jit,
+                   static_argnames=("axis", "interpret", "gate_by_counts"))
+def _exchange(sendbuf, send_counts, recv_counts, axis: str, interpret: bool,
+              gate_by_counts: bool | None = None):
+    nparts, maxcnt = sendbuf.shape
+    if gate_by_counts is None:
+        gate_by_counts = not interpret
+    kernel = functools.partial(_exchange_kernel, axis, not interpret,
+                               gate_by_counts)
+    return pl.pallas_call(
+        kernel,
+        out_shape=jax.ShapeDtypeStruct((nparts, maxcnt), sendbuf.dtype),
+        in_specs=[
+            pl.BlockSpec(memory_space=pltpu.SMEM),   # send_counts
+            pl.BlockSpec(memory_space=pltpu.SMEM),   # recv_counts
+            pl.BlockSpec(memory_space=pl.ANY),       # sendbuf
+        ],
+        out_specs=pl.BlockSpec(memory_space=pl.ANY),
+        scratch_shapes=[
+            pltpu.SemaphoreType.DMA(()),             # send (shared)
+            pltpu.SemaphoreType.DMA(()),             # recv (shared)
+        ],
+        compiler_params=pltpu.CompilerParams(has_side_effects=True,
+                                             collective_id=0),
+        interpret=interpret,
+    )(send_counts, recv_counts, sendbuf)
+
+
+def halo_exchange_dma(x_loc: jax.Array, send_idx: jax.Array,
+                      ghost_src: jax.Array, ghost_valid: jax.Array,
+                      send_counts: jax.Array, recv_counts: jax.Array,
+                      axis: str = PARTS_AXIS,
+                      interpret: bool | None = None) -> jax.Array:
+    """Exchange ghost values by one-sided remote DMA; call inside
+    `shard_map` over ``axis``.
+
+    Same contract as :func:`acg_tpu.parallel.halo.halo_exchange` plus the
+    per-neighbour counts (``send_counts[q]`` = entries this shard sends to
+    shard q), which gate the puts so only real neighbours communicate,
+    and ``ghost_valid``, which masks padding ghost slots whose gathers
+    would otherwise read receive-plane rows no neighbour ever wrote
+    (uninitialised device memory on real TPUs).
+    """
+    if interpret is None:
+        interpret = jax.devices()[0].platform != "tpu"
+    sendbuf = x_loc[send_idx]                    # pack (halo.cu:41-54)
+    recvbuf = _exchange(sendbuf, send_counts, recv_counts, axis,
+                        interpret)
+    ghost = recvbuf.reshape(-1)[ghost_src]       # unpack (halo.cu:94-107)
+    return jnp.where(ghost_valid, ghost, 0)
