@@ -79,6 +79,17 @@ def make_parser() -> argparse.ArgumentParser:
                         "to stdout as Matrix Market")
     p.add_argument("--dtype", default="f64", choices=["f64", "f32", "bf16"],
                    help="device arithmetic precision (default: f64)")
+    p.add_argument("--precise-dots", action="store_true",
+                   help="compensated (double-float) dot products for the "
+                        "CG scalars; lets f32 storage converge past the "
+                        "~1e-6 relative-residual stall")
+    p.add_argument("--refine", action="store_true",
+                   help="mixed-precision iterative refinement: f64 outer "
+                        "residual on host, --dtype inner solves on device; "
+                        "reaches f64 tolerances at f32 device speed")
+    p.add_argument("--refine-rtol", type=float, default=1e-5, metavar="TOL",
+                   help="relative tolerance of each inner refinement solve "
+                        "(default: 1e-5)")
     p.add_argument("--seed", type=int, default=42,
                    help="random seed for partitioning and manufactured solutions")
     p.add_argument("--numfmt", default="%.17g", metavar="FMT",
@@ -143,6 +154,7 @@ def _main(args) -> int:
     from acg_tpu.partition import partition_rows
     from acg_tpu.solvers import HostCGSolver, StoppingCriteria
     from acg_tpu.solvers.jax_cg import JaxCGSolver
+    from acg_tpu.solvers.refine import RefinedSolver
 
     dtype = {"f64": jnp.float64, "f32": jnp.float32, "bf16": jnp.bfloat16}[args.dtype]
     comm = {"mpi": "xla", "nccl": "xla", "nvshmem": "dma"}.get(args.comm, args.comm)
@@ -232,7 +244,11 @@ def _main(args) -> int:
                              "reference baseline")
         elif comm == "none" or nparts == 1:
             dev = device_matrix_from_csr(csr, dtype=dtype)
-            solver = JaxCGSolver(dev, pipelined=pipelined)
+            solver = JaxCGSolver(dev, pipelined=pipelined,
+                                 precise_dots=args.precise_dots)
+            if args.refine:
+                solver = RefinedSolver(solver, csr,
+                                       inner_rtol=args.refine_rtol)
             x = solver.solve(b, x0=x0, criteria=criteria, warmup=args.warmup)
         else:
             subs = partition_matrix(csr, part, nparts)
@@ -240,8 +256,12 @@ def _main(args) -> int:
                 comm_mtx_out = comm_matrix(subs, nparts)
             prob = DistributedProblem.build(csr, part, nparts, dtype=dtype,
                                             subs=subs)
-            solver = DistCGSolver(prob, pipelined=pipelined, comm=comm)
-            x = solver.solve(b, x0_global=x0, criteria=criteria,
+            solver = DistCGSolver(prob, pipelined=pipelined, comm=comm,
+                                  precise_dots=args.precise_dots)
+            if args.refine:
+                solver = RefinedSolver(solver, csr,
+                                       inner_rtol=args.refine_rtol)
+            x = solver.solve(b, x0=x0, criteria=criteria,
                              warmup=args.warmup)
     except NotConvergedError as e:
         sys.stderr.write(f"acg-tpu: {e}\n")

@@ -1,0 +1,107 @@
+"""Extended-precision building blocks for f32-native TPU solves.
+
+The reference is strictly FP64 (``ACG_DOUBLE`` is its only dtype,
+``comm.h:180-183``); TPU f64 is software-emulated and slow.  This module
+supplies the standard mitigations (SURVEY.md section 7 "hard parts"):
+
+* **Error-free transforms** (two_sum / split / two_prod, Dekker/Knuth):
+  exact f32 sum and product representations as (hi, lo) pairs, entirely
+  in hardware f32 ops, jit- and vmap-safe.
+* **Compensated reductions**: `df_sum` tree-reduces an array in
+  double-float ("df64") arithmetic -- ~2x f32 precision (~48-bit
+  mantissa) at a small constant factor over a plain `jnp.sum`;
+  `dot_compensated` is the Ogita-Rump-Oishi dot2 built on it.  Used for
+  the CG scalars (gamma, (p,t)) whose f32 rounding is what stalls plain
+  f32 CG near 1e-6 relative residuals.
+* **Iterative refinement** lives in
+  :class:`acg_tpu.solvers.refine.RefinedSolver`: f64 outer residual on
+  host, f32 inner CG on device -- f64-quality solutions at f32 device
+  speed (Wilkinson; the standard mixed-precision linear-solver loop).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+def two_sum(a, b):
+    """Knuth two-sum: s + e == a + b exactly (|e| <= ulp(s)/2)."""
+    s = a + b
+    bb = s - a
+    e = (a - (s - bb)) + (b - bb)
+    return s, e
+
+
+_MANTISSA_BITS = {"float32": 24, "float64": 53, "bfloat16": 8,
+                  "float16": 11}
+
+
+def split(a):
+    """Dekker split of a float into hi + lo with non-overlapping
+    half-width mantissas (12+12 bits for f32, 27+26 for f64); the split
+    constant is derived from the input dtype."""
+    bits = _MANTISSA_BITS[jnp.dtype(a.dtype).name]
+    c = jnp.asarray(2.0 ** ((bits + 1) // 2) + 1.0, a.dtype) * a
+    hi = c - (c - a)
+    return hi, a - hi
+
+
+def two_prod(a, b):
+    """Dekker two-product: p + e == a * b exactly (no FMA needed)."""
+    p = a * b
+    ah, al = split(a)
+    bh, bl = split(b)
+    e = ((ah * bh - p) + ah * bl + al * bh) + al * bl
+    return p, e
+
+
+def df_add(x, y):
+    """Double-float addition: (hi, lo) + (hi, lo) -> (hi, lo)."""
+    xh, xl = x
+    yh, yl = y
+    s, e = two_sum(xh, yh)
+    e = e + xl + yl
+    hi, lo = two_sum(s, e)
+    return hi, lo
+
+
+def df_sum(hi: jax.Array, lo: jax.Array | None = None):
+    """Tree-sum an array in double-float arithmetic.
+
+    Folds halves with `df_add` (log2(n) vectorised passes, ~2n df-adds
+    total), so the reduction itself carries ~48 bits -- unlike a plain
+    f32 tree sum whose error grows with log(n) ulps.  Returns (hi, lo)
+    scalars.
+    """
+    if lo is None:
+        lo = jnp.zeros_like(hi)
+    n = hi.shape[0]
+    # pad to a power of two (zeros are exact in df arithmetic)
+    p2 = 1 << max(0, (n - 1).bit_length())
+    if p2 != n:
+        hi = jnp.pad(hi, (0, p2 - n))
+        lo = jnp.pad(lo, (0, p2 - n))
+    while p2 > 1:
+        half = p2 // 2
+        hi, lo = df_add((hi[:half], lo[:half]), (hi[half:], lo[half:]))
+        p2 = half
+    return hi[0], lo[0]
+
+
+def dot_compensated(x: jax.Array, y: jax.Array):
+    """Ogita-Rump-Oishi dot2: the dot product with ~2x working
+    precision.  Returns (hi, lo); ``hi + lo`` is the compensated value.
+
+    The role of the reference's f64 cublasDdot for the CG scalars
+    (``cgcuda.c:913-972``) when vectors are stored in f32.
+    """
+    p, e = two_prod(x, y)
+    return df_sum(p, e)
+
+
+def dot2(x: jax.Array, y: jax.Array) -> jax.Array:
+    """Compensated dot product collapsed to a single working-precision
+    scalar (the 'almost-f64 then round' value)."""
+    hi, lo = dot_compensated(x, y)
+    return hi + lo

@@ -38,6 +38,7 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from acg_tpu.errors import NotConvergedError
 from acg_tpu.graph import Subdomain, partition_matrix, scatter_vector
+from acg_tpu.ops.precision import dot_compensated
 from acg_tpu.ops.spmv import ell_planes_from_csr
 from acg_tpu.parallel.halo import DeviceHaloPlan, build_device_halo, halo_exchange
 from acg_tpu.parallel.halo_dma import halo_exchange_dma
@@ -139,11 +140,13 @@ class DistCGSolver:
     """
 
     def __init__(self, problem: DistributedProblem, pipelined: bool = False,
-                 mesh: Mesh | None = None, comm: str = "xla"):
+                 mesh: Mesh | None = None, comm: str = "xla",
+                 precise_dots: bool = False):
         if comm not in ("xla", "dma"):
             raise ValueError(f"unknown halo transport {comm!r}")
         self.problem = problem
         self.pipelined = pipelined
+        self.precise_dots = precise_dots
         self.comm = comm
         self.mesh = mesh if mesh is not None else solve_mesh(problem.nparts)
         self.stats = SolverStats(unknowns=problem.n)
@@ -161,6 +164,7 @@ class DistCGSolver:
 
         comm = self.comm
         interpret = self._interpret
+        precise = self.precise_dots
 
         def dist_spmv(x_loc, ld, lc, gd, gc, sidx, gsrc, gval, scnt, rcnt):
             """halo(x) || local SpMV, then off-diagonal SpMV -- 3.2's
@@ -193,10 +197,37 @@ class DistCGSolver:
                 return dist_spmv(x, ld, lc, gd, gc, sidx, gsrc, gval, scnt,
                                  rcnt)
 
-            bnrm2 = jnp.sqrt(psum(jnp.dot(b, b)))
-            x0nrm2 = jnp.sqrt(psum(jnp.dot(x0, x0)))
+            if precise:
+                # compensated local dot (ops.precision), hi and lo
+                # psum'd as a pair so local summation error stays out of
+                # the global scalar (cross-part addition error is
+                # O(nparts) ulps, negligible vs the 4M-element sums)
+                def pdot(a, c):
+                    hi, lo = dot_compensated(a, c)
+                    pair = psum(jnp.stack([hi, lo]))
+                    return pair[0] + pair[1]
+
+                def pdot2_fused(a1, c1, a2, c2):
+                    # both compensated dots in ONE psum of 4 scalars,
+                    # preserving the pipelined variant's single-allreduce
+                    # property (cgcuda.c:1730-1737)
+                    h1, l1 = dot_compensated(a1, c1)
+                    h2, l2 = dot_compensated(a2, c2)
+                    quad = psum(jnp.stack([h1, l1, h2, l2]))
+                    return quad[0] + quad[1], quad[2] + quad[3]
+            else:
+                def pdot(a, c):
+                    return psum(jnp.dot(a, c))
+
+                def pdot2_fused(a1, c1, a2, c2):
+                    pair = psum(jnp.stack([jnp.dot(a1, c1),
+                                           jnp.dot(a2, c2)]))
+                    return pair[0], pair[1]
+
+            bnrm2 = jnp.sqrt(pdot(b, b))
+            x0nrm2 = jnp.sqrt(pdot(x0, x0))
             r = b - spmv(x0)
-            gamma = psum(jnp.dot(r, r))
+            gamma = pdot(r, r)
             r0nrm2 = jnp.sqrt(gamma)
             res_tol = jnp.maximum(res_atol, res_rtol * r0nrm2)
             diff_tol = jnp.maximum(diff_atol, diff_rtol * x0nrm2)
@@ -218,11 +249,11 @@ class DistCGSolver:
                 def body(state):
                     x, r, p, gamma = state[:4]
                     t = spmv(p)
-                    pdott = psum(jnp.dot(p, t))
+                    pdott = pdot(p, t)
                     alpha = gamma / pdott
                     x = x + alpha * p
                     r = r - alpha * t
-                    gamma_next = psum(jnp.dot(r, r))
+                    gamma_next = pdot(r, r)
                     beta = gamma_next / gamma
                     p_next = r + beta * p
                     if needs_diff:
@@ -245,8 +276,8 @@ class DistCGSolver:
                     x, r, w, p, t, z, gamma_prev, alpha_prev = state[:8]
                     # the pipelined variant's single fused allreduce:
                     # both scalars in one psum (cgcuda.c:1730-1737)
-                    pair = psum(jnp.stack([jnp.dot(r, r), jnp.dot(w, r)]))
-                    gamma, delta = pair[0], pair[1]
+                    # single fused allreduce of both scalars
+                    gamma, delta = pdot2_fused(r, r, w, r)
                     q = spmv(w)  # overlaps the psum under XLA's scheduler
                     beta = gamma / gamma_prev
                     alpha = gamma / (delta - beta * (gamma / alpha_prev))
@@ -271,7 +302,7 @@ class DistCGSolver:
                     init_gamma=gamma)
                 x, r_fin = state[0], state[1]
                 dxsqr = state[8] if needs_diff else inf
-                rnrm2 = jnp.sqrt(psum(jnp.dot(r_fin, r_fin)))
+                rnrm2 = jnp.sqrt(pdot(r_fin, r_fin))
 
             dxnrm2 = jnp.sqrt(dxsqr)
             return x[None], k, rnrm2, r0nrm2, bnrm2, x0nrm2, dxnrm2, done
@@ -300,7 +331,7 @@ class DistCGSolver:
 
     # -- public solve ------------------------------------------------------
 
-    def solve(self, b_global: np.ndarray, x0_global: np.ndarray | None = None,
+    def solve(self, b_global: np.ndarray, x0: np.ndarray | None = None,
               criteria: StoppingCriteria | None = None,
               raise_on_divergence: bool = True, warmup: int = 0) -> np.ndarray:
         crit = criteria or StoppingCriteria()
@@ -311,8 +342,8 @@ class DistCGSolver:
 
         put = functools.partial(jax.device_put, device=self._sharding)
         b = put(prob.scatter(np.asarray(b_global)))
-        x0 = put(prob.scatter(np.asarray(x0_global))
-                 if x0_global is not None
+        x0 = put(prob.scatter(np.asarray(x0))
+                 if x0 is not None
                  else np.zeros((prob.nparts, prob.nmax_owned), dtype=dtype))
         ld = put(prob.local_data)
         lc = put(prob.local_cols)

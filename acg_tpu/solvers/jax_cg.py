@@ -34,6 +34,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from acg_tpu.errors import NotConvergedError
+from acg_tpu.ops.precision import dot2
 from acg_tpu.ops.spmv import DeviceMatrix, spmv, spmv_flops
 from acg_tpu.solvers.stats import (SolverStats, StoppingCriteria,
                                    cg_flops_per_iteration)
@@ -117,16 +118,24 @@ def _iterate(iter_body, init_state, gamma_of, maxits, res_tol,
                               (jnp.int32(0), init_state, init_done))
 
 
-@functools.partial(jax.jit, static_argnames=("unbounded", "needs_diff"))
+@functools.partial(jax.jit,
+                   static_argnames=("unbounded", "needs_diff", "precise"))
 def _cg_program(A: DeviceMatrix, b, x0, res_atol, res_rtol, diff_atol,
-                diff_rtol, maxits, unbounded: bool, needs_diff: bool):
-    """Whole classic-CG solve as one XLA program."""
+                diff_rtol, maxits, unbounded: bool, needs_diff: bool,
+                precise: bool = False):
+    """Whole classic-CG solve as one XLA program.
+
+    ``precise`` switches the CG scalars' dot products to the compensated
+    dot2 (acg_tpu.ops.precision): ~2x working precision for gamma and
+    (p, t), which is what lets plain-f32 storage converge past the
+    ~1e-6 relative-residual stall."""
+    dot = dot2 if precise else jnp.dot
     dtype = b.dtype
     bnrm2 = jnp.linalg.norm(b)
     x0nrm2 = jnp.linalg.norm(x0)
     r = b - spmv(A, x0)
     p = r
-    gamma = jnp.dot(r, r)
+    gamma = dot(r, r)
     r0nrm2 = jnp.sqrt(gamma)
     res_tol = jnp.maximum(res_atol, res_rtol * r0nrm2)
     diff_tol = jnp.maximum(diff_atol, diff_rtol * x0nrm2)
@@ -137,11 +146,11 @@ def _cg_program(A: DeviceMatrix, b, x0, res_atol, res_rtol, diff_atol,
     def body(state):
         x, r, p, gamma = state[:4]
         t = spmv(A, p)
-        pdott = jnp.dot(p, t)
+        pdott = dot(p, t)
         alpha = gamma / pdott
         x = x + alpha * p
         r = r - alpha * t
-        gamma_next = jnp.dot(r, r)
+        gamma_next = dot(r, r)
         beta = gamma_next / gamma
         p_next = r + beta * p
         if needs_diff:
@@ -161,11 +170,13 @@ def _cg_program(A: DeviceMatrix, b, x0, res_atol, res_rtol, diff_atol,
                     dxnrm2=jnp.sqrt(dxsqr), converged=done)
 
 
-@functools.partial(jax.jit, static_argnames=("unbounded", "needs_diff"))
+@functools.partial(jax.jit,
+                   static_argnames=("unbounded", "needs_diff", "precise"))
 def _cg_pipelined_program(A: DeviceMatrix, b, x0, res_atol, res_rtol,
                           diff_atol, diff_rtol, maxits, unbounded: bool,
-                          needs_diff: bool):
+                          needs_diff: bool, precise: bool = False):
     """Whole pipelined-CG (Ghysels-Vanroose) solve as one XLA program."""
+    dot = dot2 if precise else jnp.dot
     dtype = b.dtype
     bnrm2 = jnp.linalg.norm(b)
     x0nrm2 = jnp.linalg.norm(x0)
@@ -180,8 +191,8 @@ def _cg_pipelined_program(A: DeviceMatrix, b, x0, res_atol, res_rtol,
     def body(state):
         x, r, w, p, t, z, gamma_prev, alpha_prev = state[:8]
         # both reductions of the iteration, fused (one allreduce on a mesh)
-        gamma = jnp.dot(r, r)
-        delta = jnp.dot(w, r)
+        gamma = dot(r, r)
+        delta = dot(w, r)
         # SpMV overlaps the allreduce in the reference (cgcuda.c:1750-1790);
         # under XLA the scheduler owns that overlap.
         q = spmv(A, w)
@@ -223,9 +234,11 @@ class JaxCGSolver:
     workspace device-resident across solves and accumulates statistics.
     """
 
-    def __init__(self, A: DeviceMatrix, pipelined: bool = False):
+    def __init__(self, A: DeviceMatrix, pipelined: bool = False,
+                 precise_dots: bool = False):
         self.A = A
         self.pipelined = pipelined
+        self.precise_dots = precise_dots
         self.stats = SolverStats(unknowns=A.nrows)
         self._spmv_flops = spmv_flops(A)
 
@@ -246,7 +259,8 @@ class JaxCGSolver:
                 jnp.asarray(crit.diff_atol, dtype),
                 jnp.asarray(crit.diff_rtol, dtype),
                 jnp.int32(crit.maxits))
-        kwargs = dict(unbounded=crit.unbounded, needs_diff=crit.needs_diff)
+        kwargs = dict(unbounded=crit.unbounded, needs_diff=crit.needs_diff,
+                      precise=self.precise_dots)
         # warmup solves outside the timed region (the reference warms up
         # each op class before timing, cgcuda.c:612-710)
         for _ in range(max(warmup, 0)):

@@ -1,0 +1,172 @@
+"""Extended-precision primitives and mixed-precision solves.
+
+The reference sidesteps all of this by being strictly f64 (comm.h:180-183);
+on TPU these are the mechanisms that recover f64-quality results from
+f32-native hardware (SURVEY.md section 7 "hard parts").
+"""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from acg_tpu.io.generators import poisson_mtx
+from acg_tpu.matrix import SymCsrMatrix
+from acg_tpu.ops.precision import (df_sum, dot2, dot_compensated, two_prod,
+                                   two_sum)
+from acg_tpu.ops.spmv import device_matrix_from_csr
+from acg_tpu.solvers import StoppingCriteria
+from acg_tpu.solvers.jax_cg import JaxCGSolver
+from acg_tpu.solvers.refine import RefinedSolver
+
+
+def test_two_sum_exact():
+    """s + e must equal a + b exactly (checked in f64)."""
+    rng = np.random.default_rng(0)
+    a = jnp.asarray(rng.standard_normal(1000) * 1e6, jnp.float32)
+    b = jnp.asarray(rng.standard_normal(1000) * 1e-3, jnp.float32)
+    s, e = jax.jit(two_sum)(a, b)
+    exact = np.asarray(a, np.float64) + np.asarray(b, np.float64)
+    got = np.asarray(s, np.float64) + np.asarray(e, np.float64)
+    np.testing.assert_array_equal(got, exact)
+
+
+def test_two_prod_exact():
+    rng = np.random.default_rng(1)
+    a = jnp.asarray(rng.standard_normal(1000), jnp.float32)
+    b = jnp.asarray(rng.standard_normal(1000), jnp.float32)
+    p, e = jax.jit(two_prod)(a, b)
+    exact = np.asarray(a, np.float64) * np.asarray(b, np.float64)
+    got = np.asarray(p, np.float64) + np.asarray(e, np.float64)
+    np.testing.assert_array_equal(got, exact)
+
+
+def test_df_sum_beats_plain_sum():
+    """Adversarial cancellation: df_sum must track the f64 sum far more
+    closely than a plain f32 sum."""
+    rng = np.random.default_rng(2)
+    n = 1 << 16
+    x64 = rng.standard_normal(n) * 10.0 ** rng.integers(0, 6, n)
+    x64 = np.concatenate([x64, -x64 * (1 + 1e-7)])  # heavy cancellation
+    x = jnp.asarray(x64, jnp.float32)
+    x64 = np.asarray(x, np.float64)  # the exactly-representable inputs
+    exact = np.sum(x64)
+    hi, lo = jax.jit(df_sum)(x)
+    df_err = abs((float(hi) + float(lo)) - exact)
+    plain_err = abs(float(jnp.sum(x)) - exact)
+    assert df_err <= plain_err / 64 or df_err < 1e-6 * abs(exact) + 1e-6
+
+
+def test_dot2_matches_f64():
+    rng = np.random.default_rng(3)
+    n = 1 << 15
+    x = jnp.asarray(rng.standard_normal(n), jnp.float32)
+    y = jnp.asarray(rng.standard_normal(n), jnp.float32)
+    exact = np.dot(np.asarray(x, np.float64), np.asarray(y, np.float64))
+    hi, lo = jax.jit(dot_compensated)(x, y)
+    assert abs((float(hi) + float(lo)) - exact) < 1e-5 * abs(exact) + 1e-8
+    # compensated beats plain by a wide margin on this size
+    plain_err = abs(float(jnp.dot(x, y)) - exact)
+    comp_err = abs(float(jax.jit(dot2)(x, y)) - exact)
+    assert comp_err <= plain_err + 1e-12
+
+
+@pytest.fixture(scope="module")
+def poisson32():
+    return SymCsrMatrix.from_mtx(poisson_mtx(32, dim=2))
+
+
+def test_precise_dots_f32_converges_deeper(poisson32):
+    """With compensated dots, f32 CG reaches tolerances where the plain
+    f32 recurrence typically stalls."""
+    csr = poisson32.to_csr()
+    n = csr.shape[0]
+    rng = np.random.default_rng(4)
+    xsol = rng.standard_normal(n)
+    xsol /= np.linalg.norm(xsol)
+    b = (csr @ xsol).astype(np.float32)
+    A = device_matrix_from_csr(csr, dtype=jnp.float32)
+    crit = StoppingCriteria(maxits=5000, residual_rtol=2e-6)
+    solver = JaxCGSolver(A, precise_dots=True)
+    x = solver.solve(b, criteria=crit)
+    assert solver.stats.converged
+    assert np.linalg.norm(x - xsol) < 5e-4
+
+
+def test_refined_solver_reaches_f64_accuracy(poisson32):
+    """f32 inner solves + f64 outer refinement: solution error at f64
+    levels, far beyond single-precision reach."""
+    csr = poisson32.to_csr()
+    n = csr.shape[0]
+    rng = np.random.default_rng(5)
+    xsol = rng.standard_normal(n)
+    xsol /= np.linalg.norm(xsol)
+    b = csr @ xsol
+    A = device_matrix_from_csr(csr, dtype=jnp.float32)
+    inner = JaxCGSolver(A)
+    solver = RefinedSolver(inner, csr, inner_rtol=1e-4)
+    crit = StoppingCriteria(maxits=20000, residual_rtol=1e-12)
+    x = solver.solve(b, criteria=crit)
+    assert solver.stats.converged
+    assert solver.stats.nrefine >= 2
+    assert np.linalg.norm(x - xsol) < 1e-10
+    assert solver.stats.rnrm2 < 1e-12 * solver.stats.r0nrm2 * 1.01
+
+
+def test_refined_solver_stagnation_raises(poisson32):
+    """An unreachable tolerance must raise NotConvergedError, not loop."""
+    from acg_tpu.errors import NotConvergedError
+    csr = poisson32.to_csr()
+    n = csr.shape[0]
+    A = device_matrix_from_csr(csr, dtype=jnp.float32)
+    inner = JaxCGSolver(A)
+    solver = RefinedSolver(inner, csr, inner_rtol=1e-4)
+    with pytest.raises(NotConvergedError):
+        solver.solve(np.ones(n),
+                     criteria=StoppingCriteria(maxits=200,
+                                               residual_rtol=1e-300))
+
+
+def test_refined_solver_unbounded_mode(poisson32):
+    """maxits-only criteria: spend the budget, report converged (the
+    direct solvers' unbounded semantics)."""
+    csr = poisson32.to_csr()
+    A = device_matrix_from_csr(csr, dtype=jnp.float32)
+    solver = RefinedSolver(JaxCGSolver(A), csr, inner_rtol=1e-4)
+    x = solver.solve(np.ones(csr.shape[0]),
+                     criteria=StoppingCriteria(maxits=50))
+    assert solver.stats.converged
+    assert solver.stats.niterations <= 50
+    assert np.isfinite(x).all()
+
+
+def test_refined_solver_budget_not_exceeded(poisson32):
+    """Total inner iterations must respect --max-iterations."""
+    csr = poisson32.to_csr()
+    A = device_matrix_from_csr(csr, dtype=jnp.float32)
+    solver = RefinedSolver(JaxCGSolver(A), csr, inner_rtol=1e-6)
+    try:
+        solver.solve(np.ones(csr.shape[0]),
+                     criteria=StoppingCriteria(maxits=37,
+                                               residual_rtol=1e-14))
+    except Exception:
+        pass
+    assert solver.stats.niterations <= 37
+
+
+def test_split_dtype_aware():
+    """The Dekker split constant must track the input dtype: f64 splits
+    must be exact in f64 (27+26 bits)."""
+    from acg_tpu.ops.precision import split
+    rng = np.random.default_rng(7)
+    a64 = jnp.asarray(rng.standard_normal(100), jnp.float64)
+    hi, lo = split(a64)
+    np.testing.assert_array_equal(np.asarray(hi) + np.asarray(lo),
+                                  np.asarray(a64))
+    # hi has at most 27 significant bits: hi * 2^27 rounds exactly
+    p, e = two_prod(a64, a64)
+    exact = np.asarray(a64, np.float64) ** 2
+    # in f64, p + e must reproduce the square to quad-ish accuracy:
+    # p is the rounded product, e the exact error
+    assert np.all(np.asarray(p) + np.asarray(e) == exact)
