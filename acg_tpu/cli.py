@@ -94,6 +94,9 @@ def make_parser() -> argparse.ArgumentParser:
                    help="random seed for partitioning and manufactured solutions")
     p.add_argument("--numfmt", default="%.17g", metavar="FMT",
                    help="printf-style format for numeric output")
+    p.add_argument("--trace", metavar="DIR", default=None,
+                   help="write a jax.profiler trace of the solve to DIR "
+                        "(the reference's nsys-trace tier; view with xprof)")
     p.add_argument("-q", "--quiet", action="store_true",
                    help="do not write the solution vector to stdout")
     p.add_argument("-v", "--verbose", action="count", default=0,
@@ -230,10 +233,14 @@ def _main(args) -> int:
         residual_atol=args.residual_atol, residual_rtol=args.residual_rtol,
         diff_atol=args.diff_atol, diff_rtol=args.diff_rtol)
 
-    # stages 6b-8: build solver and solve
+    # stages 6b-8: build solver and solve, under the profiler when
+    # --trace is set (try/finally so failed solves still finalise the
+    # trace -- that is when it is most needed)
     t0 = time.perf_counter()
     pipelined = "pipelined" in args.solver
     comm_mtx_out = None
+    if args.trace:
+        jax.profiler.start_trace(args.trace)
     try:
         if args.solver == "host":
             solver = HostCGSolver(csr)
@@ -270,6 +277,9 @@ def _main(args) -> int:
     except AcgError as e:
         sys.stderr.write(f"acg-tpu: {e}\n")
         return 1
+    finally:
+        if args.trace:
+            jax.profiler.stop_trace()
     _log(args, "solve:", t0)
 
     # stage 9: statistics block (grep-compatible with the reference)

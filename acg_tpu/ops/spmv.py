@@ -199,7 +199,16 @@ def device_matrix_from_csr(csr, dtype=jnp.float32, format: str = "auto",
 
 
 def spmv(A: DeviceMatrix, x: jax.Array) -> jax.Array:
-    """y = A @ x for a device sparse matrix (jit-safe, differentiable)."""
+    """y = A @ x for a device sparse matrix (jit-safe, differentiable).
+
+    Wrapped in a `jax.named_scope` so profiler traces show the SpMV as a
+    labelled range (the reference's NVTX tier, ``cgcuda.c:771-801``).
+    """
+    with jax.named_scope(f"spmv_{type(A).__name__}"):
+        return _spmv(A, x)
+
+
+def _spmv(A: DeviceMatrix, x: jax.Array) -> jax.Array:
     if isinstance(A, DiaMatrix):
         # static shifted views of x; XLA fuses into one VPU loop
         L = max(0, -min(A.offsets))

@@ -1,0 +1,35 @@
+#!/usr/bin/env bash
+# Single-chip TPU benchmark sweep: solver x dtype on the reference
+# workload (2D Poisson n=2048, 4.19M unknowns, 1000 iterations) -- the
+# protocol of scripts/nccl_combined.sh at np=1, plus the TPU-specific
+# precision variants (f32, f32+refine, f64).
+#
+# Usage: scripts/bench_tpu.sh [N_SIDE]
+
+set -euo pipefail
+cd "$(dirname "$0")/.."
+
+N=${1:-2048}
+WORKDIR=$(mktemp -d)
+trap 'rm -rf "$WORKDIR"' EXIT
+export PYTHONPATH=${PYTHONPATH:-$PWD}
+
+MTX="$WORKDIR/poisson2d_n$N.mtx"
+echo "# generating 2D Poisson n=$N"
+python -m acg_tpu.tools.genmatrix -n "$N" --dim 2 -o "$MTX"
+
+run() {
+    echo "=== $* ==="
+    python -m acg_tpu.cli "$MTX" --comm none --warmup 1 --quiet \
+        --manufactured-solution "$@" 2>&1 |
+        grep -E "total solver time|total flop rate|iterations:|error 2-norm" |
+        sed 's/^/    /'
+}
+
+# fixed-iteration throughput (rtol 0 = unbounded benchmark mode)
+run --solver acg --dtype f32 --max-iterations 1000 --residual-rtol 0
+run --solver acg-pipelined --dtype f32 --max-iterations 1000 --residual-rtol 0
+# time-to-tolerance
+run --solver acg --dtype f32 --max-iterations 20000 --residual-rtol 1e-6
+run --solver acg --dtype f32 --refine --max-iterations 20000 --residual-rtol 1e-11
+run --solver acg --dtype f64 --max-iterations 2000 --residual-rtol 1e-6

@@ -1,0 +1,50 @@
+#!/usr/bin/env bash
+# Solver x comm x nparts sweep -- the role of the reference's
+# scripts/{mpi,nccl,nvshmem}_combined.sh (SURVEY.md component #28):
+# run every solver variant over every transport at several mesh sizes on
+# the same manufactured-solution Poisson problem and grep the
+# "total solver time" line from each stats block.
+#
+# Usage: scripts/sweep.sh [N_SIDE] [MAXITS]
+#   N_SIDE  side of the 2D Poisson grid (default 256 -> 65,536 unknowns;
+#           the reference protocol uses 2048 -> 4.19M)
+#   MAXITS  iteration cap (default 1000, reference protocol value)
+#
+# Without real multi-chip hardware the mesh sizes np>1 run on a virtual
+# CPU device mesh (the analog of the reference's single-node np=1,2,4,8
+# runs); on a TPU pod slice, drop the JAX_PLATFORMS/XLA_FLAGS overrides.
+
+set -euo pipefail
+cd "$(dirname "$0")/.."
+
+N=${1:-256}
+MAXITS=${2:-1000}
+RTOL=1e-6
+WORKDIR=$(mktemp -d)
+trap 'rm -rf "$WORKDIR"' EXIT
+
+export PYTHONPATH=${PYTHONPATH:-$PWD}
+export JAX_PLATFORMS=${JAX_PLATFORMS:-cpu}
+export XLA_FLAGS=${XLA_FLAGS:---xla_force_host_platform_device_count=8}
+
+MTX="$WORKDIR/poisson2d_n$N.mtx"
+echo "# generating 2D Poisson n=$N"
+python -m acg_tpu.tools.genmatrix -n "$N" --dim 2 -o "$MTX"
+
+for np in 1 2 4 8; do
+    PART="$WORKDIR/part$np.mtx"
+    python -m acg_tpu.tools.mtxpartition "$MTX" --parts "$np" > "$PART"
+    for solver in acg acg-pipelined; do
+        for comm in xla dma; do
+            [ "$np" -eq 1 ] && [ "$comm" = dma ] && continue
+            echo "=== solver=$solver comm=$comm np=$np ==="
+            python -m acg_tpu.cli "$MTX" \
+                --nparts "$np" --partition "$PART" \
+                --solver "$solver" --comm "$comm" \
+                --max-iterations "$MAXITS" --residual-rtol "$RTOL" \
+                --manufactured-solution --warmup 1 --quiet 2>&1 |
+                grep -E "total solver time|iterations:|error 2-norm" |
+                sed 's/^/    /'
+        done
+    done
+done

@@ -1,0 +1,24 @@
+#!/usr/bin/env bash
+# Capture a jax.profiler trace of a solve -- the role of the reference's
+# scripts/trace_{mpi,nvshmem}.sh (nsys profile -t cuda,nvtx): the trace
+# contains the XLA op timeline with the solver's named scopes; view with
+# xprof/tensorboard.
+#
+# Usage: scripts/trace.sh [TRACE_DIR] [extra acg-tpu args...]
+
+set -euo pipefail
+cd "$(dirname "$0")/.."
+
+TRACE_DIR=${1:-/tmp/acg-tpu-trace}
+shift || true
+WORKDIR=$(mktemp -d)
+trap 'rm -rf "$WORKDIR"' EXIT
+export PYTHONPATH=${PYTHONPATH:-$PWD}
+
+MTX="$WORKDIR/poisson2d.mtx"
+python -m acg_tpu.tools.genmatrix -n 512 --dim 2 -o "$MTX"
+
+python -m acg_tpu.cli "$MTX" --comm none --solver acg --dtype f32 \
+    --max-iterations 200 --residual-rtol 0 --warmup 1 --quiet \
+    --trace "$TRACE_DIR" "$@"
+echo "trace written to $TRACE_DIR"
