@@ -1,0 +1,53 @@
+"""Host-platform (virtual CPU) mesh provisioning.
+
+The reference tests its distributed paths by launching the same binary at
+np=1,2,4,8 on one node (SURVEY.md section 4); the TPU build's analog is
+XLA's host-platform device simulation.  Getting an n-device virtual CPU
+mesh needs a two-step dance that several entry points share (tests,
+``__graft_entry__.dryrun_multichip``):
+
+  1. ``--xla_force_host_platform_device_count=n`` in ``XLA_FLAGS``, and
+  2. ``jax.config.update("jax_platforms", "cpu")`` -- the env var
+     ``JAX_PLATFORMS`` alone is NOT enough because platform plugins (e.g.
+     the axon TPU tunnel) override it,
+
+both BEFORE the first JAX backend query: XLA_FLAGS and the platform list
+are read once at backend creation and ignored afterwards.
+"""
+
+from __future__ import annotations
+
+import os
+import re
+
+_COUNT_FLAG = "--xla_force_host_platform_device_count"
+
+
+def host_device_count_flags(flags: str, n_devices: int) -> str:
+    """Return ``flags`` amended to request >= ``n_devices`` host devices.
+
+    Pure function: callers decide where the result goes (``os.environ`` of
+    this process, or a child-process environment).
+    """
+    m = re.search(_COUNT_FLAG + r"=(\d+)", flags)
+    if m is None:
+        return (flags + f" {_COUNT_FLAG}={n_devices}").strip()
+    if int(m.group(1)) < n_devices:
+        return flags.replace(m.group(0), f"{_COUNT_FLAG}={n_devices}")
+    return flags
+
+
+def provision_host_mesh(n_devices: int):
+    """Force the CPU platform with >= ``n_devices`` virtual devices.
+
+    Returns the ``jax`` module.  Must run before the first backend query;
+    afterwards the settings are frozen and this becomes a no-op (callers
+    should check ``len(jax.devices())``).
+    """
+    os.environ["XLA_FLAGS"] = host_device_count_flags(
+        os.environ.get("XLA_FLAGS", ""), n_devices)
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    return jax

@@ -8,15 +8,13 @@ strictly-FP64 semantics for correctness tests.
 """
 
 import os
+import sys
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
-flags = os.environ.get("XLA_FLAGS", "")
-if "xla_force_host_platform_device_count" not in flags:
-    os.environ["XLA_FLAGS"] = (
-        flags + " --xla_force_host_platform_device_count=8").strip()
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
 os.environ.setdefault("JAX_ENABLE_X64", "1")
 
-import jax  # noqa: E402
+from acg_tpu._platform import provision_host_mesh  # noqa: E402
 
+jax = provision_host_mesh(8)
 jax.config.update("jax_enable_x64", True)
-jax.config.update("jax_platforms", "cpu")
