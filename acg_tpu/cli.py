@@ -53,6 +53,13 @@ def make_parser() -> argparse.ArgumentParser:
                         "0 with --comm none means 1)")
     p.add_argument("--partition", metavar="FILE", default=None,
                    help="read row partition vector from FILE (mtxpartition output)")
+    p.add_argument("--partition-method", default="auto",
+                   choices=["auto", "graph", "band"],
+                   help="row partition strategy: graph = edge-cut "
+                        "minimisation (METIS/bisection), band = contiguous "
+                        "nnz-balanced ranges (keeps banded matrices in "
+                        "gather-free DIA form on TPU); auto picks band for "
+                        "banded matrices (default)")
     p.add_argument("--partition-binary", action="store_true",
                    help="partition vector file is in binary Matrix Market format")
     p.add_argument("--binary", action="store_true",
@@ -203,7 +210,14 @@ def _main(args) -> int:
         if part.max() >= nparts:
             nparts = int(part.max()) + 1
     else:
-        part = partition_rows(csr, nparts, seed=args.seed)
+        method = args.partition_method
+        if method == "auto":
+            # banded matrices keep gather-free DIA local blocks under a
+            # contiguous partition; everything else gets edge-cut
+            # minimisation
+            from acg_tpu.ops.spmv import prefers_dia
+            method = "band" if prefers_dia(csr) else "graph"
+        part = partition_rows(csr, nparts, seed=args.seed, method=method)
     _log(args, f"partition rows into {nparts} parts:", t0)
 
     # stage 4: right-hand side and initial guess

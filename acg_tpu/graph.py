@@ -82,6 +82,11 @@ class Subdomain:
     # (the reference's f*/o* split, symcsrmatrix.h:249-292)
     A_local: sp.csr_matrix | None = None
     A_ghost: sp.csr_matrix | None = None
+    # "ibg" = interior|border|ghost (the reference's invariant);
+    # "natural" = owned nodes ascending by global id (bandwidth-preserving,
+    # set by reorder_owned_natural) -- ninterior/nborder stay as counts but
+    # no longer describe contiguous ranges
+    owned_order: str = "ibg"
 
     @property
     def nowned(self) -> int:
@@ -275,6 +280,38 @@ def partition_matrix(full_csr: sp.csr_matrix, part: np.ndarray,
                                   shape=(s.nowned, max(s.nghost, 1))).tocsr()
         s.A_local.sort_indices()
         s.A_ghost.sort_indices()
+    return subs
+
+
+def reorder_owned_natural(subs: list[Subdomain]) -> list[Subdomain]:
+    """Reorder each subdomain's owned nodes into ascending global id, in
+    place (ghosts untouched).
+
+    The reference's interior|border|ghost layout trades row locality for a
+    contiguous border range; on TPU the opposite trade wins: with owned
+    rows in global (natural/RCM) order, a contiguous partition of a banded
+    matrix keeps every local diagonal block banded, enabling gather-free
+    DIA SpMV -- measured ~30x faster than the ELL gather path
+    (``ops/spmv.py``).  The halo plan stays valid because send windows are
+    keyed by *global* id order (only the local indices are remapped), and
+    scatter/gather go through ``global_ids``.
+    """
+    for s in subs:
+        if s.owned_order == "natural":
+            continue
+        owned = s.global_ids[: s.nowned]
+        perm = np.argsort(owned, kind="stable")   # new local -> old local
+        inv = np.empty_like(perm)
+        inv[perm] = np.arange(perm.size, dtype=perm.dtype)
+        s.global_ids[: s.nowned] = owned[perm]
+        s.halo.send_idx = inv[s.halo.send_idx].astype(s.halo.send_idx.dtype)
+        if s.A_local is not None:
+            s.A_local = s.A_local[perm][:, perm].tocsr()
+            s.A_local.sort_indices()
+        if s.A_ghost is not None:
+            s.A_ghost = s.A_ghost[perm].tocsr()
+            s.A_ghost.sort_indices()
+        s.owned_order = "natural"
     return subs
 
 

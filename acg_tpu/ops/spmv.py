@@ -103,6 +103,43 @@ class DiaMatrix:
 DeviceMatrix = Union[EllMatrix, CooMatrix, DiaMatrix]
 
 
+def csr_diag_offsets(csr) -> np.ndarray:
+    """Distinct diagonal offsets (col - row) of a scipy sparse matrix,
+    ascending.  Works for rectangular blocks (e.g. owned x ghost)."""
+    coo = csr.tocoo()
+    return np.unique(coo.col.astype(np.int64) - coo.row.astype(np.int64))
+
+
+def dia_planes_fixed(csr, offsets, nrows_pad: int) -> np.ndarray:
+    """Host-side CSR -> (ndiags, nrows_pad) DIA planes for a *given* offset
+    set (used for mesh-uniform stacking: every part stores the union of all
+    parts' offsets, missing diagonals as zero planes)."""
+    offsets = np.asarray(offsets, dtype=np.int64)
+    coo = csr.tocoo()
+    diag = coo.col.astype(np.int64) - coo.row.astype(np.int64)
+    dmap = np.searchsorted(offsets, diag)
+    if diag.size and ((dmap >= offsets.size) | (offsets[dmap % offsets.size] != diag)).any():
+        raise ValueError("matrix has diagonals outside the given offset set")
+    data = np.zeros((offsets.size, nrows_pad), dtype=np.float64)
+    data[dmap, coo.row] = coo.data
+    return data
+
+
+def dia_mv(planes, offsets, nrows: int, x: jax.Array) -> jax.Array:
+    """y = A @ x for DIA planes (each (nrows,)) with static ``offsets``:
+    ``y[i] = sum_d planes[d][i] * x[i + offsets[d]]``.  Pure VPU
+    multiply-adds on statically-sliced views -- no gathers.  ``x`` may be
+    shorter or longer than ``nrows`` (rectangular blocks); out-of-range
+    entries read padded zeros."""
+    L = max(0, -min(offsets))
+    R = max(0, max(offsets) + nrows - x.shape[0])
+    xp = jnp.pad(x, (L, R))
+    y = jnp.zeros((nrows,), dtype=x.dtype)
+    for plane, off in zip(planes, offsets):
+        y = y + plane * jax.lax.dynamic_slice(xp, (L + off,), (nrows,))
+    return y
+
+
 def dia_from_csr(csr, dtype=jnp.float32) -> DiaMatrix:
     """Convert a scipy CSR matrix to DIA planes (host-side)."""
     nrows, ncols = csr.shape
@@ -160,15 +197,31 @@ def coo_from_csr(rowptr, colidx, vals, nrows: int, ncols: int,
                      nrows=nrows, ncols_padded=ncols)
 
 
+# shared DIA-eligibility thresholds (device_matrix_from_csr, CLI partition
+# auto-method; dist._stack_local_blocks keeps headroom over MAX_DIAGS
+# because the union of per-part offset sets can exceed any one count)
+MAX_DIAGS = 64
+DIA_WASTE_LIMIT = 3.0
+
+
 def count_diagonals(csr) -> int:
-    coo = csr.tocoo()
-    return int(np.unique(coo.col.astype(np.int64) - coo.row.astype(np.int64)).size)
+    return int(csr_diag_offsets(csr).size)
+
+
+def prefers_dia(csr, max_diags: int = MAX_DIAGS,
+                waste_limit: float = DIA_WASTE_LIMIT) -> bool:
+    """True when the matrix is banded enough that gather-free DIA storage
+    (and hence a contiguous band partition) is the right TPU choice."""
+    if not csr.nnz:
+        return False
+    ndiags = count_diagonals(csr)
+    return ndiags <= max_diags and ndiags * csr.shape[0] / csr.nnz <= waste_limit
 
 
 def device_matrix_from_csr(csr, dtype=jnp.float32, format: str = "auto",
                            ell_waste_limit: float = 3.0,
-                           dia_waste_limit: float = 3.0,
-                           max_diags: int = 64) -> DeviceMatrix:
+                           dia_waste_limit: float = DIA_WASTE_LIMIT,
+                           max_diags: int = MAX_DIAGS) -> DeviceMatrix:
     """Pick DIA, ELL or COO from the sparsity structure of a scipy CSR.
 
     DIA wins when the matrix is banded (few distinct diagonals, bounded
@@ -211,13 +264,7 @@ def spmv(A: DeviceMatrix, x: jax.Array) -> jax.Array:
 def _spmv(A: DeviceMatrix, x: jax.Array) -> jax.Array:
     if isinstance(A, DiaMatrix):
         # static shifted views of x; XLA fuses into one VPU loop
-        L = max(0, -min(A.offsets))
-        R = max(0, max(A.offsets) + A.nrows - x.shape[0])
-        xp = jnp.pad(x, (L, R))
-        y = jnp.zeros((A.nrows,), dtype=x.dtype)
-        for plane, off in zip(A.data, A.offsets):
-            y = y + plane * jax.lax.dynamic_slice(xp, (L + off,), (A.nrows,))
-        return y
+        return dia_mv(A.data, A.offsets, A.nrows, x)
     if isinstance(A, EllMatrix):
         # K gathers of n elements each; XLA fuses the multiply-accumulate.
         return jnp.einsum("nk,nk->n", A.data, x[A.cols])

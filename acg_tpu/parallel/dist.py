@@ -14,14 +14,20 @@ Data layout (host-built by :class:`DistributedProblem`):
     identical shapes per shard; the reference does the same max-sizing for
     NVSHMEM symmetric buffers, ``halo.c:883-887``), stacked on a leading
     ``parts`` axis, and sharded over the 1-D solve mesh;
-  * vectors are `[owned | padding]`; padding rows of the ELL planes are
+  * vectors are `[owned | padding]`; padding rows of the matrix blocks are
     all-zero so padded entries stay exactly zero through every update and
     reduction -- no masks needed anywhere in the loop;
   * the local (owned x owned) and off-diagonal (owned x ghost) blocks are
-    separate ELL planes (the reference's ``f*``/``o*`` split), so XLA can
-    overlap the halo all_to_all with the local-block SpMV -- the same
-    communication/computation overlap the reference schedules by hand with
-    streams and events (``cgcuda.c:855-899``).
+    separate (the reference's ``f*``/``o*`` split), so XLA can overlap the
+    halo all_to_all with the local-block SpMV -- the same communication/
+    computation overlap the reference schedules by hand with streams and
+    events (``cgcuda.c:855-899``);
+  * the local block is stored as gather-free DIA planes whenever the
+    partition keeps it banded (:class:`StackedLocalBlock`; owned rows are
+    re-sorted into natural order for this -- ``graph.reorder_owned_
+    natural``), with ELL gather planes as the general fallback; the ghost
+    block is compressed to the coupled (border) rows only
+    (:class:`StackedGhostBlock`).
 """
 
 from __future__ import annotations
@@ -37,9 +43,11 @@ from jax import lax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from acg_tpu.errors import NotConvergedError
-from acg_tpu.graph import Subdomain, partition_matrix, scatter_vector
+from acg_tpu.graph import (Subdomain, partition_matrix, reorder_owned_natural,
+                           scatter_vector)
 from acg_tpu.ops.precision import dot_compensated
-from acg_tpu.ops.spmv import ell_planes_from_csr
+from acg_tpu.ops.spmv import (csr_diag_offsets, dia_mv, dia_planes_fixed,
+                              ell_planes_from_csr)
 from acg_tpu.parallel.halo import DeviceHaloPlan, build_device_halo, halo_exchange
 from acg_tpu.parallel.halo_dma import halo_exchange_dma
 from acg_tpu.parallel.mesh import PARTS_AXIS, solve_mesh
@@ -50,6 +58,111 @@ from acg_tpu.solvers.stats import (SolverStats, StoppingCriteria,
 
 def _ell_mv(data: jax.Array, cols: jax.Array, x: jax.Array) -> jax.Array:
     return jnp.einsum("nk,nk->n", data, x[cols])
+
+
+@dataclasses.dataclass
+class StackedLocalBlock:
+    """Per-part owned x owned blocks, stacked over the mesh (leading axis
+    = parts) in the fastest eligible device format.
+
+    ``"dia"``: gather-free diagonal planes (one (P, nrows) array per
+    offset; the union of all parts' offsets is stored so shapes are
+    mesh-uniform).  Chosen when the partition keeps local blocks banded --
+    contiguous parts of a banded matrix (``partition_rows_band``) with
+    owned rows in natural order.  ``"ell"``: row-padded gather planes
+    ``(data, cols)``, the general fallback (scattered partitions).
+    """
+
+    format: str      # "dia" | "ell"
+    arrays: tuple    # dia: ndiags x (P, nrows); ell: (data (P,nrows,K), cols)
+    offsets: tuple   # dia only: static diagonal offsets, ascending
+    nrows: int
+
+    def shard_mv(self, arrays, x):
+        """y = A_local @ x for one shard (arrays = leading axis stripped)."""
+        if self.format == "dia":
+            return dia_mv(arrays, self.offsets, self.nrows, x)
+        data, cols = arrays
+        return _ell_mv(data, cols, x)
+
+
+@dataclasses.dataclass
+class StackedGhostBlock:
+    """Per-part owned x ghost off-diagonal blocks, compressed to the rows
+    that actually touch ghosts (the reference stores its ``o*`` block over
+    border rows only, ``symcsrmatrix.h:249-292``; here the coupled-row
+    list replaces the contiguous border range).  SpMV gathers ghost values
+    for ``bmax`` coupled rows and scatter-adds their contributions --
+    O(border) work instead of O(owned)."""
+
+    rows: jax.Array   # (P, bmax) int32, ascending; padding = nrows (dropped)
+    data: jax.Array   # (P, bmax, Kg)
+    cols: jax.Array   # (P, bmax, Kg) int32 into the ghost vector
+    nrows: int
+    bmax: int
+
+    def shard_mv(self, arrays, xg):
+        rows, data, cols = arrays
+        contrib = jnp.einsum("bk,bk->b", data, xg[cols])
+        # padding rows index nrows: out of bounds -> dropped by scatter
+        return jnp.zeros((self.nrows,), xg.dtype).at[rows].add(
+            contrib, indices_are_sorted=True)
+
+
+def _stack_local_blocks(subs, nmax_owned: int, dtype,
+                        max_diags: int = 80,  # headroom over spmv.MAX_DIAGS:
+                        # the union of per-part offset sets can exceed any
+                        # single part's diagonal count
+                        dia_waste_limit: float = 3.0) -> StackedLocalBlock:
+    blocks = [s.A_local for s in subs]
+    npdtype = np.dtype(dtype)
+    offs = np.unique(np.concatenate(
+        [csr_diag_offsets(b) for b in blocks] or [np.zeros(1, np.int64)]))
+    nnz = sum(int(b.nnz) for b in blocks)
+    if (nnz and offs.size <= max_diags
+            and offs.size * nmax_owned * len(blocks) <= dia_waste_limit * nnz):
+        planes = np.stack([dia_planes_fixed(b, offs, nmax_owned)
+                           for b in blocks], axis=1)  # (D, P, nrows)
+        arrays = tuple(jnp.asarray(planes[d].astype(npdtype))
+                       for d in range(offs.size))
+        return StackedLocalBlock(format="dia", arrays=arrays,
+                                 offsets=tuple(int(o) for o in offs),
+                                 nrows=nmax_owned)
+    Kl = max(int(np.diff(b.indptr).max(initial=0)) for b in blocks)
+    ld, lc = [], []
+    for b in blocks:
+        d, c = ell_planes_from_csr(b.indptr, b.indices, b.data, nmax_owned,
+                                   pad_k=Kl)
+        ld.append(d.astype(npdtype))
+        lc.append(c)
+    return StackedLocalBlock(format="ell",
+                             arrays=(jnp.asarray(np.stack(ld)),
+                                     jnp.asarray(np.stack(lc))),
+                             offsets=(), nrows=nmax_owned)
+
+
+def _stack_ghost_blocks(subs, nmax_owned: int, dtype) -> StackedGhostBlock:
+    npdtype = np.dtype(dtype)
+    coupled = [np.flatnonzero(np.diff(s.A_ghost.indptr)) for s in subs]
+    bmax = max((r.size for r in coupled), default=0) or 1
+    Kg = max((int(np.diff(s.A_ghost.indptr).max(initial=0)) for s in subs),
+             default=0) or 1
+    P = len(subs)
+    rows = np.full((P, bmax), nmax_owned, dtype=np.int32)  # pad = OOB drop
+    data = np.zeros((P, bmax, Kg), dtype=npdtype)
+    cols = np.zeros((P, bmax, Kg), dtype=np.int32)
+    for p, (s, ri) in enumerate(zip(subs, coupled)):
+        if ri.size == 0:
+            continue
+        sub = s.A_ghost[ri]
+        d, c = ell_planes_from_csr(sub.indptr, sub.indices, sub.data,
+                                   ri.size, pad_k=Kg)
+        rows[p, : ri.size] = ri
+        data[p, : ri.size] = d.astype(npdtype)
+        cols[p, : ri.size] = c
+    return StackedGhostBlock(rows=jnp.asarray(rows), data=jnp.asarray(data),
+                             cols=jnp.asarray(cols), nrows=nmax_owned,
+                             bmax=bmax)
 
 
 @dataclasses.dataclass
@@ -65,42 +178,30 @@ class DistributedProblem:
     subs: list[Subdomain]
     nmax_owned: int
     halo: DeviceHaloPlan
-    # stacked device arrays, leading axis = parts
-    local_data: jax.Array   # (P, nmax_owned, Kl)
-    local_cols: jax.Array
-    ghost_data: jax.Array   # (P, nmax_owned, Kg)
-    ghost_cols: jax.Array
+    local: StackedLocalBlock
+    ghost: StackedGhostBlock
     nnz_total: int
     dtype: object
 
     @classmethod
     def build(cls, full_csr, part, nparts: int, dtype=jnp.float32,
-              subs: list[Subdomain] | None = None) -> "DistributedProblem":
+              subs: list[Subdomain] | None = None,
+              reorder: str = "natural") -> "DistributedProblem":
+        """``reorder="natural"`` (default) re-sorts each part's owned rows
+        by global id (in place when ``subs`` is passed) so contiguous
+        partitions of banded matrices keep gather-free DIA local blocks;
+        ``"ibg"`` preserves the interior|border|ghost layout."""
         if subs is None or subs[0].A_local is None:
             subs = partition_matrix(full_csr, part, nparts)
+        if reorder == "natural":
+            reorder_owned_natural(subs)
         nmax_owned = max(s.nowned for s in subs)
-        Kl = max(int(np.diff(s.A_local.indptr).max(initial=0)) for s in subs)
-        Kg = max(int(np.diff(s.A_ghost.indptr).max(initial=0)) for s in subs)
         halo = build_device_halo(subs)
-        nmax_ghost = max(halo.nmax_ghost, 1)
-        npdtype = np.dtype(dtype)
-        ld, lc, gd, gc = [], [], [], []
-        for s in subs:
-            d, c = ell_planes_from_csr(s.A_local.indptr, s.A_local.indices,
-                                       s.A_local.data, nmax_owned, pad_k=Kl)
-            ld.append(d.astype(npdtype))
-            lc.append(c)
-            d, c = ell_planes_from_csr(s.A_ghost.indptr, s.A_ghost.indices,
-                                       s.A_ghost.data, nmax_owned, pad_k=Kg)
-            gd.append(d.astype(npdtype))
-            gc.append(c)
+        local = _stack_local_blocks(subs, nmax_owned, dtype)
+        ghost = _stack_ghost_blocks(subs, nmax_owned, dtype)
         return cls(nparts=nparts, n=full_csr.shape[0], subs=subs,
-                   nmax_owned=nmax_owned, halo=halo,
-                   local_data=jnp.asarray(np.stack(ld)),
-                   local_cols=jnp.asarray(np.stack(lc)),
-                   ghost_data=jnp.asarray(np.stack(gd)),
-                   ghost_cols=jnp.asarray(np.stack(gc)),
-                   nnz_total=int(full_csr.nnz), dtype=dtype)
+                   nmax_owned=nmax_owned, halo=halo, local=local,
+                   ghost=ghost, nnz_total=int(full_csr.nnz), dtype=dtype)
 
     # -- vector scatter/gather to the stacked padded layout ---------------
 
@@ -166,10 +267,13 @@ class DistCGSolver:
         interpret = self._interpret
         precise = self.precise_dots
 
-        def dist_spmv(x_loc, ld, lc, gd, gc, sidx, gsrc, gval, scnt, rcnt):
+        local_block = prob.local
+        ghost_block = prob.ghost
+
+        def dist_spmv(x_loc, la, ga, sidx, gsrc, gval, scnt, rcnt):
             """halo(x) || local SpMV, then off-diagonal SpMV -- 3.2's
             overlap pattern, scheduled by XLA instead of streams."""
-            y = _ell_mv(ld, lc, x_loc)
+            y = local_block.shard_mv(la, x_loc)
             if halo.has_ghosts:
                 if comm == "dma":
                     ghost = halo_exchange_dma(x_loc, sidx, gsrc, gval,
@@ -177,25 +281,24 @@ class DistCGSolver:
                                               axis, interpret=interpret)
                 else:
                     ghost = halo_exchange(x_loc, sidx, gsrc, axis)
-                y = y + _ell_mv(gd, gc, ghost)
+                y = y + ghost_block.shard_mv(ga, ghost)
             return y
 
         def psum(v):
             return lax.psum(v, axis)
 
-        def shard_body(ld, lc, gd, gc, sidx, gsrc, gval, scnt, rcnt, b, x0,
+        def shard_body(la, ga, sidx, gsrc, gval, scnt, rcnt, b, x0,
                        tols, maxits, unbounded, needs_diff):
             # shard_map keeps the sharded parts axis as a leading size-1 dim
-            ld, lc, gd, gc, sidx, gsrc, gval, scnt, rcnt, b, x0 = (
-                a[0] for a in (ld, lc, gd, gc, sidx, gsrc, gval, scnt, rcnt,
-                               b, x0))
+            la, ga = (jax.tree.map(lambda a: a[0], t) for t in (la, ga))
+            sidx, gsrc, gval, scnt, rcnt, b, x0 = (
+                a[0] for a in (sidx, gsrc, gval, scnt, rcnt, b, x0))
             maxits = maxits.astype(jnp.int32)
             dtype = b.dtype
             res_atol, res_rtol, diff_atol, diff_rtol = tols
 
             def spmv(x):
-                return dist_spmv(x, ld, lc, gd, gc, sidx, gsrc, gval, scnt,
-                                 rcnt)
+                return dist_spmv(x, la, ga, sidx, gsrc, gval, scnt, rcnt)
 
             if precise:
                 # compensated local dot (ops.precision), hi and lo
@@ -309,23 +412,23 @@ class DistCGSolver:
 
         pspec = P(PARTS_AXIS)
         rspec = P()
-        in_specs = (pspec, pspec, pspec, pspec, pspec, pspec,  # matrix+halo
-                    pspec, pspec, pspec,                       # gval, counts
+        # pspec acts as a pytree prefix for the la/ga tuples
+        in_specs = (pspec, pspec,                              # blocks
+                    pspec, pspec, pspec, pspec, pspec,         # halo, counts
                     pspec, pspec,                              # b, x0
                     rspec, rspec)                              # tols, maxits
         out_specs = (pspec,) + (rspec,) * 7
 
         @functools.partial(jax.jit,
                            static_argnames=("unbounded", "needs_diff"))
-        def program(ld, lc, gd, gc, sidx, gsrc, gval, scnt, rcnt, b, x0,
+        def program(la, ga, sidx, gsrc, gval, scnt, rcnt, b, x0,
                     tols, maxits, unbounded, needs_diff):
             return jax.shard_map(
                 functools.partial(shard_body,
                                   unbounded=unbounded, needs_diff=needs_diff),
                 mesh=self.mesh, in_specs=in_specs, out_specs=out_specs,
                 check_vma=False,
-            )(ld, lc, gd, gc, sidx, gsrc, gval, scnt, rcnt, b, x0, tols,
-              maxits)
+            )(la, ga, sidx, gsrc, gval, scnt, rcnt, b, x0, tols, maxits)
 
         return program
 
@@ -345,10 +448,9 @@ class DistCGSolver:
         x0 = put(prob.scatter(np.asarray(x0))
                  if x0 is not None
                  else np.zeros((prob.nparts, prob.nmax_owned), dtype=dtype))
-        ld = put(prob.local_data)
-        lc = put(prob.local_cols)
-        gd = put(prob.ghost_data)
-        gc = put(prob.ghost_cols)
+        la = jax.tree.map(put, prob.local.arrays)
+        ga = jax.tree.map(put, (prob.ghost.rows, prob.ghost.data,
+                                prob.ghost.cols))
         sidx = put(prob.halo.send_idx)
         gsrc = put(prob.halo.ghost_src)
         gval = put(prob.halo.ghost_valid)
@@ -358,7 +460,7 @@ class DistCGSolver:
         tols = jnp.asarray([crit.residual_atol, crit.residual_rtol,
                             crit.diff_atol, crit.diff_rtol], dtype=dtype)
         kwargs = dict(unbounded=crit.unbounded, needs_diff=crit.needs_diff)
-        args = (ld, lc, gd, gc, sidx, gsrc, gval, scnt, rcnt, b, x0, tols,
+        args = (la, ga, sidx, gsrc, gval, scnt, rcnt, b, x0, tols,
                 jnp.int32(crit.maxits))
         for _ in range(max(warmup, 0)):
             self._program(*args, **kwargs)[0].block_until_ready()

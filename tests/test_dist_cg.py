@@ -135,3 +135,51 @@ def test_dist_cg_stats_report(problem2d):
     text = solver.stats.fwrite()
     assert "total solver time: " in text
     assert solver.stats.ops["allreduce"].n == solver.stats.niterations
+
+
+# -- stacked block formats (DIA local / compact ghost) ----------------------
+
+@pytest.mark.parametrize("pipelined", [False, True])
+def test_dist_cg_band_partition_dia(problem2d, pipelined):
+    """A contiguous band partition of a banded matrix must select the
+    gather-free DIA local format (the fast TPU path, ops/spmv.py) and
+    still match the host solver."""
+    nparts = 4
+    part = partition_rows(problem2d, nparts, seed=0, method="band")
+    prob = DistributedProblem.build(problem2d, part, nparts,
+                                    dtype=jnp.float64)
+    assert prob.local.format == "dia"
+    assert len(prob.local.offsets) <= 5  # 5-point stencil
+    xsol, b = manufactured(problem2d)
+    solver = DistCGSolver(prob, pipelined=pipelined)
+    x = solver.solve(b, criteria=StoppingCriteria(maxits=2000,
+                                                  residual_rtol=1e-10))
+    assert np.linalg.norm(x - xsol) < 1e-6
+
+
+def test_dist_cg_scattered_partition_falls_back_to_ell(problem2d):
+    """A partition with non-contiguous parts cannot stay banded; the
+    builder must fall back to ELL and still solve correctly."""
+    n = problem2d.shape[0]
+    # pathological random scatter (round-robin would still be banded:
+    # stride-4 owned sets keep the +-n stencil neighbours on diagonals)
+    part = np.random.default_rng(0).integers(0, 4, n).astype(np.int32)
+    prob = DistributedProblem.build(problem2d, part, 4, dtype=jnp.float64)
+    assert prob.local.format == "ell"
+    xsol, b = manufactured(problem2d)
+    solver = DistCGSolver(prob)
+    x = solver.solve(b, criteria=StoppingCriteria(maxits=2000,
+                                                  residual_rtol=1e-10))
+    assert np.linalg.norm(x - xsol) < 1e-6
+
+
+def test_dist_ghost_block_is_compact(problem2d):
+    """The ghost block must cover only coupled (border) rows, not all
+    owned rows (the reference's border-rows-only o* block)."""
+    part = partition_rows(problem2d, 4, seed=0, method="band")
+    prob = DistributedProblem.build(problem2d, part, 4, dtype=jnp.float64)
+    nmax_owned = prob.nmax_owned
+    assert prob.ghost.bmax < nmax_owned / 2
+    # padding row indices are out of bounds -> dropped by scatter-add
+    rows = np.asarray(prob.ghost.rows)
+    assert rows.max() <= nmax_owned
