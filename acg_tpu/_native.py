@@ -35,16 +35,33 @@ _FAIL_STAMP = os.path.join(_NATIVE_DIR, ".build_failed")
 
 def _try_build() -> bool:
     """Build once per checkout; a failure stamp prevents every subsequent
-    process from re-running make, and the .so is linked to a temp name and
-    atomically renamed so concurrent importers never dlopen a half-linked
-    file."""
+    process from re-running make, the whole make invocation runs under an
+    exclusive file lock (concurrent first imports would otherwise race on
+    the shared src/*.o targets and could link a corrupted library), and
+    the .so is linked to a temp name and atomically renamed so concurrent
+    importers never dlopen a half-linked file."""
     if not os.path.exists(os.path.join(_NATIVE_DIR, "Makefile")):
         return False
     if os.path.exists(_FAIL_STAMP):
         return False
     tmp = _LIB_PATH + f".build.{os.getpid()}"
+    lock = None
     try:
-        subprocess.run(["make", "-C", _NATIVE_DIR, f"LIB={os.path.basename(tmp)}"],
+        # best effort: a failed lock (non-POSIX, NFS without lockd, ...)
+        # must fall back to an unlocked build, not poison the fail stamp
+        import fcntl
+        lock = open(_LIB_PATH + ".lock", "w")
+        fcntl.flock(lock, fcntl.LOCK_EX)
+    except (ImportError, OSError):
+        pass
+    try:
+        # another process may have finished the build while we waited
+        if os.path.exists(_LIB_PATH):
+            return True
+        if os.path.exists(_FAIL_STAMP):
+            return False
+        subprocess.run(["make", "-C", _NATIVE_DIR,
+                        f"LIB={os.path.basename(tmp)}"],
                        check=True, capture_output=True, timeout=180)
         os.replace(tmp, _LIB_PATH)
         return True
@@ -56,6 +73,11 @@ def _try_build() -> bool:
             pass
         return False
     finally:
+        if lock is not None:
+            try:
+                lock.close()
+            except OSError:
+                pass
         if os.path.exists(tmp):
             try:
                 os.remove(tmp)

@@ -25,6 +25,7 @@ Flag names follow ``cuda/acg-cuda.c:321-377``.  Differences, by design:
 from __future__ import annotations
 
 import argparse
+import re
 import sys
 import time
 
@@ -120,15 +121,19 @@ def _log(args, msg, t0=None):
             sys.stderr.write(msg + "\n")
 
 
+_NUMFMT_RE = re.compile(r"^%[-#0 +]*(?:\d+)?(?:\.\d+)?[eEfFgG]$")
+
+
 def _validate_numfmt(fmt: str) -> str:
-    """The role of the reference's fmtspec parser (``acg/fmtspec.c``):
-    reject formats that are not a single floating-point conversion."""
-    try:
-        _ = fmt % 1.0
-    except (TypeError, ValueError) as e:
-        raise SystemExit(f"acg-tpu: invalid --numfmt {fmt!r}: {e}")
-    if fmt.count("%") != 1:
-        raise SystemExit(f"acg-tpu: invalid --numfmt {fmt!r}: need exactly one conversion")
+    """The role of the reference's fmtspec parser (``fmtspec_parse``,
+    ``acg/fmtspec.c:224``): accept exactly one floating-point printf
+    conversion (%e/%E/%f/%F/%g/%G with optional flags/width/precision).
+    Integer conversions like ``%d`` are rejected -- ``"%d" % 1.5`` is
+    valid Python but silently truncates every solution value."""
+    if not _NUMFMT_RE.match(fmt):
+        raise SystemExit(
+            f"acg-tpu: invalid --numfmt {fmt!r}: need a single "
+            f"floating-point conversion (e.g. %.17g, %e, %12.6f)")
     return fmt
 
 
@@ -214,9 +219,13 @@ def _main(args) -> int:
         if method == "auto":
             # banded matrices keep gather-free DIA local blocks under a
             # contiguous partition; everything else gets edge-cut
-            # minimisation
-            from acg_tpu.ops.spmv import prefers_dia
-            method = "band" if prefers_dia(csr) else "graph"
+            # minimisation.  The O(nnz) probe only matters (and only
+            # runs) when there is something to partition.
+            if nparts > 1:
+                from acg_tpu.ops.spmv import prefers_dia
+                method = "band" if prefers_dia(csr) else "graph"
+            else:
+                method = "graph"
         part = partition_rows(csr, nparts, seed=args.seed, method=method)
     _log(args, f"partition rows into {nparts} parts:", t0)
 
@@ -257,12 +266,21 @@ def _main(args) -> int:
         jax.profiler.start_trace(args.trace)
     try:
         if args.solver == "host":
-            solver = HostCGSolver(csr)
+            if nparts > 1 and comm != "none":
+                # the acgsolver_solvempi analog (cg.c:408): same
+                # partitioned layout as the device path, pure host
+                from acg_tpu.graph import partition_matrix as _pm
+                from acg_tpu.solvers.host_cg import HostDistCGSolver
+                solver = HostDistCGSolver(_pm(csr, part, nparts))
+            else:
+                solver = HostCGSolver(csr)
             x = solver.solve(b, x0=x0, criteria=criteria)
         elif args.solver == "petsc":
-            raise SystemExit("acg-tpu: --solver petsc: PETSc is not available "
-                             "in this build; use --solver host as the "
-                             "reference baseline")
+            # external cross-implementation oracle (the KSPCG role,
+            # cgpetsc.c:181) backed by scipy.sparse.linalg.cg
+            from acg_tpu.solvers.petsc_cg import PetscBaselineSolver
+            solver = PetscBaselineSolver(csr, pipelined=pipelined)
+            x = solver.solve(b, x0=x0, criteria=criteria)
         elif comm == "none" or nparts == 1:
             dev = device_matrix_from_csr(csr, dtype=dtype)
             solver = JaxCGSolver(dev, pipelined=pipelined,

@@ -406,6 +406,8 @@ class DistCGSolver:
                 x, r_fin = state[0], state[1]
                 dxsqr = state[8] if needs_diff else inf
                 rnrm2 = jnp.sqrt(pdot(r_fin, r_fin))
+                # stale-test consistency: see jax_cg._cg_pipelined_program
+                done = jnp.logical_or(done, rnrm2 <= res_tol)
 
             dxnrm2 = jnp.sqrt(dxsqr)
             return x[None], k, rnrm2, r0nrm2, bnrm2, x0nrm2, dxnrm2, done

@@ -1,2 +1,2 @@
 from acg_tpu.solvers.stats import SolverStats, StoppingCriteria  # noqa: F401
-from acg_tpu.solvers.host_cg import HostCGSolver  # noqa: F401
+from acg_tpu.solvers.host_cg import HostCGSolver, HostDistCGSolver  # noqa: F401

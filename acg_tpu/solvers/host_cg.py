@@ -133,3 +133,99 @@ class HostCGSolver:
         if crit.diff_rtol > 0 and st.dxnrm2 < crit.diff_rtol * max(st.x0nrm2, 1e-300):
             return True
         return False
+
+
+class HostDistCGSolver:
+    """Distributed host CG over subdomains (``acgsolver_solvempi``,
+    ``cg.c:408``), single-controller: per-part ghost-aware
+    :class:`~acg_tpu.vector.PVector` BLAS-1 with reductions summed across
+    parts (the ``MPI_Allreduce`` role) and halo exchange through
+    :func:`~acg_tpu.graph.halo_exchange_host`.  The host-side oracle for
+    the device :class:`~acg_tpu.parallel.dist.DistCGSolver` -- same data
+    layout, no device, no XLA.
+    """
+
+    def __init__(self, subs):
+        from acg_tpu.graph import Subdomain  # noqa: F401 (doc reference)
+        self.subs = subs
+        self.n = sum(s.nowned for s in subs)
+        self.nnz_total = sum(int(s.A_local.nnz + s.A_ghost.nnz) for s in subs)
+        self.stats = SolverStats(unknowns=self.n)
+
+    def _spmv(self, ps):
+        """Distributed SpMV: halo(p) then local + off-diagonal blocks
+        (``acgsymcsrmatrix_dsymvmpi``, ``symcsrmatrix.c:1353-1397``)."""
+        from acg_tpu.graph import dsymv_dist_host
+        return dsymv_dist_host(self.subs, [p.data for p in ps])
+
+    def solve(self, b_global: np.ndarray, x0: np.ndarray | None = None,
+              criteria: StoppingCriteria | None = None,
+              raise_on_divergence: bool = True) -> np.ndarray:
+        from acg_tpu.graph import gather_vector, scatter_vector
+        from acg_tpu.vector import PVector
+
+        crit = criteria or StoppingCriteria()
+        st = self.stats
+        st.criteria = crit
+        subs = self.subs
+        b_global = np.asarray(b_global, dtype=np.float64)
+
+        def pvecs(global_vec):
+            return [PVector(v, s.nghost) for s, v in
+                    zip(subs, scatter_vector(subs, global_vec))]
+
+        def gdot(us, vs):
+            return float(sum(u.dot(v) for u, v in zip(us, vs)))
+
+        bs = pvecs(b_global)
+        xs = pvecs(np.asarray(x0, dtype=np.float64) if x0 is not None
+                   else np.zeros(self.n))
+
+        tstart = time.perf_counter()
+        st.bnrm2 = float(np.sqrt(gdot(bs, bs)))
+        st.x0nrm2 = float(np.sqrt(gdot(xs, xs)))
+        ts = self._spmv(xs)
+        rs = [PVector(b.owned - t, 0) for b, t in zip(bs, ts)]
+        ps = [PVector(np.concatenate([r.owned, np.zeros(s.nghost)]), s.nghost)
+              for r, s in zip(rs, subs)]
+        gamma = gdot(rs, rs)
+        st.r0nrm2 = st.rnrm2 = float(np.sqrt(gamma))
+        st.dxnrm2 = np.inf
+        res_tol = max(crit.residual_atol, crit.residual_rtol * st.r0nrm2)
+        st.niterations = 0
+        st.nsolves += 1
+        converged = (not crit.unbounded) and HostCGSolver._test(
+            crit, st, res_tol)
+        k = 0
+        while not converged and k < crit.maxits:
+            ts = self._spmv(ps)
+            tvs = [PVector(t, 0) for t in ts]
+            pdott = float(sum(np.dot(p.owned, t) for p, t in zip(ps, ts)))
+            alpha = gamma / pdott
+            if crit.needs_diff:
+                st.dxnrm2 = abs(alpha) * float(
+                    np.sqrt(gdot(ps, ps)))
+            for x, r, p, t in zip(xs, rs, ps, tvs):
+                x.axpy(alpha, p)
+                r.axpy(-alpha, t)
+            gamma_next = gdot(rs, rs)
+            beta = gamma_next / gamma
+            gamma = gamma_next
+            for p, r in zip(ps, rs):
+                p.aypx(beta, r)
+            k += 1
+            st.niterations = k
+            st.ntotaliterations += 1
+            st.rnrm2 = float(np.sqrt(gamma))
+            if not crit.unbounded:
+                converged = HostCGSolver._test(crit, st, res_tol)
+
+        st.tsolve += time.perf_counter() - tstart
+        st.converged = converged or crit.unbounded
+        st.nflops += (3.0 * self.nnz_total + 10.0 * self.n) * max(k, 1)
+        x = gather_vector(subs, [x.data for x in xs], self.n)
+        st.fexcept_arrays = [x]
+        if not st.converged and raise_on_divergence:
+            raise NotConvergedError(
+                f"{k} iterations, residual {st.rnrm2:.3e} > {res_tol:.3e}")
+        return x

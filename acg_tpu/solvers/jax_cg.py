@@ -221,6 +221,10 @@ def _cg_pipelined_program(A: DeviceMatrix, b, x0, res_atol, res_rtol,
     x, r = state[0], state[1]
     dxsqr = state[8] if needs_diff else inf
     rnrm2 = jnp.linalg.norm(r)
+    # the in-loop test is one iteration stale; at the maxits boundary a
+    # solve whose final *fresh* residual meets tolerance must not report
+    # converged=False with a below-tolerance rnrm2 in the same stats block
+    done = jnp.logical_or(done, rnrm2 <= res_tol)
     return CGResult(x=x, niterations=k, rnrm2=rnrm2, r0nrm2=r0nrm2,
                     bnrm2=bnrm2, x0nrm2=x0nrm2, dxnrm2=jnp.sqrt(dxsqr),
                     converged=done)

@@ -60,9 +60,15 @@ class RefinedSolver:
 
         if warmup > 0:
             # compile/warm the inner program outside the timed region
-            # (the direct solvers exclude warmup from tsolve the same way)
+            # (the direct solvers exclude warmup from tsolve the same way).
+            # The warmup criteria must carry a residual tolerance: the real
+            # inner passes use residual_rtol > 0 (unbounded=False), and
+            # `unbounded` is a jit static argname, so an all-zero-tolerance
+            # warmup would compile a *different* program variant and the
+            # first timed pass would recompile inside the timed region.
             self.inner.solve(b.astype(np.float64), x0=None,
-                             criteria=StoppingCriteria(maxits=1),
+                             criteria=StoppingCriteria(
+                                 maxits=1, residual_rtol=self.inner_rtol),
                              raise_on_divergence=False, warmup=warmup - 1)
             warmup = 0
         t0 = time.perf_counter()

@@ -40,7 +40,9 @@ class PVector:
         """View of the non-ghost entries (reductions operate on this)."""
         return self.data[: self.num_owned]
 
-    # BLAS-1, ghost-aware (cf. vector.h:335-415)
+    # BLAS-1, ghost-aware (cf. vector.h:335-415).  Updates write through
+    # the owned view with explicit ``out=`` (augmented assignment on the
+    # ``owned`` property would try to rebind it).
     def dot(self, other: "PVector") -> float:
         return float(np.dot(self.owned, other.owned))
 
@@ -48,15 +50,18 @@ class PVector:
         return float(np.linalg.norm(self.owned))
 
     def axpy(self, alpha: float, x: "PVector") -> None:
-        self.owned += alpha * x.owned
+        owned = self.owned
+        np.add(owned, alpha * x.owned, out=owned)
 
     def aypx(self, alpha: float, x: "PVector") -> None:
         """y = alpha*y + x (the reference's ``daypx``)."""
-        np.multiply(self.owned, alpha, out=self.owned)
-        self.owned += x.owned
+        owned = self.owned
+        np.multiply(owned, alpha, out=owned)
+        np.add(owned, x.owned, out=owned)
 
     def scal(self, alpha: float) -> None:
-        self.owned *= alpha
+        owned = self.owned
+        np.multiply(owned, alpha, out=owned)
 
     def copy_from(self, x: "PVector") -> None:
         np.copyto(self.data, x.data)

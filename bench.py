@@ -1,72 +1,196 @@
-"""Benchmark harness: CG iterations/second on the reference workload.
+"""Benchmark harness: CG iterations/second on the reference workloads.
 
-Protocol (BASELINE.md, from the reference's scripts): 2D Poisson 5-point,
-n=2048 (N=4,194,304 unknowns, ~2.09e7 stored nonzeros), classic CG,
-1000 iterations, warmup before timing, metric = iterations/second
-("total solver time" for a fixed iteration count).  Runs on whatever
-accelerator JAX exposes (one TPU chip under the driver).
+Protocol (BASELINE.md, from the reference's scripts): Poisson stencil
+matrices, fixed 1000-iteration CG solves, warmup before timing, metric =
+iterations/second ("total solver time" for a fixed iteration count).
+Runs on whatever accelerator JAX exposes (one TPU chip under the driver).
 
-Prints ONE JSON line:
+Default mode prints ONE JSON line for the flagship config (2D Poisson
+n=2048, N=4,194,304, classic CG, f32):
   {"metric": ..., "value": N, "unit": "iters/s", "vs_baseline": N}
+
+``--full`` runs the BASELINE ladder (classic + pipelined x 2D n=2048 /
+3D 128^3 / 3D 256^3, plus the distributed program at nparts=1 to bound
+sharding overhead) and prints one JSON line per row.
+
+``--sweep-np`` runs the multi-chip CPU-mesh correctness sweep
+(np=1,2,4,8, the reference's single-node scaling protocol,
+``scripts/nccl_combined.sh:41-176``): iterations-to-rtol must stay
+nearly flat across mesh sizes.  Re-executes itself on a provisioned
+virtual CPU mesh, so it works from any platform.
 
 ``vs_baseline`` divides by an analytic roofline for one H100 running the
 reference's CUDA solver on the same workload (HBM-bound: ~600 MB of
-traffic per iteration at 3.35 TB/s with ~80% efficiency -> ~4500 iters/s).
-The reference repo publishes no measured numbers (BASELINE.md); this
+traffic per iteration at 3.35 TB/s with ~80% efficiency -> ~4500 iters/s
+for the flagship; scaled by bytes/iter for the other configs).  The
+reference repo publishes no measured numbers (BASELINE.md); this
 analytic stand-in is documented there and replaced when measured numbers
 exist.
 """
 
 from __future__ import annotations
 
+import argparse
 import json
+import os
 import sys
 import time
 
-N_SIDE = 2048
 MAXITS = 1000
 WARMUP_ITS = 50
 
-# Analytic H100 baseline for vs_baseline (see module docstring / BASELINE.md)
+# Analytic H100 baseline, flagship config (see module docstring/BASELINE.md)
 H100_BASELINE_ITERS_PER_SEC = 4500.0
+# flagship bytes/iteration (~600 MB) for scaling the stand-in to other sizes
+_FLAGSHIP_BYTES_PER_ITER = 600e6
 
 
-def main() -> int:
-    import jax
-    import jax.numpy as jnp
+def _h100_standin(bytes_per_iter: float) -> float:
+    """HBM-roofline iters/s estimate for the reference on one H100."""
+    return H100_BASELINE_ITERS_PER_SEC * _FLAGSHIP_BYTES_PER_ITER / bytes_per_iter
 
-    from acg_tpu.io.generators import poisson2d_coo
-    from acg_tpu.ops.spmv import device_matrix_from_csr
+
+def _build(side: int, dim: int):
+    from acg_tpu.io.generators import poisson2d_coo, poisson3d_coo
     from acg_tpu.matrix import SymCsrMatrix
-    from acg_tpu.solvers.jax_cg import JaxCGSolver
+
+    r, c, v, N = (poisson2d_coo if dim == 2 else poisson3d_coo)(side)
+    return SymCsrMatrix.from_coo(N, r, c, v).to_csr()
+
+
+def _bytes_per_iter(csr) -> float:
+    """Analytic HBM traffic per classic-CG iteration, f32 + int32 idx
+    (same accounting as the reference's GB/s printout,
+    ``cgcuda.c:1942-1957``): SpMV reads vals+cols+x and writes y; dots,
+    axpys and the residual update stream ~10 vector passes."""
+    n = csr.shape[0]
+    return csr.nnz * 8.0 + 10.0 * 4.0 * n
+
+
+def _time_solver(solver, b, criteria_cls):
+    solver.solve(b, criteria=criteria_cls(maxits=WARMUP_ITS))
+    solver.stats.tsolve = 0.0
+    solver.solve(b, criteria=criteria_cls(maxits=MAXITS))
+    return solver.stats.tsolve
+
+
+def run_case(csr, name: str, pipelined: bool, dist: bool = False) -> dict:
+    import jax.numpy as jnp
+    import numpy as np
+
     from acg_tpu.solvers.stats import StoppingCriteria
 
-    t0 = time.perf_counter()
-    r, c, v, N = poisson2d_coo(N_SIDE)
-    csr = SymCsrMatrix.from_coo(N, r, c, v).to_csr()
-    A = device_matrix_from_csr(csr, dtype=jnp.float32)  # DIA for stencils
-    print(f"# setup: N={N} nnz={csr.nnz} in {time.perf_counter() - t0:.1f}s "
-          f"on {jax.devices()[0].platform}", file=sys.stderr)
+    b = np.ones(csr.shape[0], dtype=np.float32)
+    if dist:
+        from acg_tpu.parallel.dist import DistCGSolver, DistributedProblem
+        from acg_tpu.partition import partition_rows
 
-    solver = JaxCGSolver(A)
-    b = jnp.ones(N, dtype=jnp.float32)
-    # warmup: compile + a short run (the reference warms up every op class)
-    solver.solve(b, criteria=StoppingCriteria(maxits=WARMUP_ITS))
-    solver.stats.tsolve = 0.0
+        part = partition_rows(csr, 1, seed=0)
+        prob = DistributedProblem.build(csr, part, 1, dtype=jnp.float32)
+        solver = DistCGSolver(prob, pipelined=pipelined)
+    else:
+        from acg_tpu.ops.spmv import device_matrix_from_csr
+        from acg_tpu.solvers.jax_cg import JaxCGSolver
 
-    solver.solve(b, criteria=StoppingCriteria(maxits=MAXITS))
-    tsolve = solver.stats.tsolve
+        A = device_matrix_from_csr(csr, dtype=jnp.float32)
+        solver = JaxCGSolver(A, pipelined=pipelined)
+    tsolve = _time_solver(solver, b, StoppingCriteria)
     iters_per_sec = MAXITS / tsolve
-    print(f"# total solver time: {tsolve:.6f} seconds "
+    standin = _h100_standin(_bytes_per_iter(csr))
+    print(f"# {name}: total solver time: {tsolve:.6f} seconds "
           f"({solver.stats.nflops * 1e-9 / tsolve:.1f} Gflop/s)",
           file=sys.stderr)
-
-    print(json.dumps({
-        "metric": "cg_iters_per_sec_poisson2d_n2048_f32",
+    return {
+        "metric": name,
         "value": round(iters_per_sec, 2),
         "unit": "iters/s",
-        "vs_baseline": round(iters_per_sec / H100_BASELINE_ITERS_PER_SEC, 4),
-    }))
+        "vs_baseline": round(iters_per_sec / standin, 4),
+    }
+
+
+def sweep_np(out=sys.stdout) -> int:
+    """Multi-chip correctness sweep on the virtual CPU mesh: iterations to
+    residual_rtol=1e-6 at np=1,2,4,8 (should be nearly flat -- CG
+    iteration count is partition-invariant up to rounding)."""
+    from acg_tpu._platform import provision_host_mesh
+
+    jax = provision_host_mesh(8)
+    jax.config.update("jax_enable_x64", True)
+    if len(jax.devices()) < 8:
+        env = dict(os.environ)
+        env["JAX_PLATFORMS"] = "cpu"
+        env.setdefault("XLA_FLAGS", "--xla_force_host_platform_device_count=8")
+        import subprocess
+        return subprocess.run(
+            [sys.executable, os.path.abspath(__file__), "--sweep-np"],
+            env=env).returncode
+
+    import jax.numpy as jnp
+    import numpy as np
+
+    from acg_tpu.parallel.dist import DistCGSolver, DistributedProblem
+    from acg_tpu.partition import partition_rows
+    from acg_tpu.solvers.stats import StoppingCriteria
+
+    csr = _build(256, 2)  # N=65,536: big enough to partition meaningfully
+    rng = np.random.default_rng(0)
+    xsol = rng.standard_normal(csr.shape[0])
+    xsol /= np.linalg.norm(xsol)
+    b = csr @ xsol
+    rows = []
+    for nparts in (1, 2, 4, 8):
+        part = partition_rows(csr, nparts, seed=0, method="band")
+        prob = DistributedProblem.build(csr, part, nparts, dtype=jnp.float64)
+        solver = DistCGSolver(prob, pipelined=False)
+        x = solver.solve(b, criteria=StoppingCriteria(
+            maxits=5000, residual_rtol=1e-6))
+        err = float(np.linalg.norm(x - xsol))
+        rows.append({"np": nparts, "iterations": solver.stats.niterations,
+                     "error_2norm": err, "local_format": prob.local.format})
+        print(f"# np={nparts}: {solver.stats.niterations} iterations, "
+              f"error {err:.3e} ({prob.local.format})", file=sys.stderr)
+    iters = [r["iterations"] for r in rows]
+    flat = max(iters) - min(iters) <= max(2, int(0.02 * max(iters)))
+    print(json.dumps({"metric": "dist_cg_iters_to_rtol1e-6_np_sweep",
+                      "rows": rows, "flat": flat}), file=out)
+    return 0 if flat else 1
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--full", action="store_true",
+                    help="run the whole BASELINE ladder (one JSON line/row)")
+    ap.add_argument("--sweep-np", action="store_true",
+                    help="multi-chip CPU-mesh correctness sweep")
+    args = ap.parse_args(argv)
+
+    if args.sweep_np:
+        return sweep_np()
+
+    import jax
+
+    cases = [("cg_iters_per_sec_poisson2d_n2048_f32", 2048, 2, False, False)]
+    if args.full:
+        cases += [
+            ("cg_pipelined_iters_per_sec_poisson2d_n2048_f32", 2048, 2, True, False),
+            ("cg_iters_per_sec_poisson3d_n128_f32", 128, 3, False, False),
+            ("cg_pipelined_iters_per_sec_poisson3d_n128_f32", 128, 3, True, False),
+            ("cg_iters_per_sec_poisson3d_n256_f32", 256, 3, False, False),
+            ("cg_dist1_iters_per_sec_poisson2d_n2048_f32", 2048, 2, False, True),
+        ]
+
+    built: dict[tuple, object] = {}
+    for name, side, dim, pipelined, dist in cases:
+        key = (side, dim)
+        if key not in built:
+            t0 = time.perf_counter()
+            built[key] = _build(side, dim)
+            csr = built[key]
+            print(f"# setup: {dim}D n={side} N={csr.shape[0]} nnz={csr.nnz} "
+                  f"in {time.perf_counter() - t0:.1f}s on "
+                  f"{jax.devices()[0].platform}", file=sys.stderr)
+        print(json.dumps(run_case(built[key], name, pipelined, dist)))
+        sys.stdout.flush()
     return 0
 
 

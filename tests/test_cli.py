@@ -120,3 +120,32 @@ def test_cli_comm_aliases(matrix_file):
                  "--max-iterations", "300", "--residual-rtol", "1e-6",
                  "--warmup", "0", "--quiet"])
     assert r.returncode == 0, r.stderr
+
+
+def test_numfmt_rejects_non_float_conversions():
+    from acg_tpu.cli import _validate_numfmt
+    import pytest as _pytest
+    for bad in ("%d", "%s", "%i", "%x", "%.17g %g", "g", "%", "%.g"):
+        with _pytest.raises(SystemExit):
+            _validate_numfmt(bad)
+    for good in ("%.17g", "%e", "%12.6f", "%+G", "%#.3E", "%-8.2f"):
+        assert _validate_numfmt(good) == good
+
+
+def test_cli_bf16_smoke(matrix_file):
+    """--dtype bf16 must run end-to-end; accuracy is limited (~2-3
+    digits) so only loose convergence is asserted."""
+    r = run_cli("acg_tpu.cli", [str(matrix_file), "--dtype", "bf16",
+                                "--comm", "none",
+                                "--max-iterations", "800",
+                                "--residual-rtol", "1e-2",
+                                "--warmup", "0", "--quiet"])
+    assert r.returncode == 0, r.stderr
+    assert "total solver time" in r.stderr
+
+
+def test_cli_rejects_integer_numfmt(matrix_file):
+    r = run_cli("acg_tpu.cli", [str(matrix_file), "--numfmt", "%d",
+                                "--comm", "none", "--max-iterations", "5"])
+    assert r.returncode != 0
+    assert "numfmt" in r.stderr
