@@ -87,6 +87,15 @@ def make_parser() -> argparse.ArgumentParser:
                         "to stdout as Matrix Market")
     p.add_argument("--dtype", default="f64", choices=["f64", "f32", "bf16"],
                    help="device arithmetic precision (default: f64)")
+    p.add_argument("--kernels", default="auto",
+                   choices=["auto", "xla", "pallas"],
+                   help="single-device hot-loop kernel tier: xla = "
+                        "compiler-fused ops, pallas = hand-written "
+                        "single-x-pass DIA SpMV (the reference's "
+                        "cg-kernels-cuda.cu tier; vector updates stay in "
+                        "XLA -- see BASELINE.md); auto picks pallas on TPU "
+                        "hardware for DIA matrices; ignored on the "
+                        "multi-part path")
     p.add_argument("--precise-dots", action="store_true",
                    help="compensated (double-float) dot products for the "
                         "CG scalars; lets f32 storage converge past the "
@@ -284,7 +293,8 @@ def _main(args) -> int:
         elif comm == "none" or nparts == 1:
             dev = device_matrix_from_csr(csr, dtype=dtype)
             solver = JaxCGSolver(dev, pipelined=pipelined,
-                                 precise_dots=args.precise_dots)
+                                 precise_dots=args.precise_dots,
+                                 kernels=args.kernels)
             if args.refine:
                 solver = RefinedSolver(solver, csr,
                                        inner_rtol=args.refine_rtol)

@@ -1,0 +1,239 @@
+"""Hand-written Pallas TPU kernels for the CG hot loop.
+
+The reference's device-kernel tier (``acg/cg-kernels-cuda.cu``): merge-CSR
+SpMV (``:340-441``), fused BLAS-1 with device scalars (``:78-303``), and
+the 6-vector pipelined update (``:187-269``).  On TPU the XLA compiler
+already fuses elementwise chains well, so each kernel here exists to beat
+a *specific* HBM-traffic bound the fusion cannot reach:
+
+* :func:`dia_spmv` -- DIA SpMV with a single pass over ``x``: the XLA
+  formulation (``ops/spmv.py:dia_mv``) reads one shifted copy of ``x``
+  per diagonal (D+1 vector reads + 1 write for D diagonals); this kernel
+  DMAs each x tile (plus band halo) into VMEM once and applies all D
+  statically-shifted multiplies from VMEM, for D/2+2-ish units of HBM
+  traffic -- the same traffic argument as the reference's merge-CSR
+  kernel, restated for a vector architecture.
+* :func:`fused_pipelined_update` -- the Ghysels-Vanroose 6-vector update
+  (z,t,p,x,r,w) in one pass with alpha/beta in SMEM, the analog of
+  ``acgsolvercuda_pipelined_update_kernel`` (``cg-kernels-cuda.cu:
+  187-269``).
+
+Both run in interpret mode on CPU (tests) and compiled on TPU.  Whether
+they actually beat XLA fusion is *measured* (``scripts/bench_pallas.py``,
+BASELINE.md) -- the solvers select per measurement via
+``kernels="pallas"``.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+# row-tile length for the SpMV kernel; multiple of the f32 (8,128) tile
+TILE = 16384
+LANE = 128
+
+
+def _pad_to(x, m):
+    r = (-x.shape[0]) % m
+    return jnp.pad(x, (0, r)) if r else x
+
+
+@functools.partial(jax.jit, static_argnames=("offsets", "interpret"))
+def dia_spmv(planes, offsets: tuple, x, interpret: bool = False):
+    """y = A @ x for DIA ``planes`` (tuple of (n,) arrays, one per static
+    diagonal offset), reading ``x`` through VMEM once per row tile.
+
+    Equivalent to :func:`acg_tpu.ops.spmv.dia_mv` with x-length == n
+    (square blocks); see that function for the semantics.
+
+    Fast path (n divisible by the row tile, band within one tile): each
+    tile issues three static-size DMAs straight out of x -- body, left
+    halo, right halo -- with edge tiles zero-filling the out-of-range
+    halo instead of reading it, so no padded copy of x is ever
+    materialised.  Out-of-range x positions only ever multiply plane
+    entries that are structurally zero (no matrix entry has a column off
+    the end), so the zero fill is correctness-neutral; it exists to keep
+    NaN-free garbage out of uninitialised VMEM.  Ragged shapes take a
+    jnp.pad fallback.
+    """
+    n = x.shape[0]
+    L = max(0, -min(offsets))
+    R = max(0, max(offsets))
+    # Mosaic must prove DMA slice offsets divisible by the flattened
+    # (sublane x lane) tile; round the halo sizes up to that quantum so
+    # every HBM/VMEM DMA offset is a multiple of it
+    align = {4: 1024, 2: 2048}.get(jnp.dtype(x.dtype).itemsize)
+    if align is not None:
+        Lpad = L + (-L) % align
+        Rpad = R + (-R) % align
+        band = max(Lpad, Rpad)
+        tile = TILE
+        while tile < band:
+            tile *= 2
+        if n % tile == 0 and n >= tile:
+            return _dia_spmv_fast(planes, offsets, x, Lpad, Rpad, tile,
+                                  align, interpret)
+    return _dia_spmv_padded(planes, offsets, x, L, R, interpret)
+
+
+def _dia_spmv_fast(planes, offsets, x, Lpad, Rpad, tile, align, interpret):
+    n = x.shape[0]
+    grid = n // tile
+    win = tile + Lpad + Rpad
+
+    def kernel(x_hbm, *plane_refs_and_out):
+        plane_refs = plane_refs_and_out[:-1]
+        y_ref = plane_refs_and_out[-1]
+        i = pl.program_id(0)
+
+        def body(xwin, sems):
+            # `align` is the dtype's flattened (sublane x lane) quantum;
+            # it divides tile, Lpad and Rpad by construction, so every
+            # hinted offset below really is a multiple of it
+            body_cp = pltpu.make_async_copy(
+                x_hbm.at[pl.ds(pl.multiple_of(i * tile, align), tile)],
+                xwin.at[pl.ds(Lpad, tile)], sems.at[0])
+            body_cp.start()
+            if Lpad:
+                @pl.when(i > 0)
+                def _():
+                    cp = pltpu.make_async_copy(
+                        x_hbm.at[pl.ds(pl.multiple_of(i * tile - Lpad, align),
+                                       Lpad)],
+                        xwin.at[pl.ds(0, Lpad)], sems.at[1])
+                    cp.start()
+                    cp.wait()
+
+                @pl.when(i == 0)
+                def _():
+                    xwin[pl.ds(0, Lpad)] = jnp.zeros((Lpad,), x.dtype)
+            if Rpad:
+                @pl.when(i < grid - 1)
+                def _():
+                    cp = pltpu.make_async_copy(
+                        x_hbm.at[pl.ds(pl.multiple_of((i + 1) * tile, align),
+                                       Rpad)],
+                        xwin.at[pl.ds(Lpad + tile, Rpad)], sems.at[2])
+                    cp.start()
+                    cp.wait()
+
+                @pl.when(i == grid - 1)
+                def _():
+                    xwin[pl.ds(Lpad + tile, Rpad)] = jnp.zeros((Rpad,),
+                                                               x.dtype)
+            body_cp.wait()
+            acc = jnp.zeros((tile,), x.dtype)
+            for pr, off in zip(plane_refs, offsets):
+                acc = acc + pr[:] * xwin[pl.ds(Lpad + off, tile)]
+            y_ref[:] = acc
+
+        pl.run_scoped(body, pltpu.VMEM((win,), x.dtype),
+                      pltpu.SemaphoreType.DMA((3,)))
+
+    return pl.pallas_call(
+        kernel,
+        grid=(grid,),
+        in_specs=[pl.BlockSpec(memory_space=pl.ANY)] + [
+            pl.BlockSpec((tile,), lambda i: (i,), memory_space=pltpu.VMEM)
+            for _ in planes],
+        out_specs=pl.BlockSpec((tile,), lambda i: (i,),
+                               memory_space=pltpu.VMEM),
+        out_shape=jax.ShapeDtypeStruct((n,), x.dtype),
+        interpret=interpret,
+    )(x, *planes)
+
+
+def _dia_spmv_padded(planes, offsets, x, L, R, interpret):
+    """Ragged-shape fallback: one padded x copy, one DMA per tile."""
+    n = x.shape[0]
+    tile = TILE if n >= TILE else (n + (-n) % LANE)
+    planes = tuple(_pad_to(p, tile) for p in planes)
+    npad = planes[0].shape[0]
+    grid = npad // tile
+    win = tile + L + R
+    win = win + (-win) % 4096  # DMA-offset alignment, any dtype
+    # sized so the last tile's window slice stays in range
+    xp = jnp.pad(x, (L, (grid - 1) * tile + win - L - n))
+
+    def kernel(xp_ref, *plane_refs_and_out):
+        plane_refs = plane_refs_and_out[:-1]
+        y_ref = plane_refs_and_out[-1]
+        i = pl.program_id(0)
+
+        def body(xwin, sem):
+            cp = pltpu.make_async_copy(
+                xp_ref.at[pl.ds(i * tile, win)], xwin, sem)
+            cp.start()
+            cp.wait()
+            acc = jnp.zeros((tile,), planes[0].dtype)
+            for pr, off in zip(plane_refs, offsets):
+                acc = acc + pr[:] * xwin[pl.ds(L + off, tile)]
+            y_ref[:] = acc
+
+        pl.run_scoped(body, pltpu.VMEM((win,), x.dtype),
+                      pltpu.SemaphoreType.DMA)
+
+    y = pl.pallas_call(
+        kernel,
+        grid=(grid,),
+        in_specs=[pl.BlockSpec(memory_space=pl.ANY)] + [
+            pl.BlockSpec((tile,), lambda i: (i,), memory_space=pltpu.VMEM)
+            for _ in planes],
+        out_specs=pl.BlockSpec((tile,), lambda i: (i,),
+                               memory_space=pltpu.VMEM),
+        out_shape=jax.ShapeDtypeStruct((npad,), x.dtype),
+        interpret=interpret,
+    )(xp, *planes)
+    return y[:n]
+
+
+@functools.partial(jax.jit, static_argnames=("interpret",))
+def fused_pipelined_update(x, r, w, p, t, z, q, alpha, beta,
+                           interpret: bool = False):
+    """One-pass Ghysels-Vanroose update (``cg-kernels-cuda.cu:187-269``):
+
+        z = q + beta z;  t = w + beta t;  p = r + beta p
+        x = x + alpha p; r = r - alpha t; w = w - alpha z
+
+    Returns (x, r, w, p, t, z).  alpha/beta ride in SMEM (the reference
+    reads them from device memory to avoid host syncs; same idea).
+    """
+    n = x.shape[0]
+    ab = jnp.stack([alpha.astype(x.dtype), beta.astype(x.dtype)]).reshape(1, 2)
+    vecs = [_pad_to(v, TILE) for v in (x, r, w, p, t, z, q)]
+    npad = vecs[0].shape[0]
+    grid = npad // TILE
+
+    def kernel(ab_ref, x_ref, r_ref, w_ref, p_ref, t_ref, z_ref, q_ref,
+               xo, ro, wo, po, to, zo):
+        a = ab_ref[0, 0]
+        b = ab_ref[0, 1]
+        zn = q_ref[:] + b * z_ref[:]
+        tn = w_ref[:] + b * t_ref[:]
+        pn = r_ref[:] + b * p_ref[:]
+        xo[:] = x_ref[:] + a * pn
+        ro[:] = r_ref[:] - a * tn
+        wo[:] = w_ref[:] - a * zn
+        po[:] = pn
+        to[:] = tn
+        zo[:] = zn
+
+    tile_spec = pl.BlockSpec((TILE,), lambda i: (i,),
+                             memory_space=pltpu.VMEM)
+    outs = pl.pallas_call(
+        kernel,
+        grid=(grid,),
+        in_specs=[pl.BlockSpec((1, 2), lambda i: (0, 0),
+                               memory_space=pltpu.SMEM)] + [tile_spec] * 7,
+        out_specs=(tile_spec,) * 6,
+        out_shape=tuple(jax.ShapeDtypeStruct((npad,), x.dtype)
+                        for _ in range(6)),
+        interpret=interpret,
+    )(ab, *vecs)
+    return tuple(o[:n] for o in outs)

@@ -8,9 +8,7 @@ Two formats are provided:
 
 * :class:`EllMatrix` -- ELLPACK: row-padded (n, K) value/column planes.
   For stencil-like matrices (Poisson: K<=5 in 2D, K<=7 in 3D) padding waste
-  is tiny and SpMV becomes K fused gather-multiply-accumulates, which XLA
-  vectorises well on the VPU; a Pallas kernel (acg_tpu.ops.pallas_kernels)
-  covers the HBM-bound case.
+  is tiny and SpMV becomes K fused gather-multiply-accumulates.
 * :class:`CooMatrix` -- sorted COO + segment-sum: the general fallback for
   matrices with skewed row lengths where ELL padding would blow up memory.
 * :class:`DiaMatrix` -- diagonal storage: y = sum_d data[d] * shift(x, d)
@@ -18,7 +16,10 @@ Two formats are provided:
   or anything after RCM reordering) SpMV becomes pure VPU multiply-adds on
   statically-sliced vectors -- NO gathers at all.  Measured on TPU this is
   ~30x faster than the ELL gather path on poisson2d n=2048; XLA gathers
-  with arbitrary indices do not vectorise on TPU.
+  with arbitrary indices do not vectorise on TPU.  A hand-written Pallas
+  kernel (:func:`acg_tpu.ops.pallas_kernels.dia_spmv`) shaves a further
+  ~1.2x off the DIA path on TPU by reading x through VMEM once instead of
+  once per diagonal (solver flag ``kernels="pallas"``).
 
 Format choice is automatic in :func:`device_matrix_from_csr` from the
 sparsity structure (diagonal count, then row-length histogram), computed at

@@ -18,7 +18,8 @@ Mapping of the reference's mechanisms:
     (src, dst) window is padded to the mesh-wide maximum count.
 
 A Pallas remote-DMA transport (the device-initiated put-with-signal analog)
-lives in ``acg_tpu.ops.pallas_kernels`` and is selected by ``--comm dma``.
+lives in ``acg_tpu.parallel.halo_dma`` and is selected by ``--comm dma``;
+the hand-written compute kernels live in ``acg_tpu.ops.pallas_kernels``.
 """
 
 from __future__ import annotations

@@ -27,16 +27,22 @@ from acg_tpu.matrix import SymCsrMatrix
 from acg_tpu.solvers.stats import SolverStats, StoppingCriteria
 
 
+def as_csr(A: SymCsrMatrix | sp.spmatrix, epsilon: float = 0.0) -> sp.csr_matrix:
+    """Normalise a solver matrix argument to scipy CSR with the
+    ``--epsilon`` diagonal shift applied (``symcsrmatrix.c:760-862``)."""
+    if isinstance(A, SymCsrMatrix):
+        return A.to_csr(epsilon)
+    A = sp.csr_matrix(A)
+    if epsilon:
+        A = (A + epsilon * sp.eye(A.shape[0], format="csr")).tocsr()
+    return A
+
+
 class HostCGSolver:
     """Serial host CG over a :class:`SymCsrMatrix` (the ``acgsolver`` role)."""
 
     def __init__(self, A: SymCsrMatrix | sp.spmatrix, epsilon: float = 0.0):
-        if isinstance(A, SymCsrMatrix):
-            self.A = A.to_csr(epsilon)
-        else:
-            self.A = sp.csr_matrix(A)
-            if epsilon:
-                self.A = (self.A + epsilon * sp.eye(self.A.shape[0], format="csr")).tocsr()
+        self.A = as_csr(A, epsilon)
         self.n = self.A.shape[0]
         self.nnz_full = self.A.nnz
         self.stats = SolverStats(unknowns=self.n)

@@ -35,9 +35,28 @@ import numpy as np
 
 from acg_tpu.errors import NotConvergedError
 from acg_tpu.ops.precision import dot2
-from acg_tpu.ops.spmv import DeviceMatrix, spmv, spmv_flops
+from acg_tpu.ops.spmv import DeviceMatrix, DiaMatrix, spmv, spmv_flops
 from acg_tpu.solvers.stats import (SolverStats, StoppingCriteria,
                                    cg_flops_per_iteration)
+
+
+def _spmv_fn(kernels: str):
+    """Select the SpMV implementation: "xla" = ops.spmv (compiler-fused);
+    "pallas"/"pallas-interpret" = the hand-written single-x-pass DIA kernel
+    (ops.pallas_kernels.dia_spmv, measured ~1.2x faster on TPU v5e --
+    BASELINE.md).  Falls back to XLA for non-DIA / rectangular matrices."""
+    if kernels.startswith("pallas"):
+        from acg_tpu.ops.pallas_kernels import dia_spmv
+
+        interp = kernels.endswith("interpret")
+
+        def f(A, x):
+            if isinstance(A, DiaMatrix) and A.ncols_padded == A.nrows:
+                return dia_spmv(A.data, A.offsets, x, interpret=interp)
+            return spmv(A, x)
+
+        return f
+    return spmv
 
 
 @functools.partial(jax.tree_util.register_dataclass,
@@ -119,10 +138,11 @@ def _iterate(iter_body, init_state, gamma_of, maxits, res_tol,
 
 
 @functools.partial(jax.jit,
-                   static_argnames=("unbounded", "needs_diff", "precise"))
+                   static_argnames=("unbounded", "needs_diff", "precise",
+                                    "kernels"))
 def _cg_program(A: DeviceMatrix, b, x0, res_atol, res_rtol, diff_atol,
                 diff_rtol, maxits, unbounded: bool, needs_diff: bool,
-                precise: bool = False):
+                precise: bool = False, kernels: str = "xla"):
     """Whole classic-CG solve as one XLA program.
 
     ``precise`` switches the CG scalars' dot products to the compensated
@@ -130,10 +150,11 @@ def _cg_program(A: DeviceMatrix, b, x0, res_atol, res_rtol, diff_atol,
     (p, t), which is what lets plain-f32 storage converge past the
     ~1e-6 relative-residual stall."""
     dot = dot2 if precise else jnp.dot
+    spmv_ = _spmv_fn(kernels)
     dtype = b.dtype
     bnrm2 = jnp.linalg.norm(b)
     x0nrm2 = jnp.linalg.norm(x0)
-    r = b - spmv(A, x0)
+    r = b - spmv_(A, x0)
     p = r
     gamma = dot(r, r)
     r0nrm2 = jnp.sqrt(gamma)
@@ -145,7 +166,7 @@ def _cg_program(A: DeviceMatrix, b, x0, res_atol, res_rtol, diff_atol,
     # extra loop-carried scalar measurably slows the TPU loop (~0.1 ms/it)
     def body(state):
         x, r, p, gamma = state[:4]
-        t = spmv(A, p)
+        t = spmv_(A, p)
         pdott = dot(p, t)
         alpha = gamma / pdott
         x = x + alpha * p
@@ -171,17 +192,20 @@ def _cg_program(A: DeviceMatrix, b, x0, res_atol, res_rtol, diff_atol,
 
 
 @functools.partial(jax.jit,
-                   static_argnames=("unbounded", "needs_diff", "precise"))
+                   static_argnames=("unbounded", "needs_diff", "precise",
+                                    "kernels"))
 def _cg_pipelined_program(A: DeviceMatrix, b, x0, res_atol, res_rtol,
                           diff_atol, diff_rtol, maxits, unbounded: bool,
-                          needs_diff: bool, precise: bool = False):
+                          needs_diff: bool, precise: bool = False,
+                          kernels: str = "xla"):
     """Whole pipelined-CG (Ghysels-Vanroose) solve as one XLA program."""
     dot = dot2 if precise else jnp.dot
+    spmv_ = _spmv_fn(kernels)
     dtype = b.dtype
     bnrm2 = jnp.linalg.norm(b)
     x0nrm2 = jnp.linalg.norm(x0)
-    r = b - spmv(A, x0)
-    w = spmv(A, r)
+    r = b - spmv_(A, x0)
+    w = spmv_(A, r)
     r0nrm2 = jnp.linalg.norm(r)
     res_tol = jnp.maximum(res_atol, res_rtol * r0nrm2)
     diff_tol = jnp.maximum(diff_atol, diff_rtol * x0nrm2)
@@ -195,9 +219,15 @@ def _cg_pipelined_program(A: DeviceMatrix, b, x0, res_atol, res_rtol,
         delta = dot(w, r)
         # SpMV overlaps the allreduce in the reference (cgcuda.c:1750-1790);
         # under XLA the scheduler owns that overlap.
-        q = spmv(A, w)
+        q = spmv_(A, w)
         beta = gamma / gamma_prev               # inf -> 0 on first iteration
         alpha = gamma / (delta - beta * (gamma / alpha_prev))
+        # the 6-vector update stays in XLA even under kernels="pallas":
+        # the hand-written fused kernel (ops.pallas_kernels.
+        # fused_pipelined_update) wins in isolation (~1.35x) but inside
+        # the loop it is an opaque call that forfeits XLA's fusion of the
+        # *next* iteration's dots into these writes -- measured 894 vs
+        # 1818 iters/s on the flagship (BASELINE.md)
         z = q + beta * z
         t = w + beta * t
         p = r + beta * p
@@ -239,10 +269,23 @@ class JaxCGSolver:
     """
 
     def __init__(self, A: DeviceMatrix, pipelined: bool = False,
-                 precise_dots: bool = False):
+                 precise_dots: bool = False, kernels: str = "auto"):
         self.A = A
         self.pipelined = pipelined
         self.precise_dots = precise_dots
+        if kernels == "auto":
+            # the Pallas kernels win on TPU hardware (BASELINE.md); off
+            # TPU they would run interpreted (slow), and the measured win
+            # only exists for the f32/bf16 fast path, so gate on both
+            itemsize = (np.dtype(A.dtype).itemsize
+                        if isinstance(A, DiaMatrix) else 0)
+            kernels = ("pallas" if jax.default_backend() == "tpu"
+                       and itemsize in (2, 4) else "xla")
+        elif kernels == "pallas" and jax.default_backend() != "tpu":
+            kernels = "pallas-interpret"
+        if kernels not in ("xla", "pallas", "pallas-interpret"):
+            raise ValueError(f"unknown kernels choice {kernels!r}")
+        self.kernels = kernels
         self.stats = SolverStats(unknowns=A.nrows)
         self._spmv_flops = spmv_flops(A)
 
@@ -264,7 +307,7 @@ class JaxCGSolver:
                 jnp.asarray(crit.diff_rtol, dtype),
                 jnp.int32(crit.maxits))
         kwargs = dict(unbounded=crit.unbounded, needs_diff=crit.needs_diff,
-                      precise=self.precise_dots)
+                      precise=self.precise_dots, kernels=self.kernels)
         # warmup solves outside the timed region (the reference warms up
         # each op class before timing, cgcuda.c:612-710)
         for _ in range(max(warmup, 0)):

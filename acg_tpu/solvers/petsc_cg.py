@@ -30,13 +30,8 @@ class PetscBaselineSolver:
 
     def __init__(self, A: SymCsrMatrix | sp.spmatrix, epsilon: float = 0.0,
                  pipelined: bool = False):
-        if isinstance(A, SymCsrMatrix):
-            self.A = A.to_csr(epsilon)
-        else:
-            self.A = sp.csr_matrix(A)
-            if epsilon:
-                self.A = (self.A
-                          + epsilon * sp.eye(self.A.shape[0], format="csr")).tocsr()
+        from acg_tpu.solvers.host_cg import as_csr
+        self.A = as_csr(A, epsilon)
         self.n = self.A.shape[0]
         self.pipelined = pipelined  # KSPPIPECG alias; same scipy call
         self.stats = SolverStats(unknowns=self.n)

