@@ -54,8 +54,11 @@ def metis_available() -> bool:
     return _load_metis() is not None
 
 
-def _metis_kway(lib, np_idx, rowptr, colidx, nparts: int, seed: int) -> np.ndarray:
-    """Raw METIS_PartGraphKway call at a given index width (np_idx dtype)."""
+def _metis_kway(lib, np_idx, rowptr, colidx, nparts: int, seed: int,
+                variant: str = "kway") -> np.ndarray:
+    """Raw METIS_PartGraph{Kway,Recursive} call at a given index width
+    (np_idx dtype).  The two entry points share one C signature
+    (``metis.h:39-43``)."""
     idx_t = ctypes.c_int32 if np_idx == np.int32 else ctypes.c_int64
     n = len(rowptr) - 1
     xadj = np.ascontiguousarray(rowptr, dtype=np_idx)
@@ -68,7 +71,9 @@ def _metis_kway(lib, np_idx, rowptr, colidx, nparts: int, seed: int) -> np.ndarr
     options[8] = seed  # METIS_OPTION_SEED
     nv = idx_t(n)
     npp = idx_t(nparts)
-    ret = lib.METIS_PartGraphKway(
+    fn = (lib.METIS_PartGraphRecursive if variant == "recursive"
+          else lib.METIS_PartGraphKway)
+    ret = fn(
         ctypes.byref(nv), ctypes.byref(ncon),
         xadj.ctypes.data_as(ctypes.POINTER(idx_t)),
         adjncy.ctypes.data_as(ctypes.POINTER(idx_t)),
@@ -77,7 +82,8 @@ def _metis_kway(lib, np_idx, rowptr, colidx, nparts: int, seed: int) -> np.ndarr
         ctypes.byref(objval),
         part.ctypes.data_as(ctypes.POINTER(idx_t)))
     if ret != 1:  # METIS_OK
-        raise AcgError(ErrorCode.METIS, f"METIS_PartGraphKway returned {ret}")
+        raise AcgError(ErrorCode.METIS,
+                       f"METIS_PartGraph{variant.capitalize()} returned {ret}")
     return part
 
 
@@ -107,24 +113,76 @@ def _metis_idx_width(lib):
     raise AcgError(ErrorCode.METIS, "could not determine libmetis index width")
 
 
-def metis_partgraphsym(rowptr, colidx, nparts: int, seed: int = 0) -> np.ndarray:
-    """Call ``METIS_PartGraphKway`` on a symmetric adjacency (no self-loops).
+def _metis_check_width(np_idx, rowptr, colidx):
+    if np_idx == np.int32 and (len(colidx) > np.iinfo(np.int32).max
+                               or len(rowptr) - 1 > np.iinfo(np.int32).max):
+        raise AcgError(ErrorCode.METIS,
+                       "graph too large for 32-bit libmetis indices")
 
-    The ``metis_partgraphsym`` role (``metis.h:81``).  Raises if libmetis
-    is not present; callers use :func:`partition_rows` for the fallback.
+
+def metis_partgraphsym(rowptr, colidx, nparts: int, seed: int = 0,
+                       variant: str = "kway") -> np.ndarray:
+    """Call ``METIS_PartGraph{Kway,Recursive}`` on a symmetric adjacency
+    (no self-loops).
+
+    The ``metis_partgraphsym`` role (``metis.h:81``); ``variant=
+    "recursive"`` selects ``METIS_PartGraphRecursive`` (the reference
+    exposes both, ``metis.h:39-43``).  Raises if libmetis is not present;
+    callers use :func:`partition_rows` for the fallback.
+    """
+    if variant not in ("kway", "recursive"):
+        raise AcgError(ErrorCode.INVALID_VALUE,
+                       f"unknown METIS variant {variant!r}")
+    lib = _load_metis()
+    if lib is None:
+        raise AcgError(ErrorCode.METIS, "libmetis not found")
+    np_idx = _metis_idx_width(lib)
+    _metis_check_width(np_idx, rowptr, colidx)
+    part = _metis_kway(lib, np_idx, rowptr, colidx, nparts, seed, variant)
+    if part.min() < 0 or part.max() >= nparts:
+        raise AcgError(ErrorCode.METIS, "METIS returned an invalid partition")
+    return part.astype(np.int32)
+
+
+def metis_nd(rowptr, colidx) -> tuple[np.ndarray, np.ndarray]:
+    """Call ``METIS_NodeND`` on a symmetric adjacency (no self-loops):
+    fill-reducing nested-dissection ordering.
+
+    The ``metis_ndsym``/``metis_nd`` role (``metis.h:249-263``).  Returns
+    ``(perm, iperm)`` with METIS's convention: ``iperm[old] = new`` and
+    ``perm[new] = old``.  Raises if libmetis is not present; callers use
+    :func:`nested_dissection` for the built-in fallback.
     """
     lib = _load_metis()
     if lib is None:
         raise AcgError(ErrorCode.METIS, "libmetis not found")
     np_idx = _metis_idx_width(lib)
-    if np_idx == np.int32 and (len(colidx) > np.iinfo(np.int32).max
-                               or len(rowptr) - 1 > np.iinfo(np.int32).max):
-        raise AcgError(ErrorCode.METIS,
-                       "graph too large for 32-bit libmetis indices")
-    part = _metis_kway(lib, np_idx, rowptr, colidx, nparts, seed)
-    if part.min() < 0 or part.max() >= nparts:
-        raise AcgError(ErrorCode.METIS, "METIS returned an invalid partition")
-    return part.astype(np.int32)
+    _metis_check_width(np_idx, rowptr, colidx)
+    idx_t = ctypes.c_int32 if np_idx == np.int32 else ctypes.c_int64
+    n = len(rowptr) - 1
+    xadj = np.ascontiguousarray(rowptr, dtype=np_idx)
+    adjncy = np.ascontiguousarray(colidx, dtype=np_idx)
+    perm = np.zeros(n, dtype=np_idx)
+    iperm = np.zeros(n, dtype=np_idx)
+    options = np.zeros(40, dtype=np_idx)
+    lib.METIS_SetDefaultOptions(options.ctypes.data_as(ctypes.POINTER(idx_t)))
+    nv = idx_t(n)
+    ret = lib.METIS_NodeND(
+        ctypes.byref(nv),
+        xadj.ctypes.data_as(ctypes.POINTER(idx_t)),
+        adjncy.ctypes.data_as(ctypes.POINTER(idx_t)),
+        None,
+        options.ctypes.data_as(ctypes.POINTER(idx_t)),
+        perm.ctypes.data_as(ctypes.POINTER(idx_t)),
+        iperm.ctypes.data_as(ctypes.POINTER(idx_t)))
+    if ret != 1:
+        raise AcgError(ErrorCode.METIS, f"METIS_NodeND returned {ret}")
+    p32, i32 = perm.astype(np.int32), iperm.astype(np.int32)
+    if not (np.array_equal(np.sort(p32), np.arange(n))
+            and np.array_equal(p32[i32], np.arange(n))):
+        raise AcgError(ErrorCode.METIS, "METIS_NodeND returned an invalid "
+                       "permutation (index-width mismatch?)")
+    return p32, i32
 
 
 # ---------------------------------------------------------------------------
@@ -239,9 +297,85 @@ def partition_rows_band(full_csr: sp.csr_matrix, nparts: int) -> np.ndarray:
     return np.cumsum(part).astype(np.int32)
 
 
+def _pattern_graph(graph: sp.csr_matrix) -> sp.csr_matrix:
+    """0/1 adjacency with the diagonal removed (refinement and BFS must
+    not see matrix values: negative off-diagonals would invert flip
+    gains, and METIS forbids self-loops)."""
+    coo = graph.tocoo()
+    off = coo.row != coo.col
+    return sp.coo_matrix((np.ones(int(off.sum())),
+                          (coo.row[off], coo.col[off])),
+                         shape=graph.shape).tocsr()
+
+
+def _bisect(graph: sp.csr_matrix, mask: np.ndarray, target0: int,
+            rng, refine: bool) -> np.ndarray:
+    """One graph-growing bisection of the masked subgraph: returns the
+    side array (0/1 per node; only masked entries meaningful)."""
+    n = graph.shape[0]
+    nnodes = int(mask.sum())
+    seed_node = _pseudo_peripheral(graph, mask, rng)
+    order = _bfs_order(graph, seed_node, mask.copy())
+    side = np.zeros(n, dtype=np.int8)
+    side[order[target0:]] = 1
+    # disconnected leftovers go to the smaller side
+    leftover = mask.copy()
+    leftover[order] = False
+    if leftover.any():
+        side[leftover] = 1 if target0 > nnodes - target0 else 0
+    if refine:
+        _refine_bisection(graph, side, mask, target0)
+    return side
+
+
+def nested_dissection(full_csr: sp.csr_matrix, seed: int = 0,
+                      use_metis: str = "auto",
+                      leaf_size: int = 32) -> tuple[np.ndarray, np.ndarray]:
+    """Fill-reducing nested-dissection ordering of the sparsity graph.
+
+    The ``metis_nd`` role (``metis.h:249-263``) with the same optional-METIS
+    contract as :func:`partition_rows`: ``METIS_NodeND`` when libmetis is
+    present, otherwise a built-in recursion -- bisect with the graph-growing
+    partitioner, extract the vertex separator (side-0 nodes adjacent to
+    side 1), order both halves recursively, separator last.  Returns
+    ``(perm, iperm)``: ``perm[new] = old``, ``iperm[old] = new``.
+    """
+    n = full_csr.shape[0]
+    graph = _pattern_graph(full_csr)
+    if use_metis in ("auto", "require") and metis_available():
+        return metis_nd(graph.indptr.astype(np.int64),
+                        graph.indices.astype(np.int64))
+    if use_metis == "require":
+        raise AcgError(ErrorCode.METIS, "libmetis required but not found")
+
+    rng = np.random.default_rng(seed)
+
+    def recurse(mask: np.ndarray) -> np.ndarray:
+        nodes = np.flatnonzero(mask)
+        if nodes.size <= leaf_size:
+            return nodes.astype(np.int32)
+        side = _bisect(graph, mask, nodes.size // 2, rng, refine=True)
+        m0 = mask & (side == 0)
+        m1 = mask & (side == 1)
+        if not m0.any() or not m1.any():
+            return nodes.astype(np.int32)
+        # vertex separator: side-0 nodes with a neighbour in side 1
+        nbr1 = (graph @ m1.astype(np.float64)) > 0
+        sep = m0 & nbr1
+        m0 = m0 & ~sep
+        left = recurse(m0) if m0.any() else np.empty(0, dtype=np.int32)
+        right = recurse(m1)
+        return np.concatenate([left, right, np.flatnonzero(sep).astype(np.int32)])
+
+    perm = recurse(np.ones(n, dtype=bool))
+    iperm = np.empty(n, dtype=np.int32)
+    iperm[perm] = np.arange(n, dtype=np.int32)
+    return perm, iperm
+
+
 def partition_rows(full_csr: sp.csr_matrix, nparts: int, seed: int = 0,
                    refine: bool = True, use_metis: str = "auto",
-                   method: str = "graph") -> np.ndarray:
+                   method: str = "graph", variant: str = "kway") -> np.ndarray:
     """Partition matrix rows into ``nparts`` balanced, low-cut parts.
 
     The ``acgsymcsrmatrix_partition_rows`` role (``symcsrmatrix.c`` ->
@@ -249,7 +383,9 @@ def partition_rows(full_csr: sp.csr_matrix, nparts: int, seed: int = 0,
     "never" forces the built-in partitioner, "require" errors without it.
     ``method``: "graph" = edge-cut minimisation (METIS or built-in
     bisection); "band" = contiguous nnz-balanced row ranges
-    (:func:`partition_rows_band`).
+    (:func:`partition_rows_band`).  ``variant``: "kway" (default) or
+    "recursive" selects the METIS algorithm (``metis.h:39-43``); the
+    built-in partitioner is recursive bisection either way.
     """
     n = full_csr.shape[0]
     if nparts <= 0:
@@ -264,26 +400,15 @@ def partition_rows(full_csr: sp.csr_matrix, nparts: int, seed: int = 0,
         raise AcgError(ErrorCode.INVALID_VALUE,
                        f"unknown partition method {method!r}")
 
-    graph = full_csr
-
     if use_metis in ("auto", "require") and metis_available():
-        # strip self-loops for METIS
-        coo = graph.tocoo()
-        off = coo.row != coo.col
-        adj = sp.coo_matrix((np.ones(off.sum(), dtype=np.int8),
-                             (coo.row[off], coo.col[off])), shape=graph.shape).tocsr()
+        adj = _pattern_graph(full_csr)
         return metis_partgraphsym(adj.indptr.astype(np.int64),
-                                  adj.indices.astype(np.int64), nparts, seed)
+                                  adj.indices.astype(np.int64), nparts, seed,
+                                  variant=variant)
     if use_metis == "require":
         raise AcgError(ErrorCode.METIS, "libmetis required but not found")
 
-    # refinement and BFS must see the 0/1 diagonal-free adjacency pattern,
-    # not matrix values (negative off-diagonals would invert flip gains)
-    pattern = graph.tocoo()
-    off = pattern.row != pattern.col
-    graph = sp.coo_matrix((np.ones(int(off.sum())),
-                           (pattern.row[off], pattern.col[off])),
-                          shape=graph.shape).tocsr()
+    graph = _pattern_graph(full_csr)
 
     rng = np.random.default_rng(seed)
     part = np.zeros(n, dtype=np.int32)
@@ -297,17 +422,7 @@ def partition_rows(full_csr: sp.csr_matrix, nparts: int, seed: int = 0,
         nleft_parts = (hi - lo) // 2
         nnodes = int(mask.sum())
         target0 = int(round(nnodes * nleft_parts / (hi - lo)))
-        seed_node = _pseudo_peripheral(graph, mask, rng)
-        order = _bfs_order(graph, seed_node, mask.copy())
-        side = np.zeros(n, dtype=np.int8)
-        side[order[target0:]] = 1
-        # disconnected leftovers go to the smaller side
-        leftover = mask.copy()
-        leftover[order] = False
-        if leftover.any():
-            side[leftover] = 1 if target0 > nnodes - target0 else 0
-        if refine:
-            _refine_bisection(graph, side, mask, target0)
+        side = _bisect(graph, mask, target0, rng, refine)
         m0 = mask & (side == 0)
         m1 = mask & (side == 1)
         if not m0.any() or not m1.any():

@@ -31,6 +31,13 @@ def main(argv=None) -> int:
     p.add_argument("--use-metis", default="auto",
                    choices=["auto", "never", "require"],
                    help="METIS usage policy (default: auto-detect)")
+    p.add_argument("--method", default="graph", choices=["graph", "band"],
+                   help="graph = edge-cut minimisation; band = contiguous "
+                        "nnz-balanced row ranges (TPU DIA-friendly)")
+    p.add_argument("--variant", default="kway",
+                   choices=["kway", "recursive"],
+                   help="METIS algorithm (METIS_PartGraphKway or "
+                        "METIS_PartGraphRecursive, metis.h:39-43)")
     p.add_argument("-v", "--verbose", action="count", default=0)
     args = p.parse_args(argv)
 
@@ -47,7 +54,8 @@ def main(argv=None) -> int:
 
     t0 = time.perf_counter()
     part = partition_rows(csr, args.parts, seed=args.seed,
-                          use_metis=args.use_metis)
+                          use_metis=args.use_metis, method=args.method,
+                          variant=args.variant)
     if args.verbose:
         sys.stderr.write(
             f"partition into {args.parts} parts: "

@@ -61,6 +61,20 @@ def test_mtxpartition_tool(matrix_file, tmp_path):
     assert "edge cut" in r.stderr
 
 
+def test_mtxpartition_tool_variant_and_band(matrix_file, tmp_path):
+    """--variant recursive and --method band both produce valid covers
+    (metis.h:39-43 variants; band = TPU DIA-friendly contiguous ranges)."""
+    for extra in (["--variant", "recursive"], ["--method", "band"]):
+        r = run_cli("acg_tpu.tools.mtxpartition",
+                    [str(matrix_file), "--parts", "3"] + extra)
+        assert r.returncode == 0, r.stderr
+        pfile = tmp_path / "part.mtx"
+        pfile.write_text(r.stdout)
+        part = np.asarray(read_mtx(pfile).vals).reshape(-1)
+        assert part.size == 144
+        assert set(np.unique(part)) == {0, 1, 2}
+
+
 def test_cli_solve_single(matrix_file):
     r = run_cli("acg_tpu.cli",
                 [str(matrix_file), "--comm", "none", "--solver", "acg",

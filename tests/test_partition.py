@@ -263,3 +263,70 @@ def test_band_partition_concentrated_nnz_keeps_parts_nonempty():
         counts = np.bincount(part, minlength=nparts)
         assert counts.min() > 0
         assert (np.diff(part) >= 0).all()
+
+
+# -- nested dissection (metis.h:249-263 role) -------------------------------
+
+def _fill_nnz(csr, perm=None):
+    """nnz(L+U) of an LU factorisation with a fixed (given) ordering."""
+    import scipy.sparse.linalg as spla
+    A = csr if perm is None else csr[perm][:, perm]
+    lu = spla.splu(A.tocsc(), permc_spec="NATURAL",
+                   options={"SymmetricMode": True})
+    return lu.L.nnz + lu.U.nnz
+
+
+def test_nested_dissection_valid_permutation():
+    from acg_tpu.partition import nested_dissection
+    A = SymCsrMatrix.from_mtx(poisson_mtx(16, dim=2)).to_csr()
+    perm, iperm = nested_dissection(A, seed=0, use_metis="never")
+    n = A.shape[0]
+    assert np.array_equal(np.sort(perm), np.arange(n))
+    assert np.array_equal(perm[iperm], np.arange(n))
+    assert np.array_equal(iperm[perm], np.arange(n))
+
+
+def test_nested_dissection_reduces_fill():
+    """The point of the ordering: Cholesky/LU fill on a 2D grid should be
+    well below natural (banded) ordering fill."""
+    from acg_tpu.partition import nested_dissection
+    A = SymCsrMatrix.from_mtx(poisson_mtx(24, dim=2)).to_csr()
+    perm, _ = nested_dissection(A, seed=0, use_metis="never")
+    assert _fill_nnz(A, perm) < 0.8 * _fill_nnz(A)
+
+
+def test_nested_dissection_leaf_only():
+    """Graphs at or below leaf_size come back as one identity-like leaf."""
+    from acg_tpu.partition import nested_dissection
+    A = SymCsrMatrix.from_mtx(poisson_mtx(4, dim=2)).to_csr()
+    perm, iperm = nested_dissection(A, use_metis="never", leaf_size=100)
+    assert np.array_equal(np.sort(perm), np.arange(A.shape[0]))
+
+
+def test_nested_dissection_require_metis_errors_without_lib():
+    from acg_tpu.errors import AcgError
+    from acg_tpu.partition import metis_available, nested_dissection
+    A = SymCsrMatrix.from_mtx(poisson_mtx(4, dim=2)).to_csr()
+    if metis_available():
+        perm, iperm = nested_dissection(A, use_metis="require")
+        assert np.array_equal(np.sort(perm), np.arange(A.shape[0]))
+    else:
+        with pytest.raises(AcgError):
+            nested_dissection(A, use_metis="require")
+
+
+@pytest.mark.parametrize("variant", ["kway", "recursive"])
+def test_partition_rows_variant_plumbing(problem, variant):
+    """Both METIS variants are accepted; without libmetis they share the
+    built-in recursive-bisection fallback and must agree."""
+    part = partition_rows(problem, 4, seed=1, variant=variant)
+    counts = np.bincount(part, minlength=4)
+    assert counts.sum() == problem.shape[0] and counts.min() > 0
+
+
+def test_metis_partgraphsym_rejects_bad_variant():
+    from acg_tpu.errors import AcgError
+    with pytest.raises(AcgError):
+        from acg_tpu.partition import metis_partgraphsym
+        metis_partgraphsym(np.array([0, 0]), np.array([], dtype=np.int64),
+                           1, variant="bogus")
