@@ -111,6 +111,18 @@ def make_parser() -> argparse.ArgumentParser:
                    help="random seed for partitioning and manufactured solutions")
     p.add_argument("--numfmt", default="%.17g", metavar="FMT",
                    help="printf-style format for numeric output")
+    p.add_argument("--multihost", action="store_true",
+                   help="initialise the JAX multi-controller runtime before "
+                        "solving (the MPI_Init stage); on TPU pods the "
+                        "cluster layout is auto-detected, elsewhere pass "
+                        "--coordinator/--num-processes/--process-id")
+    p.add_argument("--coordinator", metavar="HOST:PORT", default=None,
+                   help="multi-controller coordinator address "
+                        "(implies --multihost)")
+    p.add_argument("--num-processes", type=int, default=None, metavar="N",
+                   help="total controller processes (with --coordinator)")
+    p.add_argument("--process-id", type=int, default=None, metavar="I",
+                   help="this controller's index (with --coordinator)")
     p.add_argument("--trace", metavar="DIR", default=None,
                    help="write a jax.profiler trace of the solve to DIR "
                         "(the reference's nsys-trace tier; view with xprof)")
@@ -168,8 +180,15 @@ def _main(args) -> int:
         jax.config.update("jax_platforms", plat)
     if args.dtype == "f64":
         jax.config.update("jax_enable_x64", True)
+    if args.multihost or args.coordinator is not None:
+        from acg_tpu.parallel.multihost import initialize
+        initialize(args.coordinator, args.num_processes, args.process_id)
+        _log(args, f"multihost: process {jax.process_index()} of "
+                   f"{jax.process_count()}, {len(jax.local_devices())} local "
+                   f"/ {len(jax.devices())} global devices")
     import jax.numpy as jnp
     from acg_tpu.errors import AcgError, NotConvergedError
+    from acg_tpu.parallel.multihost import is_primary
     from acg_tpu.graph import comm_matrix, partition_matrix
     from acg_tpu.io.mtxfile import MtxFile, read_mtx, write_mtx, vector_mtx
     from acg_tpu.matrix import SymCsrMatrix
@@ -314,7 +333,8 @@ def _main(args) -> int:
                              warmup=args.warmup)
     except NotConvergedError as e:
         sys.stderr.write(f"acg-tpu: {e}\n")
-        solver.stats.fwrite(sys.stderr)
+        if is_primary():  # stats block from "rank 0" only
+            solver.stats.fwrite(sys.stderr)
         return 1
     except AcgError as e:
         sys.stderr.write(f"acg-tpu: {e}\n")
@@ -323,6 +343,11 @@ def _main(args) -> int:
         if args.trace:
             jax.profiler.stop_trace()
     _log(args, "solve:", t0)
+
+    # every controller solves; only "rank 0" speaks (the reference's
+    # fwritempi / mtxfile_fwrite_mpi_double root-rank output convention)
+    if not is_primary():
+        return 0
 
     # stage 9: statistics block (grep-compatible with the reference)
     solver.stats.fwrite(sys.stderr)

@@ -51,6 +51,7 @@ from acg_tpu.ops.spmv import (csr_diag_offsets, dia_mv, dia_planes_fixed,
 from acg_tpu.parallel.halo import DeviceHaloPlan, build_device_halo, halo_exchange
 from acg_tpu.parallel.halo_dma import halo_exchange_dma
 from acg_tpu.parallel.mesh import PARTS_AXIS, solve_mesh
+from acg_tpu.parallel.multihost import get_global, put_global
 from acg_tpu.solvers.jax_cg import _iterate
 from acg_tpu.solvers.stats import (SolverStats, StoppingCriteria,
                                    cg_flops_per_iteration)
@@ -445,7 +446,7 @@ class DistCGSolver:
         prob = self.problem
         dtype = np.dtype(prob.dtype)
 
-        put = functools.partial(jax.device_put, device=self._sharding)
+        put = functools.partial(put_global, sharding=self._sharding)
         b = put(prob.scatter(np.asarray(b_global)))
         x0 = put(prob.scatter(np.asarray(x0))
                  if x0 is not None
@@ -495,7 +496,7 @@ class DistCGSolver:
         halo_bytes = sum(int(s.halo.total_send) for s in prob.subs) * dbl
         st.ops["halo"].add(niter + 1, 0.0, halo_bytes * (niter + 1))
 
-        x = prob.gather(np.asarray(jax.device_get(x_st)))
+        x = prob.gather(get_global(x_st))
         st.fexcept_arrays = [x]
         if not st.converged and raise_on_divergence:
             raise NotConvergedError(
