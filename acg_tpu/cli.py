@@ -25,7 +25,6 @@ Flag names follow ``cuda/acg-cuda.c:321-377``.  Differences, by design:
 from __future__ import annotations
 
 import argparse
-import re
 import sys
 import time
 
@@ -142,25 +141,35 @@ def _log(args, msg, t0=None):
             sys.stderr.write(msg + "\n")
 
 
-_NUMFMT_RE = re.compile(r"^%[-#0 +]*(?:\d+)?(?:\.\d+)?[eEfFgG]$")
-
-
 def _validate_numfmt(fmt: str) -> str:
-    """The role of the reference's fmtspec parser (``fmtspec_parse``,
-    ``acg/fmtspec.c:224``): accept exactly one floating-point printf
-    conversion (%e/%E/%f/%F/%g/%G with optional flags/width/precision).
-    Integer conversions like ``%d`` are rejected -- ``"%d" % 1.5`` is
-    valid Python but silently truncates every solution value."""
-    if not _NUMFMT_RE.match(fmt):
+    """Validate ``--numfmt`` through the fmtspec parser (the reference
+    does the same via ``fmtspec_parse``, ``acg/fmtspec.c:224``) and
+    normalise it for the output writers: exactly one floating-point
+    conversion; integer conversions like ``%d`` are rejected --
+    ``"%d" % 1.5`` is valid Python but silently truncates every solution
+    value -- as are ``*`` width/precision (no argument to consume) and
+    hexfloat ``%a`` (the array writers apply the spec with Python's
+    ``%``, which lacks it).  C length modifiers (``%lg``) are accepted
+    and stripped, matching printf's type-promotion semantics."""
+    import dataclasses
+
+    from acg_tpu import fmtspec
+
+    try:
+        spec = fmtspec.parse(fmt)
+    except fmtspec.FmtSpecError as e:
+        raise SystemExit(f"acg-tpu: invalid --numfmt {fmt!r}: {e}")
+    if (not spec.is_float or spec.needs_star_args
+            or spec.conversion in "aA"):
         raise SystemExit(
             f"acg-tpu: invalid --numfmt {fmt!r}: need a single "
             f"floating-point conversion (e.g. %.17g, %e, %12.6f)")
-    return fmt
+    return str(dataclasses.replace(spec, length=""))
 
 
 def main(argv=None) -> int:
     args = make_parser().parse_args(argv)
-    _validate_numfmt(args.numfmt)
+    args.numfmt = _validate_numfmt(args.numfmt)
     try:
         return _main(args)
     except OSError as e:

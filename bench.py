@@ -49,7 +49,7 @@ H100_BASELINE_ITERS_PER_SEC = 4500.0
 # stand-in for other configs scales 4500 iters/s by the reference's own
 # traffic ratio -- NOT by our f32 traffic, which would wrongly credit
 # the H100 with our halved-precision bandwidth advantage.
-_FLAGSHIP_REF_BYTES_PER_ITER = 20_959_232 * 12.0 + 80.0 * 4_194_304
+_FLAGSHIP_REF_BYTES_PER_ITER = (5 * 2048**2 - 4 * 2048) * 12.0 + 80.0 * 2048**2
 # timed repeats; the tunneled benchmark chip is shared and contention is
 # bursty (BASELINE.md round-2 caveat), so report the best of N
 TIMED_REPEATS = 5

@@ -139,7 +139,8 @@ def test_cli_comm_aliases(matrix_file):
 def test_numfmt_rejects_non_float_conversions():
     from acg_tpu.cli import _validate_numfmt
     import pytest as _pytest
-    for bad in ("%d", "%s", "%i", "%x", "%.17g %g", "g", "%", "%.g"):
+    # note: "%.g" is VALID C (bare '.' = precision 0, fmtspec.h:120-122)
+    for bad in ("%d", "%s", "%i", "%x", "%.17g %g", "g", "%", "%q"):
         with _pytest.raises(SystemExit):
             _validate_numfmt(bad)
     for good in ("%.17g", "%e", "%12.6f", "%+G", "%#.3E", "%-8.2f"):

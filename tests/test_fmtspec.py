@@ -1,0 +1,151 @@
+"""fmtspec parser/formatter tests (SURVEY.md component #5).
+
+Parity target is C printf (``acg/fmtspec.c`` delegates application to
+libc): beyond round-trip and validation unit tests, a compiled C oracle
+checks FmtSpec.format against the platform printf over a grid of specs
+and values, including the %a/%A hexfloat conversions Python lacks.
+"""
+
+import shutil
+import subprocess
+import sys
+
+import pytest
+
+from acg_tpu.fmtspec import (STAR, Flags, FmtSpec, FmtSpecError, parse,
+                             parse_prefix)
+
+
+# -- parsing ---------------------------------------------------------------
+
+@pytest.mark.parametrize("s", [
+    "%g", "%.17g", "%e", "%12.6f", "%-+12.6e", "%#016.8G", "% .3F",
+    "%d", "%5u", "%08x", "%llX", "%hhd", "%zd", "%Lg", "%s", "%c", "%%",
+    "%*d", "%.*f", "%*.*g", "%.f", "%.0e",
+])
+def test_parse_roundtrip(s):
+    spec = parse(s)
+    # canonical form re-parses to the same spec (fmtspecstr round-trip)
+    assert parse(str(spec)) == spec
+
+
+def test_parse_fields():
+    spec = parse("%-+012.6le")
+    assert spec.flags == Flags.MINUS | Flags.PLUS | Flags.ZERO
+    assert spec.width == 12 and spec.precision == 6
+    assert spec.length == "l" and spec.conversion == "e"
+    assert spec.is_float and not spec.is_integer
+
+
+def test_parse_star_and_bare_dot():
+    assert parse("%*.*f").width == STAR
+    assert parse("%*.*f").precision == STAR
+    assert parse("%.g").precision == 0  # bare '.' means precision 0
+    assert parse("%.17g").needs_star_args is False
+    assert parse("%*g").needs_star_args is True
+
+
+def test_parse_prefix_endptr():
+    spec, end = parse_prefix("%8.3f seconds", 0)
+    assert spec.width == 8 and spec.conversion == "f"
+    assert "%8.3f seconds"[end:] == " seconds"
+
+
+@pytest.mark.parametrize("s", ["", "g", "%", "%q", "%5", "%.3", "%ly ",
+                               "%hhh", "%5.2", "%gg"])
+def test_parse_invalid(s):
+    with pytest.raises(FmtSpecError):
+        parse(s)
+
+
+def test_length_longest_match():
+    assert parse("%lld").length == "ll"
+    assert parse("%ld").length == "l"
+    assert parse("%hhu").length == "hh"
+
+
+# -- application -----------------------------------------------------------
+
+def test_format_matches_python_percent():
+    for s, v in [("%.17g", 3.141592653589793), ("%e", 1e-300),
+                 ("%12.6f", -2.5), ("%+g", 2.0), ("%05d", 42),
+                 ("%x", 255), ("%s", "hi"), ("%10.3E", 6.02e23)]:
+        assert parse(s).format(v) == s % v
+
+
+def test_format_star_args():
+    assert parse("%*.*f").format(2.5, 8, 2) == "%8.2f" % 2.5
+    with pytest.raises(FmtSpecError):
+        parse("%g").format(1.0, 8)  # unused star arg
+
+
+def test_format_strips_length_modifier():
+    assert parse("%lg").format(0.5) == "%g" % 0.5
+    assert parse("%lld").format(7) == "7"
+
+
+def test_format_integer_conversion_truncates_explicitly():
+    # the CLI rejects %d for --numfmt; the module itself follows printf
+    assert parse("%d").format(3) == "3"
+
+
+def test_format_percent_and_n():
+    assert parse("%%").format(None) == "%"
+    assert parse("%n").format(None) == ""
+
+
+def test_hexfloat_basic():
+    assert parse("%a").format(1.5) == "0x1.8p+0"
+    assert parse("%a").format(0.0) == "0x0p+0"
+    assert parse("%A").format(1.5) == "0X1.8P+0"
+    assert parse("%.0a").format(1.5) == "0x2p+0"
+    assert parse("%.3a").format(1.5) == "0x1.800p+0"
+    assert parse("%+a").format(1.5) == "+0x1.8p+0"
+    assert parse("%a").format(-2.0) == "-0x1p+1"
+
+
+# -- C printf oracle -------------------------------------------------------
+
+_CC = shutil.which("gcc") or shutil.which("cc") or shutil.which("g++")
+
+
+@pytest.mark.skipif(_CC is None, reason="no C compiler")
+def test_format_against_c_printf(tmp_path):
+    """Grid of float specs x values against the platform printf."""
+    specs = ["%g", "%.17g", "%e", "%.3E", "%12.6f", "%-12.4g", "%+e",
+             "% g", "%#.5G", "%015.6f", "%a", "%A", "%.4a", "%20.3a",
+             "%010.2a", "%.1a", "%-14.1a"]
+    vals = [0.0, 1.0, -1.0, 1.5, 3.141592653589793, -6.02e23, 1e-300,
+            0.1, 123456.789, -0.0078125,
+            float.fromhex("0x1.28p+0"),   # tie: rounds half-to-even
+            float.fromhex("0x1.38p+0")]   # tie the other parity
+    src = tmp_path / "oracle.c"
+    lines = ["#include <stdio.h>", "int main(void){"]
+    for s in specs:
+        for v in vals:
+            lines.append(f'printf("{s}\\n", {v!r});')
+    lines += ["return 0;}"]
+    src.write_text("\n".join(lines))
+    exe = tmp_path / "oracle"
+    subprocess.run([_CC, str(src), "-o", str(exe)], check=True)
+    out = subprocess.run([str(exe)], capture_output=True, text=True,
+                         check=True).stdout.splitlines()
+    i = 0
+    for s in specs:
+        spec = parse(s)
+        for v in vals:
+            got = spec.format(v)
+            assert got == out[i], f"{s} % {v!r}: ours {got!r} != C {out[i]!r}"
+            i += 1
+
+
+# -- CLI integration -------------------------------------------------------
+
+def test_cli_numfmt_validation():
+    from acg_tpu.cli import _validate_numfmt
+    assert _validate_numfmt("%.17g") == "%.17g"
+    assert _validate_numfmt("%lg") == "%g"        # length stripped for %
+    assert _validate_numfmt("%-+12.6e") == "%-+12.6e"
+    for bad in ["%d", "%s", "%*g", "%.*f", "%a", "plain", "%gg"]:
+        with pytest.raises(SystemExit):
+            _validate_numfmt(bad)
