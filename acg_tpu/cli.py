@@ -122,6 +122,12 @@ def make_parser() -> argparse.ArgumentParser:
                    help="total controller processes (with --coordinator)")
     p.add_argument("--process-id", type=int, default=None, metavar="I",
                    help="this controller's index (with --coordinator)")
+    p.add_argument("--profile-ops", nargs="?", const=10, type=int,
+                   default=None, metavar="REPS",
+                   help="fill the stats block's per-op seconds/GB/s by "
+                        "replaying each op class standalone on device "
+                        "(median of REPS calls, default 10) -- the "
+                        "reference's ACG_ENABLE_PROFILING tier")
     p.add_argument("--trace", metavar="DIR", default=None,
                    help="write a jax.profiler trace of the solve to DIR "
                         "(the reference's nsys-trace tier; view with xprof)")
@@ -352,6 +358,12 @@ def _main(args) -> int:
         if args.trace:
             jax.profiler.stop_trace()
     _log(args, "solve:", t0)
+
+    # optional per-op timing tier (replayed, see solvers/profile.py);
+    # None = flag absent, any given value is clamped to >= 1 rep
+    if args.profile_ops is not None:
+        from acg_tpu.solvers.profile import profile_ops
+        profile_ops(solver, b, reps=max(args.profile_ops, 1))
 
     # every controller solves; only "rank 0" speaks (the reference's
     # fwritempi / mtxfile_fwrite_mpi_double root-rank output convention)

@@ -232,6 +232,34 @@ class DistributedProblem:
         return out
 
 
+def make_dist_spmv(prob: "DistributedProblem", comm: str, interpret: bool,
+                   axis: str = PARTS_AXIS):
+    """Shard-level distributed SpMV: halo(x) || local SpMV, then
+    off-diagonal SpMV -- call stack 3.2's overlap pattern
+    (``cgcuda.c:855-899``), scheduled by XLA instead of streams.
+
+    Returns ``f(x_loc, la, ga, sidx, gsrc, gval, scnt, rcnt)`` for use
+    inside ``shard_map`` (shared by the solve program and the per-op
+    profiling tier)."""
+    halo = prob.halo
+    local_block = prob.local
+    ghost_block = prob.ghost
+
+    def dist_spmv(x_loc, la, ga, sidx, gsrc, gval, scnt, rcnt):
+        y = local_block.shard_mv(la, x_loc)
+        if halo.has_ghosts:
+            if comm == "dma":
+                ghost = halo_exchange_dma(x_loc, sidx, gsrc, gval,
+                                          scnt, rcnt,
+                                          axis, interpret=interpret)
+            else:
+                ghost = halo_exchange(x_loc, sidx, gsrc, axis)
+            y = y + ghost_block.shard_mv(ga, ghost)
+        return y
+
+    return dist_spmv
+
+
 class DistCGSolver:
     """Whole-solve SPMD CG program over a 1-D mesh of ``nparts`` devices.
 
@@ -260,7 +288,6 @@ class DistCGSolver:
 
     def _compile(self):
         prob = self.problem
-        halo = prob.halo
         pipelined = self.pipelined
         axis = PARTS_AXIS
 
@@ -268,22 +295,7 @@ class DistCGSolver:
         interpret = self._interpret
         precise = self.precise_dots
 
-        local_block = prob.local
-        ghost_block = prob.ghost
-
-        def dist_spmv(x_loc, la, ga, sidx, gsrc, gval, scnt, rcnt):
-            """halo(x) || local SpMV, then off-diagonal SpMV -- 3.2's
-            overlap pattern, scheduled by XLA instead of streams."""
-            y = local_block.shard_mv(la, x_loc)
-            if halo.has_ghosts:
-                if comm == "dma":
-                    ghost = halo_exchange_dma(x_loc, sidx, gsrc, gval,
-                                              scnt, rcnt,
-                                              axis, interpret=interpret)
-                else:
-                    ghost = halo_exchange(x_loc, sidx, gsrc, axis)
-                y = y + ghost_block.shard_mv(ga, ghost)
-            return y
+        dist_spmv = make_dist_spmv(prob, comm, interpret)
 
         def psum(v):
             return lax.psum(v, axis)
@@ -437,15 +449,13 @@ class DistCGSolver:
 
     # -- public solve ------------------------------------------------------
 
-    def solve(self, b_global: np.ndarray, x0: np.ndarray | None = None,
-              criteria: StoppingCriteria | None = None,
-              raise_on_divergence: bool = True, warmup: int = 0) -> np.ndarray:
-        crit = criteria or StoppingCriteria()
-        st = self.stats
-        st.criteria = crit
+    def device_args(self, b_global: np.ndarray,
+                    x0: np.ndarray | None = None):
+        """Scatter + place every solve input on the mesh (the upload
+        stage of ``acgsolvercuda_init``, ``cgcuda.c:143-332``); shared
+        by :meth:`solve` and the per-op profiler."""
         prob = self.problem
         dtype = np.dtype(prob.dtype)
-
         put = functools.partial(put_global, sharding=self._sharding)
         b = put(prob.scatter(np.asarray(b_global)))
         x0 = put(prob.scatter(np.asarray(x0))
@@ -458,8 +468,20 @@ class DistCGSolver:
         gsrc = put(prob.halo.ghost_src)
         gval = put(prob.halo.ghost_valid)
         scnt_np, rcnt_np = prob.neighbor_counts()
-        scnt = put(scnt_np)
-        rcnt = put(rcnt_np)
+        return (b, x0, la, ga, sidx, gsrc, gval,
+                put(scnt_np), put(rcnt_np))
+
+    def solve(self, b_global: np.ndarray, x0: np.ndarray | None = None,
+              criteria: StoppingCriteria | None = None,
+              raise_on_divergence: bool = True, warmup: int = 0) -> np.ndarray:
+        crit = criteria or StoppingCriteria()
+        st = self.stats
+        st.criteria = crit
+        prob = self.problem
+        dtype = np.dtype(prob.dtype)
+
+        b, x0, la, ga, sidx, gsrc, gval, scnt, rcnt = \
+            self.device_args(b_global, x0)
         tols = jnp.asarray([crit.residual_atol, crit.residual_rtol,
                             crit.diff_atol, crit.diff_rtol], dtype=dtype)
         kwargs = dict(unbounded=crit.unbounded, needs_diff=crit.needs_diff)

@@ -1,0 +1,169 @@
+"""Per-op device timing -- the reference's ``ACG_ENABLE_PROFILING`` tier.
+
+The reference brackets every GPU op with CUDA event pairs
+(``acgEventRecord``, ``cgcuda.c:73-76``; event arrays ``:585-610``;
+summed post-solve ``:1057-1095``) and reports per-op seconds and GB/s in
+the stats block (``:1942-1957``).  Under XLA the whole solve is ONE
+compiled program -- bracketing ops inside it would break the fusion that
+makes it fast -- so this tier *replays* each op class standalone on the
+solver's own device-resident arrays (median of ``reps`` timed calls
+after compile + warmup) and scales by the op counts the always-on
+counters already track.
+
+Honest caveats, also noted in the stats block docs:
+  * replay times are per-op upper bounds: in the real loop XLA fuses
+    vector updates into neighbouring ops, so the per-op sum can exceed
+    ``tsolve`` (the surplus appears as negative "other" time -- itself a
+    measure of how much fusion saves);
+  * the distributed ``gemv`` replay includes the overlapped halo
+    exchange (they are one fused program by design); the halo is also
+    measured alone so the overlap benefit is visible by comparison.
+"""
+
+from __future__ import annotations
+
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+def _median_time(fn, *args, reps: int = 10) -> float:
+    reps = max(int(reps), 1)
+    jax.block_until_ready(fn(*args))  # compile + warm
+    ts = []
+    for _ in range(reps):
+        t0 = time.perf_counter()
+        jax.block_until_ready(fn(*args))
+        ts.append(time.perf_counter() - t0)
+    return sorted(ts)[len(ts) // 2]
+
+
+def profile_ops(solver, b, reps: int = 10) -> dict[str, float]:
+    """Fill ``solver.stats.ops[*].t`` with replayed per-op device times.
+
+    Returns ``{op: seconds_per_call}`` for the measured op classes.
+    Dispatches on solver type; host solvers already time ops for real
+    (eager mode) and are returned unchanged.
+    """
+    # unwrap mixed-precision refinement down to the device solver
+    while hasattr(solver, "inner"):
+        solver = solver.inner
+
+    from acg_tpu.parallel.dist import DistCGSolver
+    from acg_tpu.solvers.jax_cg import JaxCGSolver
+
+    if isinstance(solver, JaxCGSolver):
+        per_call = _profile_single(solver, b, reps)
+    elif isinstance(solver, DistCGSolver):
+        per_call = _profile_dist(solver, b, reps)
+    else:
+        return {}
+
+    for op, t in per_call.items():
+        s = solver.stats.ops[op]
+        s.t = t * s.n
+    return per_call
+
+
+def _profile_single(solver, b, reps: int) -> dict[str, float]:
+    from acg_tpu.solvers.jax_cg import _spmv_fn
+
+    A = solver.A
+    dtype = (A.dtype if hasattr(A, "dtype")
+             else A.data.dtype if hasattr(A, "data") else A.vals.dtype)
+    x = jnp.asarray(np.asarray(b), dtype=dtype)
+    spmv_f = _spmv_fn(solver.kernels)
+    if solver.precise_dots:
+        from acg_tpu.ops.precision import dot_compensated
+
+        def _dot(a, c):
+            hi, lo = dot_compensated(a, c)
+            return hi + lo
+    else:
+        _dot = jnp.dot
+    gemv = jax.jit(lambda v: spmv_f(A, v))
+    dot = jax.jit(_dot)
+    axpy = jax.jit(lambda y, a, p: y + a * p)
+    alpha = jnp.asarray(0.5, dtype)
+    return {
+        "gemv": _median_time(gemv, x, reps=reps),
+        "dot": _median_time(dot, x, x, reps=reps),
+        "axpy": _median_time(axpy, x, alpha, x, reps=reps),
+    }
+
+
+def _profile_dist(solver, b, reps: int) -> dict[str, float]:
+    from jax import lax
+    from jax.sharding import PartitionSpec as P
+
+    from acg_tpu.parallel.dist import make_dist_spmv
+    from acg_tpu.parallel.halo import halo_exchange
+    from acg_tpu.parallel.halo_dma import halo_exchange_dma
+    from acg_tpu.parallel.mesh import PARTS_AXIS
+
+    prob = solver.problem
+    mesh = solver.mesh
+    axis = PARTS_AXIS
+    pspec, rspec = P(PARTS_AXIS), P()
+    bd, x0, la, ga, sidx, gsrc, gval, scnt, rcnt = solver.device_args(b)
+    spmv_shard = make_dist_spmv(prob, solver.comm, solver._interpret)
+
+    def smap(body, in_specs, out_specs):
+        return jax.jit(jax.shard_map(body, mesh=mesh, in_specs=in_specs,
+                                     out_specs=out_specs, check_vma=False))
+
+    # distributed SpMV (includes the overlapped halo, by design)
+    def gemv_body(la, ga, sidx, gsrc, gval, scnt, rcnt, x):
+        la, ga = (jax.tree.map(lambda a: a[0], t) for t in (la, ga))
+        sidx, gsrc, gval, scnt, rcnt, x = (
+            a[0] for a in (sidx, gsrc, gval, scnt, rcnt, x))
+        return spmv_shard(x, la, ga, sidx, gsrc, gval, scnt, rcnt)[None]
+
+    gemv = smap(gemv_body, (pspec,) * 8, pspec)
+    out = {"gemv": _median_time(
+        gemv, la, ga, sidx, gsrc, gval, scnt, rcnt, bd, reps=reps)}
+
+    # halo exchange alone (reference times it per exchange, halo.h:176-186)
+    if prob.halo.has_ghosts:
+        if solver.comm == "dma":
+            interpret = solver._interpret
+
+            def halo_body(x, sidx, gsrc, gval, scnt, rcnt):
+                return halo_exchange_dma(x[0], sidx[0], gsrc[0], gval[0],
+                                         scnt[0], rcnt[0], axis,
+                                         interpret=interpret)[None]
+
+            halo = smap(halo_body, (pspec,) * 6, pspec)
+            out["halo"] = _median_time(halo, bd, sidx, gsrc, gval, scnt,
+                                       rcnt, reps=reps)
+        else:
+            def halo_body(x, sidx, gsrc):
+                return halo_exchange(x[0], sidx[0], gsrc[0], axis)[None]
+
+            halo = smap(halo_body, (pspec,) * 3, pspec)
+            out["halo"] = _median_time(halo, bd, sidx, gsrc, reps=reps)
+
+    # local dot (no reduction) and the scalar allreduce, separately --
+    # the reference's cublasDdot + acgcomm_allreduce split
+    def dot_body(a, c):
+        return jnp.dot(a[0], c[0])[None]
+
+    dot = smap(dot_body, (pspec, pspec), pspec)
+    out["dot"] = _median_time(dot, bd, bd, reps=reps)
+
+    def psum_body(s):
+        return lax.psum(s[0], axis)
+
+    from acg_tpu.parallel.multihost import put_global
+
+    pair = put_global(np.zeros((prob.nparts, 2), dtype=prob.dtype),
+                      jax.sharding.NamedSharding(mesh, pspec))
+    allreduce = smap(psum_body, (pspec,), rspec)
+    out["allreduce"] = _median_time(allreduce, pair, reps=reps)
+
+    axpy = jax.jit(lambda y, a, p: y + a * p)
+    out["axpy"] = _median_time(axpy, bd, jnp.asarray(0.5, prob.dtype), bd,
+                               reps=reps)
+    return out

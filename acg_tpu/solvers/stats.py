@@ -9,10 +9,12 @@ is line-compatible so the reference's analysis scripts (which grep
 ``total solver time``) work unchanged.
 
 One deliberate deviation: under ``jax.jit`` the whole solve is one fused
-XLA program, so per-op *times* are not separately observable without a
-profiler trace; per-op counts and analytic bytes are still tracked, and op
-times are filled only by the host reference solver (eager mode).  Use
-``jax.profiler`` traces for the fine-grained tier.
+XLA program, so per-op *times* are not separately observable in-loop.
+Per-op counts and analytic bytes are always tracked; op times are filled
+by the host reference solver (eager mode) and, for the compiled solvers,
+by the replay-based profiling tier (:mod:`acg_tpu.solvers.profile`,
+CLI ``--profile-ops``).  Use ``jax.profiler`` traces (``--trace``) for
+the fine-grained tier.
 """
 
 from __future__ import annotations
