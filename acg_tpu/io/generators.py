@@ -46,6 +46,58 @@ def poisson3d_coo(n: int, dtype=np.float64):
     return np.concatenate(rows), np.concatenate(cols), np.concatenate(vals), N
 
 
+def irregular_spd_coo(n: int, avg_degree: float = 16.0, seed: int = 0,
+                      dtype=np.float64):
+    """Random irregular SPD matrix -> full COO.
+
+    Stands in for the irregular SuiteSparse SPD workloads of the
+    benchmark protocol (BASELINE.json configs 4-5: Flan_1565, Serena,
+    Queen_4147 -- not redistributable here): a configuration-model graph
+    whose degrees follow a truncated power law (so row lengths vary by
+    orders of magnitude, defeating banded/DIA layouts and exercising the
+    ELL/gather SpMV paths), with negative off-diagonal weights and a
+    strictly diagonally dominant diagonal -> symmetric positive
+    definite.
+    """
+    rng = np.random.default_rng(seed)
+    # power-law-ish stub counts: most rows short, a heavy tail of hubs
+    # pareto(2.2)+1 has mean ~1.83; scale so mean stubs/row ~ avg_degree
+    # (each stub becomes one off-diagonal entry in its own row)
+    deg = np.minimum((rng.pareto(2.2, n) + 1.0) * (avg_degree * 0.546),
+                     n // 4).astype(np.int64)
+    stubs = np.repeat(np.arange(n, dtype=IDX_DTYPE), deg)
+    rng.shuffle(stubs)
+    if stubs.size % 2:
+        stubs = stubs[:-1]
+    u, v = stubs[0::2], stubs[1::2]
+    keep = u != v
+    u, v = u[keep], v[keep]
+    lo, hi = np.minimum(u, v), np.maximum(u, v)
+    edges = np.unique(lo.astype(np.int64) * n + hi)
+    lo, hi = (edges // n).astype(IDX_DTYPE), (edges % n).astype(IDX_DTYPE)
+    w = -(0.1 + rng.random(lo.size)).astype(dtype)
+    # diagonal = 1 + sum of |offdiag| per row -> strict dominance
+    diag = np.ones(n, dtype=dtype)
+    np.add.at(diag, lo, -w)
+    np.add.at(diag, hi, -w)
+    idx = np.arange(n, dtype=IDX_DTYPE)
+    rows = np.concatenate([idx, lo, hi])
+    cols = np.concatenate([idx, hi, lo])
+    vals = np.concatenate([diag, w, w])
+    return rows, cols, vals, n
+
+
+def irregular_mtx(n: int, avg_degree: float = 16.0, seed: int = 0) -> MtxFile:
+    """Irregular SPD matrix as a symmetric (lower-triangle) MtxFile."""
+    r, c, v, N = irregular_spd_coo(n, avg_degree, seed)
+    keep = r >= c
+    order = np.lexsort((c[keep], r[keep]))
+    return MtxFile(object="matrix", format="coordinate", field="real",
+                   symmetry="symmetric", nrows=N, ncols=N, nnz=int(keep.sum()),
+                   rowidx=r[keep][order], colidx=c[keep][order],
+                   vals=v[keep][order])
+
+
 def poisson_mtx(n: int, dim: int = 2) -> MtxFile:
     """Poisson matrix as a symmetric (lower-triangle) MtxFile."""
     if dim == 2:

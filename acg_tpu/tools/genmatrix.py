@@ -16,20 +16,33 @@ import time
 def main(argv=None) -> int:
     p = argparse.ArgumentParser(prog="acg-tpu-genmatrix",
                                 description="Generate Poisson test matrices.")
-    p.add_argument("-n", type=int, required=True, help="grid points per side")
+    p.add_argument("-n", type=int, required=True,
+                   help="grid points per side (poisson) or rows (irregular)")
+    p.add_argument("--kind", default="poisson",
+                   choices=["poisson", "irregular"],
+                   help="poisson = banded stencil; irregular = power-law "
+                        "random SPD (the SuiteSparse-workload stand-in)")
     p.add_argument("--dim", type=int, default=2, choices=[2, 3])
+    p.add_argument("--avg-degree", type=float, default=16.0,
+                   help="mean row degree for --kind irregular")
+    p.add_argument("--seed", type=int, default=0)
     p.add_argument("-o", "--output", default=None,
                    help="output path (default: poisson{dim}d_n{n}.mtx)")
     p.add_argument("--binary", action="store_true", help="write binary format")
     p.add_argument("-v", "--verbose", action="count", default=0)
     args = p.parse_args(argv)
 
-    from acg_tpu.io.generators import poisson_mtx
+    from acg_tpu.io.generators import irregular_mtx, poisson_mtx
     from acg_tpu.io.mtxfile import write_mtx
 
     t0 = time.perf_counter()
-    mtx = poisson_mtx(args.n, dim=args.dim)
-    out = args.output or f"poisson{args.dim}d_n{args.n}.mtx"
+    if args.kind == "irregular":
+        mtx = irregular_mtx(args.n, avg_degree=args.avg_degree,
+                            seed=args.seed)
+        out = args.output or f"irregular_n{args.n}.mtx"
+    else:
+        mtx = poisson_mtx(args.n, dim=args.dim)
+        out = args.output or f"poisson{args.dim}d_n{args.n}.mtx"
     write_mtx(out, mtx, binary=args.binary)
     if args.verbose:
         sys.stderr.write(

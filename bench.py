@@ -69,10 +69,15 @@ def _h100_standin(ref_bytes_per_iter: float) -> float:
 
 
 def _build(side: int, dim: int):
-    from acg_tpu.io.generators import poisson2d_coo, poisson3d_coo
+    """dim 2/3 = Poisson stencils; dim 0 = irregular power-law SPD with
+    ``side`` rows (the SuiteSparse-workload stand-in, configs 4-5)."""
+    from acg_tpu.io.generators import (irregular_spd_coo, poisson2d_coo,
+                                       poisson3d_coo)
     from acg_tpu.matrix import SymCsrMatrix
 
-    r, c, v, N = (poisson2d_coo if dim == 2 else poisson3d_coo)(side)
+    gen = {2: poisson2d_coo, 3: poisson3d_coo,
+           0: lambda n: irregular_spd_coo(n, avg_degree=16.0, seed=0)}[dim]
+    r, c, v, N = gen(side)
     return SymCsrMatrix.from_coo(N, r, c, v).to_csr()
 
 
@@ -209,6 +214,8 @@ def main(argv=None) -> int:
             ("cg_iters_per_sec_poisson3d_n256_f32", 256, 3, False, False, "xla"),
             ("cg_dist1_iters_per_sec_poisson2d_n2048_f32",
              2048, 2, False, True, "xla"),
+            ("cg_iters_per_sec_irregular_n500k_d16_f32",
+             500_000, 0, False, False, "xla"),
         ]
 
     built: dict[tuple, object] = {}
