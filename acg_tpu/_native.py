@@ -85,20 +85,65 @@ def _try_build() -> bool:
                 pass
 
 
+_ABI_VERSION = 2  # must match acg_core_abi_version() (native/src/sort.cpp)
+
+
+def _open_and_bind(path=None):
+    """CDLL + version check + symbol binding; None on any mismatch (a
+    missing symbol or wrong version means a stale library)."""
+    try:
+        lib = ctypes.CDLL(path or _LIB_PATH)
+    except OSError:
+        return None
+    c = ctypes.c_int64
+    try:
+        lib.acg_core_abi_version.restype = ctypes.c_int32
+        if lib.acg_core_abi_version() != _ABI_VERSION:
+            return None
+        _bind(lib, c)
+    except AttributeError:
+        return None
+    return lib
+
+
 def _load():
     global _lib
     if os.environ.get("ACG_TPU_DISABLE_NATIVE"):
         return None
     if not os.path.exists(_LIB_PATH) and not _try_build():
         return None
-    try:
-        lib = ctypes.CDLL(_LIB_PATH)
-    except OSError:
-        return None
-    c = ctypes.c_int64
-    lib.acg_core_abi_version.restype = ctypes.c_int32
-    if lib.acg_core_abi_version() != 1:
-        return None
+    lib = _open_and_bind()
+    if (lib is None and os.path.exists(_LIB_PATH)
+            and os.path.exists(os.path.join(_NATIVE_DIR, "Makefile"))):
+        # stale library from an older checkout: rebuild once
+        try:
+            os.remove(_LIB_PATH)
+        except OSError:
+            return None
+        if _try_build():
+            lib = _open_and_bind()
+            if lib is None:
+                # dlopen caches the stale mapping by pathname; load the
+                # fresh build through a unique temp path (safe to unlink
+                # once dlopened)
+                import shutil
+                import tempfile
+
+                fd, tmp = tempfile.mkstemp(suffix=".so")
+                os.close(fd)
+                try:
+                    shutil.copy2(_LIB_PATH, tmp)
+                    lib = _open_and_bind(tmp)
+                finally:
+                    try:
+                        os.remove(tmp)
+                    except OSError:
+                        pass
+    _lib = lib
+    return lib
+
+
+def _bind(lib, c):
     lib.acg_radixsort_i64.argtypes = [c, _I64, _I64]
     lib.acg_radixargsort_i64.argtypes = [c, _I64, _I64]
     lib.acg_prefixsum_exclusive_i64.argtypes = [c, _I64]
@@ -128,8 +173,11 @@ def _load():
     lib.acg_pr_fill.argtypes = [ctypes.c_void_p, _I64, _I32, _I32, _I64,
                                 _I64]
     lib.acg_pr_free.argtypes = [ctypes.c_void_p]
-    _lib = lib
-    return lib
+    lib.acg_cg_solve.restype = ctypes.c_int32
+    lib.acg_cg_solve.argtypes = [
+        c, _I64, _I64, _F64, _F64, _F64, ctypes.c_int32,
+        ctypes.c_double, ctypes.c_double, ctypes.c_double, ctypes.c_double,
+        _I32, _F64, _F64, _F64]
 
 
 _lib = _load()
@@ -338,3 +386,46 @@ def graph_partition(nrows: int, frowptr, fcolidx, part, nparts: int):
     return dict(nowned=nowned, ninterior=ninterior, nghost=nghost,
                 nsend=nsend, global_ids=global_ids, ghost_owner=ghost_owner,
                 send_part=send_part, send_gid=send_gid, send_lidx=send_lidx)
+
+
+# ---- host CG solver ------------------------------------------------------
+
+def cg_solve(rowptr, colidx, vals, b, x0=None, maxits=100, res_atol=0.0,
+             res_rtol=0.0, diff_atol=0.0, diff_rtol=0.0):
+    """Native classic-CG solve over full-storage CSR (acg_cg_solve).
+
+    Returns ``(x, niter, rnrm2, r0nrm2, dxnrm2, converged)``.  The C loop
+    mirrors ``solvers.host_cg.HostCGSolver`` exactly (see
+    native/src/cg.cpp), so the two host oracles cross-check each other.
+    """
+    rowptr = _i64(rowptr)
+    colidx = _i64(colidx)
+    vals = np.ascontiguousarray(vals, dtype=np.float64)
+    b = np.ascontiguousarray(b, dtype=np.float64)
+    x = (np.zeros_like(b) if x0 is None
+         else np.array(x0, dtype=np.float64, copy=True))
+    n = b.size
+    # validate shapes BEFORE crossing into C: the native loop writes
+    # x[0..n) and reads rowptr[0..n], trusting the caller
+    if x.shape != (n,):
+        raise ValueError(f"x0 has shape {x.shape}, need ({n},)")
+    if rowptr.shape != (n + 1,):
+        raise ValueError(f"rowptr has shape {rowptr.shape}, need ({n + 1},)")
+    nnz = int(rowptr[-1])
+    if colidx.size < nnz or vals.size < nnz:
+        raise ValueError(f"colidx/vals have {colidx.size}/{vals.size} "
+                         f"entries, rowptr ends at {nnz}")
+    if nnz and (colidx[:nnz].min() < 0 or colidx[:nnz].max() >= n):
+        raise ValueError("colidx out of range")
+    niter = np.zeros(1, dtype=np.int32)
+    out = np.zeros(3, dtype=np.float64)  # rnrm2, r0nrm2, dxnrm2
+    rc = _lib.acg_cg_solve(
+        n, _ptr(rowptr, _I64), _ptr(colidx, _I64), _ptr(vals, _F64),
+        _ptr(b, _F64), _ptr(x, _F64), int(maxits),
+        float(res_atol), float(res_rtol), float(diff_atol), float(diff_rtol),
+        _ptr(niter, _I32), _ptr(out[0:], _F64), _ptr(out[1:], _F64),
+        _ptr(out[2:], _F64))
+    if rc < 0:
+        raise ValueError(f"acg_cg_solve: invalid input (code {rc})")
+    return (x, int(niter[0]), float(out[0]), float(out[1]), float(out[2]),
+            rc == 0)

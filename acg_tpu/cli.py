@@ -42,8 +42,11 @@ def make_parser() -> argparse.ArgumentParser:
     p.add_argument("x0", nargs="?", default=None, help="initial guess (default: zeros)")
     p.add_argument("--solver", default="acg",
                    choices=["acg", "acg-pipelined", "acg-device",
-                            "acg-pipelined-device", "host", "petsc"],
-                   help="solver variant (default: acg)")
+                            "acg-pipelined-device", "host", "host-native",
+                            "petsc"],
+                   help="solver variant (default: acg); host = numpy "
+                        "reference oracle, host-native = C++ core oracle "
+                        "(native/src/cg.cpp)")
     p.add_argument("--comm", default="xla",
                    choices=["none", "xla", "dma", "mpi", "nccl", "nvshmem"],
                    help="halo transport: xla collectives or pallas dma "
@@ -308,7 +311,15 @@ def _main(args) -> int:
     if args.trace:
         jax.profiler.start_trace(args.trace)
     try:
-        if args.solver == "host":
+        if args.solver == "host-native":
+            from acg_tpu.solvers.host_cg import NativeHostCGSolver
+            try:
+                solver = NativeHostCGSolver(csr)
+            except RuntimeError as e:
+                sys.stderr.write(f"acg-tpu: {e}\n")
+                return 1
+            x = solver.solve(b, x0=x0, criteria=criteria)
+        elif args.solver == "host":
             if nparts > 1 and comm != "none":
                 # the acgsolver_solvempi analog (cg.c:408): same
                 # partitioned layout as the device path, pure host

@@ -24,7 +24,8 @@ import scipy.sparse as sp
 
 from acg_tpu.errors import NotConvergedError
 from acg_tpu.matrix import SymCsrMatrix
-from acg_tpu.solvers.stats import SolverStats, StoppingCriteria
+from acg_tpu.solvers.stats import (SolverStats, StoppingCriteria,
+                                   cg_flops_per_iteration)
 
 
 def as_csr(A: SymCsrMatrix | sp.spmatrix, epsilon: float = 0.0) -> sp.csr_matrix:
@@ -139,6 +140,67 @@ class HostCGSolver:
         if crit.diff_rtol > 0 and st.dxnrm2 < crit.diff_rtol * max(st.x0nrm2, 1e-300):
             return True
         return False
+
+
+class NativeHostCGSolver:
+    """Host CG through the native C++ core (``native/src/cg.cpp``).
+
+    The reference's host solver is native C (``acg/cg.c``); this is its
+    direct counterpart -- same recurrences and stopping criteria as
+    :class:`HostCGSolver` (the two oracles cross-check each other in the
+    tests), with the OpenMP SpMV loop running at C speed.
+    """
+
+    def __init__(self, A: SymCsrMatrix | sp.spmatrix, epsilon: float = 0.0):
+        from acg_tpu import _native
+
+        if not _native.available():
+            raise RuntimeError(
+                "native core unavailable (build native/libacg_core.so or "
+                "use --solver host)")
+        self._native = _native
+        self.A = as_csr(A, epsilon)
+        self.n = self.A.shape[0]
+        self.nnz_full = self.A.nnz
+        self.stats = SolverStats(unknowns=self.n)
+
+    def solve(self, b: np.ndarray, x0: np.ndarray | None = None,
+              criteria: StoppingCriteria | None = None,
+              raise_on_divergence: bool = True) -> np.ndarray:
+        crit = criteria or StoppingCriteria()
+        st = self.stats
+        st.criteria = crit
+        A, n = self.A, self.n
+        b = np.asarray(b, dtype=np.float64)
+
+        tstart = time.perf_counter()
+        x, niter, rnrm2, r0nrm2, dxnrm2, converged = self._native.cg_solve(
+            A.indptr, A.indices, A.data, b, x0, crit.maxits,
+            crit.residual_atol, crit.residual_rtol,
+            crit.diff_atol, crit.diff_rtol)
+        st.tsolve += time.perf_counter() - tstart
+
+        st.nsolves += 1
+        st.niterations = niter
+        st.ntotaliterations += niter
+        st.bnrm2 = float(np.linalg.norm(b))
+        st.x0nrm2 = float(np.linalg.norm(x0)) if x0 is not None else 0.0
+        st.r0nrm2, st.rnrm2 = r0nrm2, rnrm2
+        st.dxnrm2 = dxnrm2
+        st.converged = converged
+        dbl = 8
+        st.nflops += (cg_flops_per_iteration(self.nnz_full, n) * niter
+                      + 3.0 * self.nnz_full + 2.0 * n)
+        st.ops["gemv"].add(niter + 1, 0.0,
+                           (self.nnz_full * (dbl + 8) + 2 * n * dbl)
+                           * (niter + 1))
+        st.ops["dot"].add(2 * niter, 0.0, 2 * n * dbl * 2 * niter)
+        st.ops["axpy"].add(3 * niter, 0.0, 3 * n * dbl * 3 * niter)
+        st.fexcept_arrays = [x]
+        if not converged and raise_on_divergence:
+            raise NotConvergedError(
+                f"{niter} iterations, residual {rnrm2:.3e}")
+        return x
 
 
 class HostDistCGSolver:

@@ -1,0 +1,119 @@
+/* Host reference CG solver in native code.
+ *
+ * The role of the reference's acg/cg.c (SURVEY.md component #16): a
+ * textbook classic-CG correctness oracle over full-storage CSR, with the
+ * same recurrences as acgsolver_solve (cg.c:198-407) and all four
+ * stopping criteria (cg.h:136-149).  The SpMV is the OpenMP row loop
+ * idiom of acgsymcsrmatrix_dsymv (symcsrmatrix.c:863-1005); dots use
+ * OpenMP reductions.  Semantics (tolerance derivation, diff-in-iterates
+ * via |alpha|*||p||) match acg_tpu.solvers.host_cg exactly, so the two
+ * oracles cross-check each other.
+ */
+
+#include "acg_core.h"
+
+#include <cmath>
+#include <vector>
+
+namespace {
+
+void spmv(int64_t n, const int64_t *rowptr, const int64_t *colidx,
+          const double *a, const double *x, double *y) {
+#ifdef _OPENMP
+#pragma omp parallel for schedule(static)
+#endif
+    for (int64_t i = 0; i < n; i++) {
+        double acc = 0.0;
+        int64_t k = rowptr[i], end = rowptr[i + 1];
+        /* 4-way unroll (the reference's dsymv loop shape) */
+        for (; k + 3 < end; k += 4)
+            acc += a[k] * x[colidx[k]] + a[k + 1] * x[colidx[k + 1]] +
+                   a[k + 2] * x[colidx[k + 2]] + a[k + 3] * x[colidx[k + 3]];
+        for (; k < end; k++) acc += a[k] * x[colidx[k]];
+        y[i] = acc;
+    }
+}
+
+double dot(int64_t n, const double *a, const double *b) {
+    double acc = 0.0;
+#ifdef _OPENMP
+#pragma omp parallel for reduction(+ : acc) schedule(static)
+#endif
+    for (int64_t i = 0; i < n; i++) acc += a[i] * b[i];
+    return acc;
+}
+
+}  // namespace
+
+extern "C" {
+
+int32_t acg_cg_solve(int64_t n, const int64_t *rowptr, const int64_t *colidx,
+                     const double *a, const double *b, double *x,
+                     int32_t maxits, double res_atol, double res_rtol,
+                     double diff_atol, double diff_rtol, int32_t *niter,
+                     double *rnrm2_out, double *r0nrm2_out,
+                     double *dxnrm2_out) {
+    if (n < 0 || maxits < 0) return ACG_NATIVE_ERR_INVALID_FORMAT;
+    std::vector<double> r(n), p(n), t(n);
+    const bool unbounded = res_atol == 0.0 && res_rtol == 0.0 &&
+                           diff_atol == 0.0 && diff_rtol == 0.0;
+    const bool needs_diff = diff_atol > 0.0 || diff_rtol > 0.0;
+
+    double x0nrm2 = std::sqrt(dot(n, x, x));
+    spmv(n, rowptr, colidx, a, x, t.data());
+#ifdef _OPENMP
+#pragma omp parallel for schedule(static)
+#endif
+    for (int64_t i = 0; i < n; i++) {
+        r[i] = b[i] - t[i];
+        p[i] = r[i];
+    }
+    double gamma = dot(n, r.data(), r.data());
+    double rnrm2 = std::sqrt(gamma);
+    double r0nrm2 = rnrm2;
+    double dxnrm2 = HUGE_VAL;
+    *r0nrm2_out = r0nrm2;
+    double res_tol = res_atol > res_rtol * r0nrm2 ? res_atol
+                                                  : res_rtol * r0nrm2;
+    auto test = [&]() {
+        if (res_tol > 0.0 && rnrm2 < res_tol) return true;
+        if (diff_atol > 0.0 && dxnrm2 < diff_atol) return true;
+        if (diff_rtol > 0.0 &&
+            dxnrm2 < diff_rtol * (x0nrm2 > 1e-300 ? x0nrm2 : 1e-300))
+            return true;
+        return false;
+    };
+
+    int32_t k = 0;
+    bool converged = !unbounded && test();
+    while (!converged && k < maxits) {
+        spmv(n, rowptr, colidx, a, p.data(), t.data());
+        double pdott = dot(n, p.data(), t.data());
+        double alpha = gamma / pdott;
+#ifdef _OPENMP
+#pragma omp parallel for schedule(static)
+#endif
+        for (int64_t i = 0; i < n; i++) {
+            x[i] += alpha * p[i];
+            r[i] -= alpha * t[i];
+        }
+        double gamma_next = dot(n, r.data(), r.data());
+        double beta = gamma_next / gamma;
+        gamma = gamma_next;
+        if (needs_diff)
+            dxnrm2 = std::fabs(alpha) * std::sqrt(dot(n, p.data(), p.data()));
+#ifdef _OPENMP
+#pragma omp parallel for schedule(static)
+#endif
+        for (int64_t i = 0; i < n; i++) p[i] = r[i] + beta * p[i];
+        k++;
+        rnrm2 = std::sqrt(gamma);
+        if (!unbounded) converged = test();
+    }
+    *niter = k;
+    *rnrm2_out = rnrm2;
+    *dxnrm2_out = dxnrm2;
+    return (converged || unbounded) ? 0 : 1;
+}
+
+}  // extern "C"

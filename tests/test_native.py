@@ -205,3 +205,64 @@ def test_mtxfile_native_vs_python_read(tmp_path):
     assert int(rs) == int(m1.rowidx.sum())
     assert int(cs) == int(m1.colidx.sum())
     assert float(vs) == float(m1.vals.sum())
+
+
+# ---- host CG solver (native/src/cg.cpp) ----------------------------------
+
+def _poisson_csr(n=24):
+    from acg_tpu.io.generators import poisson2d_coo
+    from acg_tpu.matrix import SymCsrMatrix
+
+    r, c, v, N = poisson2d_coo(n)
+    return SymCsrMatrix.from_coo(N, r, c, v).to_csr()
+
+
+def test_native_cg_matches_python_host():
+    from acg_tpu.solvers.host_cg import HostCGSolver, NativeHostCGSolver
+    from acg_tpu.solvers.stats import StoppingCriteria
+
+    csr = _poisson_csr()
+    n = csr.shape[0]
+    rng = np.random.default_rng(5)
+    xsol = rng.standard_normal(n)
+    xsol /= np.linalg.norm(xsol)
+    b = csr @ xsol
+    crit = StoppingCriteria(maxits=5000, residual_rtol=1e-11)
+    py = HostCGSolver(csr)
+    nt = NativeHostCGSolver(csr)
+    xp = py.solve(b, criteria=crit)
+    xn = nt.solve(b, criteria=crit)
+    # identical recurrences in f64: same iteration count, same solution
+    assert nt.stats.niterations == py.stats.niterations
+    np.testing.assert_allclose(xn, xp, rtol=0, atol=1e-12)
+    assert np.linalg.norm(xn - xsol) < 1e-9
+    assert nt.stats.rnrm2 == pytest.approx(py.stats.rnrm2, rel=1e-6)
+
+
+def test_native_cg_unbounded_and_divergence():
+    from acg_tpu.errors import NotConvergedError
+    from acg_tpu.solvers.host_cg import NativeHostCGSolver
+    from acg_tpu.solvers.stats import StoppingCriteria
+
+    csr = _poisson_csr(12)
+    b = np.ones(csr.shape[0])
+    s = NativeHostCGSolver(csr)
+    s.solve(b, criteria=StoppingCriteria(maxits=7))  # unbounded: exact count
+    assert s.stats.niterations == 7 and s.stats.converged
+    with pytest.raises(NotConvergedError):
+        NativeHostCGSolver(csr).solve(
+            b, criteria=StoppingCriteria(maxits=3, residual_rtol=1e-14))
+
+
+def test_native_cg_diff_criterion_and_x0():
+    from acg_tpu.solvers.host_cg import HostCGSolver, NativeHostCGSolver
+    from acg_tpu.solvers.stats import StoppingCriteria
+
+    csr = _poisson_csr(16)
+    n = csr.shape[0]
+    b = np.ones(n)
+    x0 = np.full(n, 0.1)
+    crit = StoppingCriteria(maxits=5000, diff_atol=1e-10)
+    py = HostCGSolver(csr).solve(b, x0=x0, criteria=crit)
+    nt = NativeHostCGSolver(csr).solve(b, x0=x0, criteria=crit)
+    np.testing.assert_allclose(nt, py, rtol=0, atol=1e-10)
