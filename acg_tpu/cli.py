@@ -91,13 +91,12 @@ def make_parser() -> argparse.ArgumentParser:
                    help="device arithmetic precision (default: f64)")
     p.add_argument("--kernels", default="auto",
                    choices=["auto", "xla", "pallas"],
-                   help="single-device hot-loop kernel tier: xla = "
-                        "compiler-fused ops, pallas = hand-written "
-                        "single-x-pass DIA SpMV (the reference's "
-                        "cg-kernels-cuda.cu tier; vector updates stay in "
-                        "XLA -- see BASELINE.md); auto picks pallas on TPU "
-                        "hardware for DIA matrices; ignored on the "
-                        "multi-part path")
+                   help="hot-loop kernel tier: xla = compiler-fused ops, "
+                        "pallas = hand-written single-x-pass DIA SpMV "
+                        "(the reference's cg-kernels-cuda.cu tier; vector "
+                        "updates stay in XLA -- see BASELINE.md); auto "
+                        "picks pallas on TPU hardware for DIA matrices "
+                        "and DIA local blocks of the multi-part path")
     p.add_argument("--precise-dots", action="store_true",
                    help="compensated (double-float) dot products for the "
                         "CG scalars; lets f32 storage converge past the "
@@ -351,7 +350,8 @@ def _main(args) -> int:
             prob = DistributedProblem.build(csr, part, nparts, dtype=dtype,
                                             subs=subs)
             solver = DistCGSolver(prob, pipelined=pipelined, comm=comm,
-                                  precise_dots=args.precise_dots)
+                                  precise_dots=args.precise_dots,
+                                  kernels=args.kernels)
             if args.refine:
                 solver = RefinedSolver(solver, csr,
                                        inner_rtol=args.refine_rtol)

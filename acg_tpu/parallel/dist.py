@@ -233,10 +233,15 @@ class DistributedProblem:
 
 
 def make_dist_spmv(prob: "DistributedProblem", comm: str, interpret: bool,
-                   axis: str = PARTS_AXIS):
+                   kernels: str = "xla", axis: str = PARTS_AXIS):
     """Shard-level distributed SpMV: halo(x) || local SpMV, then
     off-diagonal SpMV -- call stack 3.2's overlap pattern
     (``cgcuda.c:855-899``), scheduled by XLA instead of streams.
+
+    ``kernels="pallas*"`` runs the hand-written single-x-pass DIA kernel
+    for the local block (the role of the reference's device SpMV inside
+    ``solvempi``, ``cgcuda.c:871``); non-DIA local blocks and the small
+    ghost block stay on the XLA path.
 
     Returns ``f(x_loc, la, ga, sidx, gsrc, gval, scnt, rcnt)`` for use
     inside ``shard_map`` (shared by the solve program and the per-op
@@ -244,9 +249,17 @@ def make_dist_spmv(prob: "DistributedProblem", comm: str, interpret: bool,
     halo = prob.halo
     local_block = prob.local
     ghost_block = prob.ghost
+    use_pallas = kernels.startswith("pallas") and local_block.format == "dia"
+    pallas_interpret = kernels.endswith("interpret")
+    if use_pallas:
+        from acg_tpu.ops.pallas_kernels import dia_spmv
 
     def dist_spmv(x_loc, la, ga, sidx, gsrc, gval, scnt, rcnt):
-        y = local_block.shard_mv(la, x_loc)
+        if use_pallas:
+            y = dia_spmv(la, local_block.offsets, x_loc,
+                         interpret=pallas_interpret)
+        else:
+            y = local_block.shard_mv(la, x_loc)
         if halo.has_ghosts:
             if comm == "dma":
                 ghost = halo_exchange_dma(x_loc, sidx, gsrc, gval,
@@ -271,7 +284,7 @@ class DistCGSolver:
 
     def __init__(self, problem: DistributedProblem, pipelined: bool = False,
                  mesh: Mesh | None = None, comm: str = "xla",
-                 precise_dots: bool = False):
+                 precise_dots: bool = False, kernels: str = "auto"):
         if comm not in ("xla", "dma"):
             raise ValueError(f"unknown halo transport {comm!r}")
         self.problem = problem
@@ -282,6 +295,19 @@ class DistCGSolver:
         self.stats = SolverStats(unknowns=problem.n)
         self._sharding = NamedSharding(self.mesh, P(PARTS_AXIS))
         self._interpret = self.mesh.devices.flat[0].platform != "tpu"
+        # kernel-tier resolution mirrors JaxCGSolver: pallas on TPU
+        # hardware for f32/bf16 DIA local blocks, interpret mode when
+        # explicitly requested off-TPU (tests), XLA otherwise
+        itemsize = np.dtype(problem.dtype).itemsize
+        if kernels == "auto":
+            kernels = ("pallas" if not self._interpret
+                       and itemsize in (2, 4)
+                       and problem.local.format == "dia" else "xla")
+        elif kernels == "pallas" and self._interpret:
+            kernels = "pallas-interpret"
+        if kernels not in ("xla", "pallas", "pallas-interpret"):
+            raise ValueError(f"unknown kernels choice {kernels!r}")
+        self.kernels = kernels
         self._program = self._compile()
 
     # -- program construction ---------------------------------------------
@@ -295,7 +321,8 @@ class DistCGSolver:
         interpret = self._interpret
         precise = self.precise_dots
 
-        dist_spmv = make_dist_spmv(prob, comm, interpret)
+        dist_spmv = make_dist_spmv(prob, comm, interpret,
+                                   kernels=self.kernels)
 
         def psum(v):
             return lax.psum(v, axis)

@@ -108,7 +108,8 @@ def _profile_dist(solver, b, reps: int) -> dict[str, float]:
     axis = PARTS_AXIS
     pspec, rspec = P(PARTS_AXIS), P()
     bd, x0, la, ga, sidx, gsrc, gval, scnt, rcnt = solver.device_args(b)
-    spmv_shard = make_dist_spmv(prob, solver.comm, solver._interpret)
+    spmv_shard = make_dist_spmv(prob, solver.comm, solver._interpret,
+                                kernels=solver.kernels)
 
     def smap(body, in_specs, out_specs):
         return jax.jit(jax.shard_map(body, mesh=mesh, in_specs=in_specs,
