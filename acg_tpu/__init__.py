@@ -9,20 +9,46 @@ Pallas remote DMA over a TPU device mesh.
 Layering (mirrors the reference's layer map, SURVEY.md section 1, rebuilt
 TPU-first rather than ported):
 
-  L0  acg_tpu.errors, acg_tpu.io.mtxfile, acg_tpu.utils.*   (foundation)
+  L0  acg_tpu.errors, acg_tpu.io.mtxfile, acg_tpu.fmtspec,
+      acg_tpu._native                                       (foundation)
   L1  acg_tpu.graph, acg_tpu.partition                      (partitioning)
-  L2  acg_tpu.parallel.comm                                 (collectives)
-  L3  acg_tpu.parallel.halo                                 (halo exchange)
-  L4  acg_tpu.matrix, acg_tpu.vector                        (sparse linalg)
-  L5  acg_tpu.solvers.*                                     (CG solvers)
+  L2  acg_tpu.parallel.mesh, acg_tpu.parallel.multihost     (communicator)
+  L3  acg_tpu.parallel.halo, acg_tpu.parallel.halo_dma      (halo exchange)
+  L4  acg_tpu.matrix, acg_tpu.vector, acg_tpu.ops.*         (sparse linalg)
+  L5  acg_tpu.solvers.*, acg_tpu.parallel.dist              (CG solvers)
   L6  acg_tpu.tools.*                                       (offline tools)
   L7  acg_tpu.cli                                           (driver)
 
-This module intentionally does NOT import jax at top level so that pure
-host-side preprocessing (I/O, partitioning) stays importable and fast in
-contexts without an accelerator runtime.
+This module does NOT import jax at top level, so pure host-side
+preprocessing (I/O, partitioning, the host oracles) stays importable and
+fast in contexts without an accelerator runtime; the jax-backed solvers
+(`JaxCGSolver`, `DistCGSolver`, `DistributedProblem`, `solve_mesh`) are
+exposed lazily and import jax on first access.
 """
 
 __version__ = "0.1.0"
 
 from acg_tpu.errors import AcgError, ErrorCode  # noqa: F401
+from acg_tpu.solvers.host_cg import (HostCGSolver,  # noqa: F401
+                                     HostDistCGSolver, NativeHostCGSolver)
+from acg_tpu.solvers.stats import SolverStats, StoppingCriteria  # noqa: F401
+
+_LAZY = {
+    "solve_mesh": "acg_tpu.parallel.mesh",
+    "DistributedProblem": "acg_tpu.parallel.dist",
+    "DistCGSolver": "acg_tpu.parallel.dist",
+    "JaxCGSolver": "acg_tpu.solvers.jax_cg",
+}
+
+
+def __getattr__(name):
+    target = _LAZY.get(name)
+    if target is None:
+        raise AttributeError(f"module {__name__!r} has no attribute {name!r}")
+    import importlib
+
+    return getattr(importlib.import_module(target), name)
+
+
+def __dir__():
+    return sorted(set(globals()) | set(_LAZY))
