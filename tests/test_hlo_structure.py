@@ -1,0 +1,84 @@
+"""Structural (HLO-level) properties of the compiled distributed solve.
+
+The pipelined variant's entire reason to exist is communication
+avoidance: both CG scalars ride ONE allreduce per iteration where
+classic CG needs two (``cgcuda.c:1730-1737``; our ``pdot2_fused``).
+These tests pin that property at the compiler-artifact level -- if a
+refactor accidentally splits the fused psum or adds a collective to the
+loop body, the lowered program's collective counts change and this
+fails, no timing required.
+"""
+
+import re
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+from acg_tpu.io.generators import poisson2d_coo
+from acg_tpu.matrix import SymCsrMatrix
+from acg_tpu.parallel.dist import DistCGSolver, DistributedProblem
+from acg_tpu.partition import partition_rows
+
+
+@pytest.fixture(scope="module")
+def prob():
+    r, c, v, N = poisson2d_coo(16)
+    csr = SymCsrMatrix.from_coo(N, r, c, v).to_csr()
+    part = partition_rows(csr, 4, seed=0, method="band")
+    return DistributedProblem.build(csr, part, 4, dtype=jnp.float64)
+
+
+def _lowered_text(prob, pipelined):
+    s = DistCGSolver(prob, pipelined=pipelined)
+    b, x0, la, ga, sidx, gsrc, gval, scnt, rcnt = s.device_args(
+        np.ones(prob.n))
+    tols = jnp.zeros(4)
+    args = (la, ga, sidx, gsrc, gval, scnt, rcnt, b, x0, tols, jnp.int32(5))
+    return s._program.lower(*args, unbounded=True,
+                            needs_diff=False).as_text()
+
+
+def _counts(txt):
+    return (len(re.findall(r"all_reduce", txt)),
+            len(re.findall(r"all_to_all", txt)),
+            len(re.findall(r"stablehlo\.while|\bwhile\b", txt)))
+
+
+def test_collective_counts(prob):
+    """Static collective inventory of the whole-solve programs.
+
+    The loop body appears once in the program text, so whole-program
+    counts decompose as setup + body:
+      classic:   3 setup psums (||b||, ||x0||, gamma0) + 2 in-loop
+                 ((p,t) and (r,r))                         -> 5 ARs
+                 1 setup SpMV (r0) + 1 in-loop SpMV        -> 2 A2As
+      pipelined: 4 setup psums (+ final fresh ||r||)
+                 + 1 in-loop FUSED psum                    -> 5 ARs
+                 2 setup SpMVs (r0, w=Ar) + 1 in-loop      -> 3 A2As
+    """
+    ar_c, ata_c, wl_c = _counts(_lowered_text(prob, pipelined=False))
+    ar_p, ata_p, wl_p = _counts(_lowered_text(prob, pipelined=True))
+    assert wl_c >= 1 and wl_p >= 1, "solve loop not compiled as while"
+    assert ar_c == 5, f"classic program has {ar_c} all_reduces, expected 5"
+    assert ata_c == 2, f"classic program has {ata_c} all_to_alls, expected 2"
+    assert ar_p == 5, f"pipelined program has {ar_p} all_reduces, expected 5"
+    assert ata_p == 3, f"pipelined program has {ata_p} all_to_alls, expected 3"
+    # the communication-avoiding property, stated relatively: same AR
+    # total despite one extra setup psum => one FEWER in-loop allreduce
+    assert ar_p - 4 == 1 and ar_c - 3 == 2
+
+
+def test_precise_dots_keep_fusion(prob):
+    """Compensated dots widen each psum payload (hi+lo pairs) but must
+    not add collectives: the pipelined loop still has ONE allreduce."""
+    s = DistCGSolver(prob, pipelined=True, precise_dots=True)
+    b, x0, la, ga, sidx, gsrc, gval, scnt, rcnt = s.device_args(
+        np.ones(prob.n))
+    tols = jnp.zeros(4)
+    args = (la, ga, sidx, gsrc, gval, scnt, rcnt, b, x0, tols, jnp.int32(5))
+    txt = s._program.lower(*args, unbounded=True, needs_diff=False).as_text()
+    ar, ata, _ = _counts(txt)
+    assert ar == 5, f"precise-dots pipelined program has {ar} all_reduces"
+    assert ata == 3
