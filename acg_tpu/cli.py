@@ -97,6 +97,12 @@ def make_parser() -> argparse.ArgumentParser:
                         "updates stay in XLA -- see BASELINE.md); auto "
                         "picks pallas on TPU hardware for DIA matrices "
                         "and DIA local blocks of the multi-part path")
+    p.add_argument("--spmv-format", default="auto",
+                   choices=["auto", "dia", "ell", "coo"],
+                   help="force the device sparse format for the "
+                        "single-device path (the role of the reference's "
+                        "--cusparse-spmv-alg algorithm selector); auto "
+                        "picks by sparsity structure")
     p.add_argument("--precise-dots", action="store_true",
                    help="compensated (double-float) dot products for the "
                         "CG scalars; lets f32 storage converge past the "
@@ -335,7 +341,8 @@ def _main(args) -> int:
             solver = PetscBaselineSolver(csr, pipelined=pipelined)
             x = solver.solve(b, x0=x0, criteria=criteria)
         elif comm == "none" or nparts == 1:
-            dev = device_matrix_from_csr(csr, dtype=dtype)
+            dev = device_matrix_from_csr(csr, dtype=dtype,
+                                         format=args.spmv_format)
             solver = JaxCGSolver(dev, pipelined=pipelined,
                                  precise_dots=args.precise_dots,
                                  kernels=args.kernels)

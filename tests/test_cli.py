@@ -164,3 +164,16 @@ def test_cli_rejects_integer_numfmt(matrix_file):
                                 "--comm", "none", "--max-iterations", "5"])
     assert r.returncode != 0
     assert "numfmt" in r.stderr
+
+
+@pytest.mark.parametrize("fmt", ["dia", "ell", "coo"])
+def test_cli_spmv_format_forced(matrix_file, fmt):
+    """--spmv-format forces the device sparse format (the reference's
+    --cusparse-spmv-alg role); every format solves to the same answer."""
+    r = run_cli("acg_tpu.cli",
+                [str(matrix_file), "--comm", "none", "--spmv-format", fmt,
+                 "--max-iterations", "500", "--residual-rtol", "1e-8",
+                 "--manufactured-solution", "--warmup", "0", "--quiet"])
+    assert r.returncode == 0, r.stderr
+    err = float(r.stderr.split("\nerror 2-norm: ")[1].split()[0])
+    assert err < 1e-6, r.stderr
