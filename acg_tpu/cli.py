@@ -63,10 +63,23 @@ def make_parser() -> argparse.ArgumentParser:
                         "nnz-balanced ranges (keeps banded matrices in "
                         "gather-free DIA form on TPU); auto picks band for "
                         "banded matrices (default)")
-    p.add_argument("--partition-binary", action="store_true",
+    p.add_argument("--partition-binary", "--binary-partition",
+                   action="store_true", dest="partition_binary",
                    help="partition vector file is in binary Matrix Market format")
     p.add_argument("--binary", action="store_true",
                    help="matrix/vector files are in binary Matrix Market format")
+    p.add_argument("--gzip", "--gunzip", "--ungzip", action="store_true",
+                   dest="gzip",
+                   help="accepted for drop-in compatibility; gzip input is "
+                        "auto-detected from the magic bytes regardless")
+    # default=False: these register before their store_true partners,
+    # and argparse keeps the FIRST registered default for a shared dest
+    p.add_argument("--no-manufactured-solution",
+                   dest="manufactured_solution", action="store_false",
+                   default=False, help=argparse.SUPPRESS)
+    p.add_argument("--no-output-comm-matrix",
+                   dest="output_comm_matrix", action="store_false",
+                   default=False, help=argparse.SUPPRESS)
     p.add_argument("--max-iterations", type=int, default=100, metavar="N",
                    help="maximum number of iterations (default: 100)")
     p.add_argument("--residual-atol", type=float, default=0.0, metavar="TOL",
@@ -224,6 +237,13 @@ def _main(args) -> int:
 
     dtype = {"f64": jnp.float64, "f32": jnp.float32, "bf16": jnp.bfloat16}[args.dtype]
     comm = {"mpi": "xla", "nccl": "xla", "nvshmem": "dma"}.get(args.comm, args.comm)
+
+    if args.verbose >= 2:
+        # part -> device mapping dump (the reference's rank -> CPU/GPU
+        # map, cuda/acg-cuda.c:1055-1101)
+        for d in jax.devices():
+            _log(args, f"device {d.id}: {d.platform} {d.device_kind} "
+                       f"(process {d.process_index})")
 
     # stage 1: read the matrix
     t0 = time.perf_counter()

@@ -177,3 +177,24 @@ def test_cli_spmv_format_forced(matrix_file, fmt):
     assert r.returncode == 0, r.stderr
     err = float(r.stderr.split("\nerror 2-norm: ")[1].split()[0])
     assert err < 1e-6, r.stderr
+
+
+def test_cli_compat_flags(matrix_file, tmp_path):
+    """Reference drop-in flags: --gzip/--gunzip/--ungzip (no-ops; gzip is
+    magic-byte autodetected), --binary-partition alias, and the --no-*
+    negations (cuda/acg-cuda.c option list)."""
+    import gzip as _gzip
+    gz = tmp_path / "p.mtx.gz"
+    gz.write_bytes(_gzip.compress(matrix_file.read_bytes()))
+    r = run_cli("acg_tpu.cli",
+                [str(gz), "--gzip", "--comm", "none",
+                 "--max-iterations", "300", "--residual-rtol", "1e-8",
+                 "--manufactured-solution", "--no-manufactured-solution",
+                 "--warmup", "0", "--quiet"])
+    assert r.returncode == 0, r.stderr
+    assert "error 2-norm" not in r.stderr  # negation disabled the check
+    r = run_cli("acg_tpu.cli",
+                [str(matrix_file), "--binary-partition", "--ungzip",
+                 "--comm", "none", "--max-iterations", "10",
+                 "--residual-rtol", "0", "--warmup", "0", "--quiet"])
+    assert r.returncode == 0, r.stderr
