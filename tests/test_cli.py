@@ -198,3 +198,16 @@ def test_cli_compat_flags(matrix_file, tmp_path):
                  "--comm", "none", "--max-iterations", "10",
                  "--residual-rtol", "0", "--warmup", "0", "--quiet"])
     assert r.returncode == 0, r.stderr
+
+
+def test_cli_trace_writes_profile(matrix_file, tmp_path):
+    """--trace DIR produces a jax.profiler trace (the nsys-trace tier,
+    scripts/trace_nvshmem.sh:57-63)."""
+    tdir = tmp_path / "trace"
+    r = run_cli("acg_tpu.cli",
+                [str(matrix_file), "--comm", "none", "--max-iterations",
+                 "50", "--residual-rtol", "0", "--warmup", "0",
+                 "--trace", str(tdir), "--quiet"])
+    assert r.returncode == 0, r.stderr
+    produced = list(tdir.rglob("*"))
+    assert any(p.is_file() for p in produced), "no trace files written"

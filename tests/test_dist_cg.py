@@ -216,3 +216,20 @@ def test_dist_cg_pallas_falls_back_on_ell(problem2d):
     x = DistCGSolver(prob, kernels="pallas").solve(
         b, criteria=StoppingCriteria(maxits=2000, residual_rtol=1e-10))
     assert np.linalg.norm(x - xsol) < 1e-8
+
+
+def test_refined_distributed_solver(problem2d):
+    """Mixed-precision refinement over the DISTRIBUTED solver (the CLI's
+    --refine --nparts N path): f32 device CG + f64 host residual reaches
+    f64-class accuracy."""
+    from acg_tpu.solvers.refine import RefinedSolver
+
+    csr = problem2d
+    xsol, b = manufactured(csr, seed=4)
+    part = partition_rows(csr, 4, seed=0, method="band")
+    prob = DistributedProblem.build(csr, part, 4, dtype=jnp.float32)
+    inner = DistCGSolver(prob)
+    solver = RefinedSolver(inner, csr, inner_rtol=1e-5)
+    x = solver.solve(b, criteria=StoppingCriteria(maxits=4000,
+                                                  residual_rtol=1e-10))
+    assert np.linalg.norm(x - xsol) < 1e-7  # beyond f32's ~1e-6 stall
