@@ -63,23 +63,58 @@ def dia_spmv(planes, offsets: tuple, x, interpret: bool = False):
     jnp.pad fallback.
     """
     n = x.shape[0]
+    route = dia_spmv_route(offsets, n, x.dtype, ndiags=len(planes))
+    if route[0] == "fast":
+        _, Lpad, Rpad, tile, align = route
+        return _dia_spmv_fast(planes, offsets, x, Lpad, Rpad, tile,
+                              align, interpret)
+    if route[0] == "xla":
+        from acg_tpu.ops.spmv import dia_mv
+
+        return dia_mv(planes, offsets, n, x)
     L = max(0, -min(offsets))
     R = max(0, max(offsets))
+    return _dia_spmv_padded(planes, offsets, x, L, R, interpret)
+
+
+def dia_spmv_route(offsets: tuple, n: int, dtype, ndiags: int | None = None):
+    """Which implementation :func:`dia_spmv` will take for this shape:
+    ``("fast", Lpad, Rpad, tile, align)``, ``("padded",)``, or
+    ``("xla",)``.  Exposed so callers reporting a kernel tier (bench)
+    can record what actually ran instead of what was requested."""
+    ndiags = len(offsets) if ndiags is None else ndiags
+    L = max(0, -min(offsets))
+    R = max(0, max(offsets))
+    itemsize = jnp.dtype(dtype).itemsize
+    # scoped-VMEM budget per grid step: the x window plus the
+    # double-buffered BlockSpec tiles (D planes + y), under the ~16 MB
+    # scoped limit with margin.  A band too wide for this budget has no
+    # x-reuse win anyway (each tile's window would mostly be halo), so
+    # those matrices go to XLA's shifted-views formulation instead.
+    budget = 12 * 2 ** 20
+
+    def vmem_bytes(tile, halo):
+        return (tile + 2 * halo + 2 * (ndiags + 1) * tile) * itemsize
+
     # Mosaic must prove DMA slice offsets divisible by the flattened
     # (sublane x lane) tile; round the halo sizes up to that quantum so
     # every HBM/VMEM DMA offset is a multiple of it
-    align = {4: 1024, 2: 2048}.get(jnp.dtype(x.dtype).itemsize)
+    align = {4: 1024, 2: 2048}.get(itemsize)
     if align is not None:
         Lpad = L + (-L) % align
         Rpad = R + (-R) % align
         band = max(Lpad, Rpad)
         tile = TILE
-        while tile < band:
+        while tile < band and vmem_bytes(2 * tile, band) <= budget:
             tile *= 2
-        if n % tile == 0 and n >= tile:
-            return _dia_spmv_fast(planes, offsets, x, Lpad, Rpad, tile,
-                                  align, interpret)
-    return _dia_spmv_padded(planes, offsets, x, L, R, interpret)
+        if (band <= tile and n % tile == 0 and n >= tile
+                and vmem_bytes(tile, band) <= budget):
+            return ("fast", Lpad, Rpad, tile, align)
+    if L + R >= TILE:
+        # wide band: the window is mostly halo, so the single-x-pass
+        # traffic argument is void -- D+1 passes from XLA win
+        return ("xla",)
+    return ("padded",)
 
 
 def _dia_spmv_fast(planes, offsets, x, Lpad, Rpad, tile, align, interpret):

@@ -290,7 +290,15 @@ class JaxCGSolver:
         self._spmv_flops = spmv_flops(A)
 
     def solve(self, b, x0=None, criteria: StoppingCriteria | None = None,
-              raise_on_divergence: bool = True, warmup: int = 0) -> np.ndarray:
+              raise_on_divergence: bool = True, warmup: int = 0,
+              host_result: bool = True) -> np.ndarray:
+        """Solve Ax=b.  ``host_result=False`` returns the device array
+        instead of copying x to the host -- at pod-filling sizes the
+        copy dwarfs the solve (537 MB for 512^3), and a caller that only
+        needs the timing/stats (benchmarks) or feeds x to another device
+        computation should not pay it.  The FP-exception report then
+        comes from a device-side finiteness check instead of the host
+        scan."""
         crit = criteria or StoppingCriteria()
         st = self.stats
         st.criteria = crit
@@ -336,8 +344,18 @@ class JaxCGSolver:
                            int((self._spmv_flops / 3.0) * (dbl + 4) + 2 * n * dbl) * (niter + 1))
         st.ops["dot"].add(2 * niter, 0.0, 2 * n * dbl * 2 * niter)
         st.ops["axpy"].add(3 * niter, 0.0, 3 * n * dbl * 3 * niter)
-        x = np.asarray(res.x)
-        st.fexcept_arrays = [x]
+        if host_result:
+            x = np.asarray(res.x)
+            st.fexcept_arrays = [x]
+        else:
+            x = res.x
+            # device-side scans; only two bools cross the wire.  The
+            # sentinels reproduce the host report's NaN/Inf distinction
+            # (errors.fexcept_str).
+            has_nan = bool(jnp.isnan(res.x).any())
+            has_inf = bool(jnp.isinf(res.x).any())
+            st.fexcept_arrays = [np.asarray([np.nan if has_nan else 0.0,
+                                             np.inf if has_inf else 0.0])]
         if not st.converged and raise_on_divergence:
             raise NotConvergedError(
                 f"{niter} iterations, residual {st.rnrm2:.3e}")

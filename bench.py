@@ -81,14 +81,15 @@ def _build(side: int, dim: int):
     return SymCsrMatrix.from_coo(N, r, c, v).to_csr()
 
 
-def _time_solver(solver, b, criteria_cls):
-    """Best-of-``TIMED_REPEATS`` solve time (shared-chip contention is
+def _time_solver(solver, b, criteria_cls, repeats: int = TIMED_REPEATS,
+                 **solve_kwargs):
+    """Best-of-``repeats`` solve time (shared-chip contention is
     bursty; min is the least-noisy estimator of uncontended speed)."""
-    solver.solve(b, criteria=criteria_cls(maxits=WARMUP_ITS))
+    solver.solve(b, criteria=criteria_cls(maxits=WARMUP_ITS), **solve_kwargs)
     times = []
-    for _ in range(TIMED_REPEATS):
+    for _ in range(repeats):
         solver.stats.tsolve = 0.0
-        solver.solve(b, criteria=criteria_cls(maxits=MAXITS))
+        solver.solve(b, criteria=criteria_cls(maxits=MAXITS), **solve_kwargs)
         times.append(solver.stats.tsolve)
     if max(times) > 1.5 * min(times):
         print(f"# contention: solve times ranged "
@@ -135,6 +136,67 @@ def run_case(csr, name: str, pipelined: bool, dist: bool = False,
         # case cannot masquerade as a Pallas measurement
         row["kernels"] = solver.kernels
     return row
+
+
+def _enable_compile_cache():
+    """Persistent client-side compilation cache: the tunneled compile
+    service is shared and its latency swings like the chip's (observed
+    9s+ for trivial programs under load; whole-solve compiles can stall
+    for minutes); cached executables make re-runs immune to that."""
+    import jax
+
+    try:
+        jax.config.update("jax_compilation_cache_dir",
+                          os.path.join(os.path.dirname(
+                              os.path.abspath(__file__)), ".jax_cache"))
+        jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
+    except Exception:
+        pass  # cache is an optimisation; never fail the bench over it
+
+
+def run_case_dia(side: int, dim: int, name: str) -> dict:
+    """Stencil configs assembled DIRECTLY as DIA planes (no COO/CSR/sort
+    preprocessing) -- the only practical route to the north-star 512^3
+    problem (N=134M, ~0.9G nnz) on one chip: ~4 GB of f32 planes built
+    in seconds instead of tens of GB of COO intermediates."""
+    import jax.numpy as jnp
+
+    _enable_compile_cache()
+
+    from acg_tpu.io.generators import poisson_dia_device
+    from acg_tpu.ops.spmv import DiaMatrix
+    from acg_tpu.solvers.jax_cg import JaxCGSolver
+    from acg_tpu.solvers.stats import StoppingCriteria
+
+    planes, offsets, N = poisson_dia_device(side, dim, dtype=jnp.float32)
+    A = DiaMatrix(data=tuple(planes), offsets=offsets,
+                  nrows=N, ncols_padded=N)
+    n_axis = N // side
+    nnz = N + 2 * dim * (N - n_axis)  # full-storage stencil nonzeros
+    solver = JaxCGSolver(A, kernels="auto")
+    # b lives on device from birth, and results stay device-resident
+    # (host_result=False): at this size every 537 MB host<->device copy
+    # costs minutes over a tunneled chip and none of them are part of
+    # the measured solve; 2 repeats keep the row inside a bench budget
+    b = jnp.ones(N, dtype=jnp.float32)
+    tsolve = _time_solver(solver, b, StoppingCriteria, repeats=2,
+                          host_result=False)
+    iters_per_sec = MAXITS / tsolve
+    standin = _h100_standin(nnz * 12.0 + 80.0 * N)
+    print(f"# {name}: total solver time: {tsolve:.6f} seconds",
+          file=sys.stderr)
+    # report what actually RAN: the pallas tier routes wide-band DIA
+    # (512^3's +-n^2 diagonals) back to XLA's shifted-views SpMV
+    kernels = solver.kernels
+    if kernels.startswith("pallas"):
+        from acg_tpu.ops.pallas_kernels import dia_spmv_route
+
+        if dia_spmv_route(offsets, N, jnp.float32)[0] == "xla":
+            kernels = "xla"
+    return {"metric": name, "value": round(iters_per_sec, 2),
+            "unit": "iters/s",
+            "vs_baseline": round(iters_per_sec / standin, 4),
+            "kernels": kernels}
 
 
 def sweep_np(out=sys.stdout) -> int:
@@ -198,6 +260,8 @@ def main(argv=None) -> int:
 
     import jax
 
+    _enable_compile_cache()
+
     if not args.full:
         # flagship: measure BOTH kernel tiers in the same contention
         # window and report the better one (uncontended A/B favours
@@ -243,6 +307,16 @@ def main(argv=None) -> int:
                   f"{jax.devices()[0].platform}", file=sys.stderr)
         print(json.dumps(run_case(built[key], name, pipelined, dist, kernels)))
         sys.stdout.flush()
+
+    # the north-star problem size, single chip, direct-DIA assembly;
+    # skipped gracefully where the device memory cannot hold it
+    built.clear()
+    try:
+        print(json.dumps(run_case_dia(
+            512, 3, "cg_iters_per_sec_poisson3d_n512_f32_dia")))
+    except Exception as e:  # noqa: BLE001 -- report and continue
+        print(f"# 512^3 row skipped: {type(e).__name__}: "
+              f"{str(e).splitlines()[0][:200]}", file=sys.stderr)
     return 0
 
 
