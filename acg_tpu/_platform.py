@@ -51,3 +51,26 @@ def provision_host_mesh(n_devices: int):
 
     jax.config.update("jax_platforms", "cpu")
     return jax
+
+
+def enable_compile_cache(path: str | None = None) -> None:
+    """Turn on JAX's persistent compilation cache (client-side).
+
+    Remote/tunneled TPU setups route compiles through a shared service
+    whose latency swings with load (observed: trivial programs taking
+    9s+, whole-solve compiles stalling for minutes); cached executables
+    make repeat runs immune.  Semantics-neutral, on by default for the
+    CLI and bench; disable with ``ACG_TPU_COMPILE_CACHE=0``.
+    """
+    if os.environ.get("ACG_TPU_COMPILE_CACHE", "1") == "0":
+        return
+    import jax
+
+    if path is None:
+        path = os.path.join(os.path.dirname(os.path.dirname(
+            os.path.abspath(__file__))), ".jax_cache")
+    try:
+        jax.config.update("jax_compilation_cache_dir", path)
+        jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
+    except Exception:  # noqa: BLE001 -- an optimisation, never fatal
+        pass

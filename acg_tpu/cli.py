@@ -37,7 +37,12 @@ def make_parser() -> argparse.ArgumentParser:
         description="TPU-accelerated conjugate gradient solver for symmetric "
                     "positive definite linear systems Ax=b.",
         epilog="Report bugs to the acg-tpu repository.")
-    p.add_argument("A", help="matrix in Matrix Market format (.mtx, .mtx.gz, binary)")
+    p.add_argument("A", help="matrix in Matrix Market format (.mtx, .mtx.gz, "
+                             "binary), or a generator spec "
+                             "gen:poisson2d:N | gen:poisson3d:N | "
+                             "gen:irregular:N[:AVGDEG] -- synthesized "
+                             "in-process; large Poisson specs assemble "
+                             "directly on device (no file, no host matrix)")
     p.add_argument("b", nargs="?", default=None, help="right-hand side vector (default: ones)")
     p.add_argument("x0", nargs="?", default=None, help="initial guess (default: zeros)")
     p.add_argument("--solver", default="acg",
@@ -194,6 +199,117 @@ def _validate_numfmt(fmt: str) -> str:
     return str(dataclasses.replace(spec, length=""))
 
 
+def _parse_gen_spec(spec: str):
+    """``gen:poisson2d:N | gen:poisson3d:N | gen:irregular:N[:AVGDEG]``
+    -> (kind, dim, n, N, avg_degree)."""
+    parts = spec.split(":")
+    kind = parts[1] if len(parts) > 1 else ""
+    try:
+        if kind in ("poisson2d", "poisson3d"):
+            if len(parts) != 3:
+                raise ValueError
+            dim = 2 if kind == "poisson2d" else 3
+            n = int(parts[2])
+            if n <= 0:
+                raise ValueError
+            return ("poisson", dim, n, n ** dim, None)
+        if kind == "irregular":
+            if len(parts) not in (3, 4):
+                raise ValueError
+            n = int(parts[2])
+            avg = float(parts[3]) if len(parts) == 4 else 16.0
+            if n <= 0 or avg <= 0:
+                raise ValueError
+            return ("irregular", 0, n, n, avg)
+        raise ValueError
+    except ValueError:
+        raise SystemExit(
+            f"acg-tpu: invalid generator spec {spec!r}: expected "
+            f"gen:poisson2d:N | gen:poisson3d:N | gen:irregular:N[:AVGDEG]")
+
+
+def _gen_direct_min() -> int:
+    """Row threshold above which gen:poisson specs skip host CSR
+    assembly and build DIA planes on device (env-overridable so tests
+    can exercise the direct path at tiny sizes)."""
+    import os
+
+    return int(os.environ.get("ACG_TPU_GEN_DIRECT_MIN", 2 ** 24))
+
+
+def _solve_generated_direct(args, dim, n, N, jax, jnp, dtype) -> int:
+    """The zero-transfer large-stencil path: DIA planes assembled on
+    device (``poisson_dia_device``), solved by the compiled single-chip
+    programs.  This is what makes the north-star 512^3 problem (134M
+    rows) reachable from the CLI at all -- a Matrix Market file for it
+    would be ~25 GB of text and the host COO/CSR route needs a
+    multi-GB upload (BASELINE.md round-2 notes)."""
+    import numpy as np
+
+    from acg_tpu.errors import NotConvergedError
+    from acg_tpu.io.generators import poisson_dia_device
+    from acg_tpu.io.mtxfile import vector_mtx, write_mtx
+    from acg_tpu.ops.spmv import DiaMatrix
+    from acg_tpu.solvers import StoppingCriteria
+    from acg_tpu.solvers.jax_cg import JaxCGSolver
+
+    unsupported = [flag for flag, on in [
+        (f"--solver {args.solver}",
+         args.solver in ("host", "host-native", "petsc")),
+        ("--manufactured-solution", args.manufactured_solution),
+        ("b/x0 input files", bool(args.b or args.x0)),
+        ("--refine", args.refine),
+        (f"--nparts {args.nparts}", args.nparts > 1),
+        ("--output-comm-matrix", args.output_comm_matrix),
+        ("--profile-ops", args.profile_ops is not None),
+    ] if on]
+    if unsupported:
+        raise SystemExit(
+            f"acg-tpu: {args.A}: direct on-device assembly "
+            f"(N={N:,} rows) does not support: {', '.join(unsupported)} "
+            f"(these need a host-side matrix; use a file or a smaller "
+            f"gen: spec)")
+
+    t0 = time.perf_counter()
+    planes, offsets, _ = poisson_dia_device(n, dim, dtype=dtype)
+    if args.epsilon:
+        planes = list(planes)
+        d = offsets.index(0)
+        planes[d] = planes[d] + jnp.asarray(args.epsilon, dtype)
+    A = DiaMatrix(data=tuple(planes), offsets=offsets,
+                  nrows=N, ncols_padded=N)
+    _log(args, "assemble DIA planes on device:", t0)
+
+    solver = JaxCGSolver(A, pipelined="pipelined" in args.solver,
+                         precise_dots=args.precise_dots,
+                         kernels=args.kernels)
+    b = jnp.ones(N, dtype=dtype)
+    criteria = StoppingCriteria(
+        maxits=args.max_iterations,
+        residual_atol=args.residual_atol, residual_rtol=args.residual_rtol,
+        diff_atol=args.diff_atol, diff_rtol=args.diff_rtol)
+    t0 = time.perf_counter()
+    if args.trace:
+        jax.profiler.start_trace(args.trace)
+    try:
+        x = solver.solve(b, criteria=criteria, warmup=args.warmup,
+                         host_result=not args.quiet)
+    except NotConvergedError as e:
+        sys.stderr.write(f"acg-tpu: {e}\n")
+        solver.stats.fwrite(sys.stderr)
+        return 1
+    finally:
+        if args.trace:
+            jax.profiler.stop_trace()
+    _log(args, "solve:", t0)
+
+    solver.stats.fwrite(sys.stderr)
+    if not args.quiet:
+        write_mtx(sys.stdout.buffer, vector_mtx(np.asarray(x)),
+                  numfmt=args.numfmt)
+    return 0
+
+
 def main(argv=None) -> int:
     args = make_parser().parse_args(argv)
     args.numfmt = _validate_numfmt(args.numfmt)
@@ -216,6 +332,10 @@ def _main(args) -> int:
         jax.config.update("jax_platforms", plat)
     if args.dtype == "f64":
         jax.config.update("jax_enable_x64", True)
+    # persistent compile cache (semantics-neutral; see _platform;
+    # disable with ACG_TPU_COMPILE_CACHE=0)
+    from acg_tpu._platform import enable_compile_cache
+    enable_compile_cache()
     if args.multihost or args.coordinator is not None:
         from acg_tpu.parallel.multihost import initialize
         initialize(args.coordinator, args.num_processes, args.process_id)
@@ -245,19 +365,36 @@ def _main(args) -> int:
             _log(args, f"device {d.id}: {d.platform} {d.device_kind} "
                        f"(process {d.process_index})")
 
-    # stage 1: read the matrix
+    # stage 1: read (or synthesize) the matrix
     t0 = time.perf_counter()
-    _log(args, f"reading matrix from {args.A}")
-    try:
-        mtx = read_mtx(args.A, binary=args.binary)
-    except AcgError as e:
-        sys.stderr.write(f"acg-tpu: {args.A}: {e}\n")
-        return 1
-    _log(args, "read matrix:", t0)
+    if args.A.startswith("gen:"):
+        spec = _parse_gen_spec(args.A)
+        kind, dim, n, N = spec[:4]
+        if kind == "poisson" and N > _gen_direct_min():
+            # too large for host CSR assembly: direct on-device DIA
+            return _solve_generated_direct(args, dim, n, N, jax, jnp, dtype)
+        _log(args, f"synthesizing {args.A} (N={N})")
+        from acg_tpu.io.generators import (irregular_spd_coo, poisson2d_coo,
+                                           poisson3d_coo)
+        if kind == "poisson":
+            r, c, v, N = (poisson2d_coo if dim == 2 else poisson3d_coo)(n)
+        else:
+            r, c, v, N = irregular_spd_coo(n, avg_degree=spec[4],
+                                           seed=args.seed)
+        A = SymCsrMatrix.from_coo(N, r, c, v)
+        _log(args, "synthesize matrix:", t0)
+    else:
+        _log(args, f"reading matrix from {args.A}")
+        try:
+            mtx = read_mtx(args.A, binary=args.binary)
+        except AcgError as e:
+            sys.stderr.write(f"acg-tpu: {args.A}: {e}\n")
+            return 1
+        _log(args, "read matrix:", t0)
+        A = SymCsrMatrix.from_mtx(mtx)
 
     # stage 2a: assemble symmetric CSR
     t0 = time.perf_counter()
-    A = SymCsrMatrix.from_mtx(mtx)
     csr = A.to_csr(epsilon=args.epsilon)
     _log(args, "assemble symmetric CSR:", t0)
 

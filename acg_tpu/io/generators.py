@@ -134,6 +134,11 @@ def irregular_spd_coo(n: int, avg_degree: float = 16.0, seed: int = 0,
     ELL/gather SpMV paths), with negative off-diagonal weights and a
     strictly diagonally dominant diagonal -> symmetric positive
     definite.
+
+    Note: every row sums to exactly 1 (diag = 1 + sum|offdiag|), so
+    ``b = ones`` is an eigenvector and CG converges on it in one
+    iteration -- use a manufactured solution (random xsol, b = A xsol)
+    for convergence behaviour; fixed-iteration timing is unaffected.
     """
     rng = np.random.default_rng(seed)
     # power-law-ish stub counts: most rows short, a heavy tail of hubs

@@ -139,19 +139,9 @@ def run_case(csr, name: str, pipelined: bool, dist: bool = False,
 
 
 def _enable_compile_cache():
-    """Persistent client-side compilation cache: the tunneled compile
-    service is shared and its latency swings like the chip's (observed
-    9s+ for trivial programs under load; whole-solve compiles can stall
-    for minutes); cached executables make re-runs immune to that."""
-    import jax
+    from acg_tpu._platform import enable_compile_cache
 
-    try:
-        jax.config.update("jax_compilation_cache_dir",
-                          os.path.join(os.path.dirname(
-                              os.path.abspath(__file__)), ".jax_cache"))
-        jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
-    except Exception:
-        pass  # cache is an optimisation; never fail the bench over it
+    enable_compile_cache()
 
 
 def run_case_dia(side: int, dim: int, name: str) -> dict:
