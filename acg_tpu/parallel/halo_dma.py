@@ -44,6 +44,13 @@ default ``--comm xla`` transport is the `lax.all_to_all` in
 :mod:`acg_tpu.parallel.halo`.  Pack/unpack stay XLA gathers outside the
 kernel, exactly as the reference keeps its pack kernels separate from the
 transport (``halo.cu:41-107``).
+
+Validation status: the gating, routing, and barrier-count logic are all
+exercised in CI (interpret mode, uniform-gate rings plus randomized
+star/line/clustered topologies vs the xla transport); the compiled
+multi-chip path has NOT yet run on real ICI -- this build's environment
+exposes one chip -- so first contact on a pod slice should start with
+``--comm xla`` agreement checks at small sizes.
 """
 
 from __future__ import annotations
