@@ -191,3 +191,35 @@ def test_poisson_dia_direct_assembly_matches_csr_path():
         x = JaxCGSolver(A).solve(b, criteria=crit)
         xh = HostCGSolver(csr).solve(b, criteria=crit)
         np.testing.assert_allclose(x, xh, atol=1e-8)
+
+
+def test_solve_host_result_false():
+    """host_result=False keeps x on device (the 512^3 transfer-avoiding
+    mode) with identical values and a faithful NaN/Inf report."""
+    import jax
+    import jax.numpy as jnp
+
+    from acg_tpu.io.generators import poisson2d_coo
+    from acg_tpu.matrix import SymCsrMatrix
+    from acg_tpu.ops.spmv import device_matrix_from_csr
+
+    r, c, v, N = poisson2d_coo(12)
+    csr = SymCsrMatrix.from_coo(N, r, c, v).to_csr()
+    A = device_matrix_from_csr(csr, dtype=jnp.float64)
+    b = np.ones(N)
+    crit = StoppingCriteria(maxits=500, residual_rtol=1e-10)
+    s1, s2 = JaxCGSolver(A), JaxCGSolver(A)
+    x_host = s1.solve(b, criteria=crit)
+    x_dev = s2.solve(b, criteria=crit, host_result=False)
+    assert isinstance(x_dev, jax.Array)
+    np.testing.assert_array_equal(np.asarray(x_dev), x_host)
+    assert "none" in s2.stats.fwrite()  # fp exceptions: none
+    # a solve that overflows must report Inf (not the NaN sentinel)
+    bad = device_matrix_from_csr(csr * jnp.inf, dtype=jnp.float64)
+    sb = JaxCGSolver(bad)
+    sb.solve(b, criteria=StoppingCriteria(maxits=2), host_result=False,
+             raise_on_divergence=False)
+    report = sb.stats.fwrite()
+    line = [l for l in report.splitlines()
+            if "floating-point exceptions" in l][0]
+    assert "none" not in line
