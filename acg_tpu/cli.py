@@ -162,7 +162,40 @@ def make_parser() -> argparse.ArgumentParser:
     p.add_argument("-v", "--verbose", action="count", default=0,
                    help="print stage timings to stderr")
     p.add_argument("--version", action="version", version="acg-tpu 0.1.0")
+    p.add_argument("--buildinfo", action="store_true",
+                   help="print the runtime feature matrix (the role of "
+                        "the reference's CMake ACG_HAVE_* configuration) "
+                        "and exit")
     return p
+
+
+def _buildinfo(out) -> int:
+    import jax
+    import jaxlib
+
+    from acg_tpu import _native, __version__
+    from acg_tpu.partition import metis_available
+
+    plat = "unavailable"
+    try:
+        devs = jax.devices()
+        plat = f"{devs[0].platform} x{len(devs)} ({devs[0].device_kind})"
+    except Exception as e:  # noqa: BLE001 -- report, don't crash
+        plat = f"unavailable ({type(e).__name__})"
+    rows = [
+        ("acg-tpu", __version__),
+        ("jax", jax.__version__),
+        ("jaxlib", jaxlib.__version__),
+        ("backend", plat),
+        ("native core (libacg_core)",
+         "yes" if _native.available() else "no (numpy fallbacks)"),
+        ("libmetis", "yes" if metis_available() else
+         "no (built-in bisection fallback)"),
+        ("float64", "emulated on TPU (use --refine / --precise-dots)"),
+    ]
+    for k, v in rows:
+        out.write(f"{k}: {v}\n")
+    return 0
 
 
 def _log(args, msg, t0=None):
@@ -311,6 +344,10 @@ def _solve_generated_direct(args, dim, n, N, jax, jnp, dtype) -> int:
 
 
 def main(argv=None) -> int:
+    if argv is None:
+        argv = sys.argv[1:]
+    if "--buildinfo" in argv:
+        return _buildinfo(sys.stdout)
     args = make_parser().parse_args(argv)
     args.numfmt = _validate_numfmt(args.numfmt)
     try:

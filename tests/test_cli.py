@@ -256,3 +256,10 @@ def test_cli_gen_spec_invalid():
     r = run_cli("acg_tpu.cli", ["gen:bogus:3"])
     assert r.returncode != 0
     assert "invalid generator spec" in r.stderr
+
+
+def test_cli_buildinfo():
+    r = run_cli("acg_tpu.cli", ["--buildinfo"])
+    assert r.returncode == 0, r.stderr
+    for key in ("acg-tpu:", "jax:", "backend:", "native core", "libmetis:"):
+        assert key in r.stdout, r.stdout
