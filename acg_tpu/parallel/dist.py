@@ -287,6 +287,12 @@ class DistCGSolver:
                  precise_dots: bool = False, kernels: str = "auto"):
         if comm not in ("xla", "dma"):
             raise ValueError(f"unknown halo transport {comm!r}")
+        if comm == "dma" and jax.process_count() > 1:
+            # cross-process one-sided DMA is unvalidated (halo_dma.py
+            # docstring); fail clearly instead of deadlocking a pod
+            raise ValueError(
+                "comm='dma' is not validated on multi-controller runs; "
+                "use comm='xla' (the all_to_all transport)")
         self.problem = problem
         self.pipelined = pipelined
         self.precise_dots = precise_dots

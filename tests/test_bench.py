@@ -1,0 +1,69 @@
+"""Unit tests for the benchmark harness's timing logic (bench.py).
+
+The harness defends the one number the driver records against three
+shared-chip failure modes: bursty contention (best-of-N), long-program
+watchdog kills (trip-count reduction), and per-case crashes (isolation).
+These tests pin that logic with a fake solver -- no device needed.
+"""
+
+import sys
+
+import pytest
+
+sys.path.insert(0, "/root/repo")
+import bench  # noqa: E402
+
+
+class FakeStats:
+    tsolve = 0.0
+
+
+class FakeSolver:
+    """Solver whose per-iteration cost is scripted."""
+
+    def __init__(self, seconds_per_iter):
+        self.per_iter = seconds_per_iter
+        self.stats = FakeStats()
+        self.calls = []
+
+    def solve(self, b, criteria=None, **kw):
+        self.calls.append(criteria.maxits)
+        self.stats.tsolve += self.per_iter * criteria.maxits
+
+
+class FakeCriteria:
+    def __init__(self, maxits):
+        self.maxits = maxits
+
+
+def test_time_solver_full_trip_count_when_fast():
+    s = FakeSolver(1e-4)  # 1000 iters = 0.1s, far under the watchdog
+    tsolve, maxits = bench._time_solver(s, None, FakeCriteria, repeats=3)
+    assert maxits == bench.MAXITS
+    assert tsolve == pytest.approx(1e-4 * bench.MAXITS)
+    # warmup x2 (compile + rate estimate) then 3 timed runs
+    assert s.calls == [bench.WARMUP_ITS] * 2 + [bench.MAXITS] * 3
+
+
+def test_time_solver_reduces_trip_count_for_slow_configs():
+    s = FakeSolver(0.13)  # 1000 iters = 130s >> MAX_PROGRAM_SECONDS
+    tsolve, maxits = bench._time_solver(s, None, FakeCriteria, repeats=2)
+    assert maxits < bench.MAXITS
+    assert maxits >= 100
+    # the timed program stays under the watchdog
+    assert 0.13 * maxits <= bench.MAX_PROGRAM_SECONDS * 1.01
+    # iters/s is trip-count-invariant
+    assert maxits / tsolve == pytest.approx(1 / 0.13)
+
+
+def test_time_solver_passes_solve_kwargs():
+    seen = {}
+
+    class KwSolver(FakeSolver):
+        def solve(self, b, criteria=None, **kw):
+            seen.update(kw)
+            super().solve(b, criteria=criteria)
+
+    s = KwSolver(1e-5)
+    bench._time_solver(s, None, FakeCriteria, repeats=1, host_result=False)
+    assert seen == {"host_result": False}
