@@ -6,11 +6,12 @@ watchdog kills (trip-count reduction), and per-case crashes (isolation).
 These tests pin that logic with a fake solver -- no device needed.
 """
 
+import os
 import sys
 
 import pytest
 
-sys.path.insert(0, "/root/repo")
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 import bench  # noqa: E402
 
 
