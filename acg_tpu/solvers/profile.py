@@ -132,8 +132,11 @@ def _profile_single(solver, b, reps: int) -> dict[str, float]:
     # into the next input (unfoldable data dependence); axpy chains y
     alpha = jnp.asarray(0.5, dtype)
     tiny = jnp.asarray(1e-30, dtype)
+    # the matrix rides as an ARGUMENT, not a closure: captured device
+    # arrays become compile-time constants and are shipped with the
+    # program (gigabytes at large N)
     return {
-        "gemv": _time_op(lambda v: spmv_f(A, v), x, reps=reps),
+        "gemv": _time_op(lambda v, M: spmv_f(M, v), x, A, reps=reps),
         "dot": _time_op(lambda v, c: v + tiny * _dot(v, c), x, x,
                         reps=reps),
         "axpy": _time_op(lambda y, a, p: y + a * p, x, alpha, x,
@@ -167,7 +170,9 @@ def _profile_dist(solver, b, reps: int) -> dict[str, float]:
     # every op is expressed as x -> x' (shape/sharding preserved) so
     # _chain can amortise INNER executions inside one program; scalarish
     # results fold back through `tiny` to keep the data dependence
-    def gemv_once(x):
+    # matrix blocks ride as ARGUMENTS (captured device arrays become
+    # compile-time constants shipped with the program)
+    def gemv_once(x, la, ga, sidx, gsrc, gval, scnt, rcnt):
         def body(la, ga, sidx, gsrc, gval, scnt, rcnt, x):
             la, ga = (jax.tree.map(lambda a: a[0], t) for t in (la, ga))
             sidx, gsrc, gval, scnt, rcnt, x = (
@@ -177,14 +182,15 @@ def _profile_dist(solver, b, reps: int) -> dict[str, float]:
         return smap(body, (pspec,) * 8)(la, ga, sidx, gsrc, gval, scnt,
                                         rcnt, x)
 
-    out = {"gemv": _time_op(gemv_once, bd, reps=reps)}
+    out = {"gemv": _time_op(gemv_once, bd, la, ga, sidx, gsrc, gval,
+                            scnt, rcnt, reps=reps)}
 
     # halo exchange alone (reference times it per exchange, halo.h:176-186)
     if prob.halo.has_ghosts:
         if solver.comm == "dma":
             interpret = solver._interpret
 
-            def halo_once(x):
+            def halo_once(x, sidx, gsrc, gval, scnt, rcnt):
                 def body(x, sidx, gsrc, gval, scnt, rcnt):
                     ghost = halo_exchange_dma(x[0], sidx[0], gsrc[0],
                                               gval[0], scnt[0], rcnt[0],
@@ -193,25 +199,30 @@ def _profile_dist(solver, b, reps: int) -> dict[str, float]:
 
                 return smap(body, (pspec,) * 6)(x, sidx, gsrc, gval,
                                                 scnt, rcnt)
+
+            out["halo"] = _time_op(halo_once, bd, sidx, gsrc, gval,
+                                   scnt, rcnt, reps=reps)
         else:
-            def halo_once(x):
+            def halo_once(x, sidx, gsrc):
                 def body(x, sidx, gsrc):
                     ghost = halo_exchange(x[0], sidx[0], gsrc[0], axis)
                     return (x[0] + tiny * jnp.sum(ghost))[None]
 
                 return smap(body, (pspec,) * 3)(x, sidx, gsrc)
 
-        out["halo"] = _time_op(halo_once, bd, reps=reps)
+            out["halo"] = _time_op(halo_once, bd, sidx, gsrc, reps=reps)
 
     # local dot (no reduction) and the scalar allreduce, separately --
     # the reference's cublasDdot + acgcomm_allreduce split
-    def dot_once(x):
-        def body(a):
-            return (a[0] + tiny * jnp.dot(a[0], a[0]))[None]
+    def dot_once(x, c):
+        def body(a, c):
+            # two-vector dot (the loop's (p,t)/(r,r-after-update)
+            # class): carried vector against a fixed second operand
+            return (a[0] + tiny * jnp.dot(a[0], c[0]))[None]
 
-        return smap(body, (pspec,))(x)
+        return smap(body, (pspec, pspec))(x, c)
 
-    out["dot"] = _time_op(dot_once, bd, reps=reps)
+    out["dot"] = _time_op(dot_once, bd, x0 + 1.0, reps=reps)
 
     def allreduce_once(s):
         def body(s):

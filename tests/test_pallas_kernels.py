@@ -82,3 +82,35 @@ def test_solver_auto_kernels_off_tpu_is_xla():
     dev = device_matrix_from_csr(A.to_csr(), dtype=jnp.float64)
     assert jax.default_backend() != "tpu"  # CPU mesh in CI
     assert JaxCGSolver(dev, kernels="auto").kernels == "xla"
+
+
+def test_dia_spmv_clustered_route_and_numerics():
+    """Clustered-offset stencils (3D Poisson shape: far +-n^2 diagonals)
+    take the multi-window kernel; numerics must match dia_mv exactly,
+    including the whole-tile-shift zero-fill at both edges."""
+    import numpy as np
+
+    from acg_tpu.ops.pallas_kernels import TILE, dia_spmv, dia_spmv_route
+    from acg_tpu.ops.spmv import dia_mv
+
+    # the real 512^3 shape routes clustered
+    r = dia_spmv_route((-262144, -512, -1, 0, 1, 512, 262144),
+                       512 ** 3, np.float32)
+    assert r[0] == "clustered"
+    assert r[1] == (-512, -1, 0, 1, 512) and r[2] == (-262144, 262144)
+
+    # band too wide for one VMEM window, far offsets on tile boundaries
+    n = 64 * TILE
+    offsets = (-32 * TILE, -3, 0, 3, 32 * TILE)
+    assert dia_spmv_route(offsets, n, np.float32)[0] == "clustered"
+    rng = np.random.default_rng(0)
+    planes = tuple(jnp.asarray(rng.random(n), jnp.float32)
+                   for _ in offsets)
+    x = jnp.asarray(rng.random(n), jnp.float32)
+    y = dia_spmv(planes, offsets, x, interpret=True)
+    yref = dia_mv(planes, offsets, n, x)
+    np.testing.assert_allclose(np.asarray(y), np.asarray(yref), rtol=2e-6)
+
+    # off-tile far offsets cannot cluster -> xla fallback
+    assert dia_spmv_route((-32 * TILE + 7, 0, 1), n,
+                          np.float32)[0] == "xla"
