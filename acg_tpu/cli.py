@@ -295,6 +295,7 @@ def _solve_generated_direct(args, dim, n, N, jax, jnp, dtype) -> int:
         (f"--nparts {args.nparts}", args.nparts > 1),
         ("--output-comm-matrix", args.output_comm_matrix),
         ("--profile-ops", args.profile_ops is not None),
+        ("--multihost", args.multihost or args.coordinator is not None),
     ] if on]
     if unsupported:
         raise SystemExit(

@@ -33,3 +33,10 @@ run --solver acg-pipelined --dtype f32 --max-iterations 1000 --residual-rtol 0
 run --solver acg --dtype f32 --max-iterations 20000 --residual-rtol 1e-6
 run --solver acg --dtype f32 --refine --max-iterations 20000 --residual-rtol 1e-11
 run --solver acg --dtype f64 --max-iterations 2000 --residual-rtol 1e-6
+
+# north-star problem: 3D 512^3 via zero-transfer on-device assembly
+# (gen: spec; see BASELINE.md) -- single chip, f32
+echo "=== gen:poisson3d:512 (N=134M) classic f32 ==="
+python -m acg_tpu.cli gen:poisson3d:512 --dtype f32 --comm none \
+    --max-iterations 1000 --residual-rtol 0 --warmup 1 --quiet 2>&1 |
+    grep -E "total solver time|total flop rate|iterations:" | sed 's/^/    /'
