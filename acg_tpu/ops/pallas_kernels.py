@@ -82,6 +82,34 @@ def dia_spmv(planes, offsets: tuple, x, interpret: bool = False):
     return _dia_spmv_padded(planes, offsets, x, L, R, interpret)
 
 
+@functools.partial(jax.jit, static_argnames=("offsets", "interpret"))
+def dia_spmv_dot(planes, offsets: tuple, x, interpret: bool = False):
+    """``(y, dot(x, y))`` with the dot fused into the SpMV pass.
+
+    The classic CG step needs ``t = A p`` immediately followed by
+    ``(p, t)`` (``cgcuda.c:913``: cusparseSpMV then cublasDdot).  Fusing
+    the scalar into the kernel saves the dot's two full vector re-reads
+    (~13%% of the iteration's HBM traffic on the flagship).  Falls back
+    to kernel-then-``jnp.dot`` on routes without the fused variant.
+    """
+    n = x.shape[0]
+    route = dia_spmv_route(offsets, n, x.dtype, ndiags=len(planes))
+    if route[0] == "fast":
+        _, Lpad, Rpad, tile, align = route
+        y, d = _dia_spmv_clustered(planes, offsets, x, tuple(offsets), (),
+                                   Lpad, Rpad, tile, align, interpret,
+                                   with_dot=True)
+        return y, d[0].astype(x.dtype)
+    if route[0] == "clustered":
+        _, central, far, Lpad, Rpad, tile, align = route
+        y, d = _dia_spmv_clustered(planes, offsets, x, central, far,
+                                   Lpad, Rpad, tile, align, interpret,
+                                   with_dot=True)
+        return y, d[0].astype(x.dtype)
+    y = dia_spmv(planes, offsets, x, interpret=interpret)
+    return y, jnp.dot(x, y)
+
+
 def dia_spmv_route(offsets: tuple, n: int, dtype, ndiags: int | None = None):
     """Which implementation :func:`dia_spmv` will take for this shape:
     ``("fast", Lpad, Rpad, tile, align)`` (single-window kernel),
@@ -177,12 +205,16 @@ def _cluster_route(offsets, n, itemsize, align, budget, ndiags):
 
 
 def _dia_spmv_clustered(planes, offsets, x, central, far, Lpad, Rpad,
-                        tile, align, interpret):
+                        tile, align, interpret, with_dot=False):
     """Multi-window single-x-pass SpMV (see ``_cluster_route``): the
     central cluster reads body + left/right halos (the single-window
     "fast" route is this kernel with ``far=()``); each far
     offset reads exactly one whole x tile shifted by ``offset/tile``
-    tiles (zero-filled when that tile is off either end)."""
+    tiles (zero-filled when that tile is off either end).
+
+    ``with_dot=True`` additionally returns ``dot(x, y)`` accumulated in
+    SMEM across the (sequential) grid -- the CG step's (p, Ap) scalar
+    for free, saving the separate dot's two full vector re-reads."""
     n = x.shape[0]
     grid = n // tile
     win = tile + Lpad + Rpad
@@ -192,8 +224,10 @@ def _dia_spmv_clustered(planes, offsets, x, central, far, Lpad, Rpad,
     central_set = set(central)
 
     def kernel(x_hbm, *plane_refs_and_out):
-        plane_refs = plane_refs_and_out[:-1]
-        y_ref = plane_refs_and_out[-1]
+        nout = 2 if with_dot else 1
+        plane_refs = plane_refs_and_out[:-nout]
+        y_ref = plane_refs_and_out[-nout]
+        dot_ref = plane_refs_and_out[-1] if with_dot else None
         i = pl.program_id(0)
 
         def body(xwin, *fwins_and_sems):
@@ -277,20 +311,47 @@ def _dia_spmv_clustered(planes, offsets, x, central, far, Lpad, Rpad,
                 else:
                     acc = acc + pr[:] * fwins[far_idx[off]][:]
             y_ref[:] = acc
+            if with_dot:
+                # TPU grids run sequentially, so accumulating the
+                # partial into the (1,)-SMEM output across steps is
+                # safe; products are widened to f32 before the
+                # reduction so bf16 inputs don't collapse the scalar
+                adt = (jnp.float32 if jnp.dtype(x.dtype).itemsize <= 4
+                       else x.dtype)
+                partial = jnp.sum(acc.astype(adt)
+                                  * xwin[pl.ds(Lpad, tile)].astype(adt))
+
+                @pl.when(i == 0)
+                def _():
+                    dot_ref[0] = partial
+
+                @pl.when(i > 0)
+                def _():
+                    dot_ref[0] += partial
 
         pl.run_scoped(body, pltpu.VMEM((win,), x.dtype),
                       *[pltpu.VMEM((tile,), x.dtype) for _ in far],
                       pltpu.SemaphoreType.DMA((3 + len(far),)))
 
+    tile_spec = pl.BlockSpec((tile,), lambda i: (i,),
+                             memory_space=pltpu.VMEM)
+    out_specs = tile_spec
+    out_shape = jax.ShapeDtypeStruct((n,), x.dtype)
+    if with_dot:
+        acc_dtype = (jnp.float32 if jnp.dtype(x.dtype).itemsize <= 4
+                     else x.dtype)
+        out_specs = (tile_spec,
+                     pl.BlockSpec((1,), lambda i: (0,),
+                                  memory_space=pltpu.SMEM))
+        out_shape = (out_shape, jax.ShapeDtypeStruct((1,), acc_dtype))
     return pl.pallas_call(
         kernel,
         grid=(grid,),
         in_specs=[pl.BlockSpec(memory_space=pl.ANY)] + [
             pl.BlockSpec((tile,), lambda i: (i,), memory_space=pltpu.VMEM)
             for _ in planes],
-        out_specs=pl.BlockSpec((tile,), lambda i: (i,),
-                               memory_space=pltpu.VMEM),
-        out_shape=jax.ShapeDtypeStruct((n,), x.dtype),
+        out_specs=out_specs,
+        out_shape=out_shape,
         interpret=interpret,
     )(x, *planes)
 

@@ -59,6 +59,8 @@ def _spmv_fn(kernels: str):
     return spmv
 
 
+
+
 @functools.partial(jax.tree_util.register_dataclass,
                    data_fields=["x", "niterations", "rnrm2", "r0nrm2",
                                 "bnrm2", "x0nrm2", "dxnrm2", "converged"],
@@ -166,6 +168,11 @@ def _cg_program(A: DeviceMatrix, b, x0, res_atol, res_rtol, diff_atol,
     # extra loop-carried scalar measurably slows the TPU loop (~0.1 ms/it)
     def body(state):
         x, r, p, gamma = state[:4]
+        # NOT the fused dia_spmv_dot: measured in-loop, the in-kernel
+        # (p,t) scalar costs ~15% (1,355 vs 1,589 iters/s interleaved
+        # A/B) -- the opaque kernel boundary forfeits XLA's fusion of
+        # the updates, the same verdict as the fused 6-vector update
+        # (BASELINE.md)
         t = spmv_(A, p)
         pdott = dot(p, t)
         alpha = gamma / pdott
