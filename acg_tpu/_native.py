@@ -85,7 +85,7 @@ def _try_build() -> bool:
                 pass
 
 
-_ABI_VERSION = 2  # must match acg_core_abi_version() (native/src/sort.cpp)
+_ABI_VERSION = 3  # must match acg_core_abi_version() (native/src/sort.cpp)
 
 
 def _open_and_bind(path=None):
@@ -177,7 +177,7 @@ def _bind(lib, c):
     lib.acg_cg_solve.argtypes = [
         c, _I64, _I64, _F64, _F64, _F64, ctypes.c_int32,
         ctypes.c_double, ctypes.c_double, ctypes.c_double, ctypes.c_double,
-        _I32, _F64, _F64, _F64]
+        _I32, _F64, _F64, _F64, _F64]
 
 
 _lib = _load()
@@ -394,9 +394,13 @@ def cg_solve(rowptr, colidx, vals, b, x0=None, maxits=100, res_atol=0.0,
              res_rtol=0.0, diff_atol=0.0, diff_rtol=0.0):
     """Native classic-CG solve over full-storage CSR (acg_cg_solve).
 
-    Returns ``(x, niter, rnrm2, r0nrm2, dxnrm2, converged)``.  The C loop
-    mirrors ``solvers.host_cg.HostCGSolver`` exactly (see
-    native/src/cg.cpp), so the two host oracles cross-check each other.
+    Returns ``(x, r, niter, rnrm2, r0nrm2, dxnrm2, converged,
+    indefinite)`` -- ``r`` is the final residual vector (for the
+    caller's FP-exception scan) and ``indefinite`` reports the
+    reference's (p, Ap) == 0 abort (``ACG_ERR_NOT_CONVERGED_
+    INDEFINITE_MATRIX``, cg.c:304).  The C loop mirrors
+    ``solvers.host_cg.HostCGSolver`` exactly (see native/src/cg.cpp),
+    so the two host oracles cross-check each other.
     """
     rowptr = _i64(rowptr)
     colidx = _i64(colidx)
@@ -419,13 +423,14 @@ def cg_solve(rowptr, colidx, vals, b, x0=None, maxits=100, res_atol=0.0,
         raise ValueError("colidx out of range")
     niter = np.zeros(1, dtype=np.int32)
     out = np.zeros(3, dtype=np.float64)  # rnrm2, r0nrm2, dxnrm2
+    r = np.zeros_like(b)
     rc = _lib.acg_cg_solve(
         n, _ptr(rowptr, _I64), _ptr(colidx, _I64), _ptr(vals, _F64),
         _ptr(b, _F64), _ptr(x, _F64), int(maxits),
         float(res_atol), float(res_rtol), float(diff_atol), float(diff_rtol),
         _ptr(niter, _I32), _ptr(out[0:], _F64), _ptr(out[1:], _F64),
-        _ptr(out[2:], _F64))
+        _ptr(out[2:], _F64), _ptr(r, _F64))
     if rc < 0:
         raise ValueError(f"acg_cg_solve: invalid input (code {rc})")
-    return (x, int(niter[0]), float(out[0]), float(out[1]), float(out[2]),
-            rc == 0)
+    return (x, r, int(niter[0]), float(out[0]), float(out[1]), float(out[2]),
+            rc == 0, rc == 2)

@@ -152,8 +152,9 @@ def make_parser() -> argparse.ArgumentParser:
                    default=None, metavar="REPS",
                    help="fill the stats block's per-op seconds/GB/s by "
                         "replaying each op class standalone on device "
-                        "(median of REPS calls, default 10) -- the "
-                        "reference's ACG_ENABLE_PROFILING tier")
+                        "(best of REPS calls, default 10 -- min rides out "
+                        "shared-chip contention) -- the reference's "
+                        "ACG_ENABLE_PROFILING tier")
     p.add_argument("--trace", metavar="DIR", default=None,
                    help="write a jax.profiler trace of the solve to DIR "
                         "(the reference's nsys-trace tier; view with xprof)")
@@ -296,6 +297,8 @@ def _solve_generated_direct(args, dim, n, N, jax, jnp, dtype) -> int:
         ("--output-comm-matrix", args.output_comm_matrix),
         ("--profile-ops", args.profile_ops is not None),
         ("--multihost", args.multihost or args.coordinator is not None),
+        (f"--spmv-format {args.spmv_format}",
+         args.spmv_format not in ("auto", "dia")),
     ] if on]
     if unsupported:
         raise SystemExit(

@@ -36,6 +36,7 @@ class ErrorCode(enum.IntEnum):
     MESH = 14
     METIS = 15
     MPI = 16
+    NOT_CONVERGED_INDEFINITE_MATRIX = 17
 
 
 _ERRSTR = {
@@ -56,6 +57,8 @@ _ERRSTR = {
     ErrorCode.MESH: "device mesh error",
     ErrorCode.METIS: "graph partitioner error",
     ErrorCode.MPI: "distributed runtime error",
+    ErrorCode.NOT_CONVERGED_INDEFINITE_MATRIX:
+        "not converged (indefinite matrix)",
 }
 
 
@@ -80,6 +83,15 @@ class NotConvergedError(AcgError):
 
     def __init__(self, detail: str = ""):
         super().__init__(ErrorCode.NOT_CONVERGED, detail)
+
+
+class IndefiniteMatrixError(AcgError):
+    """Raised when CG hits (p, Ap) == 0: the matrix is not positive
+    definite (the reference's ``ACG_ERR_NOT_CONVERGED_INDEFINITE_MATRIX``
+    abort, ``cg.c:304``)."""
+
+    def __init__(self, detail: str = ""):
+        super().__init__(ErrorCode.NOT_CONVERGED_INDEFINITE_MATRIX, detail)
 
 
 def fexcept_str(*arrays) -> str:

@@ -276,12 +276,25 @@ def _spmv(A: DeviceMatrix, x: jax.Array) -> jax.Array:
     raise TypeError(f"unsupported device matrix {type(A)}")
 
 
+@jax.jit
+def _count_nonzero_on_device(arrays):
+    """Total nonzeros across a pytree of arrays, as ONE compiled device
+    reduction returning a scalar."""
+    leaves = jax.tree_util.tree_leaves(arrays)
+    return sum(jnp.count_nonzero(a) for a in leaves)
+
+
 def spmv_flops(A: DeviceMatrix) -> float:
-    """Analytic flops per SpMV, reference convention (3 per stored nz)."""
+    """Analytic flops per SpMV, reference convention (3 per stored nz).
+
+    nnz is counted ON DEVICE: pulling the planes to the host for a numpy
+    count would be an O(matrix) device->host copy -- ~3.8 GB for the
+    512^3 DIA planes, i.e. minutes over a tunneled chip, for a flop
+    statistic.  Only one scalar crosses the wire here."""
     if isinstance(A, DiaMatrix):
-        nnz = float(sum(np.count_nonzero(np.asarray(p)) for p in A.data))
+        nnz = float(_count_nonzero_on_device(tuple(A.data)))
     elif isinstance(A, EllMatrix):
-        nnz = float(np.count_nonzero(np.asarray(A.data)))
+        nnz = float(_count_nonzero_on_device((A.data,)))
     else:
         nnz = float(A.vals.size)
     return 3.0 * nnz

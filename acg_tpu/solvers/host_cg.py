@@ -22,7 +22,7 @@ import time
 import numpy as np
 import scipy.sparse as sp
 
-from acg_tpu.errors import NotConvergedError
+from acg_tpu.errors import IndefiniteMatrixError, NotConvergedError
 from acg_tpu.matrix import SymCsrMatrix
 from acg_tpu.solvers.stats import (SolverStats, StoppingCriteria,
                                    cg_flops_per_iteration)
@@ -95,6 +95,14 @@ class HostCGSolver:
             t0 = time.perf_counter()
             pdott = float(p @ t)
             self._op("dot", time.perf_counter() - t0, 2 * n * dbl, 2.0 * n)
+            if pdott == 0.0:
+                # (p, Ap) == 0 for p != 0: not positive definite; abort
+                # like the reference (cg.c:304) instead of dividing
+                st.tsolve += time.perf_counter() - tstart
+                st.converged = False
+                st.fexcept_arrays = [x, r]
+                raise IndefiniteMatrixError(
+                    f"(p, Ap) = 0 at iteration {k}")
             alpha = gamma / pdott
 
             t0 = time.perf_counter()
@@ -174,7 +182,8 @@ class NativeHostCGSolver:
         b = np.asarray(b, dtype=np.float64)
 
         tstart = time.perf_counter()
-        x, niter, rnrm2, r0nrm2, dxnrm2, converged = self._native.cg_solve(
+        (x, r, niter, rnrm2, r0nrm2, dxnrm2, converged,
+         indefinite) = self._native.cg_solve(
             A.indptr, A.indices, A.data, b, x0, crit.maxits,
             crit.residual_atol, crit.residual_rtol,
             crit.diff_atol, crit.diff_rtol)
@@ -196,7 +205,11 @@ class NativeHostCGSolver:
                            * (niter + 1))
         st.ops["dot"].add(2 * niter, 0.0, 2 * n * dbl * 2 * niter)
         st.ops["axpy"].add(3 * niter, 0.0, 3 * n * dbl * 3 * niter)
-        st.fexcept_arrays = [x]
+        # scan x AND the final residual, like HostCGSolver: a NaN/Inf
+        # present only in r must not go unreported
+        st.fexcept_arrays = [x, r]
+        if indefinite:
+            raise IndefiniteMatrixError(f"(p, Ap) = 0 at iteration {niter}")
         if not converged and raise_on_divergence:
             raise NotConvergedError(
                 f"{niter} iterations, residual {rnrm2:.3e}")

@@ -294,7 +294,16 @@ class JaxCGSolver:
             raise ValueError(f"unknown kernels choice {kernels!r}")
         self.kernels = kernels
         self.stats = SolverStats(unknowns=A.nrows)
-        self._spmv_flops = spmv_flops(A)
+        # lazy: the device nnz count (for the flop statistic) runs at
+        # first stats use, not construction -- a solver over on-device
+        # planes must construct with zero transfers (VERDICT round 2)
+        self._spmv_flops_cache: float | None = None
+
+    @property
+    def _spmv_flops(self) -> float:
+        if self._spmv_flops_cache is None:
+            self._spmv_flops_cache = spmv_flops(self.A)
+        return self._spmv_flops_cache
 
     def solve(self, b, x0=None, criteria: StoppingCriteria | None = None,
               raise_on_divergence: bool = True, warmup: int = 0,

@@ -140,16 +140,22 @@ void acg_pr_free(acg_partition_result *res);
 /* ---- cg.cpp: host reference CG solver (reference acg/cg.c, #16) ----
  *
  * Classic CG over full-storage CSR.  x holds x0 on entry and the
- * solution on return.  Tolerances of 0 disable their criterion; all
- * zero means run exactly maxits iterations.  Returns 0 on convergence
- * (or unbounded completion), 1 if tolerances were not met, negative on
+ * solution on return.  r_out (size n, may be NULL) receives the final
+ * residual vector, so callers can scan it for FP exceptions the way the
+ * reference's stats stage does.  Tolerances of 0 disable their
+ * criterion; all zero means run exactly maxits iterations.  Returns 0
+ * on convergence (or unbounded completion), 1 if tolerances were not
+ * met, 2 if (p, Ap) hit exactly zero -- the reference's
+ * ACG_ERR_NOT_CONVERGED_INDEFINITE_MATRIX (cg.c:304) -- and negative on
  * invalid input. */
+#define ACG_NATIVE_CG_NOT_CONVERGED 1
+#define ACG_NATIVE_CG_INDEFINITE 2
 int32_t acg_cg_solve(int64_t n, const int64_t *rowptr, const int64_t *colidx,
                      const double *a, const double *b, double *x,
                      int32_t maxits, double res_atol, double res_rtol,
                      double diff_atol, double diff_rtol, int32_t *niter,
                      double *rnrm2_out, double *r0nrm2_out,
-                     double *dxnrm2_out);
+                     double *dxnrm2_out, double *r_out);
 
 #ifdef __cplusplus
 }

@@ -52,7 +52,7 @@ int32_t acg_cg_solve(int64_t n, const int64_t *rowptr, const int64_t *colidx,
                      int32_t maxits, double res_atol, double res_rtol,
                      double diff_atol, double diff_rtol, int32_t *niter,
                      double *rnrm2_out, double *r0nrm2_out,
-                     double *dxnrm2_out) {
+                     double *dxnrm2_out, double *r_out) {
     if (n < 0 || maxits < 0) return ACG_NATIVE_ERR_INVALID_FORMAT;
     std::vector<double> r(n), p(n), t(n);
     const bool unbounded = res_atol == 0.0 && res_rtol == 0.0 &&
@@ -85,10 +85,14 @@ int32_t acg_cg_solve(int64_t n, const int64_t *rowptr, const int64_t *colidx,
     };
 
     int32_t k = 0;
+    bool indefinite = false;
     bool converged = !unbounded && test();
     while (!converged && k < maxits) {
         spmv(n, rowptr, colidx, a, p.data(), t.data());
         double pdott = dot(n, p.data(), t.data());
+        /* (p, Ap) == 0 for p != 0 means A is not positive definite; the
+         * reference aborts here (cg.c:304) rather than dividing */
+        if (pdott == 0.0) { indefinite = true; break; }
         double alpha = gamma / pdott;
 #ifdef _OPENMP
 #pragma omp parallel for schedule(static)
@@ -113,7 +117,10 @@ int32_t acg_cg_solve(int64_t n, const int64_t *rowptr, const int64_t *colidx,
     *niter = k;
     *rnrm2_out = rnrm2;
     *dxnrm2_out = dxnrm2;
-    return (converged || unbounded) ? 0 : 1;
+    if (r_out)
+        for (int64_t i = 0; i < n; i++) r_out[i] = r[i];
+    if (indefinite) return ACG_NATIVE_CG_INDEFINITE;
+    return (converged || unbounded) ? 0 : ACG_NATIVE_CG_NOT_CONVERGED;
 }
 
 }  // extern "C"

@@ -55,7 +55,7 @@ void radixsort_u64(int64_t n, uint64_t *keys, int64_t *perm) {
 
 extern "C" {
 
-int32_t acg_core_abi_version(void) { return 2; }
+int32_t acg_core_abi_version(void) { return 3; }
 
 void acg_radixsort_i64(int64_t n, int64_t *keys, int64_t *perm) {
     if (n <= 0) return;

@@ -198,3 +198,27 @@ def test_host_dist_cg_matches_serial(nparts):
     assert np.linalg.norm(xd - xs) < 1e-8
     assert np.linalg.norm(xd - xsol) < 1e-7
     assert dist.stats.converged
+
+
+def test_indefinite_matrix_abort():
+    """CG on a matrix with (p, Ap) == 0 must raise the reference's
+    indefinite-matrix error (ACG_ERR_NOT_CONVERGED_INDEFINITE_MATRIX,
+    cg.c:304) from BOTH host oracles, not divide by zero."""
+    import pytest
+    import scipy.sparse as sp
+
+    from acg_tpu.errors import IndefiniteMatrixError
+    from acg_tpu.solvers.host_cg import HostCGSolver, NativeHostCGSolver
+    from acg_tpu.solvers.stats import StoppingCriteria
+    from acg_tpu import _native
+
+    n = 16
+    Z = sp.csr_matrix((n, n))  # Ap = 0 for every p
+    b = np.ones(n)
+    crit = StoppingCriteria(maxits=10, residual_rtol=1e-10)
+    solvers = [HostCGSolver(Z)]
+    if _native.available():
+        solvers.append(NativeHostCGSolver(Z))
+    for s in solvers:
+        with pytest.raises(IndefiniteMatrixError):
+            s.solve(b, criteria=crit)
