@@ -105,8 +105,17 @@ def make_parser() -> argparse.ArgumentParser:
     p.add_argument("--output-comm-matrix", action="store_true",
                    help="write the part-to-part communication volume matrix "
                         "to stdout as Matrix Market")
-    p.add_argument("--dtype", default="f64", choices=["f64", "f32", "bf16"],
-                   help="device arithmetic precision (default: f64)")
+    p.add_argument("--dtype", default="f64",
+                   choices=["f64", "f32", "mixed", "bf16"],
+                   help="device precision (default: f64).  'mixed' = bf16 "
+                        "matrix storage + f32 vectors/scalars: halves "
+                        "matrix HBM traffic, and is arithmetic-identical "
+                        "to f32 when the entries are bf16-representable "
+                        "(Poisson stencils).  'bf16' stores vectors in "
+                        "bf16 too (half traffic everywhere, f32 scalars) "
+                        "but caps convergence at condition numbers "
+                        "~1/u_bf16 ~ 500 -- use for well-conditioned "
+                        "systems or throughput measurement")
     p.add_argument("--kernels", default="auto",
                    choices=["auto", "xla", "pallas"],
                    help="hot-loop kernel tier: xla = compiler-fused ops, "
@@ -271,7 +280,8 @@ def _gen_direct_min() -> int:
     return int(os.environ.get("ACG_TPU_GEN_DIRECT_MIN", 2 ** 24))
 
 
-def _solve_generated_direct(args, dim, n, N, jax, jnp, dtype) -> int:
+def _solve_generated_direct(args, dim, n, N, jax, jnp, dtype,
+                            vec_dtype=None) -> int:
     """The zero-transfer large-stencil path: DIA planes assembled on
     device (``poisson_dia_device``), solved by the compiled single-chip
     programs.  This is what makes the north-star 512^3 problem (134M
@@ -307,6 +317,7 @@ def _solve_generated_direct(args, dim, n, N, jax, jnp, dtype) -> int:
             f"(these need a host-side matrix; use a file or a smaller "
             f"gen: spec)")
 
+    vec_dtype = dtype if vec_dtype is None else vec_dtype
     t0 = time.perf_counter()
     planes, offsets, _ = poisson_dia_device(n, dim, dtype=dtype)
     if args.epsilon:
@@ -319,8 +330,8 @@ def _solve_generated_direct(args, dim, n, N, jax, jnp, dtype) -> int:
 
     solver = JaxCGSolver(A, pipelined="pipelined" in args.solver,
                          precise_dots=args.precise_dots,
-                         kernels=args.kernels)
-    b = jnp.ones(N, dtype=dtype)
+                         kernels=args.kernels, vector_dtype=vec_dtype)
+    b = jnp.ones(N, dtype=vec_dtype)
     criteria = StoppingCriteria(
         maxits=args.max_iterations,
         residual_atol=args.residual_atol, residual_rtol=args.residual_rtol,
@@ -396,7 +407,14 @@ def _main(args) -> int:
     from acg_tpu.solvers.jax_cg import JaxCGSolver
     from acg_tpu.solvers.refine import RefinedSolver
 
-    dtype = {"f64": jnp.float64, "f32": jnp.float32, "bf16": jnp.bfloat16}[args.dtype]
+    # "mixed" splits matrix storage (bf16) from vector storage (f32);
+    # every other mode stores both in the named dtype
+    if args.dtype == "mixed":
+        dtype, vec_dtype = jnp.bfloat16, jnp.float32
+    else:
+        dtype = {"f64": jnp.float64, "f32": jnp.float32,
+                 "bf16": jnp.bfloat16}[args.dtype]
+        vec_dtype = dtype
     comm = {"mpi": "xla", "nccl": "xla", "nvshmem": "dma"}.get(args.comm, args.comm)
 
     if args.verbose >= 2:
@@ -413,7 +431,8 @@ def _main(args) -> int:
         kind, dim, n, N = spec[:4]
         if kind == "poisson" and N > _gen_direct_min():
             # too large for host CSR assembly: direct on-device DIA
-            return _solve_generated_direct(args, dim, n, N, jax, jnp, dtype)
+            return _solve_generated_direct(args, dim, n, N, jax, jnp, dtype,
+                                           vec_dtype)
         _log(args, f"synthesizing {args.A} (N={N})")
         from acg_tpu.io.generators import (irregular_spd_coo, poisson2d_coo,
                                            poisson3d_coo)
@@ -543,7 +562,8 @@ def _main(args) -> int:
                                          format=args.spmv_format)
             solver = JaxCGSolver(dev, pipelined=pipelined,
                                  precise_dots=args.precise_dots,
-                                 kernels=args.kernels)
+                                 kernels=args.kernels,
+                                 vector_dtype=vec_dtype)
             if args.refine:
                 solver = RefinedSolver(solver, csr,
                                        inner_rtol=args.refine_rtol)
@@ -553,7 +573,8 @@ def _main(args) -> int:
             if args.output_comm_matrix:
                 comm_mtx_out = comm_matrix(subs, nparts)
             prob = DistributedProblem.build(csr, part, nparts, dtype=dtype,
-                                            subs=subs)
+                                            subs=subs,
+                                            vector_dtype=vec_dtype)
             solver = DistCGSolver(prob, pipelined=pipelined, comm=comm,
                                   precise_dots=args.precise_dots,
                                   kernels=args.kernels)

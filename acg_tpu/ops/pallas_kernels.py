@@ -34,6 +34,8 @@ import numpy as np
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
+from acg_tpu.ops.spmv import acc_dtype
+
 # row-tile length for the SpMV kernel; multiple of the f32 (8,128) tile
 TILE = 16384
 LANE = 128
@@ -303,21 +305,26 @@ def _dia_spmv_clustered(planes, offsets, x, central, far, Lpad, Rpad,
                             pl.multiple_of(src * tile, align), tile)],
                         fwin, sems.at[3 + f]).wait()
             body_cp.wait()
-            acc = jnp.zeros((tile,), x.dtype)
+            # sub-f32 storage accumulates in f32: the converts are free
+            # on the VPU, VMEM/HBM stay half-width
+            kadt = acc_dtype(x.dtype)
+            acc = jnp.zeros((tile,), kadt)
             far_idx = {o: f for f, o in enumerate(far)}
             for pr, off in zip(plane_refs, offsets):
                 if off in central_set:
-                    acc = acc + pr[:] * xwin[pl.ds(Lpad + off, tile)]
+                    acc = acc + (pr[:].astype(kadt)
+                                 * xwin[pl.ds(Lpad + off, tile)].astype(kadt))
                 else:
-                    acc = acc + pr[:] * fwins[far_idx[off]][:]
-            y_ref[:] = acc
+                    acc = acc + (pr[:].astype(kadt)
+                                 * fwins[far_idx[off]][:].astype(kadt))
+            y_ref[:] = acc.astype(x.dtype)
             if with_dot:
                 # TPU grids run sequentially, so accumulating the
                 # partial into the (1,)-SMEM output across steps is
-                # safe; products are widened to f32 before the
-                # reduction so bf16 inputs don't collapse the scalar
-                adt = (jnp.float32 if jnp.dtype(x.dtype).itemsize <= 4
-                       else x.dtype)
+                # safe; products are widened to the accumulation dtype
+                # before the reduction so bf16 inputs don't collapse
+                # the scalar
+                adt = acc_dtype(x.dtype)
                 partial = jnp.sum(acc.astype(adt)
                                   * xwin[pl.ds(Lpad, tile)].astype(adt))
 
@@ -338,12 +345,10 @@ def _dia_spmv_clustered(planes, offsets, x, central, far, Lpad, Rpad,
     out_specs = tile_spec
     out_shape = jax.ShapeDtypeStruct((n,), x.dtype)
     if with_dot:
-        acc_dtype = (jnp.float32 if jnp.dtype(x.dtype).itemsize <= 4
-                     else x.dtype)
         out_specs = (tile_spec,
                      pl.BlockSpec((1,), lambda i: (0,),
                                   memory_space=pltpu.SMEM))
-        out_shape = (out_shape, jax.ShapeDtypeStruct((1,), acc_dtype))
+        out_shape = (out_shape, jax.ShapeDtypeStruct((1,), acc_dtype(x.dtype)))
     return pl.pallas_call(
         kernel,
         grid=(grid,),
@@ -378,10 +383,12 @@ def _dia_spmv_padded(planes, offsets, x, L, R, interpret):
                 xp_ref.at[pl.ds(i * tile, win)], xwin, sem)
             cp.start()
             cp.wait()
-            acc = jnp.zeros((tile,), planes[0].dtype)
+            kadt = acc_dtype(x.dtype)
+            acc = jnp.zeros((tile,), kadt)
             for pr, off in zip(plane_refs, offsets):
-                acc = acc + pr[:] * xwin[pl.ds(L + off, tile)]
-            y_ref[:] = acc
+                acc = acc + (pr[:].astype(kadt)
+                             * xwin[pl.ds(L + off, tile)].astype(kadt))
+            y_ref[:] = acc.astype(x.dtype)
 
         pl.run_scoped(body, pltpu.VMEM((win,), x.dtype),
                       pltpu.SemaphoreType.DMA)

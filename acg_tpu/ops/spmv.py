@@ -126,19 +126,58 @@ def dia_planes_fixed(csr, offsets, nrows_pad: int) -> np.ndarray:
     return data
 
 
+def acc_dtype(dtype):
+    """Accumulation dtype for reductions over ``dtype`` storage: sub-f32
+    storage (bf16) accumulates in f32 -- the converts ride the VPU for
+    free while HBM traffic stays half-width -- wider dtypes accumulate
+    natively.  The storage/compute split of the mixed-precision tier
+    (the designed deviation from the reference's all-f64 arithmetic,
+    ``comm.h:180-183``; SURVEY.md section 7 "hard parts")."""
+    return jnp.promote_types(dtype, jnp.float32)
+
+
 def dia_mv(planes, offsets, nrows: int, x: jax.Array) -> jax.Array:
     """y = A @ x for DIA planes (each (nrows,)) with static ``offsets``:
     ``y[i] = sum_d planes[d][i] * x[i + offsets[d]]``.  Pure VPU
     multiply-adds on statically-sliced views -- no gathers.  ``x`` may be
     shorter or longer than ``nrows`` (rectangular blocks); out-of-range
-    entries read padded zeros."""
+    entries read padded zeros.  Sub-f32 storage accumulates in f32 and
+    rounds once on the final store (:func:`acc_dtype`)."""
     L = max(0, -min(offsets))
     R = max(0, max(offsets) + nrows - x.shape[0])
+    adt = acc_dtype(x.dtype)
     xp = jnp.pad(x, (L, R))
-    y = jnp.zeros((nrows,), dtype=x.dtype)
+    y = jnp.zeros((nrows,), dtype=adt)
     for plane, off in zip(planes, offsets):
-        y = y + plane * jax.lax.dynamic_slice(xp, (L + off,), (nrows,))
-    return y
+        y = y + (plane.astype(adt)
+                 * jax.lax.dynamic_slice(xp, (L + off,), (nrows,)).astype(adt))
+    return y.astype(x.dtype)
+
+
+def dia_mv_roll(planes, offsets, x: jax.Array) -> jax.Array:
+    """``y = A @ x`` for square DIA planes via CYCLIC shifts:
+    ``y = sum_d planes[d] * roll(x, -offsets[d])``.
+
+    Equivalent to :func:`dia_mv` when every plane is zero at positions
+    whose column would fall outside ``[0, n)`` -- true by construction
+    for planes built by :func:`dia_from_csr` / :func:`dia_planes_fixed`
+    / the stencil generators, since no matrix entry exists off the end
+    of a diagonal: the wrapped values multiply structural zeros.
+
+    This is the SPMD-native formulation of the distributed stencil SpMV:
+    under ``jit`` over a sharded ``x``, XLA compiles each roll into
+    boundary ``collective-permute``s -- the halo exchange of the
+    reference's ``acghalo`` engine (``halo.c``), *derived by the
+    partitioner* instead of hand-planned (verified: the 8-way sharded
+    3D-Poisson program contains collective-permutes and zero
+    all-gathers).  Padding-based shifts (:func:`dia_mv`) would instead
+    break the even sharding and force gathers.
+    """
+    adt = acc_dtype(x.dtype)
+    y = jnp.zeros_like(x, dtype=adt)
+    for plane, off in zip(planes, offsets):
+        y = y + plane.astype(adt) * jnp.roll(x, -off).astype(adt)
+    return y.astype(x.dtype)
 
 
 def dia_from_csr(csr, dtype=jnp.float32) -> DiaMatrix:
@@ -263,16 +302,18 @@ def spmv(A: DeviceMatrix, x: jax.Array) -> jax.Array:
 
 
 def _spmv(A: DeviceMatrix, x: jax.Array) -> jax.Array:
+    adt = acc_dtype(x.dtype)
     if isinstance(A, DiaMatrix):
         # static shifted views of x; XLA fuses into one VPU loop
         return dia_mv(A.data, A.offsets, A.nrows, x)
     if isinstance(A, EllMatrix):
         # K gathers of n elements each; XLA fuses the multiply-accumulate.
-        return jnp.einsum("nk,nk->n", A.data, x[A.cols])
+        return jnp.einsum("nk,nk->n", A.data, x[A.cols],
+                          preferred_element_type=adt).astype(x.dtype)
     if isinstance(A, CooMatrix):
-        prod = A.vals * x[A.cols]
+        prod = A.vals.astype(adt) * x[A.cols].astype(adt)
         return jax.ops.segment_sum(prod, A.rows, num_segments=A.nrows,
-                                   indices_are_sorted=True)
+                                   indices_are_sorted=True).astype(x.dtype)
     raise TypeError(f"unsupported device matrix {type(A)}")
 
 

@@ -46,8 +46,8 @@ from acg_tpu.errors import NotConvergedError
 from acg_tpu.graph import (Subdomain, partition_matrix, reorder_owned_natural,
                            scatter_vector)
 from acg_tpu.ops.precision import dot_compensated
-from acg_tpu.ops.spmv import (csr_diag_offsets, dia_mv, dia_planes_fixed,
-                              ell_planes_from_csr)
+from acg_tpu.ops.spmv import (acc_dtype, csr_diag_offsets, dia_mv,
+                              dia_planes_fixed, ell_planes_from_csr)
 from acg_tpu.parallel.halo import DeviceHaloPlan, build_device_halo, halo_exchange
 from acg_tpu.parallel.halo_dma import halo_exchange_dma
 from acg_tpu.parallel.mesh import PARTS_AXIS, solve_mesh
@@ -58,7 +58,9 @@ from acg_tpu.solvers.stats import (SolverStats, StoppingCriteria,
 
 
 def _ell_mv(data: jax.Array, cols: jax.Array, x: jax.Array) -> jax.Array:
-    return jnp.einsum("nk,nk->n", data, x[cols])
+    return jnp.einsum("nk,nk->n", data, x[cols],
+                      preferred_element_type=acc_dtype(x.dtype)
+                      ).astype(x.dtype)
 
 
 @dataclasses.dataclass
@@ -104,7 +106,9 @@ class StackedGhostBlock:
 
     def shard_mv(self, arrays, xg):
         rows, data, cols = arrays
-        contrib = jnp.einsum("bk,bk->b", data, xg[cols])
+        contrib = jnp.einsum("bk,bk->b", data, xg[cols],
+                             preferred_element_type=acc_dtype(xg.dtype)
+                             ).astype(xg.dtype)
         # padding rows index nrows: out of bounds -> dropped by scatter
         return jnp.zeros((self.nrows,), xg.dtype).at[rows].add(
             contrib, indices_are_sorted=True)
@@ -183,11 +187,20 @@ class DistributedProblem:
     ghost: StackedGhostBlock
     nnz_total: int
     dtype: object
+    # vector storage dtype; None = same as the matrix blocks.  The
+    # supported split is bf16 blocks + f32 vectors ("--dtype mixed",
+    # jax_cg.JaxCGSolver.vector_dtype rationale)
+    vector_dtype: object = None
+
+    @property
+    def vdtype(self):
+        return self.dtype if self.vector_dtype is None else self.vector_dtype
 
     @classmethod
     def build(cls, full_csr, part, nparts: int, dtype=jnp.float32,
               subs: list[Subdomain] | None = None,
-              reorder: str = "natural") -> "DistributedProblem":
+              reorder: str = "natural",
+              vector_dtype=None) -> "DistributedProblem":
         """``reorder="natural"`` (default) re-sorts each part's owned rows
         by global id (in place when ``subs`` is passed) so contiguous
         partitions of banded matrices keep gather-free DIA local blocks;
@@ -202,13 +215,14 @@ class DistributedProblem:
         ghost = _stack_ghost_blocks(subs, nmax_owned, dtype)
         return cls(nparts=nparts, n=full_csr.shape[0], subs=subs,
                    nmax_owned=nmax_owned, halo=halo, local=local,
-                   ghost=ghost, nnz_total=int(full_csr.nnz), dtype=dtype)
+                   ghost=ghost, nnz_total=int(full_csr.nnz), dtype=dtype,
+                   vector_dtype=vector_dtype)
 
     # -- vector scatter/gather to the stacked padded layout ---------------
 
     def scatter(self, x_global: np.ndarray) -> np.ndarray:
         xs = scatter_vector(self.subs, np.asarray(x_global))
-        out = np.zeros((self.nparts, self.nmax_owned), dtype=np.dtype(self.dtype))
+        out = np.zeros((self.nparts, self.nmax_owned), dtype=np.dtype(self.vdtype))
         for p, (s, x) in enumerate(zip(self.subs, xs)):
             out[p, : s.nowned] = x[: s.nowned]
         return out
@@ -341,10 +355,19 @@ class DistCGSolver:
                 a[0] for a in (sidx, gsrc, gval, scnt, rcnt, b, x0))
             maxits = maxits.astype(jnp.int32)
             dtype = b.dtype
+            # bf16 storage keeps every scalar in f32 (jax_cg._scalar_setup
+            # rationale): dots accumulate in f32, updated vectors round
+            # once on store, only half-width bytes cross HBM and the ICI
+            sdt = acc_dtype(dtype)
+            store = ((lambda v: v.astype(dtype)) if sdt != dtype
+                     else (lambda v: v))
             res_atol, res_rtol, diff_atol, diff_rtol = tols
 
             def spmv(x):
                 return dist_spmv(x, la, ga, sidx, gsrc, gval, scnt, rcnt)
+
+            def ldot(a, c):
+                return jnp.dot(a, c, preferred_element_type=sdt)
 
             if precise:
                 # compensated local dot (ops.precision), hi and lo
@@ -352,7 +375,7 @@ class DistCGSolver:
                 # the global scalar (cross-part addition error is
                 # O(nparts) ulps, negligible vs the 4M-element sums)
                 def pdot(a, c):
-                    hi, lo = dot_compensated(a, c)
+                    hi, lo = dot_compensated(a.astype(sdt), c.astype(sdt))
                     pair = psum(jnp.stack([hi, lo]))
                     return pair[0] + pair[1]
 
@@ -360,17 +383,17 @@ class DistCGSolver:
                     # both compensated dots in ONE psum of 4 scalars,
                     # preserving the pipelined variant's single-allreduce
                     # property (cgcuda.c:1730-1737)
-                    h1, l1 = dot_compensated(a1, c1)
-                    h2, l2 = dot_compensated(a2, c2)
+                    h1, l1 = dot_compensated(a1.astype(sdt), c1.astype(sdt))
+                    h2, l2 = dot_compensated(a2.astype(sdt), c2.astype(sdt))
                     quad = psum(jnp.stack([h1, l1, h2, l2]))
                     return quad[0] + quad[1], quad[2] + quad[3]
             else:
                 def pdot(a, c):
-                    return psum(jnp.dot(a, c))
+                    return psum(ldot(a, c))
 
                 def pdot2_fused(a1, c1, a2, c2):
-                    pair = psum(jnp.stack([jnp.dot(a1, c1),
-                                           jnp.dot(a2, c2)]))
+                    pair = psum(jnp.stack([ldot(a1, c1),
+                                           ldot(a2, c2)]))
                     return pair[0], pair[1]
 
             bnrm2 = jnp.sqrt(pdot(b, b))
@@ -380,7 +403,7 @@ class DistCGSolver:
             r0nrm2 = jnp.sqrt(gamma)
             res_tol = jnp.maximum(res_atol, res_rtol * r0nrm2)
             diff_tol = jnp.maximum(diff_atol, diff_rtol * x0nrm2)
-            inf = jnp.asarray(jnp.inf, dtype)
+            inf = jnp.asarray(jnp.inf, sdt)
 
             # Loop structure and convergence logic shared with the
             # single-device solver (jax_cg._iterate / _converged): gamma is
@@ -400,14 +423,14 @@ class DistCGSolver:
                     t = spmv(p)
                     pdott = pdot(p, t)
                     alpha = gamma / pdott
-                    x = x + alpha * p
-                    r = r - alpha * t
+                    x = store(x + alpha * p)
+                    r = store(r - alpha * t)
                     gamma_next = pdot(r, r)
                     beta = gamma_next / gamma
-                    p_next = r + beta * p
+                    p_next = store(r + beta * p)
                     if needs_diff:
                         return (x, r, p_next, gamma_next,
-                                alpha * alpha * psum(jnp.dot(p, p)))
+                                alpha * alpha * psum(ldot(p, p)))
                     return (x, r, p_next, gamma_next)
 
                 init_state = (x0, r, r, gamma) + ((inf,) if needs_diff else ())
@@ -430,15 +453,15 @@ class DistCGSolver:
                     q = spmv(w)  # overlaps the psum under XLA's scheduler
                     beta = gamma / gamma_prev
                     alpha = gamma / (delta - beta * (gamma / alpha_prev))
-                    z = q + beta * z
-                    t = w + beta * t
-                    p = r + beta * p
-                    x = x + alpha * p
-                    r = r - alpha * t
-                    w = w - alpha * z
+                    z = store(q + beta * z)
+                    t = store(w + beta * t)
+                    p = store(r + beta * p)
+                    x = store(x + alpha * p)
+                    r = store(r - alpha * t)
+                    w = store(w - alpha * z)
                     if needs_diff:
                         return (x, r, w, p, t, z, gamma, alpha,
-                                alpha * alpha * psum(jnp.dot(p, p)))
+                                alpha * alpha * psum(ldot(p, p)))
                     return (x, r, w, p, t, z, gamma, alpha)
 
                 # stale-gamma convergence test (see jax_cg): s[6] is the
@@ -488,7 +511,7 @@ class DistCGSolver:
         stage of ``acgsolvercuda_init``, ``cgcuda.c:143-332``); shared
         by :meth:`solve` and the per-op profiler."""
         prob = self.problem
-        dtype = np.dtype(prob.dtype)
+        dtype = np.dtype(prob.vdtype)
         put = functools.partial(put_global, sharding=self._sharding)
         b = put(prob.scatter(np.asarray(b_global)))
         x0 = put(prob.scatter(np.asarray(x0))
@@ -511,12 +534,15 @@ class DistCGSolver:
         st = self.stats
         st.criteria = crit
         prob = self.problem
-        dtype = np.dtype(prob.dtype)
+        dtype = np.dtype(prob.vdtype)
 
         b, x0, la, ga, sidx, gsrc, gval, scnt, rcnt = \
             self.device_args(b_global, x0)
+        # tolerances in the scalar dtype (f32 for bf16 storage) so a 1e-9
+        # rtol is not pre-rounded to 8 mantissa bits
         tols = jnp.asarray([crit.residual_atol, crit.residual_rtol,
-                            crit.diff_atol, crit.diff_rtol], dtype=dtype)
+                            crit.diff_atol, crit.diff_rtol],
+                           dtype=acc_dtype(dtype))
         kwargs = dict(unbounded=crit.unbounded, needs_diff=crit.needs_diff)
         args = (la, ga, sidx, gsrc, gval, scnt, rcnt, b, x0, tols,
                 jnp.int32(crit.maxits))
@@ -542,8 +568,13 @@ class DistCGSolver:
         st.nflops += (cg_flops_per_iteration(prob.nnz_total, n, self.pipelined)
                       * niter + 3.0 * prob.nnz_total + 2.0 * n)
         dbl = dtype.itemsize
+        # matrix bytes in the matrix dtype (differs from vectors under
+        # mixed); DIA local blocks read no index arrays, ELL reads 4 B
+        mat_dbl = np.dtype(prob.dtype).itemsize
+        idx_b = 0 if prob.local.format == "dia" else 4
         st.ops["gemv"].add(niter + 1, 0.0,
-                           (prob.nnz_total * (dbl + 4) + 2 * n * dbl) * (niter + 1))
+                           (prob.nnz_total * (mat_dbl + idx_b)
+                            + 2 * n * dbl) * (niter + 1))
         st.ops["dot"].add(2 * niter, 0.0, 2 * n * dbl * 2 * niter)
         st.ops["axpy"].add(3 * niter, 0.0, 3 * n * dbl * 3 * niter)
         st.ops["allreduce"].add((1 if self.pipelined else 2) * niter, 0.0,

@@ -96,6 +96,11 @@ class HostCGSolver:
             pdott = float(p @ t)
             self._op("dot", time.perf_counter() - t0, 2 * n * dbl, 2.0 * n)
             if pdott == 0.0:
+                if gamma == 0.0:
+                    # r = p = 0: exactly converged (reachable in
+                    # fixed-iteration mode past convergence); iterating
+                    # further is a 0/0, not an indefiniteness
+                    break
                 # (p, Ap) == 0 for p != 0: not positive definite; abort
                 # like the reference (cg.c:304) instead of dividing
                 st.tsolve += time.perf_counter() - tstart

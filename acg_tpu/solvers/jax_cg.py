@@ -35,7 +35,8 @@ import numpy as np
 
 from acg_tpu.errors import NotConvergedError
 from acg_tpu.ops.precision import dot2
-from acg_tpu.ops.spmv import DeviceMatrix, DiaMatrix, spmv, spmv_flops
+from acg_tpu.ops.spmv import (DeviceMatrix, DiaMatrix, acc_dtype, spmv,
+                              spmv_flops)
 from acg_tpu.solvers.stats import (SolverStats, StoppingCriteria,
                                    cg_flops_per_iteration)
 
@@ -44,7 +45,19 @@ def _spmv_fn(kernels: str):
     """Select the SpMV implementation: "xla" = ops.spmv (compiler-fused);
     "pallas"/"pallas-interpret" = the hand-written single-x-pass DIA kernel
     (ops.pallas_kernels.dia_spmv, measured ~1.2x faster on TPU v5e --
-    BASELINE.md).  Falls back to XLA for non-DIA / rectangular matrices."""
+    BASELINE.md); "xla-roll" = the cyclic-shift DIA formulation whose
+    shifts XLA's SPMD partitioner turns into boundary collective-permutes
+    (the sharded/multi-chip route, ops.spmv.dia_mv_roll).  Falls back to
+    XLA for non-DIA / rectangular matrices."""
+    if kernels == "xla-roll":
+        from acg_tpu.ops.spmv import dia_mv_roll
+
+        def f(A, x):
+            if isinstance(A, DiaMatrix) and A.ncols_padded == A.nrows:
+                return dia_mv_roll(A.data, A.offsets, x)
+            return spmv(A, x)
+
+        return f
     if kernels.startswith("pallas"):
         from acg_tpu.ops.pallas_kernels import dia_spmv
 
@@ -59,6 +72,27 @@ def _spmv_fn(kernels: str):
     return spmv
 
 
+def _scalar_setup(dtype, precise: bool):
+    """``(dot, sdt)``: the CG-scalar dot product and the scalar dtype for
+    ``dtype`` vector storage.
+
+    bf16 storage (the half-traffic tier; the designed deviation from the
+    reference's all-f64 arithmetic, ``comm.h:180-183``) computes every
+    scalar in f32: plain mode accumulates the dots in f32
+    (``preferred_element_type``), precise mode runs the compensated dot2
+    over f32-widened reads.  Either way only bf16 bytes cross HBM; the
+    widening rides the VPU.  f32/f64 storage keeps its native scalar
+    path (dot2 when ``precise``)."""
+    sdt = acc_dtype(dtype)
+    if jnp.dtype(dtype) == jnp.bfloat16:
+        if precise:
+            def dot(a, b):
+                return dot2(a.astype(sdt), b.astype(sdt))
+        else:
+            def dot(a, b):
+                return jnp.dot(a, b, preferred_element_type=sdt)
+        return dot, sdt
+    return (dot2 if precise else jnp.dot), sdt
 
 
 @functools.partial(jax.tree_util.register_dataclass,
@@ -150,19 +184,22 @@ def _cg_program(A: DeviceMatrix, b, x0, res_atol, res_rtol, diff_atol,
     ``precise`` switches the CG scalars' dot products to the compensated
     dot2 (acg_tpu.ops.precision): ~2x working precision for gamma and
     (p, t), which is what lets plain-f32 storage converge past the
-    ~1e-6 relative-residual stall."""
-    dot = dot2 if precise else jnp.dot
-    spmv_ = _spmv_fn(kernels)
+    ~1e-6 relative-residual stall.  bf16 storage keeps every scalar in
+    f32 (``_scalar_setup``) and rounds the updated vectors once on
+    store, so only half-width bytes cross HBM."""
     dtype = b.dtype
-    bnrm2 = jnp.linalg.norm(b)
-    x0nrm2 = jnp.linalg.norm(x0)
+    dot, sdt = _scalar_setup(dtype, precise)
+    store = (lambda v: v.astype(dtype)) if sdt != dtype else (lambda v: v)
+    spmv_ = _spmv_fn(kernels)
+    bnrm2 = jnp.sqrt(dot(b, b))
+    x0nrm2 = jnp.sqrt(dot(x0, x0))
     r = b - spmv_(A, x0)
     p = r
     gamma = dot(r, r)
     r0nrm2 = jnp.sqrt(gamma)
     res_tol = jnp.maximum(res_atol, res_rtol * r0nrm2)
     diff_tol = jnp.maximum(diff_atol, diff_rtol * x0nrm2)
-    inf = jnp.asarray(jnp.inf, dtype)
+    inf = jnp.asarray(jnp.inf, sdt)
 
     # dxsqr joins the carry only when a diff criterion is active: every
     # extra loop-carried scalar measurably slows the TPU loop (~0.1 ms/it)
@@ -176,14 +213,14 @@ def _cg_program(A: DeviceMatrix, b, x0, res_atol, res_rtol, diff_atol,
         t = spmv_(A, p)
         pdott = dot(p, t)
         alpha = gamma / pdott
-        x = x + alpha * p
-        r = r - alpha * t
+        x = store(x + alpha * p)
+        r = store(r - alpha * t)
         gamma_next = dot(r, r)
         beta = gamma_next / gamma
-        p_next = r + beta * p
+        p_next = store(r + beta * p)
         if needs_diff:
             return (x, r, p_next, gamma_next,
-                    alpha * alpha * jnp.dot(p, p))
+                    alpha * alpha * dot(p, p))
         return (x, r, p_next, gamma_next)
 
     init_state = (x0, r, p, gamma) + ((inf,) if needs_diff else ())
@@ -206,17 +243,18 @@ def _cg_pipelined_program(A: DeviceMatrix, b, x0, res_atol, res_rtol,
                           needs_diff: bool, precise: bool = False,
                           kernels: str = "xla"):
     """Whole pipelined-CG (Ghysels-Vanroose) solve as one XLA program."""
-    dot = dot2 if precise else jnp.dot
-    spmv_ = _spmv_fn(kernels)
     dtype = b.dtype
-    bnrm2 = jnp.linalg.norm(b)
-    x0nrm2 = jnp.linalg.norm(x0)
+    dot, sdt = _scalar_setup(dtype, precise)
+    store = (lambda v: v.astype(dtype)) if sdt != dtype else (lambda v: v)
+    spmv_ = _spmv_fn(kernels)
+    bnrm2 = jnp.sqrt(dot(b, b))
+    x0nrm2 = jnp.sqrt(dot(x0, x0))
     r = b - spmv_(A, x0)
     w = spmv_(A, r)
-    r0nrm2 = jnp.linalg.norm(r)
+    r0nrm2 = jnp.sqrt(dot(r, r))
     res_tol = jnp.maximum(res_atol, res_rtol * r0nrm2)
     diff_tol = jnp.maximum(diff_atol, diff_rtol * x0nrm2)
-    inf = jnp.asarray(jnp.inf, dtype)
+    inf = jnp.asarray(jnp.inf, sdt)
     zeros = jnp.zeros_like(b)
 
     def body(state):
@@ -235,15 +273,15 @@ def _cg_pipelined_program(A: DeviceMatrix, b, x0, res_atol, res_rtol,
         # the loop it is an opaque call that forfeits XLA's fusion of the
         # *next* iteration's dots into these writes -- measured 894 vs
         # 1818 iters/s on the flagship (BASELINE.md)
-        z = q + beta * z
-        t = w + beta * t
-        p = r + beta * p
-        x = x + alpha * p
-        r = r - alpha * t
-        w = w - alpha * z
+        z = store(q + beta * z)
+        t = store(w + beta * t)
+        p = store(r + beta * p)
+        x = store(x + alpha * p)
+        r = store(r - alpha * t)
+        w = store(w - alpha * z)
         if needs_diff:
             return (x, r, w, p, t, z, gamma, alpha,
-                    alpha * alpha * jnp.dot(p, p))
+                    alpha * alpha * dot(p, p))
         return (x, r, w, p, t, z, gamma, alpha)
 
     # convergence tests the carried gamma = ||r||^2 from *before* the
@@ -257,7 +295,7 @@ def _cg_pipelined_program(A: DeviceMatrix, b, x0, res_atol, res_rtol,
         unbounded, init_gamma=r0nrm2 * r0nrm2)
     x, r = state[0], state[1]
     dxsqr = state[8] if needs_diff else inf
-    rnrm2 = jnp.linalg.norm(r)
+    rnrm2 = jnp.sqrt(dot(r, r))
     # the in-loop test is one iteration stale; at the maxits boundary a
     # solve whose final *fresh* residual meets tolerance must not report
     # converged=False with a below-tolerance rnrm2 in the same stats block
@@ -276,8 +314,20 @@ class JaxCGSolver:
     """
 
     def __init__(self, A: DeviceMatrix, pipelined: bool = False,
-                 precise_dots: bool = False, kernels: str = "auto"):
+                 precise_dots: bool = False, kernels: str = "auto",
+                 vector_dtype=None):
+        """``vector_dtype`` decouples vector storage from matrix storage
+        (default: the matrix dtype).  The supported mix is bf16 matrix +
+        f32 vectors (``--dtype mixed``): for matrices whose entries are
+        exactly representable in bf16 (Poisson stencils: -1, 4, 6) the
+        arithmetic is IDENTICAL to all-f32 -- the f32-accumulating SpMV
+        reads the planes losslessly -- while matrix HBM traffic halves.
+        Unlike the all-bf16 tier it has no kappa limit: bf16 vector
+        storage caps convergence at kappa ~ 1/u_bf16 ~ 500 (measured:
+        diverges on 2D Poisson n >= 512), whereas this tier's iterates
+        never touch bf16."""
         self.A = A
+        self.vector_dtype = vector_dtype
         self.pipelined = pipelined
         self.precise_dots = precise_dots
         if kernels == "auto":
@@ -290,7 +340,7 @@ class JaxCGSolver:
                        and itemsize in (2, 4) else "xla")
         elif kernels == "pallas" and jax.default_backend() != "tpu":
             kernels = "pallas-interpret"
-        if kernels not in ("xla", "pallas", "pallas-interpret"):
+        if kernels not in ("xla", "xla-roll", "pallas", "pallas-interpret"):
             raise ValueError(f"unknown kernels choice {kernels!r}")
         self.kernels = kernels
         self.stats = SolverStats(unknowns=A.nrows)
@@ -321,14 +371,19 @@ class JaxCGSolver:
         dtype = (self.A.dtype if hasattr(self.A, "dtype")
                  else self.A.data.dtype if hasattr(self.A, "data")
                  else self.A.vals.dtype)
+        if self.vector_dtype is not None:
+            dtype = jnp.dtype(self.vector_dtype)
         b = jnp.asarray(b, dtype=dtype)
         x0 = jnp.zeros_like(b) if x0 is None else jnp.asarray(x0, dtype=dtype)
         program = _cg_pipelined_program if self.pipelined else _cg_program
+        # tolerances ride in the scalar dtype (f32 for bf16 storage) so a
+        # 1e-9 rtol is not pre-rounded to 8 mantissa bits
+        sdt = acc_dtype(dtype)
         args = (self.A, b, x0,
-                jnp.asarray(crit.residual_atol, dtype),
-                jnp.asarray(crit.residual_rtol, dtype),
-                jnp.asarray(crit.diff_atol, dtype),
-                jnp.asarray(crit.diff_rtol, dtype),
+                jnp.asarray(crit.residual_atol, sdt),
+                jnp.asarray(crit.residual_rtol, sdt),
+                jnp.asarray(crit.diff_atol, sdt),
+                jnp.asarray(crit.diff_rtol, sdt),
                 jnp.int32(crit.maxits))
         kwargs = dict(unbounded=crit.unbounded, needs_diff=crit.needs_diff,
                       precise=self.precise_dots, kernels=self.kernels)
@@ -356,8 +411,17 @@ class JaxCGSolver:
                                         self.pipelined)
         st.nflops += per_it * niter + self._spmv_flops + 2.0 * n
         dbl = np.dtype(dtype).itemsize
+        # matrix bytes in the MATRIX storage dtype (they differ from the
+        # vector dtype under --dtype mixed) + per-format index bytes
+        # (DIA reads no indices; ELL 4 B; COO row+col 8 B)
+        mat_dbl = np.dtype(self.A.dtype if isinstance(self.A, DiaMatrix)
+                           else self.A.data.dtype if hasattr(self.A, "data")
+                           else self.A.vals.dtype).itemsize
+        idx_b = (0 if isinstance(self.A, DiaMatrix)
+                 else 8 if hasattr(self.A, "vals") else 4)
         st.ops["gemv"].add(niter + 1, 0.0,
-                           int((self._spmv_flops / 3.0) * (dbl + 4) + 2 * n * dbl) * (niter + 1))
+                           int((self._spmv_flops / 3.0) * (mat_dbl + idx_b)
+                               + 2 * n * dbl) * (niter + 1))
         st.ops["dot"].add(2 * niter, 0.0, 2 * n * dbl * 2 * niter)
         st.ops["axpy"].add(3 * niter, 0.0, 3 * n * dbl * 3 * niter)
         if host_result:

@@ -161,7 +161,7 @@ def _profile_dist(solver, b, reps: int) -> dict[str, float]:
     spmv_shard = make_dist_spmv(prob, solver.comm, solver._interpret,
                                 kernels=solver.kernels)
 
-    tiny = jnp.asarray(1e-30, prob.dtype)
+    tiny = jnp.asarray(1e-30, prob.vdtype)
 
     def smap(body, in_specs):
         return jax.shard_map(body, mesh=mesh, in_specs=in_specs,
@@ -232,10 +232,10 @@ def _profile_dist(solver, b, reps: int) -> dict[str, float]:
 
     from acg_tpu.parallel.multihost import put_global
 
-    pair = put_global(np.zeros((prob.nparts, 2), dtype=prob.dtype),
+    pair = put_global(np.zeros((prob.nparts, 2), dtype=prob.vdtype),
                       jax.sharding.NamedSharding(mesh, pspec))
     out["allreduce"] = _time_op(allreduce_once, pair, reps=reps)
 
     out["axpy"] = _time_op(lambda y, a, p: y + a * p, bd,
-                           jnp.asarray(0.5, prob.dtype), bd, reps=reps)
+                           jnp.asarray(0.5, prob.vdtype), bd, reps=reps)
     return out

@@ -6,8 +6,16 @@ iterations/second ("total solver time" for a fixed iteration count).
 Runs on whatever accelerator JAX exposes (one TPU chip under the driver).
 
 Default mode prints ONE JSON line for the flagship config (2D Poisson
-n=2048, N=4,194,304, classic CG, f32):
-  {"metric": ..., "value": N, "unit": "iters/s", "vs_baseline": N}
+n=2048, N=4,194,304, classic CG), the best of {f32, bf16} x {pallas,
+xla} measured in the same contention window:
+  {"metric": ..., "value": N, "unit": "iters/s", "vs_baseline": N,
+   "dtype": ..., "kernels": ..., "bw_gbs": N, "roofline_frac": N}
+``bw_gbs`` is a ~1 s triad bandwidth probe (quiet v5e: ~800 GB/s) and
+``roofline_frac`` the fraction of that bandwidth the solve achieved --
+together they distinguish a contended capture from a regression.  A
+bf16 winner also reports its measured accuracy cost
+(``rel_residual_1000it``; recovery via --refine is documented in
+BASELINE.md).
 
 ``--full`` runs the BASELINE ladder (classic + pipelined x 2D n=2048 /
 3D 128^3 / 3D 256^3, plus the distributed program at nparts=1 to bound
@@ -60,6 +68,81 @@ def _ref_bytes_per_iter(csr) -> float:
     (f64 values, int32 indices -- same accounting as its GB/s printout,
     ``cgcuda.c:1942-1957``)."""
     return csr.nnz * 12.0 + 80.0 * csr.shape[0]
+
+
+def _our_bytes_per_iter(nnz: int, n: int, fmt: str, mat_itemsize: int,
+                        vec_itemsize: int, pipelined: bool) -> float:
+    """OUR analytic HBM traffic per CG iteration: matrix reads in the
+    matrix storage dtype (+index bytes for gather formats) plus the
+    vector passes of the loop (15 classic / 21 pipelined, the pass count
+    implied by the measured 335 MB/iter f32 flagship -- BASELINE.md) in
+    the vector storage dtype (they differ under --dtype mixed)."""
+    idx = {"dia": 0, "ell": 4, "coo": 8}.get(fmt, 4)
+    passes = 21 if pipelined else 15
+    return nnz * (mat_itemsize + idx) + passes * n * vec_itemsize
+
+
+# storage tiers: (matrix dtype, vector dtype) by bench dtype name;
+# "mixed" = bf16 matrix + f32 vectors (lossless for Poisson stencil
+# values -> arithmetic-identical to f32 at half the matrix traffic);
+# "bf16" = half traffic everywhere but kappa-limited (~500) vector
+# storage -- diverges at flagship conditioning, measured and reported
+def _dtypes_of(dtype_name: str):
+    import jax.numpy as jnp
+
+    return {"f32": (jnp.float32, jnp.float32),
+            "mixed": (jnp.bfloat16, jnp.float32),
+            "bf16": (jnp.bfloat16, jnp.bfloat16)}[dtype_name]
+
+
+_probe_cache: float | None = None
+
+
+def bandwidth_probe_gbs(refresh: bool = False) -> float:
+    """~1 s saxpy-triad HBM bandwidth probe on the current device.
+
+    Reported as ``bw_gbs`` in every JSON row so a contended capture is
+    distinguishable from a regression (VERDICT round 2): the v5e quiet-
+    window figure is ~800 GB/s; a probe far below that marks the whole
+    window as contended.  Uses the two-point chained-program estimator
+    (solvers/profile.py rationale) so the ~100 ms tunnel dispatch
+    latency cancels.
+    """
+    global _probe_cache
+    if _probe_cache is not None and not refresh:
+        return _probe_cache
+    import functools
+
+    import jax
+    import jax.numpy as jnp
+
+    n = 1 << 26  # 256 MB per f32 vector
+    c = jnp.full((n,), 0.5, jnp.float32)
+    a = jnp.ones((n,), jnp.float32)
+
+    @functools.partial(jax.jit, static_argnames="k")
+    def chain(a, c, k):
+        # a = c + s*a: 2 reads + 1 write per step, data-dependent chain
+        return jax.lax.fori_loop(
+            0, k, lambda _, v: c + jnp.float32(1.0000001) * v, a)
+
+    def best(k, reps=3):
+        chain(a, c, k).block_until_ready()
+        ts = []
+        for _ in range(reps):
+            t0 = time.perf_counter()
+            chain(a, c, k).block_until_ready()
+            ts.append(time.perf_counter() - t0)
+        return min(ts)
+
+    for _ in range(3):
+        dt = best(12) - best(4)
+        if dt > 0:
+            _probe_cache = 3.0 * n * 4.0 * 8 / dt / 1e9
+            return _probe_cache
+        # contention burst inverted the two-point estimate; retry
+    raise RuntimeError("bandwidth probe unstable (two-point estimate "
+                       "non-positive after 3 attempts)")
 
 
 def _h100_standin(ref_bytes_per_iter: float) -> float:
@@ -116,27 +199,46 @@ def _time_solver(solver, b, criteria_cls, repeats: int = TIMED_REPEATS,
     return min(times), maxits
 
 
+def _roofline_context(row: dict, bytes_per_iter: float) -> dict:
+    """Attach ``bw_gbs`` (probe) and ``roofline_frac`` (achieved traffic
+    over probe bandwidth) so a contended capture reads as such."""
+    try:
+        bw = bandwidth_probe_gbs()
+    except Exception as e:  # noqa: BLE001 -- the probe must not sink rows
+        print(f"# bandwidth probe failed: {e}", file=sys.stderr)
+        return row
+    row["bw_gbs"] = round(bw, 1)
+    row["roofline_frac"] = round(
+        row["value"] * bytes_per_iter / (bw * 1e9), 3)
+    return row
+
+
 def run_case(csr, name: str, pipelined: bool, dist: bool = False,
-             kernels: str = "xla") -> dict:
+             kernels: str = "xla", dtype_name: str = "f32") -> dict:
     import jax.numpy as jnp
     import numpy as np
 
     from acg_tpu.solvers.stats import StoppingCriteria
 
+    mat_dtype, vec_dtype = _dtypes_of(dtype_name)
     b = np.ones(csr.shape[0], dtype=np.float32)
     if dist:
         from acg_tpu.parallel.dist import DistCGSolver, DistributedProblem
         from acg_tpu.partition import partition_rows
 
         part = partition_rows(csr, 1, seed=0)
-        prob = DistributedProblem.build(csr, part, 1, dtype=jnp.float32)
+        prob = DistributedProblem.build(csr, part, 1, dtype=mat_dtype,
+                                        vector_dtype=vec_dtype)
         solver = DistCGSolver(prob, pipelined=pipelined)
+        fmt = prob.local.format
     else:
         from acg_tpu.ops.spmv import device_matrix_from_csr
         from acg_tpu.solvers.jax_cg import JaxCGSolver
 
-        A = device_matrix_from_csr(csr, dtype=jnp.float32)
-        solver = JaxCGSolver(A, pipelined=pipelined, kernels=kernels)
+        A = device_matrix_from_csr(csr, dtype=mat_dtype)
+        solver = JaxCGSolver(A, pipelined=pipelined, kernels=kernels,
+                             vector_dtype=vec_dtype)
+        fmt = type(A).__name__.replace("Matrix", "").lower()
     tsolve, maxits = _time_solver(solver, b, StoppingCriteria)
     iters_per_sec = maxits / tsolve
     standin = _h100_standin(_ref_bytes_per_iter(csr))
@@ -148,12 +250,15 @@ def run_case(csr, name: str, pipelined: bool, dist: bool = False,
         "value": round(iters_per_sec, 2),
         "unit": "iters/s",
         "vs_baseline": round(iters_per_sec / standin, 4),
+        "dtype": dtype_name,
     }
     if hasattr(solver, "kernels"):
         # record the *resolved* tier so an off-TPU run of the pallas-named
         # case cannot masquerade as a Pallas measurement
         row["kernels"] = solver.kernels
-    return row
+    return _roofline_context(row, _our_bytes_per_iter(
+        csr.nnz, csr.shape[0], fmt, np.dtype(mat_dtype).itemsize,
+        np.dtype(vec_dtype).itemsize, pipelined))
 
 
 def _enable_compile_cache():
@@ -162,12 +267,39 @@ def _enable_compile_cache():
     enable_compile_cache()
 
 
-def run_case_dia(side: int, dim: int, name: str) -> dict:
+def _accuracy_context(csr, row: dict) -> dict:
+    """Measure the bf16 tier's accuracy cost next to its speed: the TRUE
+    f64 relative residual after the protocol's fixed iteration count
+    (bf16 CG stalls at its storage noise floor ~1e-2; ``--refine``
+    recovers below 1e-5 -- tests/test_bf16.py, BASELINE.md)."""
+    import jax.numpy as jnp
+    import numpy as np
+
+    from acg_tpu.ops.spmv import device_matrix_from_csr
+    from acg_tpu.solvers.jax_cg import JaxCGSolver
+    from acg_tpu.solvers.stats import StoppingCriteria
+
+    try:
+        A = device_matrix_from_csr(csr, dtype=jnp.bfloat16)
+        b = np.ones(csr.shape[0], dtype=np.float32)
+        s = JaxCGSolver(A, kernels="xla")
+        x = np.asarray(s.solve(b, criteria=StoppingCriteria(maxits=MAXITS),
+                               raise_on_divergence=False), dtype=np.float64)
+        rel = float(np.linalg.norm(b - csr @ x) / np.linalg.norm(b))
+        row["rel_residual_1000it"] = float(f"{rel:.3g}")
+    except Exception as e:  # noqa: BLE001 -- context must not sink the row
+        print(f"# accuracy context failed: {e}", file=sys.stderr)
+    return row
+
+
+def run_case_dia(side: int, dim: int, name: str,
+                 dtype_name: str = "f32") -> dict:
     """Stencil configs assembled DIRECTLY as DIA planes (no COO/CSR/sort
     preprocessing) -- the only practical route to the north-star 512^3
     problem (N=134M, ~0.9G nnz) on one chip: ~4 GB of f32 planes built
     in seconds instead of tens of GB of COO intermediates."""
     import jax.numpy as jnp
+    import numpy as np
 
     _enable_compile_cache()
 
@@ -176,17 +308,18 @@ def run_case_dia(side: int, dim: int, name: str) -> dict:
     from acg_tpu.solvers.jax_cg import JaxCGSolver
     from acg_tpu.solvers.stats import StoppingCriteria
 
-    planes, offsets, N = poisson_dia_device(side, dim, dtype=jnp.float32)
+    mat_dtype, vec_dtype = _dtypes_of(dtype_name)
+    planes, offsets, N = poisson_dia_device(side, dim, dtype=mat_dtype)
     A = DiaMatrix(data=tuple(planes), offsets=offsets,
                   nrows=N, ncols_padded=N)
     n_axis = N // side
     nnz = N + 2 * dim * (N - n_axis)  # full-storage stencil nonzeros
-    solver = JaxCGSolver(A, kernels="auto")
+    solver = JaxCGSolver(A, kernels="auto", vector_dtype=vec_dtype)
     # b lives on device from birth, and results stay device-resident
     # (host_result=False): at this size every 537 MB host<->device copy
     # costs minutes over a tunneled chip and none of them are part of
     # the measured solve; 2 repeats keep the row inside a bench budget
-    b = jnp.ones(N, dtype=jnp.float32)
+    b = jnp.ones(N, dtype=vec_dtype)
     tsolve, maxits = _time_solver(solver, b, StoppingCriteria, repeats=2,
                                   host_result=False)
     iters_per_sec = maxits / tsolve
@@ -199,12 +332,15 @@ def run_case_dia(side: int, dim: int, name: str) -> dict:
     if kernels.startswith("pallas"):
         from acg_tpu.ops.pallas_kernels import dia_spmv_route
 
-        if dia_spmv_route(offsets, N, jnp.float32)[0] == "xla":
+        if dia_spmv_route(offsets, N, vec_dtype)[0] == "xla":
             kernels = "xla"
-    return {"metric": name, "value": round(iters_per_sec, 2),
-            "unit": "iters/s",
-            "vs_baseline": round(iters_per_sec / standin, 4),
-            "kernels": kernels}
+    row = {"metric": name, "value": round(iters_per_sec, 2),
+           "unit": "iters/s",
+           "vs_baseline": round(iters_per_sec / standin, 4),
+           "dtype": dtype_name, "kernels": kernels}
+    return _roofline_context(row, _our_bytes_per_iter(
+        nnz, N, "dia", np.dtype(mat_dtype).itemsize,
+        np.dtype(vec_dtype).itemsize, False))
 
 
 def sweep_np(out=sys.stdout) -> int:
@@ -271,40 +407,78 @@ def main(argv=None) -> int:
     _enable_compile_cache()
 
     if not args.full:
-        # flagship: measure BOTH kernel tiers in the same contention
-        # window and report the better one (uncontended A/B favours
-        # Pallas by ~1.03-1.33x, but contention swings dwarf that --
-        # BASELINE.md round-2 caveat -- so the tier choice must not be
-        # a blind bet).  The winning tier lands in the JSON row.
-        csr = _build(2048, 2)
+        # flagship: measure the kernel tiers AND the storage tiers in
+        # the same contention window and report the best SOUND config
+        # (uncontended A/B favours Pallas by ~1.03-1.33x and the
+        # half-traffic tiers by ~1.5-2x, while contention swings dwarf
+        # both, so no choice can be a blind bet).  "mixed" (bf16 matrix
+        # + f32 vectors) is arithmetic-identical to f32 here, so both
+        # are always sound; all-bf16 vector storage is kappa-limited
+        # (~500) and DIVERGES at the flagship's kappa ~ 1.7e6, so its
+        # throughput + measured accuracy ride along as context keys
+        # instead of competing for the headline.
+        # one stable metric name across rounds/runs; the winning tier is
+        # recorded in the "dtype"/"kernels" fields (a name that changed
+        # with the winner would split the longitudinal series)
         name = "cg_iters_per_sec_poisson2d_n2048_f32"
-        best = run_case(csr, name, False, False, "auto")
-        if best.get("kernels") != "xla":
-            alt = run_case(csr, name, False, False, "xla")
-            if alt["value"] > best["value"]:
-                best = alt
+        csr = _build(2048, 2)
+        rows = {}
+        for dtn in ("f32", "mixed", "bf16"):
+            # a tier that fails (compile flake, OOM) must not sink the
+            # tiers already measured
+            try:
+                best = run_case(csr, name, False, False, "auto", dtn)
+                if best.get("kernels") != "xla":
+                    alt = run_case(csr, name, False, False, "xla", dtn)
+                    if alt["value"] > best["value"]:
+                        best = alt
+                rows[dtn] = best
+            except Exception as e:  # noqa: BLE001 -- report and continue
+                print(f"# {dtn} tier skipped: {type(e).__name__}: "
+                      f"{str(e).splitlines()[0][:200]}", file=sys.stderr)
+        if not rows:
+            return 1
+        sound = [rows[k] for k in ("f32", "mixed") if k in rows]
+        bf = rows.get("bf16")
+        if bf is not None:
+            bf = _accuracy_context(csr, bf)
+            if bf.get("rel_residual_1000it", float("inf")) < 0.5:
+                sound.append(bf)  # made real progress: sound at this kappa
+        best = max(sound or rows.values(), key=lambda r: r["value"])
+        if bf is not None and best is not bf:
+            best["bf16_iters_per_sec"] = bf["value"]
+            if "rel_residual_1000it" in bf:
+                best["bf16_rel_residual_1000it"] = bf["rel_residual_1000it"]
         print(json.dumps(best))
         return 0
 
     cases = [
             ("cg_iters_per_sec_poisson2d_n2048_f32",
-             2048, 2, False, False, "auto"),
+             2048, 2, False, False, "auto", "f32"),
             ("cg_xla_iters_per_sec_poisson2d_n2048_f32",
-             2048, 2, False, False, "xla"),
+             2048, 2, False, False, "xla", "f32"),
+            ("cg_iters_per_sec_poisson2d_n2048_mixed",
+             2048, 2, False, False, "auto", "mixed"),
+            ("cg_iters_per_sec_poisson2d_n2048_bf16",
+             2048, 2, False, False, "auto", "bf16"),
             ("cg_pipelined_iters_per_sec_poisson2d_n2048_f32",
-             2048, 2, True, False, "xla"),
-            ("cg_iters_per_sec_poisson3d_n128_f32", 128, 3, False, False, "xla"),
+             2048, 2, True, False, "xla", "f32"),
+            ("cg_iters_per_sec_poisson3d_n128_f32",
+             128, 3, False, False, "xla", "f32"),
             ("cg_pipelined_iters_per_sec_poisson3d_n128_f32",
-             128, 3, True, False, "xla"),
-            ("cg_iters_per_sec_poisson3d_n256_f32", 256, 3, False, False, "xla"),
+             128, 3, True, False, "xla", "f32"),
+            ("cg_iters_per_sec_poisson3d_n256_f32",
+             256, 3, False, False, "xla", "f32"),
+            ("cg_iters_per_sec_poisson3d_n256_mixed",
+             256, 3, False, False, "xla", "mixed"),
             ("cg_dist1_iters_per_sec_poisson2d_n2048_f32",
-             2048, 2, False, True, "xla"),
+             2048, 2, False, True, "xla", "f32"),
             ("cg_iters_per_sec_irregular_n500k_d16_f32",
-             500_000, 0, False, False, "xla"),
+             500_000, 0, False, False, "xla", "f32"),
         ]
 
     built: dict[tuple, object] = {}
-    for name, side, dim, pipelined, dist, kernels in cases:
+    for name, side, dim, pipelined, dist, kernels, dtn in cases:
         # one failing case (device flake, OOM) must not sink the rest of
         # the ladder -- report it and keep going
         try:
@@ -317,7 +491,7 @@ def main(argv=None) -> int:
                       f"nnz={csr.nnz} in {time.perf_counter() - t0:.1f}s on "
                       f"{jax.devices()[0].platform}", file=sys.stderr)
             print(json.dumps(run_case(built[key], name, pipelined, dist,
-                                      kernels)))
+                                      kernels, dtn)))
         except Exception as e:  # noqa: BLE001 -- report and continue
             print(f"# {name} skipped: {type(e).__name__}: "
                   f"{str(e).splitlines()[0][:200]}", file=sys.stderr)
@@ -326,12 +500,14 @@ def main(argv=None) -> int:
     # the north-star problem size, single chip, direct-DIA assembly;
     # skipped gracefully where the device memory cannot hold it
     built.clear()
-    try:
-        print(json.dumps(run_case_dia(
-            512, 3, "cg_iters_per_sec_poisson3d_n512_f32_dia")))
-    except Exception as e:  # noqa: BLE001 -- report and continue
-        print(f"# 512^3 row skipped: {type(e).__name__}: "
-              f"{str(e).splitlines()[0][:200]}", file=sys.stderr)
+    for dtn in ("f32", "mixed"):
+        try:
+            print(json.dumps(run_case_dia(
+                512, 3, f"cg_iters_per_sec_poisson3d_n512_{dtn}_dia", dtn)))
+        except Exception as e:  # noqa: BLE001 -- report and continue
+            print(f"# 512^3 {dtn} row skipped: {type(e).__name__}: "
+                  f"{str(e).splitlines()[0][:200]}", file=sys.stderr)
+        sys.stdout.flush()
     return 0
 
 

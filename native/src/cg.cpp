@@ -91,8 +91,13 @@ int32_t acg_cg_solve(int64_t n, const int64_t *rowptr, const int64_t *colidx,
         spmv(n, rowptr, colidx, a, p.data(), t.data());
         double pdott = dot(n, p.data(), t.data());
         /* (p, Ap) == 0 for p != 0 means A is not positive definite; the
-         * reference aborts here (cg.c:304) rather than dividing */
-        if (pdott == 0.0) { indefinite = true; break; }
+         * reference aborts here (cg.c:304) rather than dividing.  With
+         * gamma == 0 it instead means r = p = 0: exact convergence
+         * (reachable in fixed-iteration mode), not indefiniteness. */
+        if (pdott == 0.0) {
+            if (gamma != 0.0) indefinite = true;
+            break;
+        }
         double alpha = gamma / pdott;
 #ifdef _OPENMP
 #pragma omp parallel for schedule(static)

@@ -222,3 +222,26 @@ def test_indefinite_matrix_abort():
     for s in solvers:
         with pytest.raises(IndefiniteMatrixError):
             s.solve(b, criteria=crit)
+
+
+def test_exact_convergence_is_not_indefinite():
+    """Fixed-iteration CG past exact convergence reaches r = p = 0, where
+    (p, Ap) == 0 means "done", not "indefinite": both host oracles must
+    return the exact solution instead of raising (SPD identity matrix,
+    maxits far beyond the 1 iteration needed)."""
+    import scipy.sparse as sp
+
+    from acg_tpu.solvers.host_cg import HostCGSolver, NativeHostCGSolver
+    from acg_tpu.solvers.stats import StoppingCriteria
+    from acg_tpu import _native
+
+    n = 16
+    I = sp.identity(n, format="csr")
+    b = np.ones(n)
+    crit = StoppingCriteria(maxits=10)  # unbounded fixed-iteration mode
+    solvers = [HostCGSolver(I)]
+    if _native.available():
+        solvers.append(NativeHostCGSolver(I))
+    for s in solvers:
+        x = s.solve(b, criteria=crit)
+        np.testing.assert_allclose(x, b, rtol=1e-14)
