@@ -300,13 +300,10 @@ def _solve_generated_direct(args, dim, n, N, jax, jnp, dtype,
     unsupported = [flag for flag, on in [
         (f"--solver {args.solver}",
          args.solver in ("host", "host-native", "petsc")),
-        ("--manufactured-solution", args.manufactured_solution),
         ("b/x0 input files", bool(args.b or args.x0)),
         ("--refine", args.refine),
-        (f"--nparts {args.nparts}", args.nparts > 1),
         ("--output-comm-matrix", args.output_comm_matrix),
         ("--profile-ops", args.profile_ops is not None),
-        ("--multihost", args.multihost or args.coordinator is not None),
         (f"--spmv-format {args.spmv_format}",
          args.spmv_format not in ("auto", "dia")),
     ] if on]
@@ -318,6 +315,18 @@ def _solve_generated_direct(args, dim, n, N, jax, jnp, dtype,
             f"gen: spec)")
 
     vec_dtype = dtype if vec_dtype is None else vec_dtype
+
+    # multi-part / multi-controller / manufactured configurations run the
+    # SHARDED assembly + solve (parallel/sharded_dia): per-shard on-device
+    # planes, halo exchange derived by the SPMD partitioner.  This makes
+    # the north-star configuration -- gen:poisson3d:512 --multihost
+    # --nparts N -- expressible end-to-end with O(N/P) device memory per
+    # chip and O(1) host memory per controller.
+    if (args.nparts > 1 or args.multihost or args.coordinator is not None
+            or args.manufactured_solution):
+        return _solve_generated_sharded(args, dim, n, N, jax, jnp, dtype,
+                                        vec_dtype)
+
     t0 = time.perf_counter()
     planes, offsets, _ = poisson_dia_device(n, dim, dtype=dtype)
     if args.epsilon:
@@ -355,6 +364,86 @@ def _solve_generated_direct(args, dim, n, N, jax, jnp, dtype,
     if not args.quiet:
         write_mtx(sys.stdout.buffer, vector_mtx(np.asarray(x)),
                   numfmt=args.numfmt)
+    return 0
+
+
+def _solve_generated_sharded(args, dim, n, N, jax, jnp, dtype,
+                             vec_dtype) -> int:
+    """Sharded gen-direct path: assembly and solve over the device mesh
+    (``parallel/sharded_dia``).  Runs identically single-controller and
+    under ``--multihost`` -- every array is born sharded, so controllers
+    never hold host copies (the role of the reference's root-read +
+    subgraph scatter, ``graph.c:1529-1897``, with the scatter deleted
+    rather than ported)."""
+    import numpy as np
+
+    from acg_tpu.errors import NotConvergedError
+    from acg_tpu.io.mtxfile import vector_mtx, write_mtx
+    from acg_tpu.parallel.multihost import get_global, is_primary
+    from acg_tpu.parallel.sharded_dia import build_sharded_poisson_solver
+    from acg_tpu.solvers import StoppingCriteria
+
+    if args.kernels.startswith("pallas"):
+        raise SystemExit(
+            "acg-tpu: the sharded direct-assembly path pins the SpMV to "
+            "the partitioner-friendly roll formulation; --kernels pallas "
+            "is not available here (use --nparts 1 without "
+            "--manufactured-solution for the Pallas tier)")
+
+    nparts = args.nparts or len(jax.devices())
+    t0 = time.perf_counter()
+    solver = build_sharded_poisson_solver(
+        n, dim, nparts=nparts, dtype=dtype, vector_dtype=vec_dtype,
+        pipelined="pipelined" in args.solver,
+        precise_dots=args.precise_dots, epsilon=args.epsilon)
+    _log(args, f"assemble sharded DIA planes on device ({nparts} parts):",
+         t0)
+
+    xsol = None
+    if args.manufactured_solution:
+        t0 = time.perf_counter()
+        xsol, b = solver.manufactured(seed=args.seed)
+        _log(args, "manufactured solution (on device):", t0)
+    else:
+        b = solver.ones_b()
+
+    criteria = StoppingCriteria(
+        maxits=args.max_iterations,
+        residual_atol=args.residual_atol, residual_rtol=args.residual_rtol,
+        diff_atol=args.diff_atol, diff_rtol=args.diff_rtol)
+    t0 = time.perf_counter()
+    if args.trace:
+        jax.profiler.start_trace(args.trace)
+    try:
+        # device-resident result: the gather to host happens only when
+        # the solution is actually written
+        x = solver.solve(b, criteria=criteria, warmup=args.warmup,
+                         host_result=False)
+    except NotConvergedError as e:
+        sys.stderr.write(f"acg-tpu: {e}\n")
+        if is_primary():
+            solver.stats.fwrite(sys.stderr)
+        return 1
+    finally:
+        if args.trace:
+            jax.profiler.stop_trace()
+    _log(args, "solve:", t0)
+
+    # cross-process COLLECTIVE steps run on every controller BEFORE the
+    # primary-only output gate: a non-primary process returning early
+    # while the primary still waits in an error-norm reduction or the
+    # solution allgather would deadlock the pod
+    errs = solver.error_norms(x, xsol) if xsol is not None else None
+    x_host = None if args.quiet else np.asarray(get_global(x))
+
+    if not is_primary():
+        return 0
+    solver.stats.fwrite(sys.stderr)
+    if errs is not None:
+        sys.stderr.write(f"initial error 2-norm: {errs[0]:.15g}\n")
+        sys.stderr.write(f"error 2-norm: {errs[1]:.15g}\n")
+    if x_host is not None:
+        write_mtx(sys.stdout.buffer, vector_mtx(x_host), numfmt=args.numfmt)
     return 0
 
 
@@ -569,15 +658,28 @@ def _main(args) -> int:
                                        inner_rtol=args.refine_rtol)
             x = solver.solve(b, x0=x0, criteria=criteria, warmup=args.warmup)
         else:
-            subs = partition_matrix(csr, part, nparts)
+            from acg_tpu.parallel.mesh import solve_mesh
+            mesh = solve_mesh(nparts)
+            # multi-controller: each process assembles matrix blocks and
+            # host arrays ONLY for the parts its mesh devices own --
+            # per-controller preprocessing memory is O(N/P), the role of
+            # the reference's root-read + subgraph scatter
+            # (graph.c:1529-1897) without the scatter
+            owned = None
+            if jax.process_count() > 1:
+                pi = jax.process_index()
+                owned = tuple(p for p in range(nparts)
+                              if mesh.devices.flat[p].process_index == pi)
+            subs = partition_matrix(csr, part, nparts, owned_parts=owned)
             if args.output_comm_matrix:
                 comm_mtx_out = comm_matrix(subs, nparts)
             prob = DistributedProblem.build(csr, part, nparts, dtype=dtype,
                                             subs=subs,
-                                            vector_dtype=vec_dtype)
+                                            vector_dtype=vec_dtype,
+                                            owned_parts=owned)
             solver = DistCGSolver(prob, pipelined=pipelined, comm=comm,
                                   precise_dots=args.precise_dots,
-                                  kernels=args.kernels)
+                                  kernels=args.kernels, mesh=mesh)
             if args.refine:
                 solver = RefinedSolver(solver, csr,
                                        inner_rtol=args.refine_rtol)

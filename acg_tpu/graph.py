@@ -250,7 +250,8 @@ def _partition_graph_nodes_numpy(full_csr, part, nparts) -> list[Subdomain]:
 
 
 def partition_matrix(full_csr: sp.csr_matrix, part: np.ndarray,
-                     nparts: int) -> list[Subdomain]:
+                     nparts: int,
+                     owned_parts=None) -> list[Subdomain]:
     """Build subdomains including local/off-diagonal matrix blocks.
 
     The ``f*``/``o*`` full-storage split of ``acgsymcsrmatrix_dsymv_init``
@@ -258,13 +259,25 @@ def partition_matrix(full_csr: sp.csr_matrix, part: np.ndarray,
     and an owned x ghost CSR block, both in local indices, so the
     distributed SpMV is ``y = A_local x_owned + A_ghost x_ghost`` with the
     ghost gather supplied by the halo exchange.
+
+    ``owned_parts`` (multi-controller): build matrix blocks only for the
+    listed parts; the others keep ``A_local is None``.  The subdomain
+    *structure* (node sets, halo plans) is still built for every part --
+    it is O(nnz) total and every controller needs the global plan -- but
+    the per-part block assembly and its memory are restricted to the
+    parts this controller's devices own (the role of the reference's
+    root-rank-assembles + scatter, ``graph.c:1529-1897``, with
+    "every controller is the root of its own parts").
     """
     subs = partition_graph_nodes(full_csr, part, nparts)
     n = full_csr.shape[0]
     coo = full_csr.tocoo()
     part = np.asarray(part)
     rp = part[coo.row]
+    owned_set = None if owned_parts is None else set(int(p) for p in owned_parts)
     for s in subs:
+        if owned_set is not None and s.part not in owned_set:
+            continue
         g2l = np.full(n, -1, dtype=IDX_DTYPE)
         g2l[s.global_ids] = np.arange(s.global_ids.size, dtype=IDX_DTYPE)
         mine = rp == s.part

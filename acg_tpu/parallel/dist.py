@@ -118,46 +118,90 @@ def _stack_local_blocks(subs, nmax_owned: int, dtype,
                         max_diags: int = 80,  # headroom over spmv.MAX_DIAGS:
                         # the union of per-part offset sets can exceed any
                         # single part's diagonal count
-                        dia_waste_limit: float = 3.0) -> StackedLocalBlock:
+                        dia_waste_limit: float = 3.0,
+                        global_csr=None) -> StackedLocalBlock:
+    """Stacked arrays are HOST numpy (calloc-backed zeros, filled only
+    for parts whose blocks exist): non-owned parts of a multi-controller
+    build never touch their pages, so host RSS is O(owned/P); the device
+    placement happens later through ``put_global``'s per-shard slicing
+    (``DistCGSolver.device_args``).
+
+    With restricted builds (some ``A_local is None``) the mesh-uniform
+    format decision and shape bounds come from ``global_csr`` -- every
+    controller must pick identical offsets/K."""
     blocks = [s.A_local for s in subs]
+    built = [b for b in blocks if b is not None]
     npdtype = np.dtype(dtype)
-    offs = np.unique(np.concatenate(
-        [csr_diag_offsets(b) for b in blocks] or [np.zeros(1, np.int64)]))
-    nnz = sum(int(b.nnz) for b in blocks)
+    if global_csr is not None:
+        # restricted build: the local blocks of OTHER controllers are
+        # invisible, so the mesh-uniform offset set must be derivable
+        # from global structure alone.  That is only sound when every
+        # part's owned rows form a contiguous natural-order range (band
+        # partitions): then local diagonals are a subset of the global
+        # ones.  Scattered (graph/metis) partitions have local-index
+        # diagonals unrelated to the global set -> ELL path.
+        contiguous = all(
+            s.owned_order == "natural" and (s.nowned == 0 or (
+                int(s.global_ids[s.nowned - 1]) - int(s.global_ids[0]) + 1
+                == s.nowned))
+            for s in subs)
+        offs = (csr_diag_offsets(global_csr) if contiguous
+                else np.zeros(0, np.int64))
+        nnz = int(global_csr.nnz) if contiguous else 0
+        Kl = int(np.diff(global_csr.indptr).max(initial=0))
+    else:
+        offs = np.unique(np.concatenate(
+            [csr_diag_offsets(b) for b in built] or [np.zeros(1, np.int64)]))
+        nnz = sum(int(b.nnz) for b in built)
+        Kl = max((int(np.diff(b.indptr).max(initial=0)) for b in built),
+                 default=0)
     if (nnz and offs.size <= max_diags
             and offs.size * nmax_owned * len(blocks) <= dia_waste_limit * nnz):
-        planes = np.stack([dia_planes_fixed(b, offs, nmax_owned)
-                           for b in blocks], axis=1)  # (D, P, nrows)
-        arrays = tuple(jnp.asarray(planes[d].astype(npdtype))
-                       for d in range(offs.size))
-        return StackedLocalBlock(format="dia", arrays=arrays,
+        planes = np.zeros((offs.size, len(blocks), nmax_owned),
+                          dtype=npdtype)
+        for p, b in enumerate(blocks):
+            if b is not None:
+                planes[:, p, :] = dia_planes_fixed(b, offs, nmax_owned)
+        return StackedLocalBlock(format="dia",
+                                 arrays=tuple(planes[d]
+                                              for d in range(offs.size)),
                                  offsets=tuple(int(o) for o in offs),
                                  nrows=nmax_owned)
-    Kl = max(int(np.diff(b.indptr).max(initial=0)) for b in blocks)
-    ld, lc = [], []
-    for b in blocks:
+    Kl = max(Kl, 1)
+    ld = np.zeros((len(blocks), nmax_owned, Kl), dtype=npdtype)
+    lc = np.zeros((len(blocks), nmax_owned, Kl), dtype=np.int32)
+    for p, b in enumerate(blocks):
+        if b is None:
+            continue
         d, c = ell_planes_from_csr(b.indptr, b.indices, b.data, nmax_owned,
                                    pad_k=Kl)
-        ld.append(d.astype(npdtype))
-        lc.append(c)
-    return StackedLocalBlock(format="ell",
-                             arrays=(jnp.asarray(np.stack(ld)),
-                                     jnp.asarray(np.stack(lc))),
+        ld[p], lc[p] = d.astype(npdtype), c
+    return StackedLocalBlock(format="ell", arrays=(ld, lc),
                              offsets=(), nrows=nmax_owned)
 
 
-def _stack_ghost_blocks(subs, nmax_owned: int, dtype) -> StackedGhostBlock:
+def _stack_ghost_blocks(subs, nmax_owned: int, dtype,
+                        global_csr=None) -> StackedGhostBlock:
+    """Host-numpy ghost blocks (see ``_stack_local_blocks``); with
+    restricted builds the uniform bmax/Kg bounds come from the global
+    structure (border counts are known for every part; the global max
+    row length bounds any ghost row's length)."""
     npdtype = np.dtype(dtype)
-    coupled = [np.flatnonzero(np.diff(s.A_ghost.indptr)) for s in subs]
-    bmax = max((r.size for r in coupled), default=0) or 1
-    Kg = max((int(np.diff(s.A_ghost.indptr).max(initial=0)) for s in subs),
-             default=0) or 1
+    coupled = [None if s.A_ghost is None
+               else np.flatnonzero(np.diff(s.A_ghost.indptr)) for s in subs]
+    if global_csr is not None:
+        bmax = max((s.nborder for s in subs), default=0) or 1
+        Kg = int(np.diff(global_csr.indptr).max(initial=0)) or 1
+    else:
+        bmax = max((r.size for r in coupled if r is not None), default=0) or 1
+        Kg = max((int(np.diff(s.A_ghost.indptr).max(initial=0))
+                  for s in subs if s.A_ghost is not None), default=0) or 1
     P = len(subs)
     rows = np.full((P, bmax), nmax_owned, dtype=np.int32)  # pad = OOB drop
     data = np.zeros((P, bmax, Kg), dtype=npdtype)
     cols = np.zeros((P, bmax, Kg), dtype=np.int32)
     for p, (s, ri) in enumerate(zip(subs, coupled)):
-        if ri.size == 0:
+        if ri is None or ri.size == 0:
             continue
         sub = s.A_ghost[ri]
         d, c = ell_planes_from_csr(sub.indptr, sub.indices, sub.data,
@@ -165,9 +209,8 @@ def _stack_ghost_blocks(subs, nmax_owned: int, dtype) -> StackedGhostBlock:
         rows[p, : ri.size] = ri
         data[p, : ri.size] = d.astype(npdtype)
         cols[p, : ri.size] = c
-    return StackedGhostBlock(rows=jnp.asarray(rows), data=jnp.asarray(data),
-                             cols=jnp.asarray(cols), nrows=nmax_owned,
-                             bmax=bmax)
+    return StackedGhostBlock(rows=rows, data=data, cols=cols,
+                             nrows=nmax_owned, bmax=bmax)
 
 
 @dataclasses.dataclass
@@ -196,35 +239,57 @@ class DistributedProblem:
     def vdtype(self):
         return self.dtype if self.vector_dtype is None else self.vector_dtype
 
+    # parts whose matrix blocks this controller built (None = all);
+    # scatter() only fills these, matching the device shards this
+    # process can address
+    owned_parts: tuple | None = None
+
     @classmethod
     def build(cls, full_csr, part, nparts: int, dtype=jnp.float32,
               subs: list[Subdomain] | None = None,
               reorder: str = "natural",
-              vector_dtype=None) -> "DistributedProblem":
+              vector_dtype=None,
+              owned_parts=None) -> "DistributedProblem":
         """``reorder="natural"`` (default) re-sorts each part's owned rows
         by global id (in place when ``subs`` is passed) so contiguous
         partitions of banded matrices keep gather-free DIA local blocks;
-        ``"ibg"`` preserves the interior|border|ghost layout."""
-        if subs is None or subs[0].A_local is None:
-            subs = partition_matrix(full_csr, part, nparts)
+        ``"ibg"`` preserves the interior|border|ghost layout.
+
+        ``owned_parts`` (multi-controller): assemble matrix blocks and
+        host arrays only for the listed parts -- the rest stay as
+        untouched calloc pages, so per-controller host RSS for the
+        stacked problem is O(N * owned/nparts) instead of O(N).  Shape
+        and format decisions then derive from the GLOBAL matrix so every
+        controller compiles the identical program."""
+        restricted = owned_parts is not None
+        if subs is None or (not restricted and subs[0].A_local is None):
+            subs = partition_matrix(full_csr, part, nparts,
+                                    owned_parts=owned_parts)
         if reorder == "natural":
             reorder_owned_natural(subs)
         nmax_owned = max(s.nowned for s in subs)
         halo = build_device_halo(subs)
-        local = _stack_local_blocks(subs, nmax_owned, dtype)
-        ghost = _stack_ghost_blocks(subs, nmax_owned, dtype)
+        gcsr = full_csr if restricted else None
+        local = _stack_local_blocks(subs, nmax_owned, dtype, global_csr=gcsr)
+        ghost = _stack_ghost_blocks(subs, nmax_owned, dtype, global_csr=gcsr)
         return cls(nparts=nparts, n=full_csr.shape[0], subs=subs,
                    nmax_owned=nmax_owned, halo=halo, local=local,
                    ghost=ghost, nnz_total=int(full_csr.nnz), dtype=dtype,
-                   vector_dtype=vector_dtype)
+                   vector_dtype=vector_dtype,
+                   owned_parts=None if owned_parts is None
+                   else tuple(int(p) for p in owned_parts))
 
     # -- vector scatter/gather to the stacked padded layout ---------------
 
     def scatter(self, x_global: np.ndarray) -> np.ndarray:
-        xs = scatter_vector(self.subs, np.asarray(x_global))
-        out = np.zeros((self.nparts, self.nmax_owned), dtype=np.dtype(self.vdtype))
-        for p, (s, x) in enumerate(zip(self.subs, xs)):
-            out[p, : s.nowned] = x[: s.nowned]
+        out = np.zeros((self.nparts, self.nmax_owned),
+                       dtype=np.dtype(self.vdtype))
+        owned = (range(self.nparts) if self.owned_parts is None
+                 else self.owned_parts)
+        x_global = np.asarray(x_global)
+        for p in owned:
+            s = self.subs[p]
+            out[p, : s.nowned] = x_global[s.global_ids[: s.nowned]]
         return out
 
     def neighbor_counts(self):

@@ -83,9 +83,11 @@ def build_device_halo(subs: list[Subdomain]) -> DeviceHaloPlan:
         for j, q in enumerate(h.recv_parts):
             lo, hi = int(h.recv_ptr[j]), int(h.recv_ptr[j + 1])
             ghost_src[p, lo:hi] = int(q) * max(maxcnt, 1) + np.arange(hi - lo)
-    return DeviceHaloPlan(send_idx=jax.numpy.asarray(send_idx),
-                          ghost_src=jax.numpy.asarray(ghost_src),
-                          ghost_valid=jax.numpy.asarray(ghost_valid),
+    # arrays stay HOST numpy: device placement goes through put_global's
+    # per-shard slicing (multi-controller processes must not materialise
+    # full device copies of other processes' shards)
+    return DeviceHaloPlan(send_idx=send_idx, ghost_src=ghost_src,
+                          ghost_valid=ghost_valid,
                           maxcnt=maxcnt, nmax_ghost=nmax_ghost, nparts=nparts)
 
 

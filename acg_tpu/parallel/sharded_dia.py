@@ -1,0 +1,155 @@
+"""Sharded on-device stencil assembly + solve: the north-star route.
+
+The reference reaches large problems by having the root rank read/build
+the matrix and scatter per-rank subgraphs over MPI
+(``acg/graph.c:1529-1897``, ``acg/mtxfile.h:997-1087``).  For stencil
+matrices on TPU both halves of that design are unnecessary:
+
+* **Assembly** is a jitted computation from iotas placed directly into
+  each device's HBM shard (``jit`` with sharded ``out_shardings``): no
+  host matrix, no scatter, no transfer -- each controller materialises
+  only its local shards, so host memory is O(1) and device memory
+  O(N/P) per chip.  This is the multi-chip extension of
+  :func:`acg_tpu.io.generators.poisson_dia_device`.
+* **The halo exchange is derived, not planned.**  The solve programs run
+  the cyclic-shift SpMV (:func:`acg_tpu.ops.spmv.dia_mv_roll`); XLA's
+  SPMD partitioner compiles each static shift of the sharded vector into
+  boundary ``collective-permute``s over ICI -- exactly the neighbour
+  halo the reference builds by hand (``acg/halo.c``), with zero
+  all-gathers (asserted in tests at the HLO level).  Dot products psum
+  automatically the same way.
+
+Because every input is born sharded, the identical code path runs
+single-chip, multi-chip single-controller, and multi-controller
+(``--multihost``): under a multi-process runtime the same jitted program
+executes over the global mesh and each process only ever touches its
+addressable shards.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from acg_tpu.ops.spmv import DiaMatrix
+from acg_tpu.parallel.mesh import PARTS_AXIS, solve_mesh
+from acg_tpu.solvers.jax_cg import JaxCGSolver
+
+
+def sharded_poisson_dia(n: int, dim: int, mesh: Mesh, dtype=jnp.float32):
+    """Poisson DIA planes assembled on device, sharded over ``mesh``.
+
+    Returns ``(planes, offsets, N)``; each plane is an (N,) array laid
+    out ``PartitionSpec(parts)`` over the mesh.  The computation is pure
+    iota arithmetic, so XLA materialises each shard on its own device
+    with no communication and no host data.
+    """
+    N = n ** dim
+    sh = NamedSharding(mesh, P(PARTS_AXIS))
+
+    @jax.jit
+    def build():
+        planes = []
+        for a in range(dim):
+            stride = n ** a
+            coord = (jax.lax.iota(jnp.int32, N) // stride) % n
+            planes.append(jnp.where(coord > 0, -1.0, 0.0).astype(dtype))
+            planes.append(jnp.where(coord < n - 1, -1.0, 0.0).astype(dtype))
+        planes.append(jnp.full((N,), float(2 * dim), dtype=dtype))
+        return [jax.lax.with_sharding_constraint(p, sh) for p in planes]
+
+    offsets = [s for a in range(dim) for s in (-(n ** a), n ** a)] + [0]
+    order = np.argsort(offsets)
+    planes = build()
+    return ([planes[i] for i in order],
+            tuple(int(offsets[i]) for i in order), N)
+
+
+class ShardedDiaCGSolver(JaxCGSolver):
+    """CG over a mesh-sharded square DIA matrix.
+
+    A thin specialisation of :class:`JaxCGSolver`: the solve programs
+    are unchanged -- input sharding alone turns them into SPMD programs
+    (the role of ``acgsolvercuda_solvempi``'s explicit communicator
+    plumbing, ``cgcuda.c:403-1143``, is played by GSPMD propagation).
+    The SpMV is pinned to the roll formulation, whose shifts partition
+    into neighbour collective-permutes (``kernels="xla-roll"``).
+    """
+
+    def __init__(self, A: DiaMatrix, mesh: Mesh | None = None,
+                 pipelined: bool = False, precise_dots: bool = False,
+                 vector_dtype=None):
+        if A.ncols_padded != A.nrows:
+            raise ValueError("sharded DIA solve needs a square matrix")
+        super().__init__(A, pipelined=pipelined, precise_dots=precise_dots,
+                         kernels="xla-roll", vector_dtype=vector_dtype)
+        self.mesh = mesh if mesh is not None else solve_mesh()
+        self.sharding = NamedSharding(self.mesh, P(PARTS_AXIS))
+
+    def ones_b(self, dtype=None) -> jax.Array:
+        """A sharded all-ones right-hand side (the CLI default b)."""
+        dtype = dtype or self.vector_dtype or self.A.dtype
+        return jax.jit(
+            lambda: jnp.ones(self.A.nrows, dtype=dtype),
+            out_shardings=self.sharding)()
+
+    def manufactured(self, seed: int = 42):
+        """``(xsol, b)`` on device, sharded: random unit-norm solution
+        and ``b = A xsol`` via the same sharded SpMV (the role of the
+        reference's manufactured-solution setup,
+        ``cuda/acg-cuda.c:1969-2140``; the independent-oracle role of
+        its host SpMV is covered at small sizes by the CPU-mesh tests,
+        which check this b against scipy)."""
+        from acg_tpu.ops.spmv import dia_mv_roll
+
+        dtype = self.vector_dtype or self.A.dtype
+        sdt = jnp.promote_types(dtype, jnp.float32)
+        offsets = self.A.offsets
+        nrows = self.A.nrows
+        sharding = self.sharding
+
+        # planes ride as ARGUMENTS: a jit may not close over arrays that
+        # span other controllers' devices (multi-controller rule)
+        @jax.jit
+        def build(key, planes):
+            xsol = jax.random.normal(key, (nrows,), dtype=sdt)
+            xsol = (xsol / jnp.linalg.norm(xsol)).astype(dtype)
+            xsol = jax.lax.with_sharding_constraint(xsol, sharding)
+            b = dia_mv_roll(planes, offsets, xsol)
+            return xsol, b
+
+        return build(jax.random.key(seed), self.A.data)
+
+    def error_norms(self, x, xsol):
+        """``(err0, err)``: initial and final solution error 2-norms
+        (device-side; only scalars reach the host)."""
+        sdt = jnp.promote_types(x.dtype, jnp.float32)
+        err = float(jnp.linalg.norm((x - xsol).astype(sdt)))
+        err0 = float(jnp.linalg.norm(xsol.astype(sdt)))
+        return err0, err
+
+
+def build_sharded_poisson_solver(n: int, dim: int, nparts: int | None = None,
+                                 dtype=jnp.float32, vector_dtype=None,
+                                 pipelined: bool = False,
+                                 precise_dots: bool = False,
+                                 epsilon: float = 0.0):
+    """Assemble a sharded Poisson problem and its solver in one call
+    (the gen-direct CLI path under ``--nparts``/``--multihost``)."""
+    mesh = solve_mesh(nparts)
+    planes, offsets, N = sharded_poisson_dia(n, dim, mesh, dtype=dtype)
+    if epsilon:
+        d = offsets.index(0)
+        sh = NamedSharding(mesh, P(PARTS_AXIS))
+        planes = list(planes)
+        planes[d] = jax.jit(
+            lambda p: p + jnp.asarray(epsilon, p.dtype),
+            out_shardings=sh)(planes[d])
+    A = DiaMatrix(data=tuple(planes), offsets=offsets,
+                  nrows=N, ncols_padded=N)
+    return ShardedDiaCGSolver(A, mesh=mesh, pipelined=pipelined,
+                              precise_dots=precise_dots,
+                              vector_dtype=vector_dtype)

@@ -388,7 +388,31 @@ def sweep_np(out=sys.stdout) -> int:
     flat = max(iters) - min(iters) <= max(2, int(0.02 * max(iters)))
     print(json.dumps({"metric": "dist_cg_iters_to_rtol1e-6_np_sweep",
                       "rows": rows, "flat": flat}), file=out)
-    return 0 if flat else 1
+
+    # the DIRECT-ASSEMBLY route (sharded on-device planes + derived
+    # halo, parallel/sharded_dia -- the north-star path) swept the same
+    # way: manufactured solution, iterations to rtol must stay flat
+    from acg_tpu.parallel.sharded_dia import build_sharded_poisson_solver
+
+    n3 = 32
+    rows2 = []
+    for nparts in (1, 2, 4, 8):
+        s = build_sharded_poisson_solver(n3, 3, nparts=nparts)
+        xsol, b = s.manufactured(seed=0)
+        x = s.solve(b, criteria=StoppingCriteria(maxits=5000,
+                                                 residual_rtol=1e-6),
+                    host_result=False)
+        err = float(np.linalg.norm(np.asarray(x, np.float64)
+                                   - np.asarray(xsol, np.float64)))
+        rows2.append({"np": nparts, "iterations": s.stats.niterations,
+                      "error_2norm": err})
+        print(f"# direct np={nparts}: {s.stats.niterations} iterations, "
+              f"error {err:.3e}", file=sys.stderr)
+    iters2 = [r["iterations"] for r in rows2]
+    flat2 = max(iters2) - min(iters2) <= max(2, int(0.02 * max(iters2)))
+    print(json.dumps({"metric": "direct_dia_iters_to_rtol1e-6_np_sweep",
+                      "rows": rows2, "flat": flat2}), file=out)
+    return 0 if (flat and flat2) else 1
 
 
 def main(argv=None) -> int:

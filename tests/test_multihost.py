@@ -49,6 +49,119 @@ def _launch(matrix_file, port, process_id, nparts=4, extra=()):
 # nparts=4 uses every global device; nparts=2 exercises the round-robin
 # device selection (one mesh device per controller -- devices[:2] would
 # instead drop process 1 from the mesh entirely)
+def test_restricted_build_owned_parts_only():
+    """owned_parts builds matrix blocks and fills host arrays only for
+    the listed parts -- the per-controller preprocessing restriction
+    (the reference's only-local-data-per-rank property,
+    ``graph.c:1529-1897``).  Non-owned parts keep A_local=None and
+    all-zero (untouched calloc) stacked pages, while the owned shards
+    match the unrestricted build exactly."""
+    import numpy as np
+    import jax.numpy as jnp
+
+    from acg_tpu.io.generators import poisson2d_coo
+    from acg_tpu.matrix import SymCsrMatrix
+    from acg_tpu.parallel.dist import DistributedProblem
+    from acg_tpu.partition import partition_rows
+
+    r, c, v, N = poisson2d_coo(32)
+    csr = SymCsrMatrix.from_coo(N, r, c, v).to_csr()
+    part = partition_rows(csr, 4, seed=0, method="band")
+    full = DistributedProblem.build(csr, part, 4, dtype=jnp.float64)
+    rest = DistributedProblem.build(csr, part, 4, dtype=jnp.float64,
+                                    owned_parts=(0, 1))
+    assert rest.subs[0].A_local is not None
+    assert rest.subs[2].A_local is None and rest.subs[3].A_local is None
+    assert rest.local.format == full.local.format == "dia"
+    assert rest.local.offsets == full.local.offsets
+    for d in range(len(full.local.arrays)):
+        fa, ra = np.asarray(full.local.arrays[d]), rest.local.arrays[d]
+        np.testing.assert_array_equal(ra[:2], fa[:2])   # owned: identical
+        assert not ra[2:].any()                         # non-owned: untouched
+    b = np.ones(N)
+    sf, sr = full.scatter(b), rest.scatter(b)
+    np.testing.assert_array_equal(sr[:2], sf[:2])
+    assert not sr[2:].any()
+
+
+def test_restricted_build_graph_partition_falls_back_to_ell():
+    """A restricted build of a NON-contiguous (graph) partition cannot
+    prove mesh-uniform DIA offsets from global structure (local-index
+    diagonals are unrelated to global ones), so it must take the ELL
+    path -- and still solve correctly (regression: this crashed with
+    'diagonals outside the given offset set')."""
+    import numpy as np
+    import jax.numpy as jnp
+
+    from acg_tpu.io.generators import poisson2d_coo
+    from acg_tpu.matrix import SymCsrMatrix
+    from acg_tpu.parallel.dist import DistCGSolver, DistributedProblem
+    from acg_tpu.partition import partition_rows
+    from acg_tpu.solvers.stats import StoppingCriteria
+
+    r, c, v, N = poisson2d_coo(24)
+    csr = SymCsrMatrix.from_coo(N, r, c, v).to_csr()
+    part = partition_rows(csr, 4, seed=0, method="graph")
+    rest = DistributedProblem.build(csr, part, 4, dtype=jnp.float64,
+                                    owned_parts=(0, 1, 2, 3))
+    assert rest.local.format == "ell"
+    solver = DistCGSolver(rest)
+    b = np.ones(N)
+    x = solver.solve(b, criteria=StoppingCriteria(maxits=2000,
+                                                  residual_rtol=1e-8))
+    assert np.linalg.norm(b - csr @ x) <= 1e-6 * np.linalg.norm(b)
+
+
+def test_restricted_build_rss_scales_with_owned_fraction():
+    """Peak host RSS of the stacked-problem build measured in a child
+    process: owning 1/8 of the parts must cost well under half the
+    full-replication build at a size where the difference is visible
+    (VERDICT round 2 'done' criterion)."""
+    import subprocess
+
+    code = """
+import sys
+import numpy as np, jax.numpy as jnp
+from acg_tpu.io.generators import poisson2d_coo
+from acg_tpu.matrix import SymCsrMatrix
+from acg_tpu.graph import partition_matrix
+from acg_tpu.parallel.dist import DistributedProblem
+from acg_tpu.partition import partition_rows
+
+def rss_kb():
+    with open("/proc/self/statm") as f:
+        return int(f.read().split()[1]) * 4  # pages -> KB (4 KB pages)
+
+owned = (0,) if sys.argv[1] == "restricted" else None
+r, c, v, N = poisson2d_coo(1024)  # N=1.05M; full f64 DIA stack ~42 MB
+csr = SymCsrMatrix.from_coo(N, r, c, v).to_csr()
+part = partition_rows(csr, 8, seed=0, method="band")
+subs = partition_matrix(csr, part, 8, owned_parts=owned)
+before = rss_kb()
+prob = DistributedProblem.build(csr, part, 8, dtype=jnp.float64,
+                                subs=subs, owned_parts=owned)
+assert prob.local.arrays[0] is not None
+print(rss_kb() - before)
+"""
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+
+    def run(mode):
+        out = subprocess.run([sys.executable, "-c", code, mode],
+                             capture_output=True, text=True, env=env,
+                             timeout=300)
+        assert out.returncode == 0, out.stderr
+        return int(out.stdout.strip().splitlines()[-1])  # KB
+
+    full = run("full")
+    rest = run("restricted")
+    # the stacked f64 arrays are ~42 MB fully filled; owning 1 of 8
+    # parts touches ~1/8 of those pages (the rest stay virtual calloc
+    # pages).  Resident-set growth across the stack step must reflect
+    # that -- allow generous allocator noise either side.
+    assert rest + 15_000 < full, (rest, full)
+
+
 @pytest.mark.parametrize("nparts", [4, 2])
 def test_cli_two_process_solve(matrix_file, nparts):
     """Both controllers solve; only process 0 prints stats + solution;

@@ -1,0 +1,163 @@
+"""Sharded on-device stencil assembly + solve (parallel/sharded_dia).
+
+The north-star route (VERDICT round 2 item 2): per-shard on-device DIA
+assembly, halo exchange DERIVED by the SPMD partitioner from the
+cyclic-shift SpMV, same code path single-chip / multi-chip /
+multi-controller.  Tests pin correctness against scipy, agreement with
+the unsharded solver, the compiled communication structure (neighbour
+collective-permutes, no all-gathers), and the 2-process CLI run.
+"""
+
+import os
+import re
+import socket
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from acg_tpu.io.generators import poisson2d_coo, poisson3d_coo
+from acg_tpu.matrix import SymCsrMatrix
+from acg_tpu.ops.spmv import DiaMatrix, dia_mv_roll, device_matrix_from_csr
+from acg_tpu.parallel.mesh import solve_mesh
+from acg_tpu.parallel.sharded_dia import (build_sharded_poisson_solver,
+                                          sharded_poisson_dia)
+from acg_tpu.solvers.jax_cg import JaxCGSolver
+from acg_tpu.solvers.stats import StoppingCriteria
+
+
+def _csr(n, dim):
+    gen = poisson2d_coo if dim == 2 else poisson3d_coo
+    r, c, v, N = gen(n)
+    return SymCsrMatrix.from_coo(N, r, c, v).to_csr()
+
+
+@pytest.mark.parametrize("dim,n", [(2, 32), (3, 16)])
+def test_sharded_spmv_matches_scipy(dim, n):
+    mesh = solve_mesh(8)
+    planes, offsets, N = sharded_poisson_dia(n, dim, mesh)
+    x = np.random.default_rng(0).standard_normal(N).astype(np.float32)
+    xs = jax.device_put(x, jax.sharding.NamedSharding(
+        mesh, jax.sharding.PartitionSpec("parts")))
+    y = np.asarray(jax.jit(
+        lambda p, v: dia_mv_roll(p, offsets, v))(planes, xs), np.float64)
+    y_ref = _csr(n, dim) @ x.astype(np.float64)
+    assert np.linalg.norm(y - y_ref) <= 1e-5 * np.linalg.norm(y_ref)
+
+
+def test_sharded_solve_matches_unsharded():
+    """The 8-way sharded solve and the single-device solve run the same
+    recurrences; iteration counts and solutions must agree closely."""
+    n, dim = 24, 2
+    csr = _csr(n, dim)
+    crit = StoppingCriteria(maxits=2000, residual_rtol=1e-6)
+    solver = build_sharded_poisson_solver(n, dim, nparts=8)
+    b = solver.ones_b()
+    x = np.asarray(solver.solve(b, criteria=crit, host_result=False),
+                   np.float64)
+    k_sharded = solver.stats.niterations
+
+    A = device_matrix_from_csr(csr, dtype=jnp.float32)
+    ref = JaxCGSolver(A, kernels="xla")
+    x1 = np.asarray(ref.solve(np.ones(csr.shape[0], np.float32),
+                              criteria=crit), np.float64)
+    # iteration counts only agree loosely: near the f32 recurrence-vs-
+    # true-residual drift the crossing point shifts with reduction order
+    # (measured: trajectories track to <20% at every checkpoint).  The
+    # hard invariants are convergence and solution agreement.
+    assert solver.stats.converged and ref.stats.converged
+    assert abs(k_sharded - ref.stats.niterations) <= 0.3 * ref.stats.niterations
+    bnrm = np.linalg.norm(np.ones(csr.shape[0]))
+    assert np.linalg.norm(x - x1) <= 1e-4 * bnrm
+
+
+def test_sharded_hlo_has_permutes_not_gathers():
+    """The compiled sharded SpMV must exchange halos via
+    collective-permute (the derived neighbour exchange) and must NOT
+    all-gather the vector -- the property that makes the route scale."""
+    mesh = solve_mesh(8)
+    planes, offsets, N = sharded_poisson_dia(16, 3, mesh)
+    sh = jax.sharding.NamedSharding(mesh,
+                                    jax.sharding.PartitionSpec("parts"))
+    x = jax.device_put(np.ones(N, np.float32), sh)
+    f = jax.jit(lambda p, v: dia_mv_roll(p, offsets, v))
+    hlo = f.lower(planes, x).compile().as_text()
+    assert re.search(r"collective-permute", hlo)
+    assert not re.search(r"all-gather", hlo)
+
+
+def test_sharded_manufactured_b_matches_scipy():
+    n, dim = 16, 3
+    solver = build_sharded_poisson_solver(n, dim, nparts=8)
+    xsol, b = solver.manufactured(seed=7)
+    xs = np.asarray(xsol, np.float64)
+    np.testing.assert_allclose(np.asarray(b, np.float64),
+                               _csr(n, dim) @ xs, atol=1e-5)
+    assert np.linalg.norm(xs) == pytest.approx(1.0, abs=1e-5)
+
+
+def test_sharded_mixed_dtype():
+    """The mixed tier (bf16 planes + f32 vectors) on the sharded route
+    matches the all-f32 sharded solve bitwise (Poisson planes are
+    bf16-exact)."""
+    n, dim = 24, 2
+    crit = StoppingCriteria(maxits=400, residual_rtol=1e-6)
+    s32 = build_sharded_poisson_solver(n, dim, nparts=8)
+    x32 = np.asarray(s32.solve(s32.ones_b(), criteria=crit,
+                               host_result=False))
+    sm = build_sharded_poisson_solver(n, dim, nparts=8,
+                                      dtype=jnp.bfloat16,
+                                      vector_dtype=jnp.float32)
+    xm = np.asarray(sm.solve(sm.ones_b(), criteria=crit, host_result=False))
+    assert np.array_equal(x32, xm)
+
+
+def test_epsilon_shift_applies():
+    """--epsilon adds to the diagonal plane on the sharded route."""
+    s = build_sharded_poisson_solver(8, 2, nparts=2, epsilon=1.5)
+    d = s.A.offsets.index(0)
+    assert float(np.asarray(s.A.data[d])[0]) == pytest.approx(4.0 + 1.5)
+
+
+# -- 2-process multi-controller run of the full gen-direct sharded CLI --
+
+def _free_port() -> int:
+    with socket.socket() as s:
+        s.bind(("", 0))
+        return s.getsockname()[1]
+
+
+def test_cli_two_process_gen_direct():
+    """gen:poisson3d under --multihost --nparts 4: the north-star
+    configuration shape, on the 2-process CPU pod.  Both controllers
+    run the sharded assembly (no host matrix anywhere); only process 0
+    prints stats; the manufactured-solution error converges."""
+    port = _free_port()
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["XLA_FLAGS"] = "--xla_force_host_platform_device_count=2"
+    env["ACG_TPU_GEN_DIRECT_MIN"] = "100"  # force the direct path at 16^3
+
+    def launch(pid):
+        argv = [sys.executable, "-m", "acg_tpu.cli", "gen:poisson3d:16",
+                "--nparts", "4", "--manufactured-solution",
+                "--max-iterations", "2000", "--residual-rtol", "1e-6",
+                "--dtype", "f32", "--warmup", "0", "--quiet",
+                "--coordinator", f"localhost:{port}",
+                "--num-processes", "2", "--process-id", str(pid)]
+        return subprocess.Popen(argv, stdout=subprocess.PIPE,
+                                stderr=subprocess.PIPE, text=True, env=env)
+
+    procs = [launch(i) for i in range(2)]
+    outs = [p.communicate(timeout=600) for p in procs]
+    for p, (_, se) in zip(procs, outs):
+        assert p.returncode == 0, se
+    (so0, se0), (so1, se1) = outs
+    assert "total solver time" in se0
+    assert "total solver time" not in se1
+    err = float(se0.split("\nerror 2-norm: ")[1].split()[0])
+    assert err < 1e-4, se0
