@@ -157,6 +157,12 @@ def make_parser() -> argparse.ArgumentParser:
                    help="total controller processes (with --coordinator)")
     p.add_argument("--process-id", type=int, default=None, metavar="I",
                    help="this controller's index (with --coordinator)")
+    p.add_argument("--err-timeout", type=float, default=120.0,
+                   metavar="SECONDS",
+                   help="multi-controller error-agreement watchdog: how "
+                        "long to wait at a stage checkpoint for peers "
+                        "before concluding one died and aborting (the "
+                        "acgerrmpi analog; default: 120)")
     p.add_argument("--profile-ops", nargs="?", const=10, type=int,
                    default=None, metavar="REPS",
                    help="fill the stats block's per-op seconds/GB/s by "
@@ -367,6 +373,17 @@ def _solve_generated_direct(args, dim, n, N, jax, jnp, dtype,
     return 0
 
 
+def _checkpoint(args, stage: str, code: int = 0) -> int:
+    """Cross-controller error agreement at a stage boundary (the
+    acgerrmpi analog, parallel/erragree): every controller learns the
+    worst status code so all exit together; a dead peer trips the
+    watchdog instead of wedging the pod in the next collective."""
+    if not (args.multihost or args.coordinator is not None):
+        return int(code)
+    from acg_tpu.parallel.erragree import agree_status
+    return agree_status(code, what=stage, timeout=args.err_timeout)
+
+
 def _solve_generated_sharded(args, dim, n, N, jax, jnp, dtype,
                              vec_dtype) -> int:
     """Sharded gen-direct path: assembly and solve over the device mesh
@@ -423,11 +440,17 @@ def _solve_generated_sharded(args, dim, n, N, jax, jnp, dtype,
         sys.stderr.write(f"acg-tpu: {e}\n")
         if is_primary():
             solver.stats.fwrite(sys.stderr)
+        _checkpoint(args, "solve", 1)
         return 1
     finally:
         if args.trace:
             jax.profiler.stop_trace()
     _log(args, "solve:", t0)
+    rc = _checkpoint(args, "solve", 0)
+    if rc:
+        sys.stderr.write("acg-tpu: aborting: a peer controller failed "
+                         "during the solve\n")
+        return rc
 
     # cross-process COLLECTIVE steps run on every controller BEFORE the
     # primary-only output gate: a non-primary process returning early
@@ -506,6 +529,9 @@ def _main(args) -> int:
         vec_dtype = dtype
     comm = {"mpi": "xla", "nccl": "xla", "nvshmem": "dma"}.get(args.comm, args.comm)
 
+    def checkpoint(stage: str, code: int = 0) -> int:
+        return _checkpoint(args, stage, code)
+
     if args.verbose >= 2:
         # part -> device mapping dump (the reference's rank -> CPU/GPU
         # map, cuda/acg-cuda.c:1055-1101)
@@ -513,105 +539,122 @@ def _main(args) -> int:
             _log(args, f"device {d.id}: {d.platform} {d.device_kind} "
                        f"(process {d.process_index})")
 
-    # stage 1: read (or synthesize) the matrix
-    t0 = time.perf_counter()
-    if args.A.startswith("gen:"):
-        spec = _parse_gen_spec(args.A)
-        kind, dim, n, N = spec[:4]
-        if kind == "poisson" and N > _gen_direct_min():
-            # too large for host CSR assembly: direct on-device DIA
-            return _solve_generated_direct(args, dim, n, N, jax, jnp, dtype,
-                                           vec_dtype)
-        _log(args, f"synthesizing {args.A} (N={N})")
-        from acg_tpu.io.generators import (irregular_spd_coo, poisson2d_coo,
-                                           poisson3d_coo)
-        if kind == "poisson":
-            r, c, v, N = (poisson2d_coo if dim == 2 else poisson3d_coo)(n)
-        else:
-            r, c, v, N = irregular_spd_coo(n, avg_degree=spec[4],
-                                           seed=args.seed)
-        A = SymCsrMatrix.from_coo(N, r, c, v)
-        _log(args, "synthesize matrix:", t0)
-    else:
-        _log(args, f"reading matrix from {args.A}")
-        try:
-            mtx = read_mtx(args.A, binary=args.binary)
-        except AcgError as e:
-            sys.stderr.write(f"acg-tpu: {args.A}: {e}\n")
-            return 1
-        _log(args, "read matrix:", t0)
-        A = SymCsrMatrix.from_mtx(mtx)
-
-    # stage 2a: assemble symmetric CSR
-    t0 = time.perf_counter()
-    csr = A.to_csr(epsilon=args.epsilon)
-    _log(args, "assemble symmetric CSR:", t0)
-
-    n = A.nrows
-
-    # stage 2b/2c: partition rows and build subdomains
-    nparts = args.nparts
-    if comm == "none":
-        nparts = nparts or 1
-    else:
-        nparts = nparts or len(jax.devices())
-    t0 = time.perf_counter()
-    if args.partition:
-        try:
-            pmtx = read_mtx(args.partition, binary=args.partition_binary)
-        except AcgError as e:
-            sys.stderr.write(f"acg-tpu: {args.partition}: {e}\n")
-            return 1
-        part = np.asarray(pmtx.vals, dtype=np.int64).reshape(-1)
-        if part.size != n:
-            raise SystemExit(f"acg-tpu: partition vector has {part.size} "
-                             f"entries, matrix has {n} rows")
-        if part.min() == 1 and part.max() == nparts:
-            part = part - 1  # tolerate 1-based partition vectors
-        part = part.astype(np.int32)
-        if part.max() >= nparts:
-            nparts = int(part.max()) + 1
-    else:
-        method = args.partition_method
-        if method == "auto":
-            # banded matrices keep gather-free DIA local blocks under a
-            # contiguous partition; everything else gets edge-cut
-            # minimisation.  The O(nnz) probe only matters (and only
-            # runs) when there is something to partition.
-            if nparts > 1:
-                from acg_tpu.ops.spmv import prefers_dia
-                method = "band" if prefers_dia(csr) else "graph"
+    # stages 1-4 under the ingest error-agreement guard: these are
+    # the host-local stages (file I/O, partitioning) where one
+    # controller can fail alone; the checkpoint below is the last
+    # point before the first collective
+    ingest_rc = 0
+    try:
+        # stage 1: read (or synthesize) the matrix
+        t0 = time.perf_counter()
+        if args.A.startswith("gen:"):
+            spec = _parse_gen_spec(args.A)
+            kind, dim, n, N = spec[:4]
+            if kind == "poisson" and N > _gen_direct_min():
+                # too large for host CSR assembly: direct on-device DIA
+                return _solve_generated_direct(args, dim, n, N, jax, jnp, dtype,
+                                               vec_dtype)
+            _log(args, f"synthesizing {args.A} (N={N})")
+            from acg_tpu.io.generators import (irregular_spd_coo, poisson2d_coo,
+                                               poisson3d_coo)
+            if kind == "poisson":
+                r, c, v, N = (poisson2d_coo if dim == 2 else poisson3d_coo)(n)
             else:
-                method = "graph"
-        part = partition_rows(csr, nparts, seed=args.seed, method=method)
-    _log(args, f"partition rows into {nparts} parts:", t0)
+                r, c, v, N = irregular_spd_coo(n, avg_degree=spec[4],
+                                               seed=args.seed)
+            A = SymCsrMatrix.from_coo(N, r, c, v)
+            _log(args, "synthesize matrix:", t0)
+        else:
+            _log(args, f"reading matrix from {args.A}")
+            try:
+                mtx = read_mtx(args.A, binary=args.binary)
+            except AcgError as e:
+                raise SystemExit(f"acg-tpu: {args.A}: {e}")
+            _log(args, "read matrix:", t0)
+            A = SymCsrMatrix.from_mtx(mtx)
 
-    # stage 4: right-hand side and initial guess
-    rng = np.random.default_rng(args.seed)
-    xsol = None
-    if args.manufactured_solution:
-        # random unit-norm solution; b = A*xsol via the independent host
-        # SpMV (cuda/acg-cuda.c:1969-2140)
-        xsol = rng.standard_normal(n)
-        xsol /= np.linalg.norm(xsol)
-        b = A.dsymv(xsol, epsilon=args.epsilon)
-    elif args.b:
-        bmtx = read_mtx(args.b, binary=args.binary)
-        b = np.asarray(bmtx.vals, dtype=np.float64).reshape(-1)
-        if b.size != n:
-            raise SystemExit(f"acg-tpu: b has {b.size} entries, need {n}")
-    else:
-        b = np.ones(n)
-    if args.x0:
-        xmtx = read_mtx(args.x0, binary=args.binary)
-        x0 = np.asarray(xmtx.vals, dtype=np.float64).reshape(-1)
-    else:
-        x0 = None
+        # stage 2a: assemble symmetric CSR
+        t0 = time.perf_counter()
+        csr = A.to_csr(epsilon=args.epsilon)
+        _log(args, "assemble symmetric CSR:", t0)
 
-    criteria = StoppingCriteria(
-        maxits=args.max_iterations,
-        residual_atol=args.residual_atol, residual_rtol=args.residual_rtol,
-        diff_atol=args.diff_atol, diff_rtol=args.diff_rtol)
+        n = A.nrows
+
+        # stage 2b/2c: partition rows and build subdomains
+        nparts = args.nparts
+        if comm == "none":
+            nparts = nparts or 1
+        else:
+            nparts = nparts or len(jax.devices())
+        t0 = time.perf_counter()
+        if args.partition:
+            try:
+                pmtx = read_mtx(args.partition, binary=args.partition_binary)
+            except AcgError as e:
+                raise SystemExit(f"acg-tpu: {args.partition}: {e}")
+            part = np.asarray(pmtx.vals, dtype=np.int64).reshape(-1)
+            if part.size != n:
+                raise SystemExit(f"acg-tpu: partition vector has {part.size} "
+                                 f"entries, matrix has {n} rows")
+            if part.min() == 1 and part.max() == nparts:
+                part = part - 1  # tolerate 1-based partition vectors
+            part = part.astype(np.int32)
+            if part.max() >= nparts:
+                nparts = int(part.max()) + 1
+        else:
+            method = args.partition_method
+            if method == "auto":
+                # banded matrices keep gather-free DIA local blocks under a
+                # contiguous partition; everything else gets edge-cut
+                # minimisation.  The O(nnz) probe only matters (and only
+                # runs) when there is something to partition.
+                if nparts > 1:
+                    from acg_tpu.ops.spmv import prefers_dia
+                    method = "band" if prefers_dia(csr) else "graph"
+                else:
+                    method = "graph"
+            part = partition_rows(csr, nparts, seed=args.seed, method=method)
+        _log(args, f"partition rows into {nparts} parts:", t0)
+
+        # stage 4: right-hand side and initial guess
+        rng = np.random.default_rng(args.seed)
+        xsol = None
+        if args.manufactured_solution:
+            # random unit-norm solution; b = A*xsol via the independent host
+            # SpMV (cuda/acg-cuda.c:1969-2140)
+            xsol = rng.standard_normal(n)
+            xsol /= np.linalg.norm(xsol)
+            b = A.dsymv(xsol, epsilon=args.epsilon)
+        elif args.b:
+            bmtx = read_mtx(args.b, binary=args.binary)
+            b = np.asarray(bmtx.vals, dtype=np.float64).reshape(-1)
+            if b.size != n:
+                raise SystemExit(f"acg-tpu: b has {b.size} entries, need {n}")
+        else:
+            b = np.ones(n)
+        if args.x0:
+            xmtx = read_mtx(args.x0, binary=args.binary)
+            x0 = np.asarray(xmtx.vals, dtype=np.float64).reshape(-1)
+        else:
+            x0 = None
+
+        criteria = StoppingCriteria(
+            maxits=args.max_iterations,
+            residual_atol=args.residual_atol, residual_rtol=args.residual_rtol,
+            diff_atol=args.diff_atol, diff_rtol=args.diff_rtol)
+    except SystemExit as e:
+        if e.code and not isinstance(e.code, int):
+            sys.stderr.write(str(e.code) + "\n")
+        ingest_rc = e.code if isinstance(e.code, int) else 1
+    except (AcgError, OSError) as e:
+        sys.stderr.write(f"acg-tpu: {e}\n")
+        ingest_rc = 1
+    rc = checkpoint("ingest", ingest_rc)
+    if rc:
+        if not ingest_rc:
+            sys.stderr.write("acg-tpu: aborting: a peer controller "
+                             "failed during ingest\n")
+        return rc
 
     # stages 6b-8: build solver and solve, under the profiler when
     # --trace is set (try/finally so failed solves still finalise the
@@ -689,14 +732,21 @@ def _main(args) -> int:
         sys.stderr.write(f"acg-tpu: {e}\n")
         if is_primary():  # stats block from "rank 0" only
             solver.stats.fwrite(sys.stderr)
+        checkpoint("solve", 1)
         return 1
     except AcgError as e:
         sys.stderr.write(f"acg-tpu: {e}\n")
+        checkpoint("solve", 1)
         return 1
     finally:
         if args.trace:
             jax.profiler.stop_trace()
     _log(args, "solve:", t0)
+    rc = checkpoint("solve", 0)
+    if rc:
+        sys.stderr.write("acg-tpu: aborting: a peer controller failed "
+                         "during the solve\n")
+        return rc
 
     # optional per-op timing tier (replayed, see solvers/profile.py);
     # None = flag absent, any given value is clamped to >= 1 rep

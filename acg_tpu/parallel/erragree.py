@@ -1,0 +1,82 @@
+"""Cross-controller error agreement -- the ``acgerrmpi`` analog.
+
+The reference wraps hazardous stages in a collective error agreement so
+every rank learns the worst error code and all exit together instead of
+one rank dying alone while its peers wedge in the next collective
+(``acg/error.c`` ``acgerrmpi``, used e.g. at ``cuda/acg-cuda.c:2410``).
+
+TPU-native version: :func:`agree_status` allgathers an int32 status code
+across controller processes at stage boundaries (host-local stages --
+file I/O, partitioning -- are where one-sided failures happen; the solve
+itself is one replicated SPMD program, so its failures are symmetric).
+A watchdog guards the agreement itself: when a peer process died before
+reaching the checkpoint, the allgather would block forever -- the
+watchdog hard-exits this process with a distinct code after ``timeout``
+seconds, so the pod tears down in seconds instead of hanging until the
+scheduler's global timeout.
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+import threading
+
+import numpy as np
+
+# exit code for "a peer never reached the checkpoint" (distinct from any
+# ErrorCode value; chosen in the 64..113 hole left by shell conventions)
+PEER_LOST_EXIT = 97
+
+
+def agree_status(code: int, what: str = "", timeout: float = 120.0) -> int:
+    """Collective max of per-process status codes (0 = OK).
+
+    Every controller must call this at the same stage boundary.  Returns
+    the agreed (worst) code so callers can exit in unison.  If agreement
+    does not complete within ``timeout`` seconds -- a peer crashed
+    before its checkpoint -- the process prints a diagnosis and exits
+    with :data:`PEER_LOST_EXIT`.
+
+    Single-process: returns ``code`` immediately (no collective).
+    """
+    import jax
+
+    if jax.process_count() == 1:
+        return int(code)
+
+    from jax.experimental import multihost_utils
+
+    done = threading.Event()
+
+    def _abort():
+        if done.is_set():
+            # agreement completed in the race window between the
+            # allgather returning and the timer being cancelled
+            return
+        sys.stderr.write(
+            f"acg-tpu: error agreement{' (' + what + ')' if what else ''} "
+            f"timed out after {timeout:.0f}s -- a peer controller died "
+            f"before its checkpoint; aborting this process\n")
+        sys.stderr.flush()
+        os._exit(PEER_LOST_EXIT)
+
+    watchdog = threading.Timer(timeout, _abort)
+    watchdog.daemon = True
+    watchdog.start()
+    try:
+        codes = multihost_utils.process_allgather(
+            np.int32(code), tiled=False)
+        done.set()
+    except Exception as e:  # noqa: BLE001 -- a failed collective here
+        # means a peer died mid-connection; same teardown as a timeout
+        watchdog.cancel()
+        sys.stderr.write(
+            f"acg-tpu: error agreement{' (' + what + ')' if what else ''} "
+            f"failed ({type(e).__name__}) -- a peer controller died; "
+            f"aborting this process\n")
+        sys.stderr.flush()
+        os._exit(PEER_LOST_EXIT)
+    finally:
+        watchdog.cancel()
+    return int(np.max(codes))

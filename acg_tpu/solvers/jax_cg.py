@@ -35,7 +35,8 @@ import numpy as np
 
 from acg_tpu.errors import NotConvergedError
 from acg_tpu.ops.precision import dot2
-from acg_tpu.ops.spmv import (DeviceMatrix, DiaMatrix, acc_dtype, spmv,
+from acg_tpu.ops.spmv import (DeviceMatrix, DiaMatrix, acc_dtype,
+                              matrix_dtype, matrix_index_bytes, spmv,
                               spmv_flops)
 from acg_tpu.solvers.stats import (SolverStats, StoppingCriteria,
                                    cg_flops_per_iteration)
@@ -368,9 +369,7 @@ class JaxCGSolver:
         crit = criteria or StoppingCriteria()
         st = self.stats
         st.criteria = crit
-        dtype = (self.A.dtype if hasattr(self.A, "dtype")
-                 else self.A.data.dtype if hasattr(self.A, "data")
-                 else self.A.vals.dtype)
+        dtype = matrix_dtype(self.A)
         if self.vector_dtype is not None:
             dtype = jnp.dtype(self.vector_dtype)
         b = jnp.asarray(b, dtype=dtype)
@@ -413,12 +412,8 @@ class JaxCGSolver:
         dbl = np.dtype(dtype).itemsize
         # matrix bytes in the MATRIX storage dtype (they differ from the
         # vector dtype under --dtype mixed) + per-format index bytes
-        # (DIA reads no indices; ELL 4 B; COO row+col 8 B)
-        mat_dbl = np.dtype(self.A.dtype if isinstance(self.A, DiaMatrix)
-                           else self.A.data.dtype if hasattr(self.A, "data")
-                           else self.A.vals.dtype).itemsize
-        idx_b = (0 if isinstance(self.A, DiaMatrix)
-                 else 8 if hasattr(self.A, "vals") else 4)
+        mat_dbl = np.dtype(matrix_dtype(self.A)).itemsize
+        idx_b = matrix_index_bytes(self.A)
         st.ops["gemv"].add(niter + 1, 0.0,
                            int((self._spmv_flops / 3.0) * (mat_dbl + idx_b)
                                + 2 * n * dbl) * (niter + 1))

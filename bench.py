@@ -70,16 +70,17 @@ def _ref_bytes_per_iter(csr) -> float:
     return csr.nnz * 12.0 + 80.0 * csr.shape[0]
 
 
-def _our_bytes_per_iter(nnz: int, n: int, fmt: str, mat_itemsize: int,
-                        vec_itemsize: int, pipelined: bool) -> float:
+def _our_bytes_per_iter(nnz: int, n: int, idx_bytes: float,
+                        mat_itemsize: int, vec_itemsize: int,
+                        pipelined: bool) -> float:
     """OUR analytic HBM traffic per CG iteration: matrix reads in the
-    matrix storage dtype (+index bytes for gather formats) plus the
-    vector passes of the loop (15 classic / 21 pipelined, the pass count
-    implied by the measured 335 MB/iter f32 flagship -- BASELINE.md) in
-    the vector storage dtype (they differ under --dtype mixed)."""
-    idx = {"dia": 0, "ell": 4, "coo": 8}.get(fmt, 4)
+    matrix storage dtype (+``idx_bytes`` index bytes per nonzero --
+    ops.spmv.matrix_index_bytes) plus the vector passes of the loop
+    (15 classic / 21 pipelined, the pass count implied by the measured
+    335 MB/iter f32 flagship -- BASELINE.md) in the vector storage
+    dtype (they differ under --dtype mixed)."""
     passes = 21 if pipelined else 15
-    return nnz * (mat_itemsize + idx) + passes * n * vec_itemsize
+    return nnz * (mat_itemsize + idx_bytes) + passes * n * vec_itemsize
 
 
 # storage tiers: (matrix dtype, vector dtype) by bench dtype name;
@@ -135,14 +136,19 @@ def bandwidth_probe_gbs(refresh: bool = False) -> float:
             ts.append(time.perf_counter() - t0)
         return min(ts)
 
-    for _ in range(3):
-        dt = best(12) - best(4)
+    for _ in range(4):
+        dt = best(16) - best(4)
         if dt > 0:
-            _probe_cache = 3.0 * n * 4.0 * 8 / dt / 1e9
-            return _probe_cache
-        # contention burst inverted the two-point estimate; retry
+            bw = 3.0 * n * 4.0 * 12 / dt / 1e9
+            # plausibility bounds: nothing in this hardware class moves
+            # under 20 or over 4000 GB/s -- out-of-range means a
+            # contention burst landed inside the two-point difference
+            if 20.0 <= bw <= 4000.0:
+                _probe_cache = bw
+                return bw
+        # contention burst corrupted the estimate; retry
     raise RuntimeError("bandwidth probe unstable (two-point estimate "
-                       "non-positive after 3 attempts)")
+                       "implausible after 4 attempts)")
 
 
 def _h100_standin(ref_bytes_per_iter: float) -> float:
@@ -214,7 +220,8 @@ def _roofline_context(row: dict, bytes_per_iter: float) -> dict:
 
 
 def run_case(csr, name: str, pipelined: bool, dist: bool = False,
-             kernels: str = "xla", dtype_name: str = "f32") -> dict:
+             kernels: str = "xla", dtype_name: str = "f32",
+             spmv_format: str = "auto") -> dict:
     import jax.numpy as jnp
     import numpy as np
 
@@ -231,14 +238,18 @@ def run_case(csr, name: str, pipelined: bool, dist: bool = False,
                                         vector_dtype=vec_dtype)
         solver = DistCGSolver(prob, pipelined=pipelined)
         fmt = prob.local.format
+        idx_bytes = 0.0 if fmt == "dia" else 4.0
     else:
         from acg_tpu.ops.spmv import device_matrix_from_csr
         from acg_tpu.solvers.jax_cg import JaxCGSolver
 
-        A = device_matrix_from_csr(csr, dtype=mat_dtype)
+        from acg_tpu.ops.spmv import matrix_index_bytes
+
+        A = device_matrix_from_csr(csr, dtype=mat_dtype, format=spmv_format)
         solver = JaxCGSolver(A, pipelined=pipelined, kernels=kernels,
                              vector_dtype=vec_dtype)
         fmt = type(A).__name__.replace("Matrix", "").lower()
+        idx_bytes = matrix_index_bytes(A)
     tsolve, maxits = _time_solver(solver, b, StoppingCriteria)
     iters_per_sec = maxits / tsolve
     standin = _h100_standin(_ref_bytes_per_iter(csr))
@@ -251,14 +262,40 @@ def run_case(csr, name: str, pipelined: bool, dist: bool = False,
         "unit": "iters/s",
         "vs_baseline": round(iters_per_sec / standin, 4),
         "dtype": dtype_name,
+        "format": fmt,
     }
     if hasattr(solver, "kernels"):
         # record the *resolved* tier so an off-TPU run of the pallas-named
         # case cannot masquerade as a Pallas measurement
         row["kernels"] = solver.kernels
     return _roofline_context(row, _our_bytes_per_iter(
-        csr.nnz, csr.shape[0], fmt, np.dtype(mat_dtype).itemsize,
+        csr.nnz, csr.shape[0], idx_bytes, np.dtype(mat_dtype).itemsize,
         np.dtype(vec_dtype).itemsize, pipelined))
+
+
+def run_host_baseline(csr, name: str, kind: str) -> dict:
+    """Host/external baseline row (f64 on the host CPU): ``petsc`` =
+    the scipy-CG external oracle, ``native`` = the C++ core solver."""
+    import numpy as np
+
+    from acg_tpu.solvers.stats import StoppingCriteria
+
+    if kind == "petsc":
+        from acg_tpu.solvers.petsc_cg import PetscBaselineSolver
+        solver = PetscBaselineSolver(csr)
+    else:
+        from acg_tpu.solvers.host_cg import NativeHostCGSolver
+        solver = NativeHostCGSolver(csr)
+    b = np.ones(csr.shape[0])
+    tsolve, maxits = _time_solver(solver, b, StoppingCriteria, repeats=2)
+    iters_per_sec = maxits / tsolve
+    standin = _h100_standin(_ref_bytes_per_iter(csr))
+    print(f"# {name}: total solver time: {tsolve:.6f} seconds",
+          file=sys.stderr)
+    return {"metric": name, "value": round(iters_per_sec, 2),
+            "unit": "iters/s",
+            "vs_baseline": round(iters_per_sec / standin, 4),
+            "dtype": "f64", "host": True}
 
 
 def _enable_compile_cache():
@@ -339,7 +376,7 @@ def run_case_dia(side: int, dim: int, name: str,
            "vs_baseline": round(iters_per_sec / standin, 4),
            "dtype": dtype_name, "kernels": kernels}
     return _roofline_context(row, _our_bytes_per_iter(
-        nnz, N, "dia", np.dtype(mat_dtype).itemsize,
+        nnz, N, 0.0, np.dtype(mat_dtype).itemsize,
         np.dtype(vec_dtype).itemsize, False))
 
 
@@ -412,7 +449,33 @@ def sweep_np(out=sys.stdout) -> int:
     flat2 = max(iters2) - min(iters2) <= max(2, int(0.02 * max(iters2)))
     print(json.dumps({"metric": "direct_dia_iters_to_rtol1e-6_np_sweep",
                       "rows": rows2, "flat": flat2}), file=out)
-    return 0 if (flat and flat2) else 1
+
+    # IRREGULAR workload over the mesh (VERDICT r2 item 6): graph
+    # partition -> ELL local blocks; iterations to rtol must stay flat
+    csr_i = _build(20_000, 0)
+    xsol_i = rng.standard_normal(csr_i.shape[0])
+    xsol_i /= np.linalg.norm(xsol_i)
+    b_i = csr_i @ xsol_i
+    rows3 = []
+    for nparts in (1, 2, 4, 8):
+        part = partition_rows(csr_i, nparts, seed=0, method="graph")
+        prob = DistributedProblem.build(csr_i, part, nparts,
+                                        dtype=jnp.float64)
+        solver = DistCGSolver(prob)
+        x = solver.solve(b_i, criteria=StoppingCriteria(
+            maxits=5000, residual_rtol=1e-6))
+        err = float(np.linalg.norm(x - xsol_i))
+        rows3.append({"np": nparts, "iterations": solver.stats.niterations,
+                      "error_2norm": err,
+                      "local_format": prob.local.format})
+        print(f"# irregular np={nparts}: {solver.stats.niterations} "
+              f"iterations, error {err:.3e} ({prob.local.format})",
+              file=sys.stderr)
+    iters3 = [r["iterations"] for r in rows3]
+    flat3 = max(iters3) - min(iters3) <= max(2, int(0.02 * max(iters3)))
+    print(json.dumps({"metric": "irregular_iters_to_rtol1e-6_np_sweep",
+                      "rows": rows3, "flat": flat3}), file=out)
+    return 0 if (flat and flat2 and flat3) else 1
 
 
 def main(argv=None) -> int:
@@ -497,7 +560,11 @@ def main(argv=None) -> int:
              256, 3, False, False, "xla", "mixed"),
             ("cg_dist1_iters_per_sec_poisson2d_n2048_f32",
              2048, 2, False, True, "xla", "f32"),
+            # auto -> binned ELL (the merge-CSR-goal format); the COO row
+            # stays as the within-window A/B partner
             ("cg_iters_per_sec_irregular_n500k_d16_f32",
+             500_000, 0, False, False, "xla", "f32"),
+            ("cg_coo_iters_per_sec_irregular_n500k_d16_f32",
              500_000, 0, False, False, "xla", "f32"),
         ]
 
@@ -514,8 +581,23 @@ def main(argv=None) -> int:
                 print(f"# setup: {dim}D n={side} N={csr.shape[0]} "
                       f"nnz={csr.nnz} in {time.perf_counter() - t0:.1f}s on "
                       f"{jax.devices()[0].platform}", file=sys.stderr)
-            print(json.dumps(run_case(built[key], name, pipelined, dist,
-                                      kernels, dtn)))
+            print(json.dumps(run_case(
+                built[key], name, pipelined, dist, kernels, dtn,
+                spmv_format="coo" if "_coo_" in name else "auto")))
+        except Exception as e:  # noqa: BLE001 -- report and continue
+            print(f"# {name} skipped: {type(e).__name__}: "
+                  f"{str(e).splitlines()[0][:200]}", file=sys.stderr)
+        sys.stdout.flush()
+
+    # external/host baselines on the SAME 128^3 matrix (the reference's
+    # PETSc performance-baseline role, cgpetsc.c:335-378): scipy-CG
+    # oracle and the native C++ core, timed under the same protocol so
+    # the cross-implementation perf comparison is reproducible here
+    for name, kind in (
+            ("cg_iters_per_sec_poisson3d_n128_petsc_f64", "petsc"),
+            ("cg_iters_per_sec_poisson3d_n128_hostnative_f64", "native")):
+        try:
+            print(json.dumps(run_host_baseline(built[(128, 3)], name, kind)))
         except Exception as e:  # noqa: BLE001 -- report and continue
             print(f"# {name} skipped: {type(e).__name__}: "
                   f"{str(e).splitlines()[0][:200]}", file=sys.stderr)

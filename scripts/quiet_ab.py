@@ -1,0 +1,225 @@
+"""Quiet-window kernel A/B: re-evaluate the fused-kernel verdicts.
+
+Round 2 retired the fused SpMV+dot and fused 6-vector-update kernels on
+in-loop A/Bs taken in CONTENDED windows (BASELINE.md); the round-2
+verdict asked for a probe-gated re-run.  This script refuses to measure
+unless the bandwidth probe confirms a quiet window (>= --min-bw GB/s,
+default 600: quiet v5e probes ~800-915), then runs interleaved
+whole-solve A/Bs on the flagship config:
+
+  * classic CG: pallas dia_spmv tier vs xla tier
+  * classic CG: fused dia_spmv_dot in-loop vs pallas-SpMV + XLA dot
+  * pipelined CG: fused 6-vector pallas update vs XLA fusion
+  * storage tiers: f32 vs mixed vs bf16 (xla tier)
+
+Exit 3 = window contended, nothing measured.  Results print as JSON
+lines; paste the verdicts into BASELINE.md.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+import time
+
+ROOT = __file__.rsplit("/", 2)[0]
+sys.path.insert(0, ROOT)
+
+
+def _flagship():
+    import jax.numpy as jnp
+
+    from acg_tpu.io.generators import poisson2d_coo
+    from acg_tpu.matrix import SymCsrMatrix
+    from acg_tpu.ops.spmv import device_matrix_from_csr
+
+    r, c, v, N = poisson2d_coo(2048)
+    csr = SymCsrMatrix.from_coo(N, r, c, v).to_csr()
+    return {
+        "f32": device_matrix_from_csr(csr, dtype=jnp.float32),
+        "bf16": device_matrix_from_csr(csr, dtype=jnp.bfloat16),
+    }, csr.shape[0]
+
+
+def _time_case(make_solver, b, its=1000, reps=3):
+    import numpy as np
+
+    from acg_tpu.solvers.stats import StoppingCriteria
+
+    s = make_solver()
+    s.solve(b, criteria=StoppingCriteria(maxits=50))
+    s.solve(b, criteria=StoppingCriteria(maxits=50))
+    best = np.inf
+    for _ in range(reps):
+        s.stats.tsolve = 0.0
+        s.solve(b, criteria=StoppingCriteria(maxits=its))
+        best = min(best, s.stats.tsolve)
+    return its / best
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--min-bw", type=float, default=600.0,
+                    help="GB/s probe threshold for a quiet window")
+    ap.add_argument("--pairs", type=int, default=4,
+                    help="interleaved A/B pairs per comparison")
+    args = ap.parse_args(argv)
+
+    from acg_tpu._platform import enable_compile_cache
+    enable_compile_cache()
+    import numpy as np
+
+    from bench import bandwidth_probe_gbs
+    bw = bandwidth_probe_gbs()
+    print(f"# probe: {bw:.0f} GB/s", file=sys.stderr)
+    if bw < args.min_bw:
+        print(json.dumps({"quiet": False, "bw_gbs": round(bw, 1)}))
+        return 3
+
+    from acg_tpu.solvers.jax_cg import JaxCGSolver
+
+    As, N = _flagship()
+    b = np.ones(N, dtype=np.float32)
+
+    def ab(name, mk_a, mk_b, label_a, label_b):
+        va, vb = [], []
+        for _ in range(args.pairs):
+            va.append(_time_case(mk_a, b, reps=1))
+            vb.append(_time_case(mk_b, b, reps=1))
+        ra, rb = float(np.median(va)), float(np.median(vb))
+        bw2 = bandwidth_probe_gbs(refresh=True)
+        print(json.dumps({
+            "ab": name, label_a: round(ra, 1), label_b: round(rb, 1),
+            "ratio": round(ra / rb, 3), "bw_gbs": round(bw, 1),
+            "bw_gbs_after": round(bw2, 1)}))
+
+    ab("pallas_vs_xla_classic",
+       lambda: JaxCGSolver(As["f32"], kernels="pallas"),
+       lambda: JaxCGSolver(As["f32"], kernels="xla"),
+       "pallas", "xla")
+    ab("mixed_vs_f32_classic",
+       lambda: JaxCGSolver(As["bf16"], kernels="xla",
+                           vector_dtype=np.float32),
+       lambda: JaxCGSolver(As["f32"], kernels="xla"),
+       "mixed", "f32")
+    ab("bf16_vs_f32_classic",
+       lambda: JaxCGSolver(As["bf16"], kernels="xla"),
+       lambda: JaxCGSolver(As["f32"], kernels="xla"),
+       "bf16", "f32")
+    ab("pipelined_pallas_update_vs_xla",
+       lambda: _fused_update_solver(As["f32"]),
+       lambda: JaxCGSolver(As["f32"], pipelined=True, kernels="xla"),
+       "fused", "xla")
+    ab("fused_spmv_dot_vs_split",
+       lambda: _fused_dot_solver(As["f32"]),
+       lambda: JaxCGSolver(As["f32"], kernels="pallas"),
+       "fused", "split")
+    return 0
+
+
+def _fused_dot_solver(A):
+    """Classic CG whose (p, Ap) comes from the fused dia_spmv_dot kernel
+    (the round-2 retiree, re-tried under quiet-window conditions)."""
+    import functools
+
+    import jax
+    import jax.numpy as jnp
+
+    from acg_tpu.ops.pallas_kernels import dia_spmv_dot
+    from acg_tpu.solvers.stats import SolverStats
+
+    class FusedDotSolver:
+        def __init__(self, A):
+            self.A = A
+            self.stats = SolverStats(unknowns=A.nrows)
+            offs = A.offsets
+
+            @functools.partial(jax.jit, static_argnames=("maxits",))
+            def prog(planes, b, maxits):
+                x = jnp.zeros_like(b)
+                r = b
+                p = r
+                gamma = jnp.dot(r, r)
+
+                def body(_, st):
+                    x, r, p, gamma = st
+                    t, pdott = dia_spmv_dot(planes, offs, p)
+                    alpha = gamma / pdott
+                    x = x + alpha * p
+                    r = r - alpha * t
+                    gamma_next = jnp.dot(r, r)
+                    p2 = r + (gamma_next / gamma) * p
+                    return (x, r, p2, gamma_next)
+
+                return jax.lax.fori_loop(0, maxits, body,
+                                         (x, r, p, gamma))[0]
+
+            self._prog = prog
+
+        def solve(self, b, criteria=None, **kw):
+            import time as _t
+            b = jnp.asarray(b, self.A.dtype)
+            t0 = _t.perf_counter()
+            x = self._prog(tuple(self.A.data), b, criteria.maxits)
+            x.block_until_ready()
+            self.stats.tsolve += _t.perf_counter() - t0
+            return x
+
+    return FusedDotSolver(A)
+
+
+def _fused_update_solver(A):
+    """Pipelined CG using the pallas fused 6-vector update in-loop."""
+    import functools
+
+    import jax
+    import jax.numpy as jnp
+
+    from acg_tpu.ops.pallas_kernels import dia_spmv, fused_pipelined_update
+    from acg_tpu.solvers.stats import SolverStats
+
+    class FusedUpdateSolver:
+        def __init__(self, A):
+            self.A = A
+            self.stats = SolverStats(unknowns=A.nrows)
+            offs = A.offsets
+
+            @functools.partial(jax.jit, static_argnames=("maxits",))
+            def prog(planes, b, maxits):
+                x = jnp.zeros_like(b)
+                r = b
+                w = dia_spmv(planes, offs, r)
+                z = t = p = jnp.zeros_like(b)
+                inf = jnp.asarray(jnp.inf, b.dtype)
+
+                def body(_, st):
+                    x, r, w, p, t, z, gp, ap = st
+                    gamma = jnp.dot(r, r)
+                    delta = jnp.dot(w, r)
+                    q = dia_spmv(planes, offs, w)
+                    beta = gamma / gp
+                    alpha = gamma / (delta - beta * (gamma / ap))
+                    x, r, w, p, t, z = fused_pipelined_update(
+                        x, r, w, p, t, z, q, alpha, beta)
+                    return (x, r, w, p, t, z, gamma, alpha)
+
+                return jax.lax.fori_loop(
+                    0, maxits, body, (x, r, w, p, t, z, inf, inf))[0]
+
+            self._prog = prog
+
+        def solve(self, b, criteria=None, **kw):
+            import time as _t
+            b = jnp.asarray(b, self.A.dtype)
+            t0 = _t.perf_counter()
+            x = self._prog(tuple(self.A.data), b, criteria.maxits)
+            x.block_until_ready()
+            self.stats.tsolve += _t.perf_counter() - t0
+            return x
+
+    return FusedUpdateSolver(A)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -243,13 +243,21 @@ def test_cli_gen_spec_direct_device_path():
     assert "total solver time" in r.stderr
     # solution written and solves A x = ones
     assert "%%MatrixMarket matrix array" in r.stdout
-    # restrictions produce a clear error
+    # --manufactured-solution routes to the SHARDED direct path (round 3)
+    # and verifies end-to-end
     r2 = subprocess.run(
         [sys.executable, "-m", "acg_tpu.cli", "gen:poisson3d:8",
-         "--manufactured-solution"],
+         "--manufactured-solution", "--max-iterations", "500",
+         "--residual-rtol", "1e-6", "--warmup", "0", "--quiet"],
         capture_output=True, text=True, env=env)
-    assert r2.returncode != 0
-    assert "does not support" in r2.stderr
+    assert r2.returncode == 0, r2.stderr
+    assert float(r2.stderr.split("\nerror 2-norm: ")[1].split()[0]) < 1e-4
+    # remaining restrictions still produce a clear error
+    r3 = subprocess.run(
+        [sys.executable, "-m", "acg_tpu.cli", "gen:poisson3d:8", "--refine"],
+        capture_output=True, text=True, env=env)
+    assert r3.returncode != 0
+    assert "does not support" in r3.stderr
 
 
 def test_cli_gen_spec_invalid():
