@@ -206,6 +206,62 @@ def _cluster_route(offsets, n, itemsize, align, budget, ndiags):
             Lpad, Rpad, tile, align)
 
 
+def _window_copies(hbm, wref, sems, s0: int, i, grid: int, tile: int,
+                   Lpad: int, Rpad: int, align: int, dtype):
+    """(start, wait) callables streaming HBM tile ``i`` plus its left/
+    right band halos into a ``(Lpad + tile + Rpad,)`` VMEM window, edge
+    tiles zero-filling the out-of-range halo (correctness-neutral: those
+    positions only multiply structural zeros).  Uses semaphores
+    ``sems[s0:s0+3]``.  Shared by the single-x-pass SpMV kernels and the
+    fused CG phase A, so the subtle Mosaic DMA logic (alignment proofs,
+    edge fills) lives once."""
+    body_cp = pltpu.make_async_copy(
+        hbm.at[pl.ds(pl.multiple_of(i * tile, align), tile)],
+        wref.at[pl.ds(Lpad, tile)], sems.at[s0])
+
+    def _left_cp():
+        return pltpu.make_async_copy(
+            hbm.at[pl.ds(pl.multiple_of(i * tile - Lpad, align), Lpad)],
+            wref.at[pl.ds(0, Lpad)], sems.at[s0 + 1])
+
+    def _right_cp():
+        return pltpu.make_async_copy(
+            hbm.at[pl.ds(pl.multiple_of((i + 1) * tile, align), Rpad)],
+            wref.at[pl.ds(Lpad + tile, Rpad)], sems.at[s0 + 2])
+
+    def start():
+        body_cp.start()
+        if Lpad:
+            @pl.when(i > 0)
+            def _():
+                _left_cp().start()
+
+            @pl.when(i == 0)
+            def _():
+                wref[pl.ds(0, Lpad)] = jnp.zeros((Lpad,), dtype)
+        if Rpad:
+            @pl.when(i < grid - 1)
+            def _():
+                _right_cp().start()
+
+            @pl.when(i == grid - 1)
+            def _():
+                wref[pl.ds(Lpad + tile, Rpad)] = jnp.zeros((Rpad,), dtype)
+
+    def wait():
+        if Lpad:
+            @pl.when(i > 0)
+            def _():
+                _left_cp().wait()
+        if Rpad:
+            @pl.when(i < grid - 1)
+            def _():
+                _right_cp().wait()
+        body_cp.wait()
+
+    return start, wait
+
+
 def _dia_spmv_clustered(planes, offsets, x, central, far, Lpad, Rpad,
                         tile, align, interpret, with_dot=False):
     """Multi-window single-x-pass SpMV (see ``_cluster_route``): the
@@ -238,34 +294,9 @@ def _dia_spmv_clustered(planes, offsets, x, central, far, Lpad, Rpad,
             # start every copy first, wait after: the DMAs overlap each
             # other (and the zero-fills) instead of serialising the
             # grid step on round-trips
-            body_cp = pltpu.make_async_copy(
-                x_hbm.at[pl.ds(pl.multiple_of(i * tile, align), tile)],
-                xwin.at[pl.ds(Lpad, tile)], sems.at[0])
-            body_cp.start()
-            if Lpad:
-                @pl.when(i > 0)
-                def _():
-                    pltpu.make_async_copy(
-                        x_hbm.at[pl.ds(pl.multiple_of(i * tile - Lpad,
-                                                      align), Lpad)],
-                        xwin.at[pl.ds(0, Lpad)], sems.at[1]).start()
-
-                @pl.when(i == 0)
-                def _():
-                    xwin[pl.ds(0, Lpad)] = jnp.zeros((Lpad,), x.dtype)
-            if Rpad:
-                @pl.when(i < grid - 1)
-                def _():
-                    pltpu.make_async_copy(
-                        x_hbm.at[pl.ds(pl.multiple_of((i + 1) * tile,
-                                                      align), Rpad)],
-                        xwin.at[pl.ds(Lpad + tile, Rpad)],
-                        sems.at[2]).start()
-
-                @pl.when(i == grid - 1)
-                def _():
-                    xwin[pl.ds(Lpad + tile, Rpad)] = jnp.zeros((Rpad,),
-                                                               x.dtype)
+            start, wait = _window_copies(x_hbm, xwin, sems, 0, i, grid,
+                                         tile, Lpad, Rpad, align, x.dtype)
+            start()
             for f, (fwin, s) in enumerate(zip(fwins, shifts)):
                 src = i + s  # whole-tile shift: static in-range test
 
@@ -279,22 +310,6 @@ def _dia_spmv_clustered(planes, offsets, x, central, far, Lpad, Rpad,
                 @pl.when((src < 0) | (src >= grid))
                 def _(fwin=fwin):
                     fwin[...] = jnp.zeros((tile,), x.dtype)
-            # waits (same conditions as the starts)
-            if Lpad:
-                @pl.when(i > 0)
-                def _():
-                    pltpu.make_async_copy(
-                        x_hbm.at[pl.ds(pl.multiple_of(i * tile - Lpad,
-                                                      align), Lpad)],
-                        xwin.at[pl.ds(0, Lpad)], sems.at[1]).wait()
-            if Rpad:
-                @pl.when(i < grid - 1)
-                def _():
-                    pltpu.make_async_copy(
-                        x_hbm.at[pl.ds(pl.multiple_of((i + 1) * tile,
-                                                      align), Rpad)],
-                        xwin.at[pl.ds(Lpad + tile, Rpad)],
-                        sems.at[2]).wait()
             for f, (fwin, s) in enumerate(zip(fwins, shifts)):
                 src = i + s
 
@@ -304,7 +319,7 @@ def _dia_spmv_clustered(planes, offsets, x, central, far, Lpad, Rpad,
                         x_hbm.at[pl.ds(
                             pl.multiple_of(src * tile, align), tile)],
                         fwin, sems.at[3 + f]).wait()
-            body_cp.wait()
+            wait()
             # sub-f32 storage accumulates in f32: the converts are free
             # on the VPU, VMEM/HBM stay half-width
             kadt = acc_dtype(x.dtype)
@@ -405,6 +420,175 @@ def _dia_spmv_padded(planes, offsets, x, L, R, interpret):
         interpret=interpret,
     )(xp, *planes)
     return y[:n]
+
+
+def fused_cg_route(offsets: tuple, n: int, dtype) -> tuple | None:
+    """(Lpad, Rpad, tile, align) when the two-phase fused CG iteration
+    supports this shape (square DIA, single-window band, n divisible by
+    the tile), else None.
+
+    The tile is grown beyond the SpMV route's choice while VMEM allows:
+    phase A issues its r/p window DMAs synchronously per grid step (no
+    cross-step prefetch), so fewer, larger steps amortise the DMA
+    round-trips (measured: the base 16384 tile loses ~30% to this)."""
+    route = dia_spmv_route(offsets, n, dtype)
+    if route[0] != "fast":
+        return None
+    Lpad, Rpad, tile, align = route[1:]
+    ndiags = len(offsets)
+    itemsize = jnp.dtype(dtype).itemsize
+    budget = 12 * 2 ** 20
+
+    def vmem(t):
+        # two windows + double-buffered BlockSpec tiles (planes, p, t)
+        return (2 * (t + Lpad + Rpad) + 2 * (ndiags + 2) * t) * itemsize
+
+    while n % (2 * tile) == 0 and vmem(2 * tile) <= budget:
+        tile *= 2
+    return Lpad, Rpad, tile, align
+
+
+def cg_phase_a(planes, offsets: tuple, r, p_old, gamma, gamma_prev,
+               interpret: bool = False):
+    """Phase A of the fused classic-CG iteration: one streamed pass that
+    computes ``p = r + beta p_old`` (beta = gamma/gamma_prev, inf -> 0
+    on the first iteration), ``t = A p``, and ``(p, t)``.
+
+    The p-update is folded INTO the SpMV's halo windows: p values at
+    shifted positions are recomputed from the r/p_old windows already in
+    VMEM, so p_old's deferred update costs one extra streamed window
+    instead of a separate full pass.  HBM traffic: D plane reads + r
+    window + p_old window + p write + t write (~D+4 passes) vs the
+    XLA formulation's ~D+7 for the same ops.
+
+    This is the reference's monolithic device-kernel concept
+    (``acgsolvercuda_cg_kernel``, ``cg-kernels-cuda.cu:627-970``)
+    restated for TPU: the whole iteration as two kernels with scalars
+    riding SMEM, leaving nothing for XLA to fuse (the failure mode that
+    retired the single fused kernels in round 2 -- BASELINE.md).
+
+    Returns ``(p, t, pdott)``; pdott is a () f32 scalar.
+    """
+    n = r.shape[0]
+    route = fused_cg_route(offsets, n, r.dtype)
+    if route is None:
+        raise ValueError("shape not supported by the fused CG kernels")
+    Lpad, Rpad, tile, align = route
+    grid = n // tile
+    win = tile + Lpad + Rpad
+    kadt = acc_dtype(r.dtype)
+
+    def kernel(scal_ref, r_hbm, p_hbm, *plane_refs_and_out):
+        plane_refs = plane_refs_and_out[:-3]
+        p_ref, t_ref, dot_ref = plane_refs_and_out[-3:]
+        i = pl.program_id(0)
+        beta = (scal_ref[0, 0] / scal_ref[0, 1]).astype(r.dtype)
+
+        def body(rwin, pwin, sems):
+            # six DMAs (body + left/right halo for r and p_old), all
+            # started before any wait so they overlap
+            pairs = [
+                _window_copies(hbm, wref, sems, s0, i, grid, tile,
+                               Lpad, Rpad, align, r.dtype)
+                for hbm, wref, s0 in ((r_hbm, rwin, 0), (p_hbm, pwin, 3))]
+            for start, _ in pairs:
+                start()
+            for _, wait in pairs:
+                wait()
+            # p over the whole window (halo positions recomputed from
+            # the r/p_old windows -- the deferred-p-update trick).
+            # pw is a VALUE; offsets are static, so plain slices compile
+            pw = rwin[...] + beta * pwin[...]
+            acc = jnp.zeros((tile,), kadt)
+            for pr, off in zip(plane_refs, offsets):
+                acc = acc + (pr[:].astype(kadt)
+                             * pw[Lpad + off:Lpad + off + tile]
+                             .astype(kadt))
+            p_body = pw[Lpad:Lpad + tile]
+            p_ref[:] = p_body
+            t_ref[:] = acc.astype(r.dtype)
+            partial = jnp.sum(acc * p_body.astype(kadt))
+
+            @pl.when(i == 0)
+            def _():
+                dot_ref[0] = partial
+
+            @pl.when(i > 0)
+            def _():
+                dot_ref[0] += partial
+
+        pl.run_scoped(body, pltpu.VMEM((win,), r.dtype),
+                      pltpu.VMEM((win,), r.dtype),
+                      pltpu.SemaphoreType.DMA((6,)))
+
+    tile_spec = pl.BlockSpec((tile,), lambda i: (i,),
+                             memory_space=pltpu.VMEM)
+    scal = jnp.stack([gamma.astype(jnp.float32),
+                      gamma_prev.astype(jnp.float32)]).reshape(1, 2)
+    p, t, d = pl.pallas_call(
+        kernel,
+        grid=(grid,),
+        in_specs=[pl.BlockSpec((1, 2), lambda i: (0, 0),
+                               memory_space=pltpu.SMEM),
+                  pl.BlockSpec(memory_space=pl.ANY),
+                  pl.BlockSpec(memory_space=pl.ANY)] + [
+            tile_spec for _ in planes],
+        out_specs=(tile_spec, tile_spec,
+                   pl.BlockSpec((1,), lambda i: (0,),
+                                memory_space=pltpu.SMEM)),
+        out_shape=(jax.ShapeDtypeStruct((n,), r.dtype),
+                   jax.ShapeDtypeStruct((n,), r.dtype),
+                   jax.ShapeDtypeStruct((1,), jnp.float32)),
+        interpret=interpret,
+    )(scal, r, p_old, *planes)
+    return p, t, d[0]
+
+
+def cg_phase_b(x, p, r, t, gamma, pdott, interpret: bool = False):
+    """Phase B of the fused classic-CG iteration: one streamed pass for
+    ``alpha = gamma/(p,t); x += alpha p; r -= alpha t`` and the next
+    ``gamma = (r, r)`` accumulated in SMEM.  Returns (x, r, gamma)."""
+    n = x.shape[0]
+    tile = TILE if n % TILE == 0 and n >= TILE else None
+    if tile is None:
+        raise ValueError("shape not supported by the fused CG kernels")
+    grid = n // tile
+    kadt = acc_dtype(x.dtype)
+
+    def kernel(scal_ref, x_ref, p_ref, r_ref, t_ref, xo, ro, go):
+        i = pl.program_id(0)
+        alpha = (scal_ref[0, 0] / scal_ref[0, 1]).astype(x.dtype)
+        xo[:] = x_ref[:] + alpha * p_ref[:]
+        rn = r_ref[:] - alpha * t_ref[:]
+        ro[:] = rn
+        partial = jnp.sum(rn.astype(kadt) * rn.astype(kadt))
+
+        @pl.when(i == 0)
+        def _():
+            go[0] = partial
+
+        @pl.when(i > 0)
+        def _():
+            go[0] += partial
+
+    tile_spec = pl.BlockSpec((tile,), lambda i: (i,),
+                             memory_space=pltpu.VMEM)
+    scal = jnp.stack([gamma.astype(jnp.float32),
+                      pdott.astype(jnp.float32)]).reshape(1, 2)
+    xn, rn, g = pl.pallas_call(
+        kernel,
+        grid=(grid,),
+        in_specs=[pl.BlockSpec((1, 2), lambda i: (0, 0),
+                               memory_space=pltpu.SMEM)] + [tile_spec] * 4,
+        out_specs=(tile_spec, tile_spec,
+                   pl.BlockSpec((1,), lambda i: (0,),
+                                memory_space=pltpu.SMEM)),
+        out_shape=(jax.ShapeDtypeStruct((n,), x.dtype),
+                   jax.ShapeDtypeStruct((n,), x.dtype),
+                   jax.ShapeDtypeStruct((1,), jnp.float32)),
+        interpret=interpret,
+    )(scal, x, p, r, t)
+    return xn, rn, g[0]
 
 
 @functools.partial(jax.jit, static_argnames=("interpret",))

@@ -237,6 +237,64 @@ def _cg_program(A: DeviceMatrix, b, x0, res_atol, res_rtol, diff_atol,
 
 
 @functools.partial(jax.jit,
+                   static_argnames=("unbounded", "interpret"))
+def _cg_fused_program(A: DeviceMatrix, b, x0, res_atol, res_rtol,
+                      maxits, unbounded: bool, interpret: bool = False):
+    """Whole classic-CG solve with the TWO-PHASE fused iteration
+    (ops.pallas_kernels.cg_phase_a/b): the reference's monolithic
+    device-kernel concept (``cg-kernels-cuda.cu:627-970``) done the TPU
+    way -- each iteration is exactly two streamed kernels with scalars
+    in SMEM, ~15 HBM passes vs the XLA formulation's ~20 (and ~12.5
+    with bf16 planes).  Unlike round 2's single fused kernels, nothing
+    is left outside the kernels for XLA to fuse, so there is no fusion
+    to forfeit.  Scalars are f32 throughout; supports residual criteria
+    (the carried gamma IS the fresh ||r||^2 -- the convergence test is
+    free) but not diff criteria."""
+    from acg_tpu.ops.pallas_kernels import cg_phase_a, cg_phase_b
+
+    dtype = b.dtype
+    sdt = jnp.float32
+    bnrm2 = jnp.sqrt(jnp.dot(b, b, preferred_element_type=sdt))
+    x0nrm2 = jnp.sqrt(jnp.dot(x0, x0, preferred_element_type=sdt))
+    r = b - spmv(A, x0)
+    gamma = jnp.dot(r, r, preferred_element_type=sdt)
+    r0nrm2 = jnp.sqrt(gamma)
+    res_tol = jnp.maximum(res_atol.astype(sdt),
+                          res_rtol.astype(sdt) * r0nrm2)
+    inf = jnp.asarray(jnp.inf, sdt)
+    p0 = jnp.zeros_like(b)
+
+    def body(st):
+        x, r, p, gamma, gamma_prev = st
+        p, t, pdott = cg_phase_a(A.data, A.offsets, r, p, gamma,
+                                 gamma_prev, interpret=interpret)
+        x, r, gamma_next = cg_phase_b(x, p, r, t, gamma, pdott,
+                                      interpret=interpret)
+        return (x, r, p, gamma_next, gamma)
+
+    init = (x0, r, p0, gamma, inf)
+    if unbounded:
+        state = jax.lax.fori_loop(0, maxits, lambda _, s: body(s), init)
+        k, done = maxits, jnp.asarray(True)
+    else:
+        def wcond(carry):
+            k, st, done = carry
+            return (~done) & (k < maxits)
+
+        def wbody(carry):
+            k, st, _ = carry
+            st = body(st)
+            return (k + 1, st, st[3] < res_tol * res_tol)
+
+        k, state, done = jax.lax.while_loop(
+            wcond, wbody, (jnp.int32(0), init, gamma < res_tol * res_tol))
+    x, r_fin, _, gamma_fin, _ = state
+    return CGResult(x=x, niterations=k, rnrm2=jnp.sqrt(gamma_fin),
+                    r0nrm2=r0nrm2, bnrm2=bnrm2, x0nrm2=x0nrm2,
+                    dxnrm2=inf, converged=done)
+
+
+@functools.partial(jax.jit,
                    static_argnames=("unbounded", "needs_diff", "precise",
                                     "kernels"))
 def _cg_pipelined_program(A: DeviceMatrix, b, x0, res_atol, res_rtol,
@@ -341,7 +399,30 @@ class JaxCGSolver:
                        and itemsize in (2, 4) else "xla")
         elif kernels == "pallas" and jax.default_backend() != "tpu":
             kernels = "pallas-interpret"
-        if kernels not in ("xla", "xla-roll", "pallas", "pallas-interpret"):
+        elif kernels in ("fused", "fused-interpret"):
+            from acg_tpu.ops.pallas_kernels import fused_cg_route
+
+            if pipelined:
+                raise ValueError("kernels='fused' implements classic CG "
+                                 "(use the pipelined variant with "
+                                 "kernels='pallas'/'xla')")
+            if precise_dots:
+                raise ValueError("kernels='fused' accumulates its dots "
+                                 "in plain f32 SMEM; compensated dots "
+                                 "(precise_dots) need kernels='xla'/"
+                                 "'pallas'")
+            vdt = (jnp.dtype(vector_dtype) if vector_dtype is not None
+                   else matrix_dtype(A))
+            if not (isinstance(A, DiaMatrix)
+                    and A.ncols_padded == A.nrows
+                    and fused_cg_route(A.offsets, A.nrows, vdt) is not None):
+                raise ValueError("kernels='fused' needs a square DIA "
+                                 "matrix on the single-window kernel "
+                                 "route")
+            if jax.default_backend() != "tpu":
+                kernels = "fused-interpret"
+        if kernels not in ("xla", "xla-roll", "pallas", "pallas-interpret",
+                           "fused", "fused-interpret"):
             raise ValueError(f"unknown kernels choice {kernels!r}")
         self.kernels = kernels
         self.stats = SolverStats(unknowns=A.nrows)
@@ -374,18 +455,31 @@ class JaxCGSolver:
             dtype = jnp.dtype(self.vector_dtype)
         b = jnp.asarray(b, dtype=dtype)
         x0 = jnp.zeros_like(b) if x0 is None else jnp.asarray(x0, dtype=dtype)
-        program = _cg_pipelined_program if self.pipelined else _cg_program
         # tolerances ride in the scalar dtype (f32 for bf16 storage) so a
         # 1e-9 rtol is not pre-rounded to 8 mantissa bits
         sdt = acc_dtype(dtype)
-        args = (self.A, b, x0,
-                jnp.asarray(crit.residual_atol, sdt),
-                jnp.asarray(crit.residual_rtol, sdt),
-                jnp.asarray(crit.diff_atol, sdt),
-                jnp.asarray(crit.diff_rtol, sdt),
-                jnp.int32(crit.maxits))
-        kwargs = dict(unbounded=crit.unbounded, needs_diff=crit.needs_diff,
-                      precise=self.precise_dots, kernels=self.kernels)
+        if self.kernels.startswith("fused"):
+            if crit.needs_diff:
+                raise ValueError("kernels='fused' supports residual "
+                                 "criteria only")
+            program = _cg_fused_program
+            args = (self.A, b, x0,
+                    jnp.asarray(crit.residual_atol, sdt),
+                    jnp.asarray(crit.residual_rtol, sdt),
+                    jnp.int32(crit.maxits))
+            kwargs = dict(unbounded=crit.unbounded,
+                          interpret=self.kernels.endswith("interpret"))
+        else:
+            program = _cg_pipelined_program if self.pipelined else _cg_program
+            args = (self.A, b, x0,
+                    jnp.asarray(crit.residual_atol, sdt),
+                    jnp.asarray(crit.residual_rtol, sdt),
+                    jnp.asarray(crit.diff_atol, sdt),
+                    jnp.asarray(crit.diff_rtol, sdt),
+                    jnp.int32(crit.maxits))
+            kwargs = dict(unbounded=crit.unbounded,
+                          needs_diff=crit.needs_diff,
+                          precise=self.precise_dots, kernels=self.kernels)
         # warmup solves outside the timed region (the reference warms up
         # each op class before timing, cgcuda.c:612-710)
         for _ in range(max(warmup, 0)):
@@ -414,11 +508,20 @@ class JaxCGSolver:
         # vector dtype under --dtype mixed) + per-format index bytes
         mat_dbl = np.dtype(matrix_dtype(self.A)).itemsize
         idx_b = matrix_index_bytes(self.A)
-        st.ops["gemv"].add(niter + 1, 0.0,
-                           int((self._spmv_flops / 3.0) * (mat_dbl + idx_b)
-                               + 2 * n * dbl) * (niter + 1))
-        st.ops["dot"].add(2 * niter, 0.0, 2 * n * dbl * 2 * niter)
-        st.ops["axpy"].add(3 * niter, 0.0, 3 * n * dbl * 3 * niter)
+        mat_bytes = int((self._spmv_flops / 3.0) * (mat_dbl + idx_b))
+        if self.kernels.startswith("fused"):
+            # both dots and all updates are folded into the two streamed
+            # kernels: bill phase A (planes + r/p windows + p/t writes)
+            # as gemv and phase B (4 reads + 2 writes) as axpy; nothing
+            # re-reads vectors for dots
+            st.ops["gemv"].add(niter + 1, 0.0,
+                               (mat_bytes + 4 * n * dbl) * (niter + 1))
+            st.ops["axpy"].add(niter, 0.0, 6 * n * dbl * niter)
+        else:
+            st.ops["gemv"].add(niter + 1, 0.0,
+                               (mat_bytes + 2 * n * dbl) * (niter + 1))
+            st.ops["dot"].add(2 * niter, 0.0, 2 * n * dbl * 2 * niter)
+            st.ops["axpy"].add(3 * niter, 0.0, 3 * n * dbl * 3 * niter)
         if host_result:
             x = np.asarray(res.x)
             st.fexcept_arrays = [x]

@@ -1,0 +1,123 @@
+"""Two-phase fused CG iteration (ops.pallas_kernels.cg_phase_a/b,
+solvers.jax_cg._cg_fused_program, kernels="fused").
+
+The reference's monolithic device-kernel CG
+(``acgsolvercuda_cg_kernel``, ``cg-kernels-cuda.cu:627-970``) done the
+TPU way: each iteration is exactly two streamed Pallas kernels with the
+CG scalars riding SMEM -- the p-update folded into the SpMV's halo
+windows, both dots accumulated in-kernel -- so no XLA fusion is
+forfeited (round 2's single-fused-kernel failure mode) and the
+iteration runs in ~15 HBM passes instead of ~20.
+"""
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+from acg_tpu.io.generators import poisson_dia
+from acg_tpu.ops.pallas_kernels import cg_phase_a, cg_phase_b, fused_cg_route
+from acg_tpu.ops.spmv import DiaMatrix, dia_mv
+from acg_tpu.solvers.jax_cg import JaxCGSolver
+from acg_tpu.solvers.stats import StoppingCriteria
+
+
+def _dia(n=128, dim=2, dtype=jnp.float32):
+    planes, offsets, N = poisson_dia(n, dim, dtype=np.float64)
+    return DiaMatrix(data=tuple(jnp.asarray(p, dtype) for p in planes),
+                     offsets=offsets, nrows=N, ncols_padded=N)
+
+
+def test_phase_a_matches_reference():
+    """p = r + beta p_old, t = A p, (p, t) -- all exact vs the XLA
+    formulation (the kernel computes the same f32 sums)."""
+    A = _dia()
+    N = A.nrows
+    rng = np.random.default_rng(0)
+    r = jnp.asarray(rng.standard_normal(N), jnp.float32)
+    p_old = jnp.asarray(rng.standard_normal(N), jnp.float32)
+    p, t, pdott = cg_phase_a(A.data, A.offsets, r, p_old,
+                             jnp.float32(2.0), jnp.float32(4.0),
+                             interpret=True)
+    p_ref = np.asarray(r) + 0.5 * np.asarray(p_old)
+    t_ref = np.asarray(dia_mv(A.data, A.offsets, N, jnp.asarray(p_ref)))
+    np.testing.assert_array_equal(np.asarray(p), p_ref)
+    np.testing.assert_array_equal(np.asarray(t), t_ref)
+    assert float(pdott) == pytest.approx(float(p_ref @ t_ref), rel=1e-6)
+
+
+def test_phase_a_first_iteration_beta_zero():
+    """gamma_prev = inf must give beta = 0 exactly (p = r)."""
+    A = _dia()
+    N = A.nrows
+    r = jnp.asarray(np.random.default_rng(1).standard_normal(N),
+                    jnp.float32)
+    junk = jnp.full((N,), 1e30, jnp.float32)  # must not leak into p
+    p, t, _ = cg_phase_a(A.data, A.offsets, r, junk,
+                         jnp.float32(3.0), jnp.float32(np.inf),
+                         interpret=True)
+    np.testing.assert_array_equal(np.asarray(p), np.asarray(r))
+
+
+def test_phase_b_matches_reference():
+    N = 16384
+    rng = np.random.default_rng(2)
+    x, p, r, t = (jnp.asarray(rng.standard_normal(N), jnp.float32)
+                  for _ in range(4))
+    xn, rn, g = cg_phase_b(x, p, r, t, jnp.float32(3.0), jnp.float32(1.5),
+                           interpret=True)
+    np.testing.assert_array_equal(np.asarray(xn),
+                                  np.asarray(x) + 2.0 * np.asarray(p))
+    r_ref = np.asarray(r) - 2.0 * np.asarray(t)
+    np.testing.assert_array_equal(np.asarray(rn), r_ref)
+    assert float(g) == pytest.approx(float(r_ref @ r_ref), rel=1e-6)
+
+
+def test_fused_solver_matches_xla():
+    A = _dia()
+    b = np.ones(A.nrows, np.float32)
+    crit = StoppingCriteria(maxits=2000, residual_rtol=1e-6)
+    sf = JaxCGSolver(A, kernels="fused")
+    assert sf.kernels == "fused-interpret"  # off-TPU resolution
+    xf = np.asarray(sf.solve(b, criteria=crit))
+    sx = JaxCGSolver(A, kernels="xla")
+    xx = np.asarray(sx.solve(b, criteria=crit))
+    assert sf.stats.converged and sx.stats.converged
+    # near-stall crossing wobble: counts agree loosely, solutions tightly
+    assert abs(sf.stats.niterations - sx.stats.niterations) \
+        <= 0.3 * sx.stats.niterations
+    assert np.linalg.norm(xf - xx) <= 1e-5 * np.linalg.norm(xx)
+
+
+def test_fused_mixed_bitwise_equals_fused_f32():
+    A32 = _dia(dtype=jnp.float32)
+    A16 = _dia(dtype=jnp.bfloat16)
+    b = np.ones(A32.nrows, np.float32)
+    crit = StoppingCriteria(maxits=300)
+    x32 = np.asarray(JaxCGSolver(A32, kernels="fused")
+                     .solve(b, criteria=crit))
+    xm = np.asarray(JaxCGSolver(A16, kernels="fused",
+                                vector_dtype=jnp.float32)
+                    .solve(b, criteria=crit))
+    assert np.array_equal(x32, xm)
+
+
+def test_fused_rejects_unsupported_shapes():
+    # ragged N (not a multiple of the kernel tile) has no fast route;
+    # the solver must say so instead of miscompiling
+    planes, offsets, N = poisson_dia(90, 2, dtype=np.float64)
+    A = DiaMatrix(data=tuple(jnp.asarray(p, jnp.float32) for p in planes),
+                  offsets=offsets, nrows=N, ncols_padded=N)
+    assert fused_cg_route(offsets, N, jnp.float32) is None
+    with pytest.raises(ValueError, match="fused"):
+        JaxCGSolver(A, kernels="fused")
+    with pytest.raises(ValueError, match="fused"):
+        JaxCGSolver(_dia(), kernels="fused", pipelined=True)
+
+
+def test_fused_rejects_diff_criteria():
+    A = _dia()
+    s = JaxCGSolver(A, kernels="fused")
+    with pytest.raises(ValueError, match="residual"):
+        s.solve(np.ones(A.nrows, np.float32),
+                criteria=StoppingCriteria(maxits=10, diff_atol=1e-3))
