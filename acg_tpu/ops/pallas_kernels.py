@@ -428,9 +428,11 @@ def fused_cg_route(offsets: tuple, n: int, dtype) -> tuple | None:
     the tile), else None.
 
     The tile is grown beyond the SpMV route's choice while VMEM allows:
-    phase A issues its r/p window DMAs synchronously per grid step (no
-    cross-step prefetch), so fewer, larger steps amortise the DMA
-    round-trips (measured: the base 16384 tile loses ~30% to this)."""
+    even with the cross-step double-buffered windows, fewer/larger
+    steps amortise the per-step fixed costs (slot bookkeeping, output
+    tile turnover); the pre-double-buffering measurement (base tile
+    losing ~30% to synchronous DMAs) established the direction and the
+    growth stays beneficial-or-neutral after it."""
     route = dia_spmv_route(offsets, n, dtype)
     if route[0] != "fast":
         return None
@@ -440,8 +442,9 @@ def fused_cg_route(offsets: tuple, n: int, dtype) -> tuple | None:
     budget = 12 * 2 ** 20
 
     def vmem(t):
-        # two windows + double-buffered BlockSpec tiles (planes, p, t)
-        return (2 * (t + Lpad + Rpad) + 2 * (ndiags + 2) * t) * itemsize
+        # 2x double-buffered windows + double-buffered BlockSpec tiles
+        # (planes, p, t)
+        return (4 * (t + Lpad + Rpad) + 2 * (ndiags + 2) * t) * itemsize
 
     while n % (2 * tile) == 0 and vmem(2 * tile) <= budget:
         tile *= 2
@@ -478,23 +481,37 @@ def cg_phase_a(planes, offsets: tuple, r, p_old, gamma, gamma_prev,
     win = tile + Lpad + Rpad
     kadt = acc_dtype(r.dtype)
 
-    def kernel(scal_ref, r_hbm, p_hbm, *plane_refs_and_out):
-        plane_refs = plane_refs_and_out[:-3]
-        p_ref, t_ref, dot_ref = plane_refs_and_out[-3:]
+    ndiags = len(planes)
+
+    def kernel(scal_ref, r_hbm, p_hbm, *rest):
+        plane_refs = rest[:ndiags]
+        p_ref, t_ref, dot_ref = rest[ndiags:ndiags + 3]
+        rwin_a, rwin_b, pwin_a, pwin_b, sems = rest[ndiags + 3:]
+        rwins, pwins = (rwin_a, rwin_b), (pwin_a, pwin_b)
         i = pl.program_id(0)
         beta = (scal_ref[0, 0] / scal_ref[0, 1]).astype(r.dtype)
 
-        def body(rwin, pwin, sems):
-            # six DMAs (body + left/right halo for r and p_old), all
-            # started before any wait so they overlap
-            pairs = [
-                _window_copies(hbm, wref, sems, s0, i, grid, tile,
-                               Lpad, Rpad, align, r.dtype)
-                for hbm, wref, s0 in ((r_hbm, rwin, 0), (p_hbm, pwin, 3))]
-            for start, _ in pairs:
-                start()
-            for _, wait in pairs:
-                wait()
+        # DOUBLE-BUFFERED windows: scratch_shapes persist across the
+        # (strictly sequential) TPU grid steps, so step i's compute
+        # overlaps step i+1's window DMAs -- the cross-step prefetch
+        # Mosaic gives BlockSpec operands, hand-rolled for the halo
+        # windows.  Slot selection is static via even/odd duplication;
+        # slot s uses semaphores sems[s*6 : s*6+6].
+        def starts(step, slot):
+            for hbm, wref, s0 in ((r_hbm, rwins[slot], slot * 6),
+                                  (p_hbm, pwins[slot], slot * 6 + 3)):
+                st, _ = _window_copies(hbm, wref, sems, s0, step, grid,
+                                       tile, Lpad, Rpad, align, r.dtype)
+                st()
+
+        def waits(step, slot):
+            for hbm, wref, s0 in ((r_hbm, rwins[slot], slot * 6),
+                                  (p_hbm, pwins[slot], slot * 6 + 3)):
+                _, wt = _window_copies(hbm, wref, sems, s0, step, grid,
+                                       tile, Lpad, Rpad, align, r.dtype)
+                wt()
+
+        def compute(rwin, pwin):
             # p over the whole window (halo positions recomputed from
             # the r/p_old windows -- the deferred-p-update trick).
             # pw is a VALUE; offsets are static, so plain slices compile
@@ -507,19 +524,30 @@ def cg_phase_a(planes, offsets: tuple, r, p_old, gamma, gamma_prev,
             p_body = pw[Lpad:Lpad + tile]
             p_ref[:] = p_body
             t_ref[:] = acc.astype(r.dtype)
-            partial = jnp.sum(acc * p_body.astype(kadt))
+            return jnp.sum(acc * p_body.astype(kadt))
 
-            @pl.when(i == 0)
-            def _():
-                dot_ref[0] = partial
+        @pl.when(i == 0)
+        def _():
+            starts(i, 0)
 
-            @pl.when(i > 0)
-            def _():
-                dot_ref[0] += partial
+        for parity in (0, 1):
+            @pl.when((i % 2 == parity) & (i < grid - 1))
+            def _(parity=parity):
+                starts(i + 1, 1 - parity)
 
-        pl.run_scoped(body, pltpu.VMEM((win,), r.dtype),
-                      pltpu.VMEM((win,), r.dtype),
-                      pltpu.SemaphoreType.DMA((6,)))
+        for parity in (0, 1):
+            @pl.when(i % 2 == parity)
+            def _(parity=parity):
+                waits(i, parity)
+                partial = compute(rwins[parity], pwins[parity])
+
+                @pl.when(i == 0)
+                def _():
+                    dot_ref[0] = partial
+
+                @pl.when(i > 0)
+                def _():
+                    dot_ref[0] += partial
 
     tile_spec = pl.BlockSpec((tile,), lambda i: (i,),
                              memory_space=pltpu.VMEM)
@@ -539,6 +567,11 @@ def cg_phase_a(planes, offsets: tuple, r, p_old, gamma, gamma_prev,
         out_shape=(jax.ShapeDtypeStruct((n,), r.dtype),
                    jax.ShapeDtypeStruct((n,), r.dtype),
                    jax.ShapeDtypeStruct((1,), jnp.float32)),
+        scratch_shapes=[pltpu.VMEM((win,), r.dtype),
+                        pltpu.VMEM((win,), r.dtype),
+                        pltpu.VMEM((win,), r.dtype),
+                        pltpu.VMEM((win,), r.dtype),
+                        pltpu.SemaphoreType.DMA((12,))],
         interpret=interpret,
     )(scal, r, p_old, *planes)
     return p, t, d[0]

@@ -9,6 +9,9 @@ whole-solve A/Bs on the flagship config:
 
   * classic CG: pallas dia_spmv tier vs xla tier
   * classic CG: fused dia_spmv_dot in-loop vs pallas-SpMV + XLA dot
+  * classic CG: the two-phase fused iteration (kernels="fused"), f32
+    and mixed, vs the xla tier -- the verdict BASELINE.md defers to
+    this harness
   * pipelined CG: fused 6-vector pallas update vs XLA fusion
   * storage tiers: f32 vs mixed vs bf16 (xla tier)
 
@@ -107,6 +110,15 @@ def main(argv=None) -> int:
        lambda: JaxCGSolver(As["bf16"], kernels="xla"),
        lambda: JaxCGSolver(As["f32"], kernels="xla"),
        "bf16", "f32")
+    ab("fused_vs_xla_classic",
+       lambda: JaxCGSolver(As["f32"], kernels="fused"),
+       lambda: JaxCGSolver(As["f32"], kernels="xla"),
+       "fused", "xla")
+    ab("mixed_fused_vs_xla_classic",
+       lambda: JaxCGSolver(As["bf16"], kernels="fused",
+                           vector_dtype=np.float32),
+       lambda: JaxCGSolver(As["f32"], kernels="xla"),
+       "mixed_fused", "xla")
     ab("pipelined_pallas_update_vs_xla",
        lambda: _fused_update_solver(As["f32"]),
        lambda: JaxCGSolver(As["f32"], pipelined=True, kernels="xla"),
