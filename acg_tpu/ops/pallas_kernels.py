@@ -215,19 +215,22 @@ def _window_copies(hbm, wref, sems, s0: int, i, grid: int, tile: int,
     ``sems[s0:s0+3]``.  Shared by the single-x-pass SpMV kernels and the
     fused CG phase A, so the subtle Mosaic DMA logic (alignment proofs,
     edge fills) lives once."""
+    # int32-explicit semaphore indices: under jax_enable_x64 a Python
+    # int traces as an i64 constant, which tpu.memref_slice rejects
+    sem = [jnp.int32(s0 + k) for k in range(3)]
     body_cp = pltpu.make_async_copy(
         hbm.at[pl.ds(pl.multiple_of(i * tile, align), tile)],
-        wref.at[pl.ds(Lpad, tile)], sems.at[s0])
+        wref.at[pl.ds(Lpad, tile)], sems.at[sem[0]])
 
     def _left_cp():
         return pltpu.make_async_copy(
             hbm.at[pl.ds(pl.multiple_of(i * tile - Lpad, align), Lpad)],
-            wref.at[pl.ds(0, Lpad)], sems.at[s0 + 1])
+            wref.at[pl.ds(0, Lpad)], sems.at[sem[1]])
 
     def _right_cp():
         return pltpu.make_async_copy(
             hbm.at[pl.ds(pl.multiple_of((i + 1) * tile, align), Rpad)],
-            wref.at[pl.ds(Lpad + tile, Rpad)], sems.at[s0 + 2])
+            wref.at[pl.ds(Lpad + tile, Rpad)], sems.at[sem[2]])
 
     def start():
         body_cp.start()
@@ -526,17 +529,21 @@ def cg_phase_a(planes, offsets: tuple, r, p_old, gamma, gamma_prev,
             t_ref[:] = acc.astype(r.dtype)
             return jnp.sum(acc * p_body.astype(kadt))
 
+        # int32-explicit modulo: under jax_enable_x64 a plain `i % 2`
+        # promotes through int64, which Mosaic cannot lower
+        par = jax.lax.rem(i, jnp.int32(2))
+
         @pl.when(i == 0)
         def _():
             starts(i, 0)
 
         for parity in (0, 1):
-            @pl.when((i % 2 == parity) & (i < grid - 1))
+            @pl.when((par == jnp.int32(parity)) & (i < grid - 1))
             def _(parity=parity):
                 starts(i + 1, 1 - parity)
 
         for parity in (0, 1):
-            @pl.when(i % 2 == parity)
+            @pl.when(par == jnp.int32(parity))
             def _(parity=parity):
                 waits(i, parity)
                 partial = compute(rwins[parity], pwins[parity])

@@ -421,6 +421,12 @@ class JaxCGSolver:
                                  "route")
             if jax.default_backend() != "tpu":
                 kernels = "fused-interpret"
+            elif jax.config.jax_enable_x64:
+                # Mosaic lowers x64-mode index maps as i64, which the
+                # TPU memref ops reject; compiled Pallas needs x64 off
+                raise ValueError("kernels='fused' cannot compile with "
+                                 "jax_enable_x64 on TPU; disable x64 "
+                                 "or use kernels='xla'")
         if kernels not in ("xla", "xla-roll", "pallas", "pallas-interpret",
                            "fused", "fused-interpret"):
             raise ValueError(f"unknown kernels choice {kernels!r}")

@@ -93,12 +93,31 @@ def test_phase_a_multi_tile_grid():
 
 
 def test_fused_solver_matches_xla_multi_tile():
-    """Whole fused solve on a multi-tile grid agrees with XLA."""
-    A = _dia(n=512, dim=2)
+    """Whole fused solve on a multi-tile grid agrees with XLA.
+
+    On a WELL-CONDITIONED matrix (diagonal shift -> kappa ~ 9): at the
+    flagship's kappa ~ 1e5, any two f32 CG implementations legitimately
+    diverge by percents at fixed iteration counts (dot summation order
+    alone; measured: the fused tier tracks an f64 reference to 5e-7
+    where the XLA tier sits at 2.6% after 8 iterations), so unshifted
+    mid-convergence iterates are not comparable.  Shifted, both
+    converge and the solutions must agree tightly; the per-kernel
+    multi-tile test above pins the kernels bitwise."""
+    base = _dia(n=512, dim=2)
+    d = base.offsets.index(0)
+    planes = list(base.data)
+    planes[d] = planes[d] + jnp.float32(2.0)   # A + 2I: kappa ~ 9/2
+    from acg_tpu.ops.spmv import DiaMatrix
+    A = DiaMatrix(data=tuple(planes), offsets=base.offsets,
+                  nrows=base.nrows, ncols_padded=base.ncols_padded)
     b = np.ones(A.nrows, np.float32)
-    crit = StoppingCriteria(maxits=60)
-    xf = np.asarray(JaxCGSolver(A, kernels="fused").solve(b, criteria=crit))
-    xx = np.asarray(JaxCGSolver(A, kernels="xla").solve(b, criteria=crit))
+    crit = StoppingCriteria(maxits=500, residual_rtol=1e-6)
+    sf = JaxCGSolver(A, kernels="fused")
+    xf = np.asarray(sf.solve(b, criteria=crit))
+    sx = JaxCGSolver(A, kernels="xla")
+    xx = np.asarray(sx.solve(b, criteria=crit))
+    assert sf.stats.converged and sx.stats.converged
+    assert abs(sf.stats.niterations - sx.stats.niterations) <= 2
     assert np.linalg.norm(xf - xx) <= 1e-5 * np.linalg.norm(xx)
 
 
