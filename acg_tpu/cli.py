@@ -117,13 +117,16 @@ def make_parser() -> argparse.ArgumentParser:
                         "~1/u_bf16 ~ 500 -- use for well-conditioned "
                         "systems or throughput measurement")
     p.add_argument("--kernels", default="auto",
-                   choices=["auto", "xla", "pallas"],
+                   choices=["auto", "xla", "pallas", "fused"],
                    help="hot-loop kernel tier: xla = compiler-fused ops, "
                         "pallas = hand-written single-x-pass DIA SpMV "
                         "(the reference's cg-kernels-cuda.cu tier; vector "
-                        "updates stay in XLA -- see BASELINE.md); auto "
-                        "picks pallas on TPU hardware for DIA matrices "
-                        "and DIA local blocks of the multi-part path")
+                        "updates stay in XLA -- see BASELINE.md); fused = "
+                        "the two-phase whole-iteration kernel pair (the "
+                        "monolithic device-kernel analog; classic CG on "
+                        "single-window DIA shapes only); auto picks "
+                        "pallas on TPU hardware for DIA matrices and DIA "
+                        "local blocks of the multi-part path")
     p.add_argument("--spmv-format", default="auto",
                    choices=["auto", "dia", "ell", "coo"],
                    help="force the device sparse format for the "
@@ -400,12 +403,12 @@ def _solve_generated_sharded(args, dim, n, N, jax, jnp, dtype,
     from acg_tpu.parallel.sharded_dia import build_sharded_poisson_solver
     from acg_tpu.solvers import StoppingCriteria
 
-    if args.kernels.startswith("pallas"):
+    if args.kernels in ("pallas", "fused"):
         raise SystemExit(
             "acg-tpu: the sharded direct-assembly path pins the SpMV to "
-            "the partitioner-friendly roll formulation; --kernels pallas "
-            "is not available here (use --nparts 1 without "
-            "--manufactured-solution for the Pallas tier)")
+            "the partitioner-friendly roll formulation; --kernels "
+            f"{args.kernels} is not available here (use --nparts 1 "
+            "without --manufactured-solution for the kernel tiers)")
 
     nparts = args.nparts or len(jax.devices())
     t0 = time.perf_counter()

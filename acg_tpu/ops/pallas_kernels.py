@@ -308,7 +308,7 @@ def _dia_spmv_clustered(planes, offsets, x, central, far, Lpad, Rpad,
                     pltpu.make_async_copy(
                         x_hbm.at[pl.ds(
                             pl.multiple_of(src * tile, align), tile)],
-                        fwin, sems.at[3 + f]).start()
+                        fwin, sems.at[jnp.int32(3 + f)]).start()
 
                 @pl.when((src < 0) | (src >= grid))
                 def _(fwin=fwin):
@@ -321,7 +321,7 @@ def _dia_spmv_clustered(planes, offsets, x, central, far, Lpad, Rpad,
                     pltpu.make_async_copy(
                         x_hbm.at[pl.ds(
                             pl.multiple_of(src * tile, align), tile)],
-                        fwin, sems.at[3 + f]).wait()
+                        fwin, sems.at[jnp.int32(3 + f)]).wait()
             wait()
             # sub-f32 storage accumulates in f32: the converts are free
             # on the VPU, VMEM/HBM stay half-width

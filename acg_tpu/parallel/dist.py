@@ -390,6 +390,9 @@ class DistCGSolver:
                        and problem.local.format == "dia" else "xla")
         elif kernels == "pallas" and self._interpret:
             kernels = "pallas-interpret"
+        elif kernels.startswith("fused"):
+            raise ValueError("kernels='fused' is single-device only; the "
+                             "distributed path uses 'xla' or 'pallas'")
         if kernels not in ("xla", "pallas", "pallas-interpret"):
             raise ValueError(f"unknown kernels choice {kernels!r}")
         self.kernels = kernels

@@ -399,6 +399,12 @@ class JaxCGSolver:
                        and itemsize in (2, 4) else "xla")
         elif kernels == "pallas" and jax.default_backend() != "tpu":
             kernels = "pallas-interpret"
+        elif kernels == "pallas" and jax.config.jax_enable_x64:
+            # Mosaic lowers x64-mode BlockSpec index maps as i64, which
+            # the TPU memref ops reject: compiled Pallas needs x64 off
+            raise ValueError("kernels='pallas' cannot compile with "
+                             "jax_enable_x64 on TPU; disable x64 or use "
+                             "kernels='xla'")
         elif kernels in ("fused", "fused-interpret"):
             from acg_tpu.ops.pallas_kernels import fused_cg_route
 
@@ -421,9 +427,10 @@ class JaxCGSolver:
                                  "route")
             if jax.default_backend() != "tpu":
                 kernels = "fused-interpret"
-            elif jax.config.jax_enable_x64:
+            elif kernels == "fused" and jax.config.jax_enable_x64:
                 # Mosaic lowers x64-mode index maps as i64, which the
                 # TPU memref ops reject; compiled Pallas needs x64 off
+                # (explicit 'fused-interpret' never compiles -> exempt)
                 raise ValueError("kernels='fused' cannot compile with "
                                  "jax_enable_x64 on TPU; disable x64 "
                                  "or use kernels='xla'")

@@ -225,6 +225,18 @@ def test_cli_gen_spec_standard_pipeline():
     assert err < 1e-6
 
 
+def test_cli_kernels_fused():
+    """--kernels fused runs the two-phase iteration end-to-end from the
+    CLI on a single-window DIA shape (gen 2D Poisson n=128 -> N=16384 =
+    one kernel tile)."""
+    r = run_cli("acg_tpu.cli",
+                ["gen:poisson2d:128", "--comm", "none", "--kernels",
+                 "fused", "--dtype", "f32", "--max-iterations", "2000",
+                 "--residual-rtol", "1e-6", "--warmup", "0", "--quiet"])
+    assert r.returncode == 0, r.stderr
+    assert "total solver time" in r.stderr
+
+
 def test_cli_gen_spec_direct_device_path():
     """Above the size threshold, gen:poisson specs assemble DIA planes
     on device with no host matrix at all (the 512^3 route; threshold

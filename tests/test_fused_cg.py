@@ -107,7 +107,6 @@ def test_fused_solver_matches_xla_multi_tile():
     d = base.offsets.index(0)
     planes = list(base.data)
     planes[d] = planes[d] + jnp.float32(2.0)   # A + 2I: kappa ~ 9/2
-    from acg_tpu.ops.spmv import DiaMatrix
     A = DiaMatrix(data=tuple(planes), offsets=base.offsets,
                   nrows=base.nrows, ncols_padded=base.ncols_padded)
     b = np.ones(A.nrows, np.float32)
