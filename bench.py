@@ -171,10 +171,12 @@ def _build(side: int, dim: int):
 
 
 # longest single device program we let the timing loop launch: the
-# tunneled chip kills long-running programs (observed: a ~50s COO solve
-# dies with "UNAVAILABLE: TPU device error" while the same program at
-# 1/5 the trip count runs fine)
-MAX_PROGRAM_SECONDS = 25.0
+# tunneled chip kills long-running programs (observed round 2: a ~50s
+# COO solve dies with "UNAVAILABLE: TPU device error"; round 3: a
+# program SIZED to 25s from its warmup estimate died when contention
+# stretched it further -- so budget half of the observed kill threshold
+# to leave contention headroom)
+MAX_PROGRAM_SECONDS = 12.0
 
 
 def _time_solver(solver, b, criteria_cls, repeats: int = TIMED_REPEATS,
