@@ -51,8 +51,11 @@ def test_time_solver_reduces_trip_count_for_slow_configs():
     tsolve, maxits = bench._time_solver(s, None, FakeCriteria, repeats=2)
     assert maxits < bench.MAXITS
     assert maxits >= 100
-    # the timed program stays under the watchdog
-    assert 0.13 * maxits <= bench.MAX_PROGRAM_SECONDS * 1.01
+    # the timed program stays under the budget OR at the 100-iteration
+    # floor (very slow configs keep 100 its so iters/s stays meaningful,
+    # accepting the watchdog risk for that one class)
+    budget_its = max(100, int(bench.MAX_PROGRAM_SECONDS / 0.13))
+    assert maxits == budget_its
     # iters/s is trip-count-invariant
     assert maxits / tsolve == pytest.approx(1 / 0.13)
 
