@@ -160,6 +160,17 @@ def make_parser() -> argparse.ArgumentParser:
                    help="total controller processes (with --coordinator)")
     p.add_argument("--process-id", type=int, default=None, metavar="I",
                    help="this controller's index (with --coordinator)")
+    p.add_argument("--distributed-read", action="store_true",
+                   help="pod-scale ingest: each controller RANGE-READS "
+                        "only its own rows from a row-sorted full-"
+                        "storage binary file (mtx2bin --expand output; "
+                        "requires --binary) and builds only its own "
+                        "subdomains -- I/O, host memory and "
+                        "preprocessing are O(local nnz) per controller "
+                        "(the role of the reference's root-read + "
+                        "subgraph scatter, graph.c:1529-1897, without "
+                        "the root).  Uses a contiguous equal-rows band "
+                        "partition")
     p.add_argument("--err-timeout", type=float, default=120.0,
                    metavar="SECONDS",
                    help="multi-controller error-agreement watchdog: how "
@@ -387,6 +398,124 @@ def _checkpoint(args, stage: str, code: int = 0) -> int:
     return agree_status(code, what=stage, timeout=args.err_timeout)
 
 
+def _solve_distributed_read(args, jax, jnp, dtype, vec_dtype) -> int:
+    """The --distributed-read pipeline: range-read ingest, local
+    subdomain construction, distributed solve.  Kept separate from the
+    replicated-read pipeline because its stages are per-controller-local
+    by design (no full matrix exists anywhere to share code with)."""
+    from acg_tpu.errors import AcgError, NotConvergedError
+    from acg_tpu.io.mtxfile import vector_mtx, write_mtx
+    from acg_tpu.parallel.dist import DistCGSolver, DistributedProblem
+    from acg_tpu.parallel.multihost import is_primary
+    from acg_tpu.solvers import StoppingCriteria
+
+    unsupported = [flag for flag, on in [
+        ("a gen: spec (use the sharded direct path)",
+         args.A.startswith("gen:")),
+        ("text input (needs --binary; see mtx2bin --expand)",
+         not args.binary),
+        (f"--solver {args.solver}",
+         args.solver in ("host", "host-native", "petsc")),
+        ("b/x0 input files", bool(args.b or args.x0)),
+        ("--refine", args.refine),
+        ("--partition FILE", args.partition is not None),
+        ("--output-comm-matrix", args.output_comm_matrix),
+        ("--profile-ops", args.profile_ops is not None),
+        ("--comm dma", args.comm in ("dma", "nvshmem")),
+    ] if on]
+    if unsupported:
+        raise SystemExit(
+            f"acg-tpu: --distributed-read does not support: "
+            f"{', '.join(unsupported)}")
+
+    nparts = args.nparts or len(jax.devices())
+    # two-phase ingest: the host-local reads (phase 1) are the stage
+    # where one controller can fail alone, and they are checkpointed
+    # BEFORE the uniform-shape allgather of phase 2 -- a failed peer
+    # must never leave the others blocked in a mismatched collective
+    ingest_rc = 0
+    state = None
+    try:
+        t0 = time.perf_counter()
+        state = DistributedProblem.read_local_subdomains(args.A, nparts)
+        _log(args, f"range-read + local build ({len(state[3])} of "
+                   f"{nparts} parts on this controller):", t0)
+    except (AcgError, OSError, SystemExit) as e:
+        sys.stderr.write(f"acg-tpu: {e}\n")
+        ingest_rc = 1
+    rc = _checkpoint(args, "ingest", ingest_rc)
+    if rc:
+        if not ingest_rc:
+            sys.stderr.write("acg-tpu: aborting: a peer controller failed "
+                             "during ingest\n")
+        return rc
+    subs, bounds, n_rows, owned = state
+    prob = DistributedProblem.assemble_local(
+        subs, bounds, n_rows, nparts, owned, dtype=dtype,
+        vector_dtype=vec_dtype)
+
+    n = prob.n
+    rng = np.random.default_rng(args.seed)
+    xsol = None
+    if args.manufactured_solution:
+        # identical seed -> identical xsol on every controller; b = A xsol
+        # assembled from the LOCAL blocks only (the distributed host
+        # SpMV, computed per-part: b_p = A_local x_owned + A_ghost x_ghost)
+        xsol = rng.standard_normal(n)
+        xsol /= np.linalg.norm(xsol)
+        b = np.zeros(n)
+        for p in prob.owned_parts:
+            s = prob.subs[p]
+            lo, hi = prob.band_bounds[p], prob.band_bounds[p + 1]
+            bp = s.A_local @ xsol[lo:hi]
+            if s.nghost:
+                bp = bp + s.A_ghost @ xsol[s.global_ids[s.nowned:]]
+            b[lo:hi] = bp
+        # b needs only the owned slices: scatter() reads owned parts only
+    else:
+        b = np.ones(n)
+
+    criteria = StoppingCriteria(
+        maxits=args.max_iterations,
+        residual_atol=args.residual_atol, residual_rtol=args.residual_rtol,
+        diff_atol=args.diff_atol, diff_rtol=args.diff_rtol)
+    solver = DistCGSolver(prob, pipelined="pipelined" in args.solver,
+                          precise_dots=args.precise_dots,
+                          kernels=args.kernels)
+    t0 = time.perf_counter()
+    if args.trace:
+        jax.profiler.start_trace(args.trace)
+    try:
+        x = solver.solve(b, criteria=criteria, warmup=args.warmup)
+    except NotConvergedError as e:
+        sys.stderr.write(f"acg-tpu: {e}\n")
+        if is_primary():
+            solver.stats.fwrite(sys.stderr)
+        _checkpoint(args, "solve", 1)
+        return 1
+    finally:
+        if args.trace:
+            jax.profiler.stop_trace()
+    _log(args, "solve:", t0)
+    rc = _checkpoint(args, "solve", 0)
+    if rc:
+        sys.stderr.write("acg-tpu: aborting: a peer controller failed "
+                         "during the solve\n")
+        return rc
+
+    if not is_primary():
+        return 0
+    solver.stats.fwrite(sys.stderr)
+    if xsol is not None:
+        err0 = np.linalg.norm(xsol)
+        err = np.linalg.norm(x - xsol)
+        sys.stderr.write(f"initial error 2-norm: {err0:.15g}\n")
+        sys.stderr.write(f"error 2-norm: {err:.15g}\n")
+    if not args.quiet:
+        write_mtx(sys.stdout.buffer, vector_mtx(x), numfmt=args.numfmt)
+    return 0
+
+
 def _solve_generated_sharded(args, dim, n, N, jax, jnp, dtype,
                              vec_dtype) -> int:
     """Sharded gen-direct path: assembly and solve over the device mesh
@@ -541,6 +670,9 @@ def _main(args) -> int:
         for d in jax.devices():
             _log(args, f"device {d.id}: {d.platform} {d.device_kind} "
                        f"(process {d.process_index})")
+
+    if args.distributed_read:
+        return _solve_distributed_read(args, jax, jnp, dtype, vec_dtype)
 
     # stages 1-4 under the ingest error-agreement guard: these are
     # the host-local stages (file I/O, partitioning) where one

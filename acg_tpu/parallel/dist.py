@@ -114,12 +114,90 @@ class StackedGhostBlock:
             contrib, indices_are_sorted=True)
 
 
+@dataclasses.dataclass(frozen=True)
+class UniformShapes:
+    """Mesh-uniform sizing agreed across controllers for the LOCAL-READ
+    flow (each controller sees only its own parts): the union DIA offset
+    set (or None -> ELL), padded widths, and halo maxima.  The analog of
+    the reference's max-allreduce symmetric-buffer sizing
+    (``halo.c:883-887``), computed by one small allgather."""
+
+    offsets: tuple | None   # DIA offsets union, or None for the ELL path
+    Kl: int                 # max local-block row width
+    bmax: int               # max coupled (border) rows per part
+    Kg: int                 # max ghost-block row width
+    maxcnt: int             # max per-neighbour halo send count
+    nmax_ghost: int         # max ghost count per part
+    nnz_total: int
+    halo_send_total: int = 0   # sum of per-part halo send entries
+
+
+def _agree_uniform_shapes(subs_owned, nparts: int,
+                          max_diags: int = 80,
+                          dia_waste_limit: float = 3.0,
+                          nmax_owned: int = 0) -> UniformShapes:
+    """Compute this controller's local stats and allgather-max/union them
+    so every controller derives the IDENTICAL stacked shapes.  The
+    payload is one fixed-size int64 vector per process."""
+    import jax
+
+    offs = np.unique(np.concatenate(
+        [csr_diag_offsets(s.A_local) for s in subs_owned]
+        or [np.zeros(0, np.int64)]))
+    Kl = max((int(np.diff(s.A_local.indptr).max(initial=0))
+              for s in subs_owned), default=0)
+    bmax = max((int(np.count_nonzero(np.diff(s.A_ghost.indptr)))
+                for s in subs_owned), default=0)
+    Kg = max((int(np.diff(s.A_ghost.indptr).max(initial=0))
+              for s in subs_owned), default=0)
+    maxcnt = max((int(c) for s in subs_owned for c in s.halo.send_counts),
+                 default=0)
+    nmax_ghost = max((s.nghost for s in subs_owned), default=0)
+    nnz = sum(int(s.A_local.nnz + s.A_ghost.nnz) for s in subs_owned)
+    send_total = sum(int(s.halo.total_send) for s in subs_owned)
+    cap = 2 * max_diags
+    too_many = offs.size > cap
+    payload = np.full(cap + 8, np.iinfo(np.int64).min, dtype=np.int64)
+    payload[:min(offs.size, cap)] = offs[:cap]
+    payload[cap:cap + 8] = (offs.size if not too_many else cap + 1,
+                            Kl, bmax, Kg, maxcnt, nmax_ghost, nnz,
+                            send_total)
+    if jax.process_count() > 1:
+        from jax.experimental import multihost_utils
+
+        gathered = np.asarray(
+            multihost_utils.process_allgather(payload, tiled=False))
+    else:
+        gathered = payload[None]
+    all_offs = np.unique(np.concatenate(
+        [g[:cap][g[:cap] != np.iinfo(np.int64).min] for g in gathered]
+        or [np.zeros(0, np.int64)]))
+    counts = gathered[:, cap]
+    Kl = int(gathered[:, cap + 1].max())
+    bmax = int(gathered[:, cap + 2].max())
+    Kg = int(gathered[:, cap + 3].max())
+    maxcnt = int(gathered[:, cap + 4].max())
+    nmax_ghost = int(gathered[:, cap + 5].max())
+    nnz_total = int(gathered[:, cap + 6].sum())
+    halo_send_total = int(gathered[:, cap + 7].sum())
+    dia_ok = (not (counts > cap).any() and all_offs.size <= max_diags
+              and nnz_total
+              and (all_offs.size * nmax_owned * nparts
+                   <= dia_waste_limit * nnz_total))
+    return UniformShapes(
+        offsets=tuple(int(o) for o in all_offs) if dia_ok else None,
+        Kl=Kl, bmax=bmax, Kg=Kg, maxcnt=maxcnt, nmax_ghost=nmax_ghost,
+        nnz_total=nnz_total, halo_send_total=halo_send_total)
+
+
 def _stack_local_blocks(subs, nmax_owned: int, dtype,
                         max_diags: int = 80,  # headroom over spmv.MAX_DIAGS:
                         # the union of per-part offset sets can exceed any
                         # single part's diagonal count
                         dia_waste_limit: float = 3.0,
-                        global_csr=None) -> StackedLocalBlock:
+                        global_csr=None,
+                        uniform: UniformShapes | None = None
+                        ) -> StackedLocalBlock:
     """Stacked arrays are HOST numpy (calloc-backed zeros, filled only
     for parts whose blocks exist): non-owned parts of a multi-controller
     build never touch their pages, so host RSS is O(owned/P); the device
@@ -132,7 +210,16 @@ def _stack_local_blocks(subs, nmax_owned: int, dtype,
     blocks = [s.A_local for s in subs]
     built = [b for b in blocks if b is not None]
     npdtype = np.dtype(dtype)
-    if global_csr is not None:
+    if uniform is not None:
+        # local-read flow: shapes pre-agreed across controllers
+        if uniform.offsets is not None:
+            offs = np.asarray(uniform.offsets, dtype=np.int64)
+            nnz = uniform.nnz_total
+        else:
+            offs = np.zeros(0, np.int64)
+            nnz = 0  # force the ELL path
+        Kl = uniform.Kl
+    elif global_csr is not None:
         # restricted build: the local blocks of OTHER controllers are
         # invisible, so the mesh-uniform offset set must be derivable
         # from global structure alone.  That is only sound when every
@@ -181,15 +268,21 @@ def _stack_local_blocks(subs, nmax_owned: int, dtype,
 
 
 def _stack_ghost_blocks(subs, nmax_owned: int, dtype,
-                        global_csr=None) -> StackedGhostBlock:
+                        global_csr=None,
+                        uniform: UniformShapes | None = None
+                        ) -> StackedGhostBlock:
     """Host-numpy ghost blocks (see ``_stack_local_blocks``); with
     restricted builds the uniform bmax/Kg bounds come from the global
     structure (border counts are known for every part; the global max
-    row length bounds any ghost row's length)."""
+    row length bounds any ghost row's length) or the pre-agreed
+    ``uniform`` shapes (local-read flow)."""
     npdtype = np.dtype(dtype)
     coupled = [None if s.A_ghost is None
                else np.flatnonzero(np.diff(s.A_ghost.indptr)) for s in subs]
-    if global_csr is not None:
+    if uniform is not None:
+        bmax = uniform.bmax or 1
+        Kg = uniform.Kg or 1
+    elif global_csr is not None:
         bmax = max((s.nborder for s in subs), default=0) or 1
         Kg = int(np.diff(global_csr.indptr).max(initial=0)) or 1
     else:
@@ -243,6 +336,10 @@ class DistributedProblem:
     # scatter() only fills these, matching the device shards this
     # process can address
     owned_parts: tuple | None = None
+    # contiguous band boundaries (nparts+1) in the local-read flow:
+    # lets gather()/scatter() use analytic global ids where non-owned
+    # parts are stubs without them
+    band_bounds: tuple | None = None
 
     @classmethod
     def build(cls, full_csr, part, nparts: int, dtype=jnp.float32,
@@ -279,6 +376,97 @@ class DistributedProblem:
                    owned_parts=None if owned_parts is None
                    else tuple(int(p) for p in owned_parts))
 
+    @staticmethod
+    def read_local_subdomains(path, nparts: int, mesh=None, bounds=None):
+        """Phase 1 of the local-read flow: the HOST-LOCAL part (header
+        read, per-part range reads, subdomain construction) with NO
+        collectives -- so a one-sided I/O failure can be error-agreed at
+        a checkpoint before any controller enters the shape allgather of
+        :meth:`assemble_local` (mismatched collectives would otherwise
+        cross-match and hang).  Returns ``(subs, bounds, n, owned)``."""
+        from acg_tpu.errors import AcgError, ErrorCode
+        from acg_tpu.graph import BandStub, subdomain_from_row_slice
+        from acg_tpu.io.mtxfile import read_mtx_row_range, read_mtx_sizes
+
+        n, _, _ = read_mtx_sizes(path)
+        if bounds is None:
+            bounds = np.linspace(0, n, nparts + 1).astype(np.int64)
+        bounds = np.asarray(bounds, dtype=np.int64)
+        if mesh is None:
+            mesh = solve_mesh(nparts)
+        pi = jax.process_index()
+        owned = tuple(p for p in range(nparts)
+                      if mesh.devices.flat[p].process_index == pi)
+        subs: list = [None] * nparts
+        for p in range(nparts):
+            if p in owned:
+                sl = read_mtx_row_range(path, int(bounds[p]),
+                                        int(bounds[p + 1]))
+                if sl.symmetry != "general":
+                    raise AcgError(
+                        ErrorCode.NOT_SUPPORTED,
+                        f"{path}: range reads need FULL storage "
+                        f"(symmetry 'general'); this file declares "
+                        f"{sl.symmetry!r} -- regenerate with "
+                        f"mtx2bin --expand")
+                r, c, v = sl.to_coo()
+                subs[p] = subdomain_from_row_slice(r, c, v, bounds, p)
+            else:
+                subs[p] = BandStub(part=p,
+                                   nowned_=int(bounds[p + 1] - bounds[p]))
+        return subs, bounds, n, owned
+
+    @classmethod
+    def assemble_local(cls, subs, bounds, n: int, nparts: int,
+                       owned, dtype=jnp.float32,
+                       vector_dtype=None) -> "DistributedProblem":
+        """Phase 2 of the local-read flow: the COLLECTIVE part (uniform-
+        shape allgather) plus stacking.  Call only after all controllers
+        passed phase 1 (checkpointed)."""
+        bounds = np.asarray(bounds, dtype=np.int64)
+        nmax_owned = int(np.max(np.diff(bounds)))
+        uniform = _agree_uniform_shapes([subs[p] for p in owned], nparts,
+                                        nmax_owned=nmax_owned)
+        halo = build_device_halo(subs, maxcnt=uniform.maxcnt,
+                                 nmax_ghost=uniform.nmax_ghost)
+        local = _stack_local_blocks(subs, nmax_owned, dtype, uniform=uniform)
+        ghost = _stack_ghost_blocks(subs, nmax_owned, dtype, uniform=uniform)
+        prob = cls(nparts=nparts, n=n, subs=subs, nmax_owned=nmax_owned,
+                   halo=halo, local=local, ghost=ghost,
+                   nnz_total=uniform.nnz_total, dtype=dtype,
+                   vector_dtype=vector_dtype, owned_parts=owned,
+                   band_bounds=tuple(int(b) for b in bounds))
+        prob.halo_send_total = uniform.halo_send_total
+        return prob
+
+    @classmethod
+    def build_local_read(cls, path, nparts: int, dtype=jnp.float32,
+                         vector_dtype=None, mesh=None,
+                         bounds=None) -> "DistributedProblem":
+        """Pod-scale ingest: each controller RANGE-READS only its own
+        rows from a row-sorted full-storage binary file (``mtx2bin
+        --expand`` output) and builds only its own subdomains -- no
+        controller ever holds the full matrix, its COO triplets, or any
+        other part's blocks.  The role of the reference's root-rank read
+        + subgraph scatter (``graph.c:1529-1897``,
+        ``mtxfile.h:997-1087``) with the root removed: I/O, host memory
+        and preprocessing are all O(local nnz).
+
+        Uses a contiguous band partition (``bounds`` or equal rows);
+        mesh-uniform shapes come from one small allgather
+        (:func:`_agree_uniform_shapes`).  Structural symmetry of the
+        matrix is assumed (SPD inputs) -- it is what makes the halo
+        send side locally derivable (``graph.subdomain_from_row_slice``).
+
+        Multi-controller callers that want clean one-sided-failure
+        semantics should run :meth:`read_local_subdomains`, checkpoint,
+        then :meth:`assemble_local` (the CLI does).
+        """
+        subs, bounds, n, owned = cls.read_local_subdomains(
+            path, nparts, mesh=mesh, bounds=bounds)
+        return cls.assemble_local(subs, bounds, n, nparts, owned,
+                                  dtype=dtype, vector_dtype=vector_dtype)
+
     # -- vector scatter/gather to the stacked padded layout ---------------
 
     def scatter(self, x_global: np.ndarray) -> np.ndarray:
@@ -296,16 +484,37 @@ class DistributedProblem:
         """(send_counts, recv_counts), each (nparts, nparts) int32:
         ``send_counts[p, q]`` = entries p sends to q.  Gates the puts in
         the DMA transport (the reference's per-neighbour sendcounts,
-        ``halo.h:72-186``)."""
+        ``halo.h:72-186``).
+
+        In the local-read flow only owned parts carry plans; their rows
+        are filled from local info (recv side directly from the owned
+        recv windows -- the transpose shortcut would need other
+        controllers' send rows), and non-owned rows stay zero: each
+        controller's device shards only ever read its own rows."""
         scnt = np.zeros((self.nparts, self.nparts), dtype=np.int32)
+        rcnt = np.zeros((self.nparts, self.nparts), dtype=np.int32)
         for p, s in enumerate(self.subs):
             h = s.halo
+            if h is None:
+                continue
             for q, cnt in zip(h.send_parts, h.send_counts):
                 scnt[p, int(q)] = int(cnt)
-        return scnt, scnt.T.copy()
+            for q, cnt in zip(h.recv_parts, h.recv_counts):
+                rcnt[p, int(q)] = int(cnt)
+        if self.owned_parts is None:
+            # full-information build: keep the exact transpose (identical
+            # to the recv fill, but bit-for-bit the historical behavior)
+            rcnt = scnt.T.copy()
+        return scnt, rcnt
 
     def gather(self, stacked: np.ndarray) -> np.ndarray:
         out = np.zeros(self.n, dtype=np.asarray(stacked).dtype)
+        if self.band_bounds is not None:
+            # analytic global ids: non-owned parts are stubs here
+            for p in range(self.nparts):
+                lo, hi = self.band_bounds[p], self.band_bounds[p + 1]
+                out[lo:hi] = stacked[p, : hi - lo]
+            return out
         for p, s in enumerate(self.subs):
             out[s.global_ids[: s.nowned]] = stacked[p, : s.nowned]
         return out
@@ -647,7 +856,13 @@ class DistCGSolver:
         st.ops["axpy"].add(3 * niter, 0.0, 3 * n * dbl * 3 * niter)
         st.ops["allreduce"].add((1 if self.pipelined else 2) * niter, 0.0,
                                 8 * (1 if self.pipelined else 2) * niter)
-        halo_bytes = sum(int(s.halo.total_send) for s in prob.subs) * dbl
+        # local-read problems carry the allgathered total (summing subs
+        # here would count only this controller's parts)
+        halo_total = getattr(prob, "halo_send_total", None)
+        if halo_total is None:
+            halo_total = sum(int(s.halo.total_send) for s in prob.subs
+                             if s.halo is not None)
+        halo_bytes = halo_total * dbl
         st.ops["halo"].add(niter + 1, 0.0, halo_bytes * (niter + 1))
 
         x = prob.gather(get_global(x_st))

@@ -64,15 +64,27 @@ class DeviceHaloPlan:
         return self.nmax_ghost > 0 and self.maxcnt > 0
 
 
-def build_device_halo(subs: list[Subdomain]) -> DeviceHaloPlan:
-    """Compile host halo plans into padded device index arrays."""
+def build_device_halo(subs: list[Subdomain], maxcnt: int | None = None,
+                      nmax_ghost: int | None = None) -> DeviceHaloPlan:
+    """Compile host halo plans into padded device index arrays.
+
+    ``maxcnt``/``nmax_ghost`` override the locally-derived maxima in the
+    local-read flow, where this controller only holds its own parts'
+    plans (parts with ``halo is None`` are skipped; their rows stay as
+    untouched calloc pages and their device shards are filled by the
+    owning controller)."""
     nparts = len(subs)
-    maxcnt = max((int(c) for s in subs for c in s.halo.send_counts), default=0)
-    nmax_ghost = max((s.nghost for s in subs), default=0)
+    if maxcnt is None:
+        maxcnt = max((int(c) for s in subs if s.halo is not None
+                      for c in s.halo.send_counts), default=0)
+    if nmax_ghost is None:
+        nmax_ghost = max((s.nghost for s in subs), default=0)
     send_idx = np.zeros((nparts, nparts, max(maxcnt, 1)), dtype=np.int32)
     ghost_src = np.zeros((nparts, max(nmax_ghost, 1)), dtype=np.int32)
     ghost_valid = np.zeros((nparts, max(nmax_ghost, 1)), dtype=bool)
     for p, s in enumerate(subs):
+        if s.halo is None:
+            continue
         ghost_valid[p, : s.nghost] = True
         h = s.halo
         for j, q in enumerate(h.send_parts):

@@ -20,16 +20,26 @@ def main(argv=None) -> int:
     p.add_argument("input", help="text or gzipped .mtx file")
     p.add_argument("output", nargs="?", default=None,
                    help="output path (default: stdout)")
+    p.add_argument("--expand", action="store_true",
+                   help="expand symmetric one-triangle storage to full "
+                        "storage and sort entries by row: the layout "
+                        "required for per-controller RANGE reads "
+                        "(read_mtx_row_range) at pod scale -- each "
+                        "controller then reads only its rows")
     p.add_argument("-v", "--verbose", action="count", default=0)
     args = p.parse_args(argv)
 
-    from acg_tpu.io.mtxfile import read_mtx, write_mtx
+    from acg_tpu.io.mtxfile import expand_to_rowsorted_full, read_mtx, write_mtx
 
     t0 = time.perf_counter()
     mtx = read_mtx(args.input)
     if args.verbose:
         sys.stderr.write(f"read: {time.perf_counter() - t0:.6f} s "
                          f"({mtx.nrows}x{mtx.ncols}, {mtx.nnz} nnz)\n")
+    if args.expand:
+        mtx = expand_to_rowsorted_full(mtx)
+        if args.verbose:
+            sys.stderr.write(f"expand: full storage, {mtx.nnz} nnz\n")
     t0 = time.perf_counter()
     if args.output:
         write_mtx(args.output, mtx, binary=True)

@@ -1,0 +1,207 @@
+"""Pod-scale local ingest: range reads + per-controller subdomain
+construction (io.mtxfile.read_mtx_row_range, graph.subdomain_from_row_
+slice, DistributedProblem.build_local_read).
+
+The reference scales file ingest by root-read + MPI scatter of
+subgraphs (``graph.c:1529-1897``, ``mtxfile.h:997-1087``); the TPU
+build removes the root instead: every controller bisects a row-sorted
+full-storage binary file (``mtx2bin --expand``) for exactly its rows
+and derives its halo locally from structural symmetry.  Tests pin
+range-read equivalence, subdomain equivalence against the full-graph
+partitioner, solve agreement, and the 2-process CLI flow.
+"""
+
+import os
+import socket
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+from acg_tpu.io.generators import poisson_mtx, poisson2d_coo
+from acg_tpu.io.mtxfile import (expand_to_rowsorted_full, read_mtx,
+                                read_mtx_row_range, read_mtx_sizes,
+                                write_mtx)
+from acg_tpu.graph import partition_graph_nodes, subdomain_from_row_slice
+from acg_tpu.matrix import SymCsrMatrix
+from acg_tpu.parallel.dist import DistCGSolver, DistributedProblem
+from acg_tpu.solvers.stats import StoppingCriteria
+
+
+@pytest.fixture(scope="module")
+def binfile(tmp_path_factory):
+    """24x24 2D Poisson as an expanded row-sorted binary file."""
+    path = tmp_path_factory.mktemp("lr") / "p24.bin.mtx"
+    mtx = expand_to_rowsorted_full(poisson_mtx(24, dim=2))
+    write_mtx(path, mtx, binary=True)
+    return path
+
+
+@pytest.fixture(scope="module")
+def csr():
+    r, c, v, N = poisson2d_coo(24)
+    return SymCsrMatrix.from_coo(N, r, c, v).to_csr()
+
+
+def test_read_sizes(binfile):
+    assert read_mtx_sizes(binfile) == (576, 576, 5*576 - 4*24)
+
+
+def test_row_range_matches_full_read(binfile, csr):
+    full = read_mtx(binfile, binary=True)
+    for lo, hi in ((0, 100), (100, 400), (400, 576), (0, 576), (50, 50)):
+        sl = read_mtx_row_range(binfile, lo, hi)
+        keep = (np.asarray(full.rowidx) >= lo) & (np.asarray(full.rowidx) < hi)
+        np.testing.assert_array_equal(sl.rowidx, np.asarray(full.rowidx)[keep])
+        np.testing.assert_array_equal(sl.colidx, np.asarray(full.colidx)[keep])
+        np.testing.assert_array_equal(sl.vals, np.asarray(full.vals)[keep])
+        assert sl.nrows == 576 and sl.nnz == int(keep.sum())
+
+
+def test_row_range_rejects_unsorted(tmp_path):
+    mtx = poisson_mtx(8, dim=2)  # one-triangle, row-sorted, but NOT full
+    # scramble entry order to break row sorting
+    rng = np.random.default_rng(0)
+    perm = rng.permutation(mtx.nnz)
+    mtx.rowidx = np.asarray(mtx.rowidx)[perm]
+    mtx.colidx = np.asarray(mtx.colidx)[perm]
+    mtx.vals = np.asarray(mtx.vals)[perm]
+    p = tmp_path / "scrambled.bin.mtx"
+    write_mtx(p, mtx, binary=True)
+    from acg_tpu.errors import AcgError
+    with pytest.raises(AcgError):
+        read_mtx_row_range(p, 10, 40)
+
+
+def test_subdomain_matches_full_partitioner(binfile, csr):
+    """The locally-built subdomain equals what the full-graph path
+    (partition_graph_nodes + natural reorder + block build) produces for
+    the same band partition: global ids, halo windows, matrix blocks."""
+    from acg_tpu.graph import partition_matrix, reorder_owned_natural
+
+    N = csr.shape[0]
+    bounds = np.array([0, 200, 390, N])
+    part = np.zeros(N, dtype=np.int32)
+    for p in range(3):
+        part[bounds[p]:bounds[p + 1]] = p
+    ref_subs = reorder_owned_natural(partition_matrix(csr, part, 3))
+    for p in range(3):
+        sl = read_mtx_row_range(binfile, int(bounds[p]), int(bounds[p + 1]))
+        r, c, v = sl.to_coo()
+        s = subdomain_from_row_slice(r, c, v, bounds, p)
+        ref = ref_subs[p]
+        assert s.nowned == ref.nowned and s.nghost == ref.nghost
+        assert s.nborder == ref.nborder
+        np.testing.assert_array_equal(s.global_ids, ref.global_ids)
+        np.testing.assert_array_equal(s.ghost_owner, ref.ghost_owner)
+        np.testing.assert_array_equal(s.halo.send_parts, ref.halo.send_parts)
+        np.testing.assert_array_equal(s.halo.send_idx, ref.halo.send_idx)
+        np.testing.assert_array_equal(s.halo.recv_counts,
+                                      ref.halo.recv_counts)
+        assert (s.A_local != ref.A_local).nnz == 0
+        assert (s.A_ghost != ref.A_ghost).nnz == 0
+
+
+def test_build_local_read_solves(binfile, csr):
+    """Single-process build_local_read (owns every part) solves to the
+    same answer as the replicated-read build."""
+    prob = DistributedProblem.build_local_read(binfile, 4,
+                                               dtype=jnp.float64)
+    assert prob.local.format == "dia"  # band partition keeps DIA
+    solver = DistCGSolver(prob)
+    b = np.ones(csr.shape[0])
+    crit = StoppingCriteria(maxits=2000, residual_rtol=1e-10)
+    x = solver.solve(b, criteria=crit)
+    assert np.linalg.norm(b - csr @ x) <= 1e-8 * np.linalg.norm(b)
+
+
+def test_build_local_read_rejects_one_triangle(tmp_path):
+    """A plain mtx2bin file (symmetric one-triangle, no --expand) must be
+    rejected -- silently solving half the matrix would be worse."""
+    p = tmp_path / "tri.bin.mtx"
+    write_mtx(p, poisson_mtx(8, dim=2), binary=True)
+    from acg_tpu.errors import AcgError
+    with pytest.raises(AcgError, match="expand"):
+        DistributedProblem.build_local_read(p, 2)
+
+
+def test_expand_rejects_unsupported_symmetry():
+    from acg_tpu.io.mtxfile import MtxFile
+    from acg_tpu.errors import AcgError
+    m = MtxFile(symmetry="skew-symmetric", nrows=2, ncols=2, nnz=1,
+                rowidx=np.array([1]), colidx=np.array([0]),
+                vals=np.array([1.0]))
+    with pytest.raises(AcgError, match="expand"):
+        expand_to_rowsorted_full(m)
+
+
+def _free_port():
+    with socket.socket() as s:
+        s.bind(("", 0))
+        return s.getsockname()[1]
+
+
+def test_cli_two_process_distributed_read(binfile):
+    """The full 2-process flow: both controllers range-read only their
+    rows (--distributed-read), solve, and process 0 reports the
+    manufactured error."""
+    port = _free_port()
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["XLA_FLAGS"] = "--xla_force_host_platform_device_count=2"
+
+    def launch(pid):
+        argv = [sys.executable, "-m", "acg_tpu.cli", str(binfile),
+                "--binary", "--distributed-read", "--nparts", "4",
+                "--manufactured-solution", "--max-iterations", "2000",
+                "--residual-rtol", "1e-8", "--dtype", "f64",
+                "--warmup", "0", "--quiet",
+                "--coordinator", f"localhost:{port}",
+                "--num-processes", "2", "--process-id", str(pid)]
+        return subprocess.Popen(argv, stdout=subprocess.PIPE,
+                                stderr=subprocess.PIPE, text=True, env=env)
+
+    procs = [launch(i) for i in range(2)]
+    outs = [p.communicate(timeout=600) for p in procs]
+    for p, (_, se) in zip(procs, outs):
+        assert p.returncode == 0, se
+    (so0, se0), (so1, se1) = outs
+    assert "total solver time" in se0 and "total solver time" not in se1
+    err = float(se0.split("\nerror 2-norm: ")[1].split()[0])
+    assert err < 1e-6, se0
+
+
+def test_cli_two_process_one_sided_read_failure(binfile, tmp_path):
+    """One controller's file is missing; the ingest checkpoint (run
+    BEFORE the uniform-shape allgather) must bring both down in
+    agreement instead of wedging the healthy peer in a mismatched
+    collective."""
+    import time as _time
+
+    port = _free_port()
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["XLA_FLAGS"] = "--xla_force_host_platform_device_count=2"
+
+    def launch(pid, path):
+        argv = [sys.executable, "-m", "acg_tpu.cli", str(path),
+                "--binary", "--distributed-read", "--nparts", "4",
+                "--max-iterations", "100", "--residual-rtol", "1e-6",
+                "--dtype", "f64", "--warmup", "0", "--quiet",
+                "--err-timeout", "20",
+                "--coordinator", f"localhost:{port}",
+                "--num-processes", "2", "--process-id", str(pid)]
+        return subprocess.Popen(argv, stdout=subprocess.PIPE,
+                                stderr=subprocess.PIPE, text=True, env=env)
+
+    t0 = _time.monotonic()
+    p0 = launch(0, binfile)
+    p1 = launch(1, tmp_path / "nope.bin.mtx")
+    outs = [p.communicate(timeout=180) for p in (p0, p1)]
+    elapsed = _time.monotonic() - t0
+    assert p0.returncode != 0 and p1.returncode != 0
+    assert elapsed < 150
+    assert "peer controller failed during ingest" in outs[0][1]
