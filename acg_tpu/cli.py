@@ -323,7 +323,6 @@ def _solve_generated_direct(args, dim, n, N, jax, jnp, dtype,
         ("b/x0 input files", bool(args.b or args.x0)),
         ("--refine", args.refine),
         ("--output-comm-matrix", args.output_comm_matrix),
-        ("--profile-ops", args.profile_ops is not None),
         (f"--spmv-format {args.spmv_format}",
          args.spmv_format not in ("auto", "dia")),
     ] if on]
@@ -380,6 +379,9 @@ def _solve_generated_direct(args, dim, n, N, jax, jnp, dtype,
             jax.profiler.stop_trace()
     _log(args, "solve:", t0)
 
+    if args.profile_ops is not None:
+        from acg_tpu.solvers.profile import profile_ops
+        profile_ops(solver, b, reps=max(args.profile_ops, 1))
     solver.stats.fwrite(sys.stderr)
     if not args.quiet:
         write_mtx(sys.stdout.buffer, vector_mtx(np.asarray(x)),
@@ -532,6 +534,11 @@ def _solve_generated_sharded(args, dim, n, N, jax, jnp, dtype,
     from acg_tpu.parallel.sharded_dia import build_sharded_poisson_solver
     from acg_tpu.solvers import StoppingCriteria
 
+    if args.profile_ops is not None:
+        raise SystemExit(
+            "acg-tpu: --profile-ops is not available on the sharded "
+            "direct-assembly path (single-chip: drop --nparts/"
+            "--manufactured-solution)")
     if args.kernels in ("pallas", "fused"):
         raise SystemExit(
             "acg-tpu: the sharded direct-assembly path pins the SpMV to "

@@ -118,8 +118,12 @@ def _profile_single(solver, b, reps: int) -> dict[str, float]:
     A = solver.A
     dtype = (A.dtype if hasattr(A, "dtype")
              else A.data.dtype if hasattr(A, "data") else A.vals.dtype)
-    x = jnp.asarray(np.asarray(b), dtype=dtype)
-    spmv_f = _spmv_fn(solver.kernels)
+    # b may already live on device (gen-direct path): no host round-trip
+    x = jnp.asarray(b, dtype=dtype)
+    # the fused tier's gemv replay uses the closest standalone kernel
+    # (its phase kernels have no standalone-SpMV form)
+    spmv_f = _spmv_fn("pallas" if solver.kernels.startswith("fused")
+                      else solver.kernels)
     if solver.precise_dots:
         from acg_tpu.ops.precision import dot_compensated
 
