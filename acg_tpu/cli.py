@@ -176,7 +176,13 @@ def make_parser() -> argparse.ArgumentParser:
                    help="multi-controller error-agreement watchdog: how "
                         "long to wait at a stage checkpoint for peers "
                         "before concluding one died and aborting (the "
-                        "acgerrmpi analog; default: 120)")
+                        "acgerrmpi analog; default: 120).  Must exceed the "
+                        "worst-case arrival SKEW between controllers at "
+                        "any checkpoint (not the stage duration): e.g. a "
+                        "replicated read of a large .mtx from a slow "
+                        "filesystem can stagger 'ingest' arrivals by "
+                        "minutes -- raise this accordingly or a healthy "
+                        "but slow peer gets the pod aborted")
     p.add_argument("--profile-ops", nargs="?", const=10, type=int,
                    default=None, metavar="REPS",
                    help="fill the stats block's per-op seconds/GB/s by "
@@ -423,6 +429,7 @@ def _solve_distributed_read(args, jax, jnp, dtype, vec_dtype) -> int:
         ("--partition FILE", args.partition is not None),
         ("--output-comm-matrix", args.output_comm_matrix),
         ("--profile-ops", args.profile_ops is not None),
+        ("--kernels fused (single-device only)", args.kernels == "fused"),
         ("--comm dma", args.comm in ("dma", "nvshmem")),
     ] if on]
     if unsupported:

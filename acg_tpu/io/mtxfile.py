@@ -295,6 +295,29 @@ def read_mtx_row_range(path, row_lo: int, row_hi: int) -> MtxFile:
 
         idx_sz = np.dtype(IDX_DTYPE).itemsize
 
+        # probe that the data section IS binary before bisecting over it
+        # (read_mtx takes an explicit ``binary`` flag; this reader has no
+        # flag, and frombuffer over an ASCII data section would otherwise
+        # fail with a misleading "not row-sorted" error, or worse, pass):
+        # the binary layout's size is fully determined by the header
+        # (rowidx, colidx, vals as consecutive raw arrays), and entry 0's
+        # 1-based rowidx must be a plausible row number.
+        val_sz = 0 if field == "pattern" else \
+            (8 if field == "real" else 4)
+        f.seek(0, os.SEEK_END)
+        if f.tell() != data_off + nnz * (2 * idx_sz + val_sz):
+            raise AcgError(ErrorCode.INVALID_FORMAT,
+                           f"{path}: data section size does not match the "
+                           f"binary layout for {nnz} entries -- not a "
+                           f"binary file? (convert with mtx2bin --expand)")
+        if nnz:
+            f.seek(data_off)
+            first = int(np.frombuffer(f.read(idx_sz), dtype=IDX_DTYPE)[0])
+            if not (1 <= first <= nrows):
+                raise AcgError(ErrorCode.INVALID_FORMAT,
+                               f"{path}: first rowidx {first} out of range "
+                               f"-- not a binary coordinate file?")
+
         def row_at(k: int) -> int:
             f.seek(data_off + idx_sz * k)
             buf = f.read(idx_sz)

@@ -38,6 +38,14 @@ def agree_status(code: int, what: str = "", timeout: float = 120.0) -> int:
     before its checkpoint -- the process prints a diagnosis and exits
     with :data:`PEER_LOST_EXIT`.
 
+    ``timeout`` bounds the checkpoint-arrival *skew* between controllers,
+    not the stage duration: the watchdog starts when THIS process reaches
+    the checkpoint, so a healthy-but-slow peer (e.g. a replicated read of
+    a large file from a slow filesystem arriving minutes after its peers)
+    is indistinguishable from a dead one once the skew exceeds
+    ``timeout``.  Size it for the worst-case stage imbalance, not the
+    mean (``--err-timeout`` in the CLI).
+
     Single-process: returns ``code`` immediately (no collective).
     """
     import jax

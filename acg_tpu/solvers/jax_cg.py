@@ -237,6 +237,162 @@ def _cg_program(A: DeviceMatrix, b, x0, res_atol, res_rtol, diff_atol,
 
 
 @functools.partial(jax.jit,
+                   static_argnames=("K", "unbounded", "restart", "kernels"))
+def _cg_replaced_program(A: DeviceMatrix, b, x0, res_atol, res_rtol,
+                         maxits, K: int, unbounded: bool, restart: bool,
+                         kernels: str = "xla"):
+    """Classic CG over bf16 vector storage made SOUND by periodic f32
+    residual replacement -- the accuracy contract for the half-traffic
+    tier at high condition numbers.
+
+    Plain bf16 CG diverges once kappa exceeds ~1/u_bf16 ~ 500 (measured:
+    rel residual 1e3 after 1000 iterations at the flagship's kappa ~
+    1.7e6, BASELINE.md): the bf16-rounded r/p recurrences drift off the
+    true residual and the drift compounds.  The classical fix (residual
+    replacement, Van der Vorst & Ye) bounds the drift window: every
+    ``K`` iterations the true residual ``r = b - A x`` is recomputed in
+    f32 and swapped in for the recurrence residual.
+
+    Device layout: ``x`` accumulates in f32 but is NOT touched by the
+    inner loop -- each segment accumulates its correction ``d`` from
+    zero in bf16 and adds it to x once per segment, so the per-iteration
+    HBM traffic stays identical to the plain bf16 tier (~40 B/row on the
+    5-point flagship) and the replacement costs one mixed-precision
+    SpMV (bf16 planes x f32 vector, f32 accumulation -- lossless for
+    bf16-exact stencil values, the ``--dtype mixed`` arithmetic) per K
+    iterations: ~2% at K=50.  ``d``'s bf16 rounding does not feed back
+    into the inner recurrences at all (r evolves independently); it only
+    caps the per-segment residual reduction, and the next replacement
+    measures whatever reduction was actually achieved, so the outer
+    iteration is self-correcting -- iterative refinement with an
+    inner-bf16-CG solver (the same structure as solvers.refine, fully
+    device-resident).
+
+    ``restart=True`` additionally resets ``p = r`` at each replacement
+    (restarted CG: discards Krylov memory, maximally stable);
+    ``restart=False`` carries ``p`` across segments (classical residual
+    replacement: keeps the convergence rate, slightly less protection).
+    Convergence is tested once per segment on the TRUE f32 residual --
+    so unlike the plain tiers, a converged report from this program is
+    grounded in an f32-accurate residual by construction.
+
+    The role of the reference's strictly-f64 contract (``comm.h:
+    180-183``) restated for TPU storage tiers; SURVEY.md section 7
+    "hard parts" (f64-on-TPU mitigation ladder).
+    """
+    sdt = jnp.float32
+    vdt = jnp.bfloat16
+    spmv_ = _spmv_fn(kernels)
+
+    def dot(u, v):
+        return jnp.dot(u, v, preferred_element_type=sdt)
+
+    b = b.astype(sdt)
+    x0 = x0.astype(sdt)
+    bnrm2 = jnp.sqrt(dot(b, b))
+    x0nrm2 = jnp.sqrt(dot(x0, x0))
+    r32 = b - spmv_(A, x0)
+    gamma32 = dot(r32, r32)
+    r0nrm2 = jnp.sqrt(gamma32)
+    res_tol = jnp.maximum(res_atol.astype(sdt), res_rtol.astype(sdt) * r0nrm2)
+    inf = jnp.asarray(jnp.inf, sdt)
+
+    def segment(x32, r32, p, its):
+        """One replacement period: inner bf16 CG on A d = r32 from d=0,
+        then x += d and ONE f32-accurate SpMV for the fresh residual.
+
+        The inner loop's trip count is the STATIC K even when fewer
+        iterations remain (a carry-dependent bound would compile to a
+        dynamic-trip loop XLA cannot software-pipeline -- measured 0.55x
+        the plain-bf16 rate, uniformly over K and mode); the final
+        partial segment instead masks the updates of its dead tail via
+        ``live`` (at most K-1 wasted iterations per solve, and none at
+        all when maxits is a multiple of K, as in the bench protocol)."""
+        r = r32.astype(vdt)
+        gamma = dot(r, r)
+        if restart:
+            p = r
+        else:
+            # carried-direction health check: at kappa*u_bf16 >> 1 the
+            # bf16 p-recurrence can blow up across segments (beta > 1
+            # sustained); once p overflows, alpha*p = 0*inf would poison
+            # d with NaNs.  Reset the direction to r when it has grown
+            # out of all proportion to the residual -- a restart at
+            # exactly the boundaries where one is needed.
+            pn = dot(p, p)
+            bad = (~jnp.isfinite(pn)) | (pn > jnp.asarray(1e24, sdt) * gamma)
+            p = jnp.where(bad, r, p)
+        nin = jnp.minimum(jnp.int32(K), maxits - its)
+
+        def ibody(j, st):
+            d, r, p, gamma = st
+            live = j < nin
+            t = spmv_(A, p)
+            pdott = dot(p, t)
+            # carried directions (restart=False) are not orthogonal to
+            # the replaced residual, so the classic numerator gamma =
+            # (r, r) misestimates the step along p -- measured:
+            # catastrophic overshoot (rel residual 1e18).  The general
+            # line-search numerator (r, p) reduces to gamma under exact
+            # conjugacy and stays correct without it; restarted segments
+            # keep the cheaper classic form.
+            num = gamma if restart else dot(r, p)
+            # breakdown guards: bf16 rounding noise can drive (p, Ap)
+            # to 0 or negative once the segment's progress is
+            # exhausted; freeze the updates (alpha = 0) instead of
+            # poisoning d -- the next replacement resets the segment
+            # either way.  The same freeze implements the dead tail of
+            # the final partial segment.
+            alpha = jnp.where(live & (pdott > 0), num / pdott,
+                              jnp.zeros_like(gamma))
+            d = (d.astype(sdt) + alpha * p.astype(sdt)).astype(vdt)
+            r_new = (r.astype(sdt) - alpha * t.astype(sdt)).astype(vdt)
+            gamma_next = dot(r_new, r_new)
+            beta = jnp.where(gamma > 0, gamma_next / gamma,
+                             jnp.zeros_like(gamma))
+            # alpha = 0 already freezes d and r; p needs an explicit
+            # select (beta freezes at 1 there, which would add r to p)
+            p = jnp.where(live,
+                          (r_new.astype(sdt)
+                           + beta * p.astype(sdt)).astype(vdt), p)
+            return (d, r_new, p, gamma_next)
+
+        d, _, p, _ = jax.lax.fori_loop(
+            0, K, ibody, (jnp.zeros_like(r), r, p, gamma))
+        x32 = x32 + d.astype(sdt)
+        r32 = b - spmv_(A, x32)
+        return x32, r32, p, its + nin, dot(r32, r32)
+
+    p0 = r32.astype(vdt)
+    if unbounded:
+        nouter = (maxits + jnp.int32(K) - 1) // jnp.int32(K)
+
+        def obody(_, carry):
+            x32, r32, p, its, _ = carry
+            return segment(x32, r32, p, its)
+
+        x32, r32f, _, its, gamma_f = jax.lax.fori_loop(
+            0, nouter, obody, (x0, r32, p0, jnp.int32(0), gamma32))
+        return CGResult(x=x32, niterations=its, rnrm2=jnp.sqrt(gamma_f),
+                        r0nrm2=r0nrm2, bnrm2=bnrm2, x0nrm2=x0nrm2,
+                        dxnrm2=inf, converged=jnp.asarray(True))
+
+    def wcond(carry):
+        _, _, _, its, gamma = carry
+        return (gamma >= res_tol * res_tol) & (its < maxits)
+
+    def wbody(carry):
+        x32, r32, p, its, _ = carry
+        return segment(x32, r32, p, its)
+
+    x32, r32f, _, its, gamma_f = jax.lax.while_loop(
+        wcond, wbody, (x0, r32, p0, jnp.int32(0), gamma32))
+    return CGResult(x=x32, niterations=its, rnrm2=jnp.sqrt(gamma_f),
+                    r0nrm2=r0nrm2, bnrm2=bnrm2, x0nrm2=x0nrm2,
+                    dxnrm2=inf, converged=gamma_f < res_tol * res_tol)
+
+
+@functools.partial(jax.jit,
                    static_argnames=("unbounded", "interpret"))
 def _cg_fused_program(A: DeviceMatrix, b, x0, res_atol, res_rtol,
                       maxits, unbounded: bool, interpret: bool = False):
@@ -374,7 +530,8 @@ class JaxCGSolver:
 
     def __init__(self, A: DeviceMatrix, pipelined: bool = False,
                  precise_dots: bool = False, kernels: str = "auto",
-                 vector_dtype=None):
+                 vector_dtype=None, replace_every: int = 0,
+                 replace_restart: bool = True):
         """``vector_dtype`` decouples vector storage from matrix storage
         (default: the matrix dtype).  The supported mix is bf16 matrix +
         f32 vectors (``--dtype mixed``): for matrices whose entries are
@@ -391,12 +548,15 @@ class JaxCGSolver:
         self.precise_dots = precise_dots
         if kernels == "auto":
             # the Pallas kernels win on TPU hardware (BASELINE.md); off
-            # TPU they would run interpreted (slow), and the measured win
-            # only exists for the f32/bf16 fast path, so gate on both
+            # TPU they would run interpreted (slow), the measured win
+            # only exists for the f32/bf16 fast path, and under x64 mode
+            # Mosaic lowers index maps as i64 (rejected by TPU memrefs)
+            # -- so auto gates on all three and falls back to XLA
             itemsize = (np.dtype(A.dtype).itemsize
                         if isinstance(A, DiaMatrix) else 0)
             kernels = ("pallas" if jax.default_backend() == "tpu"
-                       and itemsize in (2, 4) else "xla")
+                       and itemsize in (2, 4)
+                       and not jax.config.jax_enable_x64 else "xla")
         elif kernels == "pallas" and jax.default_backend() != "tpu":
             kernels = "pallas-interpret"
         elif kernels == "pallas" and jax.config.jax_enable_x64:
@@ -437,6 +597,35 @@ class JaxCGSolver:
         if kernels not in ("xla", "xla-roll", "pallas", "pallas-interpret",
                            "fused", "fused-interpret"):
             raise ValueError(f"unknown kernels choice {kernels!r}")
+        self.replace_every = int(replace_every)
+        self.replace_restart = bool(replace_restart)
+        if self.replace_every < 0:
+            raise ValueError("replace_every must be >= 0 (a negative "
+                             "period would compile a non-terminating "
+                             "segment loop)")
+        if self.replace_every:
+            vdt = (jnp.dtype(vector_dtype) if vector_dtype is not None
+                   else jnp.dtype(matrix_dtype(A)))
+            if vdt != jnp.bfloat16:
+                raise ValueError(
+                    "replace_every is the bf16 tier's accuracy contract "
+                    "(periodic f32 residual replacement); f32/f64 vector "
+                    "storage has no replacement drift to correct -- use "
+                    "precise_dots or a RefinedSolver there")
+            if pipelined:
+                raise ValueError("replace_every implements classic CG "
+                                 "(the pipelined recurrence carries w=Ar, "
+                                 "which replacement would invalidate)")
+            if precise_dots:
+                raise ValueError("replace_every computes its scalars in "
+                                 "plain f32 (the bf16 tier's scalar "
+                                 "path); precise_dots needs the direct "
+                                 "programs")
+            if kernels.startswith("fused"):
+                raise ValueError("replace_every composes with "
+                                 "kernels='xla'/'pallas' (the fused "
+                                 "two-phase iteration has no replacement "
+                                 "hook)")
         self.kernels = kernels
         self.stats = SolverStats(unknowns=A.nrows)
         # lazy: the device nnz count (for the flop statistic) runs at
@@ -466,12 +655,30 @@ class JaxCGSolver:
         dtype = matrix_dtype(self.A)
         if self.vector_dtype is not None:
             dtype = jnp.dtype(self.vector_dtype)
+        if self.replace_every:
+            # the outer iteration owns b/x0 in f32 -- rounding b to bf16
+            # here would bake a u_bf16-sized backward error into every
+            # residual the replacement recomputes
+            dtype = jnp.dtype(jnp.float32)
         b = jnp.asarray(b, dtype=dtype)
         x0 = jnp.zeros_like(b) if x0 is None else jnp.asarray(x0, dtype=dtype)
         # tolerances ride in the scalar dtype (f32 for bf16 storage) so a
         # 1e-9 rtol is not pre-rounded to 8 mantissa bits
         sdt = acc_dtype(dtype)
-        if self.kernels.startswith("fused"):
+        if self.replace_every:
+            if crit.needs_diff:
+                raise ValueError("replace_every supports residual "
+                                 "criteria only (the diff criterion has "
+                                 "no meaning across replacement segments)")
+            program = _cg_replaced_program
+            args = (self.A, b, x0,
+                    jnp.asarray(crit.residual_atol, sdt),
+                    jnp.asarray(crit.residual_rtol, sdt),
+                    jnp.int32(crit.maxits))
+            kwargs = dict(K=self.replace_every, unbounded=crit.unbounded,
+                          restart=self.replace_restart,
+                          kernels=self.kernels)
+        elif self.kernels.startswith("fused"):
             if crit.needs_diff:
                 raise ValueError("kernels='fused' supports residual "
                                  "criteria only")
@@ -522,7 +729,22 @@ class JaxCGSolver:
         mat_dbl = np.dtype(matrix_dtype(self.A)).itemsize
         idx_b = matrix_index_bytes(self.A)
         mat_bytes = int((self._spmv_flops / 3.0) * (mat_dbl + idx_b))
-        if self.kernels.startswith("fused"):
+        if self.replace_every:
+            # inner vectors are bf16 regardless of the (f32) outer dtype;
+            # each segment adds one f32-vector replacement SpMV
+            nseg = -(-niter // self.replace_every) if niter else 0
+            st.nflops += self._spmv_flops * nseg
+            vb = 2
+            st.ops["gemv"].add(niter + nseg + 1, 0.0,
+                               (mat_bytes + 2 * n * vb) * niter
+                               + (mat_bytes + 2 * n * 4) * (nseg + 1))
+            # carried-direction mode adds the (r, p) line-search dot per
+            # iteration and a (p, p) health check per segment
+            ndot = (2 * niter if self.replace_restart
+                    else 3 * niter + nseg)
+            st.ops["dot"].add(ndot, 0.0, 2 * n * vb * ndot)
+            st.ops["axpy"].add(3 * niter, 0.0, 3 * n * vb * 3 * niter)
+        elif self.kernels.startswith("fused"):
             # both dots and all updates are folded into the two streamed
             # kernels: bill phase A (planes + r/p windows + p/t writes)
             # as gemv and phase B (4 reads + 2 writes) as axpy; nothing

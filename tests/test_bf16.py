@@ -166,3 +166,93 @@ def test_bf16_distributed_matches_single(problem):
     # both land at the bf16 noise floor; iteration counts may differ by
     # a few (different reduction orders), the achieved residual must not
     assert rel4 < max(5e-2, 3 * rel1)
+
+
+@pytest.fixture(scope="module")
+def hard_problem():
+    """2D Poisson n=128 (kappa ~ 6.6e3): far beyond the ~500 kappa limit
+    where plain bf16 vector storage converges (BASELINE.md)."""
+    r, c, v, N = poisson2d_coo(128)
+    csr = SymCsrMatrix.from_coo(N, r, c, v).to_csr()
+    rng = np.random.default_rng(1)
+    xsol = rng.standard_normal(N)
+    xsol /= np.linalg.norm(xsol)
+    return csr, xsol, csr @ xsol
+
+
+def _true_rel_residual(csr, b, x):
+    x = np.asarray(x, dtype=np.float64)
+    return np.linalg.norm(b - csr @ x) / np.linalg.norm(b)
+
+
+@pytest.mark.parametrize("restart", [True, False])
+def test_replaced_bf16_sound_beyond_kappa_limit(hard_problem, restart):
+    """Periodic f32 residual replacement (replace_every) makes the bf16
+    tier converge where the plain tier stalls at its storage noise
+    floor: the sound-bf16 contract (VERDICT round 3 item 4)."""
+    csr, xsol, b = hard_problem
+    A = device_matrix_from_csr(csr, dtype=jnp.bfloat16)
+    crit = StoppingCriteria(maxits=1500)
+
+    plain = JaxCGSolver(A, kernels="xla")
+    rel_plain = _true_rel_residual(
+        csr, b, plain.solve(b, criteria=crit, raise_on_divergence=False))
+
+    rr = JaxCGSolver(A, kernels="xla", replace_every=50,
+                     replace_restart=restart)
+    rel_rr = _true_rel_residual(
+        csr, b, rr.solve(b, criteria=crit, raise_on_divergence=False))
+
+    # the replaced tier must be *sound* (f32-class residual), not merely
+    # better than the stalled plain tier (whose residual may be NaN --
+    # outright divergence -- at this kappa)
+    assert rel_rr < 1e-5
+    assert np.isnan(rel_plain) or rel_rr < 0.1 * rel_plain
+
+
+def test_replaced_reported_residual_is_true(hard_problem):
+    """The convergence test and the reported rnrm2 come from the f32
+    residual recompute, not the drifting bf16 recurrence -- so the
+    reported residual must match the true one to f32 class."""
+    csr, xsol, b = hard_problem
+    A = device_matrix_from_csr(csr, dtype=jnp.bfloat16)
+    s = JaxCGSolver(A, kernels="xla", replace_every=50)
+    x = s.solve(b, criteria=StoppingCriteria(maxits=3000,
+                                             residual_rtol=1e-5),
+                raise_on_divergence=False)
+    assert s.stats.converged
+    true_r = np.linalg.norm(b - csr @ np.asarray(x, np.float64))
+    assert abs(true_r - s.stats.rnrm2) <= 1e-5 * np.linalg.norm(b) + \
+        1e-2 * true_r
+    # converged within tolerance per the TRUE residual
+    assert true_r <= 1.01 * 1e-5 * s.stats.r0nrm2
+    # iteration count honors maxits quantized to whole segments
+    assert s.stats.niterations <= 3000
+
+
+def test_replaced_honors_maxits_exactly(problem):
+    """maxits that is not a multiple of K still stops at maxits
+    (the last segment runs short)."""
+    csr, xsol, b = problem
+    A = device_matrix_from_csr(csr, dtype=jnp.bfloat16)
+    s = JaxCGSolver(A, kernels="xla", replace_every=64)
+    s.solve(b, criteria=StoppingCriteria(maxits=130),
+            raise_on_divergence=False)
+    assert s.stats.niterations == 130
+
+
+def test_replaced_validation():
+    planes, offsets, N = poisson_dia(8, dim=2)
+    A32 = DiaMatrix(data=tuple(jnp.asarray(p, jnp.float32) for p in planes),
+                    offsets=offsets, nrows=N, ncols_padded=N)
+    A16 = DiaMatrix(data=tuple(jnp.asarray(p, jnp.bfloat16) for p in planes),
+                    offsets=offsets, nrows=N, ncols_padded=N)
+    with pytest.raises(ValueError, match="bf16"):
+        JaxCGSolver(A32, kernels="xla", replace_every=50)
+    with pytest.raises(ValueError, match="classic"):
+        JaxCGSolver(A16, kernels="xla", replace_every=50, pipelined=True)
+    with pytest.raises(ValueError, match="precise"):
+        JaxCGSolver(A16, kernels="xla", replace_every=50, precise_dots=True)
+    with pytest.raises(ValueError, match="diff"):
+        JaxCGSolver(A16, kernels="xla", replace_every=50).solve(
+            np.ones(N), criteria=StoppingCriteria(maxits=10, diff_rtol=1e-3))

@@ -76,6 +76,18 @@ def test_row_range_rejects_unsorted(tmp_path):
         read_mtx_row_range(p, 10, 40)
 
 
+def test_row_range_rejects_text_file(tmp_path):
+    """A TEXT coordinate file must be diagnosed as such (ADVICE round 3:
+    frombuffer over an ASCII data section used to surface as a
+    misleading 'not row-sorted' error)."""
+    p = tmp_path / "text.mtx"
+    write_mtx(p, expand_to_rowsorted_full(poisson_mtx(8, dim=2)),
+              binary=False)
+    from acg_tpu.errors import AcgError
+    with pytest.raises(AcgError, match="binary"):
+        read_mtx_row_range(p, 0, 10)
+
+
 def test_subdomain_matches_full_partitioner(binfile, csr):
     """The locally-built subdomain equals what the full-graph path
     (partition_graph_nodes + natural reorder + block build) produces for
