@@ -60,7 +60,15 @@ def make_parser() -> argparse.ArgumentParser:
                    help="mesh size / number of subdomains (default: all devices; "
                         "0 with --comm none means 1)")
     p.add_argument("--partition", metavar="FILE", default=None,
-                   help="read row partition vector from FILE (mtxpartition output)")
+                   help="read row partition vector from FILE (mtxpartition "
+                        "output).  Under --distributed-read the partition "
+                        "must instead be applied OFFLINE (mtx2bin --expand "
+                        "--partition VECFILE, which permutes the matrix "
+                        "part-contiguous) and FILE names the tiny "
+                        ".bounds.mtx sidecar it writes (auto-detected "
+                        "next to the matrix when omitted) -- reading a "
+                        "full vector per controller would break the "
+                        "O(local nnz) ingest contract")
     p.add_argument("--partition-method", default="auto",
                    choices=["auto", "graph", "band"],
                    help="row partition strategy: graph = edge-cut "
@@ -114,8 +122,10 @@ def make_parser() -> argparse.ArgumentParser:
                         "(Poisson stencils).  'bf16' stores vectors in "
                         "bf16 too (half traffic everywhere, f32 scalars) "
                         "but caps convergence at condition numbers "
-                        "~1/u_bf16 ~ 500 -- use for well-conditioned "
-                        "systems or throughput measurement")
+                        "~1/u_bf16 ~ 500 -- combine with --replace-every "
+                        "for f32-class residuals at any conditioning, or "
+                        "use alone for well-conditioned systems / "
+                        "throughput measurement")
     p.add_argument("--kernels", default="auto",
                    choices=["auto", "xla", "pallas", "fused"],
                    help="hot-loop kernel tier: xla = compiler-fused ops, "
@@ -133,6 +143,12 @@ def make_parser() -> argparse.ArgumentParser:
                         "single-device path (the role of the reference's "
                         "--cusparse-spmv-alg algorithm selector); auto "
                         "picks by sparsity structure")
+    p.add_argument("--replace-every", type=int, default=0, metavar="K",
+                   help="with --dtype bf16: periodic f32 residual "
+                        "replacement every K iterations (classic CG, "
+                        "single-device path) -- the sound-bf16 contract: "
+                        "f32-class residuals at ~2%% overhead (K=50 "
+                        "measured at flagship conditioning; 0 = off)")
     p.add_argument("--precise-dots", action="store_true",
                    help="compensated (double-float) dot products for the "
                         "CG scalars; lets f32 storage converge past the "
@@ -362,9 +378,13 @@ def _solve_generated_direct(args, dim, n, N, jax, jnp, dtype,
                   nrows=N, ncols_padded=N)
     _log(args, "assemble DIA planes on device:", t0)
 
-    solver = JaxCGSolver(A, pipelined="pipelined" in args.solver,
-                         precise_dots=args.precise_dots,
-                         kernels=args.kernels, vector_dtype=vec_dtype)
+    try:
+        solver = JaxCGSolver(A, pipelined="pipelined" in args.solver,
+                             precise_dots=args.precise_dots,
+                             kernels=args.kernels, vector_dtype=vec_dtype,
+                             replace_every=args.replace_every)
+    except ValueError as e:
+        raise SystemExit(f"acg-tpu: {e}")
     b = jnp.ones(N, dtype=vec_dtype)
     criteria = StoppingCriteria(
         maxits=args.max_iterations,
@@ -411,8 +431,10 @@ def _solve_distributed_read(args, jax, jnp, dtype, vec_dtype) -> int:
     subdomain construction, distributed solve.  Kept separate from the
     replicated-read pipeline because its stages are per-controller-local
     by design (no full matrix exists anywhere to share code with)."""
+    import os
+
     from acg_tpu.errors import AcgError, NotConvergedError
-    from acg_tpu.io.mtxfile import vector_mtx, write_mtx
+    from acg_tpu.io.mtxfile import read_mtx, vector_mtx, write_mtx
     from acg_tpu.parallel.dist import DistCGSolver, DistributedProblem
     from acg_tpu.parallel.multihost import is_primary
     from acg_tpu.solvers import StoppingCriteria
@@ -426,10 +448,10 @@ def _solve_distributed_read(args, jax, jnp, dtype, vec_dtype) -> int:
          args.solver in ("host", "host-native", "petsc")),
         ("b/x0 input files", bool(args.b or args.x0)),
         ("--refine", args.refine),
-        ("--partition FILE", args.partition is not None),
         ("--output-comm-matrix", args.output_comm_matrix),
         ("--profile-ops", args.profile_ops is not None),
         ("--kernels fused (single-device only)", args.kernels == "fused"),
+        ("--replace-every (single-device only)", args.replace_every > 0),
         ("--comm dma", args.comm in ("dma", "nvshmem")),
     ] if on]
     if unsupported:
@@ -437,7 +459,43 @@ def _solve_distributed_read(args, jax, jnp, dtype, vec_dtype) -> int:
             f"acg-tpu: --distributed-read does not support: "
             f"{', '.join(unsupported)}")
 
-    nparts = args.nparts or len(jax.devices())
+    # partition bounds: arbitrary (METIS/graph) partitions arrive here
+    # PRE-APPLIED by ``mtx2bin --expand --partition`` (the matrix is
+    # permuted so parts are contiguous) as a tiny bounds sidecar --
+    # O(nparts) to read, keeping per-controller ingest O(local nnz).
+    # --partition FILE names the sidecar explicitly; otherwise the
+    # mtx2bin-written default next to the matrix is picked up.
+    bounds = None
+    bounds_path = args.partition
+    if bounds_path is None and os.path.exists(args.A + ".bounds.mtx"):
+        bounds_path = args.A + ".bounds.mtx"
+    if bounds_path is not None:
+        try:
+            bmtx = read_mtx(bounds_path, binary=args.partition_binary)
+        except AcgError as e:
+            raise SystemExit(f"acg-tpu: {bounds_path}: {e}")
+        bounds = np.asarray(bmtx.vals).reshape(-1).astype(np.int64)
+        try:
+            from acg_tpu.io.mtxfile import read_mtx_sizes
+            n_check = read_mtx_sizes(args.A)[0]
+        except (AcgError, OSError):
+            n_check = None  # the matrix read below reports its own error
+        if (bounds.size < 2 or bounds[0] != 0 or (np.diff(bounds) < 0).any()
+                or (n_check is not None and bounds[-1] != n_check)):
+            raise SystemExit(
+                f"acg-tpu: {bounds_path} is not a part-bounds sidecar "
+                f"(nparts+1 ascending boundaries from 0 to nrows).  For "
+                f"--distributed-read, apply the partition VECTOR offline "
+                f"with: mtx2bin IN OUT --expand --partition VECFILE, "
+                f"then pass OUT here (its .bounds.mtx is found "
+                f"automatically)")
+        if args.nparts and args.nparts != bounds.size - 1:
+            raise SystemExit(
+                f"acg-tpu: --nparts {args.nparts} != {bounds.size - 1} "
+                f"parts in {bounds_path}")
+
+    nparts = (bounds.size - 1 if bounds is not None
+              else args.nparts or len(jax.devices()))
     # two-phase ingest: the host-local reads (phase 1) are the stage
     # where one controller can fail alone, and they are checkpointed
     # BEFORE the uniform-shape allgather of phase 2 -- a failed peer
@@ -446,7 +504,8 @@ def _solve_distributed_read(args, jax, jnp, dtype, vec_dtype) -> int:
     state = None
     try:
         t0 = time.perf_counter()
-        state = DistributedProblem.read_local_subdomains(args.A, nparts)
+        state = DistributedProblem.read_local_subdomains(args.A, nparts,
+                                                         bounds=bounds)
         _log(args, f"range-read + local build ({len(state[3])} of "
                    f"{nparts} parts on this controller):", t0)
     except (AcgError, OSError, SystemExit) as e:
@@ -521,8 +580,38 @@ def _solve_distributed_read(args, jax, jnp, dtype, vec_dtype) -> int:
         sys.stderr.write(f"initial error 2-norm: {err0:.15g}\n")
         sys.stderr.write(f"error 2-norm: {err:.15g}\n")
     if not args.quiet:
+        # a partition-permuted matrix (mtx2bin --partition) solves in
+        # permuted row order; map the solution back to the input
+        # ordering via the perm sidecar so users see their own numbering
+        perm = _load_perm_sidecar(args.A, n)
+        if perm is not None:
+            xo = np.empty_like(np.asarray(x))
+            xo[perm] = np.asarray(x)
+            x = xo
         write_mtx(sys.stdout.buffer, vector_mtx(x), numfmt=args.numfmt)
     return 0
+
+
+def _load_perm_sidecar(matrix_path: str, n: int):
+    """The permuted-to-original row map written by ``mtx2bin
+    --partition``, or None.  A sidecar whose size disagrees with the
+    matrix is STALE (e.g. the matrix was regenerated for a different
+    size at the same path) -- fail loudly rather than scramble output."""
+    import os
+
+    from acg_tpu.io.mtxfile import read_mtx
+
+    path = matrix_path + ".perm.mtx"
+    if not os.path.exists(path):
+        return None
+    perm = np.asarray(read_mtx(path, binary=True).vals
+                      ).reshape(-1).astype(np.int64) - 1
+    if perm.size != n or (np.sort(perm) != np.arange(n)).any():
+        raise SystemExit(
+            f"acg-tpu: {path} is not a permutation of {n} rows -- stale "
+            f"sidecar from an earlier mtx2bin run?  Regenerate with "
+            f"mtx2bin --expand [--partition] or delete it")
+    return perm
 
 
 def _solve_generated_sharded(args, dim, n, N, jax, jnp, dtype,
@@ -552,6 +641,10 @@ def _solve_generated_sharded(args, dim, n, N, jax, jnp, dtype,
             "the partitioner-friendly roll formulation; --kernels "
             f"{args.kernels} is not available here (use --nparts 1 "
             "without --manufactured-solution for the kernel tiers)")
+    if args.replace_every:
+        raise SystemExit(
+            "acg-tpu: --replace-every is single-device only (the "
+            "sharded path's accuracy route is --refine)")
 
     nparts = args.nparts or len(jax.devices())
     t0 = time.perf_counter()
@@ -728,6 +821,11 @@ def _main(args) -> int:
         _log(args, "assemble symmetric CSR:", t0)
 
         n = A.nrows
+        # partition-permuted input (mtx2bin --partition): the matrix on
+        # disk is P A P^T, but user-facing vectors (b, x0, the printed
+        # solution) stay in the ORIGINAL row ordering on every path
+        perm_sidecar = (None if args.A.startswith("gen:")
+                        else _load_perm_sidecar(args.A, n))
 
         # stage 2b/2c: partition rows and build subdomains
         nparts = args.nparts
@@ -779,11 +877,15 @@ def _main(args) -> int:
             b = np.asarray(bmtx.vals, dtype=np.float64).reshape(-1)
             if b.size != n:
                 raise SystemExit(f"acg-tpu: b has {b.size} entries, need {n}")
+            if perm_sidecar is not None:
+                b = b[perm_sidecar]
         else:
             b = np.ones(n)
         if args.x0:
             xmtx = read_mtx(args.x0, binary=args.binary)
             x0 = np.asarray(xmtx.vals, dtype=np.float64).reshape(-1)
+            if perm_sidecar is not None and x0.size == n:
+                x0 = x0[perm_sidecar]
         else:
             x0 = None
 
@@ -810,6 +912,14 @@ def _main(args) -> int:
     # trace -- that is when it is most needed)
     t0 = time.perf_counter()
     pipelined = "pipelined" in args.solver
+    if args.replace_every and (
+            args.solver in ("host", "host-native", "petsc")
+            or not (comm == "none" or nparts == 1)):
+        sys.stderr.write("acg-tpu: --replace-every applies to the "
+                         "single-device bf16 solve only (use --refine "
+                         "for f64-grade accuracy elsewhere)\n")
+        checkpoint("solve", 1)
+        return 1
     comm_mtx_out = None
     if args.trace:
         jax.profiler.start_trace(args.trace)
@@ -841,10 +951,14 @@ def _main(args) -> int:
         elif comm == "none" or nparts == 1:
             dev = device_matrix_from_csr(csr, dtype=dtype,
                                          format=args.spmv_format)
-            solver = JaxCGSolver(dev, pipelined=pipelined,
-                                 precise_dots=args.precise_dots,
-                                 kernels=args.kernels,
-                                 vector_dtype=vec_dtype)
+            try:
+                solver = JaxCGSolver(dev, pipelined=pipelined,
+                                     precise_dots=args.precise_dots,
+                                     kernels=args.kernels,
+                                     vector_dtype=vec_dtype,
+                                     replace_every=args.replace_every)
+            except ValueError as e:
+                raise SystemExit(f"acg-tpu: {e}")
             if args.refine:
                 solver = RefinedSolver(solver, csr,
                                        inner_rtol=args.refine_rtol)
@@ -927,6 +1041,10 @@ def _main(args) -> int:
             rowidx=nz[0], colidx=nz[1], vals=comm_mtx_out[nz]),
             numfmt="%d")
     if not args.quiet:
+        if perm_sidecar is not None:
+            xo = np.empty_like(np.asarray(x))
+            xo[perm_sidecar] = np.asarray(x)
+            x = xo
         write_mtx(sys.stdout.buffer, vector_mtx(x), numfmt=args.numfmt)
     return 0
 

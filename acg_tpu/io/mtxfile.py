@@ -261,6 +261,57 @@ def expand_to_rowsorted_full(mtx: MtxFile) -> MtxFile:
                    comments=list(mtx.comments))
 
 
+def apply_partition_rowsorted(mtx: MtxFile, part: np.ndarray):
+    """Symmetrically permute FULL-storage ``mtx`` so each partition's
+    rows are CONTIGUOUS: rows grouped by part id (stable -- natural
+    order within a part), columns renumbered by the same permutation
+    (P A P^T), entries re-sorted by (row, col).
+
+    This is what lets an arbitrary (METIS/graph) partition ride the
+    band-partition range-read machinery unchanged: after grouping,
+    part p owns rows ``[bounds[p], bounds[p+1])`` of the permuted
+    matrix, so :func:`read_mtx_row_range` +
+    ``graph.subdomain_from_row_slice`` (which is fully general in
+    column connectivity) reconstruct exactly the partition METIS chose.
+    The role of the reference's partition/permute/compact of matrix
+    files (``acgmtxfilepartition``, ``mtxfile.h:436,1450``) restated
+    for rootless range reads.
+
+    Returns ``(permuted, bounds, perm)``: ``bounds`` has nparts+1
+    ascending row boundaries and ``perm[new] = old`` maps permuted row
+    ids back to the input ordering (apply to solutions as
+    ``x_orig[perm] = x_perm``).
+    """
+    if mtx.symmetry != "general":
+        raise AcgError(ErrorCode.NOT_SUPPORTED,
+                       "apply_partition_rowsorted needs FULL storage "
+                       "(expand first)")
+    part = np.asarray(part)
+    if part.size != mtx.nrows:
+        raise AcgError(ErrorCode.INVALID_VALUE,
+                       f"partition vector has {part.size} entries, "
+                       f"matrix has {mtx.nrows} rows")
+    nparts = int(part.max()) + 1 if part.size else 0
+    if part.size and part.min() < 0:
+        raise AcgError(ErrorCode.INVALID_VALUE, "negative part id")
+    perm = np.argsort(part, kind="stable").astype(np.int64)
+    rank = np.empty_like(perm)
+    rank[perm] = np.arange(perm.size, dtype=np.int64)
+    counts = np.bincount(part, minlength=nparts)
+    bounds = np.concatenate([[0], np.cumsum(counts)]).astype(np.int64)
+
+    r, c, v = mtx.to_coo()
+    nr, nc = rank[np.asarray(r)], rank[np.asarray(c)]
+    order = np.lexsort((nc, nr))
+    permuted = MtxFile(object=mtx.object, format=mtx.format,
+                       field=mtx.field, symmetry="general",
+                       nrows=mtx.nrows, ncols=mtx.ncols, nnz=int(nr.size),
+                       rowidx=nr[order], colidx=nc[order],
+                       vals=None if v is None else np.asarray(v)[order],
+                       comments=list(mtx.comments))
+    return permuted, bounds, perm
+
+
 def read_mtx_sizes(path) -> tuple[int, int, int]:
     """(nrows, ncols, nnz) from a Matrix Market header without reading
     the data section (O(1) I/O; used to derive band bounds before a

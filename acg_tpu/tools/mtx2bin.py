@@ -26,10 +26,35 @@ def main(argv=None) -> int:
                         "required for per-controller RANGE reads "
                         "(read_mtx_row_range) at pod scale -- each "
                         "controller then reads only its rows")
+    p.add_argument("--partition", metavar="FILE", default=None,
+                   help="with --expand: apply a partition vector "
+                        "(mtxpartition output) by symmetrically "
+                        "permuting the matrix so each part's rows are "
+                        "contiguous -- arbitrary METIS/graph partitions "
+                        "then ride the band range-read ingest "
+                        "(--distributed-read) unchanged.  Writes two "
+                        "sidecars next to OUTPUT: OUTPUT.bounds.mtx "
+                        "(nparts+1 part boundaries, read automatically "
+                        "by --distributed-read) and OUTPUT.perm.mtx "
+                        "(permuted-to-original row map, applied "
+                        "automatically to solution output)")
+    p.add_argument("--partition-binary", action="store_true",
+                   help="the --partition file is binary")
     p.add_argument("-v", "--verbose", action="count", default=0)
     args = p.parse_args(argv)
 
-    from acg_tpu.io.mtxfile import expand_to_rowsorted_full, read_mtx, write_mtx
+    import numpy as np
+
+    from acg_tpu.io.mtxfile import (apply_partition_rowsorted,
+                                    expand_to_rowsorted_full, read_mtx,
+                                    vector_mtx, write_mtx)
+
+    if args.partition and not args.expand:
+        p.error("--partition requires --expand (range reads need "
+                "row-sorted full storage)")
+    if args.partition and not args.output:
+        p.error("--partition requires an OUTPUT path (the bounds/perm "
+                "sidecars are named after it)")
 
     t0 = time.perf_counter()
     mtx = read_mtx(args.input)
@@ -40,6 +65,32 @@ def main(argv=None) -> int:
         mtx = expand_to_rowsorted_full(mtx)
         if args.verbose:
             sys.stderr.write(f"expand: full storage, {mtx.nnz} nnz\n")
+    if args.output and not args.partition:
+        # remove stale sidecars from an earlier --partition run to the
+        # same path: a leftover perm/bounds pair would silently reorder
+        # solutions of the now-unpermuted matrix
+        import os
+        for ext in (".bounds.mtx", ".perm.mtx"):
+            if os.path.exists(args.output + ext):
+                os.remove(args.output + ext)
+                if args.verbose:
+                    sys.stderr.write(f"removed stale {args.output}{ext}\n")
+    if args.partition:
+        pmtx = read_mtx(args.partition, binary=args.partition_binary)
+        part = np.asarray(pmtx.vals).reshape(-1).astype(np.int64)
+        if part.size and part.min() == 1:
+            part = part - 1  # tolerate 1-based partition vectors
+        t0 = time.perf_counter()
+        mtx, bounds, perm = apply_partition_rowsorted(mtx, part)
+        write_mtx(args.output + ".bounds.mtx",
+                  vector_mtx(bounds, field="integer"), numfmt="%d")
+        write_mtx(args.output + ".perm.mtx",
+                  vector_mtx(perm + 1, field="integer"), binary=True)
+        if args.verbose:
+            sys.stderr.write(
+                f"partition: {bounds.size - 1} parts grouped contiguous "
+                f"in {time.perf_counter() - t0:.6f} s; sidecars "
+                f"{args.output}.bounds.mtx, {args.output}.perm.mtx\n")
     t0 = time.perf_counter()
     if args.output:
         write_mtx(args.output, mtx, binary=True)

@@ -87,13 +87,21 @@ def _our_bytes_per_iter(nnz: int, n: int, idx_bytes: float,
 # "mixed" = bf16 matrix + f32 vectors (lossless for Poisson stencil
 # values -> arithmetic-identical to f32 at half the matrix traffic);
 # "bf16" = half traffic everywhere but kappa-limited (~500) vector
-# storage -- diverges at flagship conditioning, measured and reported
+# storage -- diverges at flagship conditioning, measured and reported;
+# "bf16rr" = bf16 with periodic f32 residual replacement every
+# REPLACE_EVERY iterations (solvers.jax_cg._cg_replaced_program): the
+# SOUND half-traffic tier -- f32-class residuals at flagship
+# conditioning for ~2% replacement overhead (round 4)
+REPLACE_EVERY = 50
+
+
 def _dtypes_of(dtype_name: str):
     import jax.numpy as jnp
 
     return {"f32": (jnp.float32, jnp.float32),
             "mixed": (jnp.bfloat16, jnp.float32),
-            "bf16": (jnp.bfloat16, jnp.bfloat16)}[dtype_name]
+            "bf16": (jnp.bfloat16, jnp.bfloat16),
+            "bf16rr": (jnp.bfloat16, jnp.bfloat16)}[dtype_name]
 
 
 _probe_cache: float | None = None
@@ -209,9 +217,18 @@ def _time_solver(solver, b, criteria_cls, repeats: int = TIMED_REPEATS,
 
 def _roofline_context(row: dict, bytes_per_iter: float) -> dict:
     """Attach ``bw_gbs`` (probe) and ``roofline_frac`` (achieved traffic
-    over probe bandwidth) so a contended capture reads as such."""
+    over probe bandwidth) so a contended capture reads as such.
+
+    The probe runs FRESH for every row (round-3 verdict: a cached probe
+    minutes stale under different contention produced roofline_frac >
+    1.0 -- a context key that cannot distinguish a contended probe from
+    a wrong traffic model).  ``roofline_frac`` can still legitimately
+    exceed 1.0 for configs whose working set is partly on-chip-resident
+    (the bf16 flagship family: measured up to ~6.8k iters/s against a
+    ~700 GB/s probe); the paired fresh probe makes that reading
+    interpretable instead of inconsistent."""
     try:
-        bw = bandwidth_probe_gbs()
+        bw = bandwidth_probe_gbs(refresh=True)
     except Exception as e:  # noqa: BLE001 -- the probe must not sink rows
         print(f"# bandwidth probe failed: {e}", file=sys.stderr)
         return row
@@ -219,6 +236,33 @@ def _roofline_context(row: dict, bytes_per_iter: float) -> dict:
     row["roofline_frac"] = round(
         row["value"] * bytes_per_iter / (bw * 1e9), 3)
     return row
+
+
+# a window counts as quiet when the triad probe reaches this fraction of
+# the chip's quiet-window bandwidth (v5e: ~800-915 GB/s measured)
+QUIET_GBS = 600.0
+
+
+def wait_for_quiet(budget_s: float = 240.0, min_bw: float = QUIET_GBS):
+    """Probe-gate for the headline capture (round-3 verdict item 1):
+    retry the bandwidth probe until it reports a quiet window or the
+    time budget runs out.  Returns ``(bw_gbs, quiet)``; the caller
+    records both so a contended capture self-describes."""
+    deadline = time.monotonic() + budget_s
+    while True:
+        try:
+            bw = bandwidth_probe_gbs(refresh=True)
+        except RuntimeError:
+            bw = 0.0
+        if bw >= min_bw:
+            return bw, True
+        left = deadline - time.monotonic()
+        if left <= 0:
+            return bw, False
+        wait = min(20.0, left)
+        print(f"# window contended (probe {bw:.0f} GB/s < {min_bw:.0f}); "
+              f"retrying in {wait:.0f}s", file=sys.stderr)
+        time.sleep(wait)
 
 
 def run_case(csr, name: str, pipelined: bool, dist: bool = False,
@@ -248,8 +292,10 @@ def run_case(csr, name: str, pipelined: bool, dist: bool = False,
         from acg_tpu.ops.spmv import matrix_index_bytes
 
         A = device_matrix_from_csr(csr, dtype=mat_dtype, format=spmv_format)
-        solver = JaxCGSolver(A, pipelined=pipelined, kernels=kernels,
-                             vector_dtype=vec_dtype)
+        solver = JaxCGSolver(
+            A, pipelined=pipelined, kernels=kernels,
+            vector_dtype=vec_dtype,
+            replace_every=REPLACE_EVERY if dtype_name == "bf16rr" else 0)
         fmt = type(A).__name__.replace("Matrix", "").lower()
         idx_bytes = matrix_index_bytes(A)
     tsolve, maxits = _time_solver(solver, b, StoppingCriteria)
@@ -306,11 +352,25 @@ def _enable_compile_cache():
     enable_compile_cache()
 
 
-def _accuracy_context(csr, row: dict) -> dict:
-    """Measure the bf16 tier's accuracy cost next to its speed: the TRUE
-    f64 relative residual after the protocol's fixed iteration count
-    (bf16 CG stalls at its storage noise floor ~1e-2; ``--refine``
-    recovers below 1e-5 -- tests/test_bf16.py, BASELINE.md)."""
+# headline-eligibility threshold for the bf16-family tiers: the true
+# relative residual after the protocol's 1000 iterations under the
+# manufactured-solution setup must be f32-class.  Measured flagship
+# values: f32 8.0e-7, bf16rr 1.0e-6, plain bf16 2.2e-1 (stall), so the
+# gate cleanly separates sound from stalled tiers with margin
+SOUND_REL_RESIDUAL = 1e-4
+
+
+def _accuracy_context(csr, row: dict, dtype_name: str) -> dict:
+    """Measure a bf16-family tier's accuracy next to its speed: the TRUE
+    f64 relative residual after the protocol's fixed iteration count,
+    under the reference's own verification setup (random unit-norm
+    manufactured xsol, b = A xsol -- ``cuda/acg-cuda.c:1969-1984``; the
+    benchmark scripts always run with --manufactured-solution,
+    ``scripts/nccl_combined.sh:55-60``).  b = ones is NOT used here: at
+    flagship conditioning its solution norm is ~1e8, putting even exact
+    f32 arithmetic at an O(10) relative-residual floor -- a scale
+    artifact that would mask the actual soundness difference between
+    tiers (plain bf16 stalls at 2e-1, replacement reaches 1e-6)."""
     import jax.numpy as jnp
     import numpy as np
 
@@ -319,13 +379,20 @@ def _accuracy_context(csr, row: dict) -> dict:
     from acg_tpu.solvers.stats import StoppingCriteria
 
     try:
+        rng = np.random.default_rng(0)
+        xsol = rng.standard_normal(csr.shape[0])
+        xsol /= np.linalg.norm(xsol)
+        b = (csr @ xsol).astype(np.float32)
         A = device_matrix_from_csr(csr, dtype=jnp.bfloat16)
-        b = np.ones(csr.shape[0], dtype=np.float32)
-        s = JaxCGSolver(A, kernels="xla")
+        s = JaxCGSolver(
+            A, kernels="xla",
+            replace_every=REPLACE_EVERY if dtype_name == "bf16rr" else 0)
         x = np.asarray(s.solve(b, criteria=StoppingCriteria(maxits=MAXITS),
                                raise_on_divergence=False), dtype=np.float64)
         rel = float(np.linalg.norm(b - csr @ x) / np.linalg.norm(b))
         row["rel_residual_1000it"] = float(f"{rel:.3g}")
+        row["error_2norm_1000it"] = float(
+            f"{np.linalg.norm(x - xsol):.3g}")
     except Exception as e:  # noqa: BLE001 -- context must not sink the row
         print(f"# accuracy context failed: {e}", file=sys.stderr)
     return row
@@ -484,6 +551,12 @@ def main(argv=None) -> int:
     ap = argparse.ArgumentParser()
     ap.add_argument("--full", action="store_true",
                     help="run the whole BASELINE ladder (one JSON line/row)")
+    ap.add_argument("--row", metavar="SUBSTR", default=None,
+                    help="with --full: run only ladder rows whose metric "
+                         "name contains SUBSTR (per-row driver "
+                         "invocations -- scripts/ladder.sh -- so one "
+                         "contention burst or tunnel drop cannot take "
+                         "out subsequent rows; round-3 verdict item 8)")
     ap.add_argument("--sweep-np", action="store_true",
                     help="multi-chip CPU-mesh correctness sweep")
     args = ap.parse_args(argv)
@@ -496,23 +569,27 @@ def main(argv=None) -> int:
     _enable_compile_cache()
 
     if not args.full:
-        # flagship: measure the kernel tiers AND the storage tiers in
-        # the same contention window and report the best SOUND config
-        # (uncontended A/B favours Pallas by ~1.03-1.33x and the
-        # half-traffic tiers by ~1.5-2x, while contention swings dwarf
-        # both, so no choice can be a blind bet).  "mixed" (bf16 matrix
-        # + f32 vectors) is arithmetic-identical to f32 here, so both
-        # are always sound; all-bf16 vector storage is kappa-limited
-        # (~500) and DIVERGES at the flagship's kappa ~ 1.7e6, so its
-        # throughput + measured accuracy ride along as context keys
-        # instead of competing for the headline.
+        # flagship: wait for a quiet window (probe-gated, round-3
+        # verdict item 1), then measure the kernel AND storage tiers in
+        # that window and report the best SOUND config.  "f32"/"mixed"
+        # are sound by construction ("mixed" is arithmetic-identical to
+        # f32); the bf16-family tiers must DEMONSTRATE soundness -- the
+        # measured true relative residual under the manufactured-
+        # solution protocol must clear SOUND_REL_RESIDUAL.  Plain bf16
+        # stalls at ~2e-1 at flagship kappa (context keys only);
+        # "bf16rr" (periodic f32 residual replacement) measures ~1e-6
+        # and competes for the headline at ~0.94x plain-bf16 speed.
         # one stable metric name across rounds/runs; the winning tier is
         # recorded in the "dtype"/"kernels" fields (a name that changed
         # with the winner would split the longitudinal series)
         name = "cg_iters_per_sec_poisson2d_n2048_f32"
         csr = _build(2048, 2)
+        bw0, quiet = wait_for_quiet()
+        print(f"# capture window: probe {bw0:.0f} GB/s "
+              f"({'quiet' if quiet else 'CONTENDED -- budget exhausted'})",
+              file=sys.stderr)
         rows = {}
-        for dtn in ("f32", "mixed", "bf16"):
+        for dtn in ("f32", "mixed", "bf16", "bf16rr"):
             # a tier that fails (compile flake, OOM) must not sink the
             # tiers already measured
             try:
@@ -528,16 +605,23 @@ def main(argv=None) -> int:
         if not rows:
             return 1
         sound = [rows[k] for k in ("f32", "mixed") if k in rows]
-        bf = rows.get("bf16")
-        if bf is not None:
-            bf = _accuracy_context(csr, bf)
-            if bf.get("rel_residual_1000it", float("inf")) < 0.5:
-                sound.append(bf)  # made real progress: sound at this kappa
+        for dtn in ("bf16", "bf16rr"):
+            row = rows.get(dtn)
+            if row is None:
+                continue
+            row = _accuracy_context(csr, row, dtn)
+            if row.get("rel_residual_1000it",
+                       float("inf")) < SOUND_REL_RESIDUAL:
+                sound.append(row)
         best = max(sound or rows.values(), key=lambda r: r["value"])
-        if bf is not None and best is not bf:
-            best["bf16_iters_per_sec"] = bf["value"]
-            if "rel_residual_1000it" in bf:
-                best["bf16_rel_residual_1000it"] = bf["rel_residual_1000it"]
+        for dtn in ("bf16", "bf16rr"):
+            row = rows.get(dtn)
+            if row is not None and best is not row:
+                best[f"{dtn}_iters_per_sec"] = row["value"]
+                if "rel_residual_1000it" in row:
+                    best[f"{dtn}_rel_residual_1000it"] = \
+                        row["rel_residual_1000it"]
+        best["quiet_window"] = bool(quiet)
         print(json.dumps(best))
         return 0
 
@@ -550,6 +634,8 @@ def main(argv=None) -> int:
              2048, 2, False, False, "auto", "mixed"),
             ("cg_iters_per_sec_poisson2d_n2048_bf16",
              2048, 2, False, False, "auto", "bf16"),
+            ("cg_iters_per_sec_poisson2d_n2048_bf16rr",
+             2048, 2, False, False, "auto", "bf16rr"),
             ("cg_pipelined_iters_per_sec_poisson2d_n2048_f32",
              2048, 2, True, False, "xla", "f32"),
             ("cg_iters_per_sec_poisson3d_n128_f32",
@@ -571,6 +657,12 @@ def main(argv=None) -> int:
         ]
 
     built: dict[tuple, object] = {}
+    if args.row:
+        # exact name match wins (several row names are substrings of
+        # others, e.g. ..._bf16 / ..._bf16rr); substring is the
+        # fallback for family selections
+        exact = [c for c in cases if c[0] == args.row]
+        cases = exact or [c for c in cases if args.row in c[0]]
     for name, side, dim, pipelined, dist, kernels, dtn in cases:
         # one failing case (device flake, OOM) must not sink the rest of
         # the ladder -- report it and keep going
@@ -598,7 +690,11 @@ def main(argv=None) -> int:
     for name, kind in (
             ("cg_iters_per_sec_poisson3d_n128_petsc_f64", "petsc"),
             ("cg_iters_per_sec_poisson3d_n128_hostnative_f64", "native")):
+        if args.row and args.row not in name:
+            continue
         try:
+            if (128, 3) not in built:
+                built[(128, 3)] = _build(128, 3)
             print(json.dumps(run_host_baseline(built[(128, 3)], name, kind)))
         except Exception as e:  # noqa: BLE001 -- report and continue
             print(f"# {name} skipped: {type(e).__name__}: "
@@ -609,9 +705,11 @@ def main(argv=None) -> int:
     # skipped gracefully where the device memory cannot hold it
     built.clear()
     for dtn in ("f32", "mixed"):
+        name = f"cg_iters_per_sec_poisson3d_n512_{dtn}_dia"
+        if args.row and args.row not in name:
+            continue
         try:
-            print(json.dumps(run_case_dia(
-                512, 3, f"cg_iters_per_sec_poisson3d_n512_{dtn}_dia", dtn)))
+            print(json.dumps(run_case_dia(512, 3, name, dtn)))
         except Exception as e:  # noqa: BLE001 -- report and continue
             print(f"# 512^3 {dtn} row skipped: {type(e).__name__}: "
                   f"{str(e).splitlines()[0][:200]}", file=sys.stderr)

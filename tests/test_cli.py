@@ -302,3 +302,27 @@ def test_cli_buildinfo():
     assert r.returncode == 0, r.stderr
     for key in ("acg-tpu:", "jax:", "backend:", "native core", "libmetis:"):
         assert key in r.stdout, r.stdout
+
+
+def test_cli_replace_every_bf16(tmp_path):
+    """--dtype bf16 --replace-every: the sound-bf16 tier end-to-end --
+    converges to a residual tolerance plain bf16 cannot reach, with the
+    manufactured-solution error reported."""
+    r = run_cli("acg_tpu.cli",
+                ["gen:poisson2d:64", "--dtype", "bf16", "--nparts", "1",
+                 "--replace-every", "25", "--solver", "acg",
+                 "--max-iterations", "4000", "--residual-rtol", "1e-4",
+                 "--manufactured-solution", "--warmup", "0", "--quiet"])
+    assert r.returncode == 0, r.stderr
+    err = float([ln for ln in r.stderr.splitlines()
+                 if ln.startswith("error 2-norm:")][0].split(":")[1])
+    assert err < 2e-2
+    assert "total solver time:" in r.stderr
+
+
+def test_cli_replace_every_rejects_f32():
+    r = run_cli("acg_tpu.cli",
+                ["gen:poisson2d:16", "--dtype", "f32",
+                 "--replace-every", "25", "--warmup", "0", "--quiet"])
+    assert r.returncode != 0
+    assert "bf16" in r.stderr

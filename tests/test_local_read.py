@@ -217,3 +217,165 @@ def test_cli_two_process_one_sided_read_failure(binfile, tmp_path):
     assert p0.returncode != 0 and p1.returncode != 0
     assert elapsed < 150
     assert "peer controller failed during ingest" in outs[0][1]
+
+
+# -- arbitrary (METIS/graph) partitions via offline permutation ----------
+# (round-3 verdict item 2: the band-only limitation removed)
+
+@pytest.fixture(scope="module")
+def irregular():
+    """An irregular SPD matrix a band partition would serve poorly."""
+    from acg_tpu.io.generators import irregular_spd_coo
+    r, c, v, N = irregular_spd_coo(400, avg_degree=6.0, seed=3)
+    return SymCsrMatrix.from_coo(N, r, c, v).to_csr()
+
+
+@pytest.fixture(scope="module")
+def part_binfile(tmp_path_factory, irregular):
+    """The offline pipeline: graph partition -> mtx2bin --expand
+    --partition -> permuted binary + sidecars."""
+    from acg_tpu.io.mtxfile import vector_mtx
+    from acg_tpu.partition import partition_rows
+    from acg_tpu.tools.mtx2bin import main as mtx2bin_main
+
+    d = tmp_path_factory.mktemp("mp")
+    src = d / "irr.mtx"
+    coo = irregular.tocoo()
+    up = coo.row <= coo.col  # one-triangle symmetric input, like mtxpartition
+    from acg_tpu.io.mtxfile import MtxFile
+    write_mtx(src, MtxFile(object="matrix", format="coordinate",
+                           field="real", symmetry="symmetric",
+                           nrows=irregular.shape[0],
+                           ncols=irregular.shape[0], nnz=int(up.sum()),
+                           rowidx=coo.row[up], colidx=coo.col[up],
+                           vals=coo.data[up]))
+    part = partition_rows(irregular, 3, seed=0, method="graph")
+    pf = d / "part.mtx"
+    write_mtx(pf, vector_mtx(part.astype(np.int64), field="integer"),
+              numfmt="%d")
+    out = d / "irr.bin.mtx"
+    rc = mtx2bin_main([str(src), str(out), "--expand",
+                       "--partition", str(pf)])
+    assert rc == 0
+    return out, part
+
+
+def test_partitioned_sidecars(part_binfile, irregular):
+    from acg_tpu.io.mtxfile import read_mtx
+    out, part = part_binfile
+    bounds = np.asarray(read_mtx(str(out) + ".bounds.mtx").vals).reshape(-1)
+    counts = np.bincount(part, minlength=3)
+    np.testing.assert_array_equal(bounds,
+                                  np.concatenate([[0], np.cumsum(counts)]))
+    perm = np.asarray(read_mtx(str(out) + ".perm.mtx",
+                               binary=True).vals).reshape(-1) - 1
+    # perm groups rows by part, stable
+    np.testing.assert_array_equal(part[perm], np.sort(part, kind="stable"))
+
+
+def test_partitioned_subdomains_match_full_partitioner(part_binfile,
+                                                       irregular):
+    """Range-read subdomains of the permuted file == the full-graph
+    partitioner run on the permuted matrix with the same (now grouped)
+    partition -- the METIS generalization of the band exactness test."""
+    from acg_tpu.graph import partition_matrix, reorder_owned_natural
+    from acg_tpu.io.mtxfile import read_mtx
+
+    out, part = part_binfile
+    bounds = np.asarray(read_mtx(str(out) + ".bounds.mtx").vals
+                        ).reshape(-1).astype(np.int64)
+    perm = np.asarray(read_mtx(str(out) + ".perm.mtx", binary=True).vals
+                      ).reshape(-1).astype(np.int64) - 1
+    perm_csr = irregular[perm][:, perm].tocsr()
+    gpart = (np.searchsorted(bounds, np.arange(irregular.shape[0]),
+                             side="right") - 1).astype(np.int32)
+    ref_subs = reorder_owned_natural(partition_matrix(perm_csr, gpart, 3))
+    for p in range(3):
+        sl = read_mtx_row_range(out, int(bounds[p]), int(bounds[p + 1]))
+        r, c, v = sl.to_coo()
+        s = subdomain_from_row_slice(r, c, v, bounds, p)
+        ref = ref_subs[p]
+        assert s.nowned == ref.nowned and s.nghost == ref.nghost
+        np.testing.assert_array_equal(s.global_ids, ref.global_ids)
+        np.testing.assert_array_equal(s.ghost_owner, ref.ghost_owner)
+        np.testing.assert_array_equal(s.halo.send_parts,
+                                      ref.halo.send_parts)
+        np.testing.assert_array_equal(s.halo.send_idx, ref.halo.send_idx)
+        assert (s.A_local != ref.A_local).nnz == 0
+        assert (s.A_ghost != ref.A_ghost).nnz == 0
+
+
+def test_partitioned_local_read_solves_to_original(part_binfile, irregular):
+    """build_local_read over the permuted file solves the ORIGINAL
+    system: un-permuting the solution must satisfy the original matrix."""
+    from acg_tpu.io.mtxfile import read_mtx
+
+    out, part = part_binfile
+    bounds = np.asarray(read_mtx(str(out) + ".bounds.mtx").vals
+                        ).reshape(-1).astype(np.int64)
+    perm = np.asarray(read_mtx(str(out) + ".perm.mtx", binary=True).vals
+                      ).reshape(-1).astype(np.int64) - 1
+    prob = DistributedProblem.build_local_read(out, 3, dtype=jnp.float64,
+                                               bounds=bounds)
+    assert prob.local.format == "ell"  # irregular: no DIA structure
+    solver = DistCGSolver(prob)
+    n = irregular.shape[0]
+    b_orig = np.ones(n)
+    x_perm = solver.solve(b_orig[perm],  # b in permuted ordering
+                          criteria=StoppingCriteria(maxits=3000,
+                                                    residual_rtol=1e-10))
+    x = np.empty(n)
+    x[perm] = x_perm
+    rel = np.linalg.norm(b_orig - irregular @ x) / np.linalg.norm(b_orig)
+    assert rel < 1e-8
+
+
+def test_cli_two_process_partitioned_distributed_read(part_binfile):
+    """2-process METIS-partitioned ingest: each controller range-reads
+    only its permuted rows (O(local nnz)), bounds sidecar auto-detected."""
+    out, part = part_binfile
+    port = _free_port()
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["XLA_FLAGS"] = "--xla_force_host_platform_device_count=2"
+
+    def launch(pid):
+        argv = [sys.executable, "-m", "acg_tpu.cli", str(out),
+                "--binary", "--distributed-read",
+                "--manufactured-solution", "--max-iterations", "3000",
+                "--residual-rtol", "1e-8", "--dtype", "f64",
+                "--warmup", "0", "--quiet",
+                "--coordinator", f"localhost:{port}",
+                "--num-processes", "2", "--process-id", str(pid)]
+        return subprocess.Popen(argv, stdout=subprocess.PIPE,
+                                stderr=subprocess.PIPE, text=True, env=env)
+
+    procs = [launch(i) for i in range(2)]
+    outs = [p.communicate(timeout=600) for p in procs]
+    for p, (_, se) in zip(procs, outs):
+        assert p.returncode == 0, se
+    (so0, se0), (so1, se1) = outs
+    err = float(se0.split("\nerror 2-norm: ")[1].split()[0])
+    assert err < 1e-6, se0
+
+
+def test_cli_singledevice_permuted_output_original_order(part_binfile,
+                                                         irregular):
+    """The replicated single-device path must honor the perm sidecar
+    too: solving the permuted binary prints the solution in ORIGINAL
+    row ordering (consistent with --distributed-read)."""
+    out, part = part_binfile
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    r = subprocess.run(
+        [sys.executable, "-m", "acg_tpu.cli", str(out), "--binary",
+         "--nparts", "1", "--dtype", "f64", "--max-iterations", "3000",
+         "--residual-rtol", "1e-10", "--warmup", "0"],
+        capture_output=True, text=True, env=env)
+    assert r.returncode == 0, r.stderr
+    from io import BytesIO
+    from acg_tpu.io.mtxfile import read_mtx
+    x = np.asarray(read_mtx(BytesIO(r.stdout.encode())).vals).reshape(-1)
+    b = np.ones(irregular.shape[0])
+    rel = np.linalg.norm(b - irregular @ x) / np.linalg.norm(b)
+    assert rel < 1e-8
