@@ -74,3 +74,90 @@ def enable_compile_cache(path: str | None = None) -> None:
         jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
     except Exception:  # noqa: BLE001 -- an optimisation, never fatal
         pass
+
+
+_block_broken: bool | None = None
+
+
+def block_until_ready_works() -> bool:
+    """Whether ``Array.block_until_ready`` actually waits on this
+    backend.
+
+    The tunneled TPU plugin has been observed (2026-07-30) to return
+    from ``block_until_ready`` in ~0.03 ms while the submitted program
+    still runs for seconds -- which silently zeroes every wall-clock
+    measurement in the solvers and the bandwidth probe.  Probe once: a
+    data-dependent chained program sized to take >= tens of ms must not
+    "complete" instantly.  Cached for the process lifetime.
+    """
+    global _block_broken
+    if _block_broken is not None:
+        return not _block_broken
+    import functools
+    import time
+
+    import jax
+    import jax.numpy as jnp
+
+    n = 1 << 26  # 256 MB f32 working vector
+
+    @functools.partial(jax.jit, static_argnames="k")
+    def chain(a, k):
+        return jax.lax.fori_loop(
+            0, k, lambda _, v: jnp.float32(1.0000001) * v + 0.5, a)
+
+    a = jnp.ones((n,), jnp.float32)
+    # Grow the chained program until EITHER side of the discriminator is
+    # unambiguous.  An honest block absorbs the (k-proportional)
+    # execution, leaving the fetch one dispatch round-trip; a broken
+    # block returns instantly and pushes the execution into the fetch.
+    # Declaring HONEST requires positive evidence (block both long in
+    # absolute terms and >= the fetch) because a false "honest" silently
+    # zeroes every timing, while a false "broken" merely adds one
+    # harmless fetch per measurement -- so the fallthrough is "broken".
+    verdict = True  # broken unless proven otherwise
+    k = 8
+    while k <= 2048:  # 2048 * 0.75 GB: >= 100 ms even at v5p bandwidth
+        r = chain(a, k)
+        t0 = time.perf_counter()
+        r.block_until_ready()
+        t_block = time.perf_counter() - t0
+        t0 = time.perf_counter()
+        jax.device_get(jnp.ravel(r)[:1])  # cannot return before r exists
+        t_fetch = time.perf_counter() - t0
+        if t_block >= 0.05 and t_block >= t_fetch:
+            verdict = False  # block demonstrably waited on real work
+            break
+        if t_fetch >= 0.25 and t_block * 20 < t_fetch:
+            break  # execution landed in the fetch: broken
+        k *= 4
+    _block_broken = verdict
+    if _block_broken:
+        import sys
+        print("# acg-tpu: block_until_ready does not wait on this "
+              "backend; timing falls back to scalar-fetch sync",
+              file=sys.stderr)
+    return not _block_broken
+
+
+def device_sync(x) -> None:
+    """Wait until ``x`` has actually been computed, even on backends
+    whose ``block_until_ready`` lies (see
+    :func:`block_until_ready_works`).  The fallback fetches ONE element
+    through a dependent slice -- adding a dispatch round-trip, which
+    callers doing fine timing should cancel with a chained two-point
+    protocol (bench.bandwidth_probe_gbs does)."""
+    x.block_until_ready()
+    if not block_until_ready_works():
+        import jax
+        import jax.numpy as jnp
+
+        if getattr(x, "is_fully_addressable", True):
+            jax.device_get(jnp.ravel(x)[:1])
+        else:
+            # multi-controller sharded array: a global [:1] slice is
+            # not fetchable from processes that do not own shard 0;
+            # sync on one LOCAL shard instead (same completion point --
+            # the program finishes as a unit)
+            sh = x.addressable_shards[0].data
+            jax.device_get(jnp.ravel(sh)[:1])

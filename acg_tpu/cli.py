@@ -211,6 +211,17 @@ def make_parser() -> argparse.ArgumentParser:
                         "(the reference's nsys-trace tier; view with xprof)")
     p.add_argument("-q", "--quiet", action="store_true",
                    help="do not write the solution vector to stdout")
+    p.add_argument("-o", "--output", metavar="FILE", default=None,
+                   help="write the solution to FILE instead of stdout.  "
+                        "Under --distributed-read the write is "
+                        "DISTRIBUTED: each controller range-writes its "
+                        "owned row windows of a binary array vector "
+                        "directly (no full-vector gather on any "
+                        "controller -- the mtxfile_fwrite_mpi_double "
+                        "role, mtxfile.h:1087), the primary writes only "
+                        "the header.  Rows are in the matrix's on-disk "
+                        "ordering (permuted inputs stay permuted; the "
+                        ".perm.mtx sidecar maps back)")
     p.add_argument("-v", "--verbose", action="count", default=0,
                    help="print stage timings to stderr")
     p.add_argument("--version", action="version", version="acg-tpu 0.1.0")
@@ -343,7 +354,6 @@ def _solve_generated_direct(args, dim, n, N, jax, jnp, dtype,
         (f"--solver {args.solver}",
          args.solver in ("host", "host-native", "petsc")),
         ("b/x0 input files", bool(args.b or args.x0)),
-        ("--refine", args.refine),
         ("--output-comm-matrix", args.output_comm_matrix),
         (f"--spmv-format {args.spmv_format}",
          args.spmv_format not in ("auto", "dia")),
@@ -357,14 +367,15 @@ def _solve_generated_direct(args, dim, n, N, jax, jnp, dtype,
 
     vec_dtype = dtype if vec_dtype is None else vec_dtype
 
-    # multi-part / multi-controller / manufactured configurations run the
-    # SHARDED assembly + solve (parallel/sharded_dia): per-shard on-device
-    # planes, halo exchange derived by the SPMD partitioner.  This makes
-    # the north-star configuration -- gen:poisson3d:512 --multihost
-    # --nparts N -- expressible end-to-end with O(N/P) device memory per
+    # multi-part / multi-controller / manufactured / refined
+    # configurations run the SHARDED assembly + solve
+    # (parallel/sharded_dia): per-shard on-device planes, halo exchange
+    # derived by the SPMD partitioner.  This makes the north-star
+    # configuration -- gen:poisson3d:512 --multihost --nparts N
+    # [--refine] -- expressible end-to-end with O(N/P) device memory per
     # chip and O(1) host memory per controller.
     if (args.nparts > 1 or args.multihost or args.coordinator is not None
-            or args.manufactured_solution):
+            or args.manufactured_solution or args.refine):
         return _solve_generated_sharded(args, dim, n, N, jax, jnp, dtype,
                                         vec_dtype)
 
@@ -395,7 +406,7 @@ def _solve_generated_direct(args, dim, n, N, jax, jnp, dtype,
         jax.profiler.start_trace(args.trace)
     try:
         x = solver.solve(b, criteria=criteria, warmup=args.warmup,
-                         host_result=not args.quiet)
+                         host_result=bool(not args.quiet or args.output))
     except NotConvergedError as e:
         sys.stderr.write(f"acg-tpu: {e}\n")
         solver.stats.fwrite(sys.stderr)
@@ -409,9 +420,7 @@ def _solve_generated_direct(args, dim, n, N, jax, jnp, dtype,
         from acg_tpu.solvers.profile import profile_ops
         profile_ops(solver, b, reps=max(args.profile_ops, 1))
     solver.stats.fwrite(sys.stderr)
-    if not args.quiet:
-        write_mtx(sys.stdout.buffer, vector_mtx(np.asarray(x)),
-                  numfmt=args.numfmt)
+    _emit_solution(args, x)
     return 0
 
 
@@ -554,7 +563,8 @@ def _solve_distributed_read(args, jax, jnp, dtype, vec_dtype) -> int:
     if args.trace:
         jax.profiler.start_trace(args.trace)
     try:
-        x = solver.solve(b, criteria=criteria, warmup=args.warmup)
+        x = solver.solve(b, criteria=criteria, warmup=args.warmup,
+                         host_result=not args.output)
     except NotConvergedError as e:
         sys.stderr.write(f"acg-tpu: {e}\n")
         if is_primary():
@@ -570,6 +580,9 @@ def _solve_distributed_read(args, jax, jnp, dtype, vec_dtype) -> int:
         sys.stderr.write("acg-tpu: aborting: a peer controller failed "
                          "during the solve\n")
         return rc
+
+    if args.output:
+        return _distributed_write(args, solver, x, xsol, n)
 
     if not is_primary():
         return 0
@@ -590,6 +603,99 @@ def _solve_distributed_read(args, jax, jnp, dtype, vec_dtype) -> int:
             x = xo
         write_mtx(sys.stdout.buffer, vector_mtx(x), numfmt=args.numfmt)
     return 0
+
+
+def _distributed_write(args, solver, x_st, xsol, n: int) -> int:
+    """Rootless distributed solution output (the reference's
+    ``mtxfile_fwrite_mpi_double`` role, ``mtxfile.h:1087``): each
+    controller extracts its owned part windows from ITS OWN device
+    shards of the stacked solution and range-writes them into the
+    shared output file; the primary writes only the header.  No
+    full-vector gather happens on any controller -- at 512^3 that
+    avoids a 0.5-1 GB host gather per output (round-3 verdict item 5).
+    """
+    import jax
+
+    from acg_tpu.io.mtxfile import finalize_vector_file, write_vector_window
+    from acg_tpu.parallel.multihost import is_primary
+
+    prob = solver.problem
+    bounds = prob.band_bounds
+    windows = []  # (row_lo, values) for this controller's parts
+    wrc = 0
+    try:
+        seen = set()
+        for sh in x_st.addressable_shards:
+            data = np.asarray(sh.data)
+            sl = sh.index[0]
+            start = (int(sl.start or 0) if isinstance(sl, slice)
+                     else int(sl))
+            for j in range(data.shape[0]):
+                p = start + j
+                s = prob.subs[p]
+                if p in seen or s is None or s.A_local is None:
+                    continue  # stub/duplicate row on this device
+                seen.add(p)
+                windows.append((int(bounds[p]),
+                                data[j, : s.nowned].astype(np.float64)))
+        t0 = time.perf_counter()
+        for lo, vals in windows:
+            write_vector_window(args.output, n, lo, vals)
+        _log(args, f"range-write {len(windows)} owned windows:", t0)
+    except OSError as e:
+        sys.stderr.write(f"acg-tpu: {args.output}: {e}\n")
+        wrc = 1
+    rc = _checkpoint(args, "write", wrc)
+    if rc:
+        if not wrc:
+            sys.stderr.write("acg-tpu: aborting: a peer controller "
+                             "failed during the solution write\n")
+        return rc
+
+    # manufactured error norms without a gather: per-controller partial
+    # sums over owned windows, combined across controllers
+    err = None
+    if xsol is not None:
+        part_sq = sum(float(np.sum((vals - xsol[lo:lo + vals.size]) ** 2))
+                      for lo, vals in windows)
+        if jax.process_count() > 1:
+            from jax.experimental import multihost_utils
+            part_sq = float(np.sum(multihost_utils.process_allgather(
+                np.float64(part_sq), tiled=False)))
+        err = np.sqrt(part_sq)
+
+    if not is_primary():
+        return 0
+    finalize_vector_file(args.output, n)
+    solver.stats.fwrite(sys.stderr)
+    if err is not None:
+        sys.stderr.write(f"initial error 2-norm: "
+                         f"{np.linalg.norm(xsol):.15g}\n")
+        sys.stderr.write(f"error 2-norm: {err:.15g}\n")
+    return 0
+
+
+def _emit_solution(args, x, perm=None) -> None:
+    """Solution output policy, uniform across paths: ``--output FILE``
+    writes a binary array vector (the same layout the distributed write
+    assembles -- readable with ``read_mtx(binary=True)``), regardless
+    of ``--quiet``; otherwise the text form goes to stdout unless
+    ``--quiet``.  ``perm`` (a permuted-to-original row map) is applied
+    first so users always see their own ordering."""
+    if args.output is None and args.quiet:
+        return
+    from acg_tpu.io.mtxfile import vector_mtx, write_mtx
+
+    x = np.asarray(x)
+    if perm is not None:
+        xo = np.empty_like(x)
+        xo[perm] = x
+        x = xo
+    if args.output is not None:
+        write_mtx(args.output, vector_mtx(np.asarray(x, np.float64)),
+                  binary=True)
+    elif not args.quiet:
+        write_mtx(sys.stdout.buffer, vector_mtx(x), numfmt=args.numfmt)
 
 
 def _load_perm_sidecar(matrix_path: str, n: int):
@@ -627,7 +733,8 @@ def _solve_generated_sharded(args, dim, n, N, jax, jnp, dtype,
     from acg_tpu.errors import NotConvergedError
     from acg_tpu.io.mtxfile import vector_mtx, write_mtx
     from acg_tpu.parallel.multihost import get_global, is_primary
-    from acg_tpu.parallel.sharded_dia import build_sharded_poisson_solver
+    from acg_tpu.parallel.sharded_dia import (build_sharded_poisson_solver,
+                                              spot_check_manufactured)
     from acg_tpu.solvers import StoppingCriteria
 
     if args.profile_ops is not None:
@@ -635,6 +742,10 @@ def _solve_generated_sharded(args, dim, n, N, jax, jnp, dtype,
             "acg-tpu: --profile-ops is not available on the sharded "
             "direct-assembly path (single-chip: drop --nparts/"
             "--manufactured-solution)")
+    if args.refine and args.dtype not in ("f32", "mixed"):
+        raise SystemExit(
+            "acg-tpu: sharded --refine runs df64 outer residuals over "
+            "f32 inner solves; use --dtype f32 or mixed")
     if args.kernels in ("pallas", "fused"):
         raise SystemExit(
             "acg-tpu: the sharded direct-assembly path pins the SpMV to "
@@ -658,8 +769,24 @@ def _solve_generated_sharded(args, dim, n, N, jax, jnp, dtype,
     xsol = None
     if args.manufactured_solution:
         t0 = time.perf_counter()
-        xsol, b = solver.manufactured(seed=args.seed)
+        if args.refine:
+            # b in double-float: an f32-rounded b would cap the
+            # reachable error at ~1e-7 regardless of solver accuracy
+            xsol, b = solver.manufactured_df(seed=args.seed)
+        else:
+            xsol, b = solver.manufactured(seed=args.seed)
         _log(args, "manufactured solution (on device):", t0)
+        if solver.stencil is not None:
+            # independent oracle: analytic stencil rows recomputed on
+            # the host (shares NOTHING with the solve's SpMV)
+            dev = spot_check_manufactured(solver, xsol, b)
+            sys.stderr.write(f"manufactured-b spot check (analytic "
+                             f"stencil rows): max rel dev {dev:.3e}\n")
+            if not dev < 1e-5:
+                sys.stderr.write("acg-tpu: manufactured b FAILED the "
+                                 "independent spot check\n")
+                _checkpoint(args, "solve", 1)
+                return 1
     else:
         b = solver.ones_b()
 
@@ -673,8 +800,15 @@ def _solve_generated_sharded(args, dim, n, N, jax, jnp, dtype,
     try:
         # device-resident result: the gather to host happens only when
         # the solution is actually written
-        x = solver.solve(b, criteria=criteria, warmup=args.warmup,
-                         host_result=False)
+        if args.refine:
+            xh, xl = solver.solve_refined(b, criteria=criteria,
+                                          inner_rtol=args.refine_rtol,
+                                          warmup=args.warmup)
+            x = xh
+        else:
+            x = solver.solve(b, criteria=criteria, warmup=args.warmup,
+                             host_result=False)
+            xl = None
     except NotConvergedError as e:
         sys.stderr.write(f"acg-tpu: {e}\n")
         if is_primary():
@@ -695,8 +829,14 @@ def _solve_generated_sharded(args, dim, n, N, jax, jnp, dtype,
     # primary-only output gate: a non-primary process returning early
     # while the primary still waits in an error-norm reduction or the
     # solution allgather would deadlock the pod
-    errs = solver.error_norms(x, xsol) if xsol is not None else None
-    x_host = None if args.quiet else np.asarray(get_global(x))
+    if xsol is None:
+        errs = None
+    elif xl is not None:
+        errs = solver.error_norms_df(x, xl, xsol)
+    else:
+        errs = solver.error_norms(x, xsol)
+    want_x = not args.quiet or args.output is not None
+    x_host = np.asarray(get_global(x)) if want_x else None
 
     if not is_primary():
         return 0
@@ -705,7 +845,7 @@ def _solve_generated_sharded(args, dim, n, N, jax, jnp, dtype,
         sys.stderr.write(f"initial error 2-norm: {errs[0]:.15g}\n")
         sys.stderr.write(f"error 2-norm: {errs[1]:.15g}\n")
     if x_host is not None:
-        write_mtx(sys.stdout.buffer, vector_mtx(x_host), numfmt=args.numfmt)
+        _emit_solution(args, x_host)
     return 0
 
 
@@ -1040,12 +1180,7 @@ def _main(args) -> int:
             symmetry="general", nrows=nparts, ncols=nparts, nnz=len(nz[0]),
             rowidx=nz[0], colidx=nz[1], vals=comm_mtx_out[nz]),
             numfmt="%d")
-    if not args.quiet:
-        if perm_sidecar is not None:
-            xo = np.empty_like(np.asarray(x))
-            xo[perm_sidecar] = np.asarray(x)
-            x = xo
-        write_mtx(sys.stdout.buffer, vector_mtx(x), numfmt=args.numfmt)
+    _emit_solution(args, x, perm_sidecar)
     return 0
 
 

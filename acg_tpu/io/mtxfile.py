@@ -501,6 +501,54 @@ def _write_mtx_stream(f, mtx: MtxFile, binary: bool, numfmt: str) -> None:
             f.write(("\n".join(numfmt % v for v in vals) + "\n").encode())
 
 
+def vector_binary_header(n: int) -> bytes:
+    """The exact header bytes of a binary array double vector file of
+    length ``n`` -- deterministic from ``n`` alone, which is what makes
+    rootless range WRITES possible: every controller computes the same
+    data offset with no coordination."""
+    return f"%%MatrixMarket matrix array double general\n{n} 1\n".encode()
+
+
+def write_vector_window(path, n: int, row_lo: int,
+                        values: np.ndarray) -> None:
+    """Range-WRITE ``values`` (float64) into rows ``[row_lo, row_lo +
+    len(values))`` of a binary array vector file of global length ``n``
+    -- the output mirror of :func:`read_mtx_row_range` and the rootless
+    restatement of the reference's rank-ordered distributed solution
+    output (``mtxfile_fwrite_mpi_double``, ``mtxfile.h:1087``): each
+    controller writes exactly its owned windows, I/O is O(local rows),
+    and no full vector is ever gathered anywhere.
+
+    Creates the file if needed (sparse until every window lands); call
+    :func:`finalize_vector_file` from ONE process to write the header
+    and pin the exact length.
+    """
+    values = np.ascontiguousarray(values, dtype=np.float64)
+    if not (0 <= row_lo and row_lo + values.size <= n):
+        raise AcgError(ErrorCode.INVALID_VALUE,
+                       f"window [{row_lo}, {row_lo + values.size}) outside "
+                       f"[0, {n})")
+    fd = os.open(os.fspath(path), os.O_CREAT | os.O_WRONLY, 0o644)
+    try:
+        os.lseek(fd, len(vector_binary_header(n)) + 8 * row_lo, os.SEEK_SET)
+        os.write(fd, values.tobytes())
+    finally:
+        os.close(fd)
+
+
+def finalize_vector_file(path, n: int) -> None:
+    """Write the deterministic header of a range-written vector file and
+    truncate it to its exact size (one process -- the primary -- calls
+    this; the reference's root writes the header the same way)."""
+    hdr = vector_binary_header(n)
+    fd = os.open(os.fspath(path), os.O_CREAT | os.O_WRONLY, 0o644)
+    try:
+        os.write(fd, hdr)
+        os.ftruncate(fd, len(hdr) + 8 * n)
+    finally:
+        os.close(fd)
+
+
 def vector_mtx(x: np.ndarray, field: str = "real") -> MtxFile:
     """Wrap a dense vector as a Matrix Market array file object."""
     x = np.asarray(x)

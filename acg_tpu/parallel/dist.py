@@ -576,11 +576,16 @@ class DistCGSolver:
         if comm not in ("xla", "dma"):
             raise ValueError(f"unknown halo transport {comm!r}")
         if comm == "dma" and jax.process_count() > 1:
-            # cross-process one-sided DMA is unvalidated (halo_dma.py
-            # docstring); fail clearly instead of deadlocking a pod
+            # the transport's primitives (make_async_remote_copy +
+            # barrier handshake) are proven on real silicon
+            # single-device (scripts/dma_probe.py, 2026-07-30), but the
+            # MULTI-CHIP case has never touched real ICI -- this build's
+            # environment exposes one chip -- so fail clearly instead
+            # of risking a deadlocked pod
             raise ValueError(
-                "comm='dma' is not validated on multi-controller runs; "
-                "use comm='xla' (the all_to_all transport)")
+                "comm='dma' is not validated on multi-controller runs "
+                "(single-chip Mosaic lowering is -- scripts/dma_probe."
+                "py); use comm='xla' (the all_to_all transport)")
         self.problem = problem
         self.pipelined = pipelined
         self.precise_dots = precise_dots
@@ -806,7 +811,15 @@ class DistCGSolver:
 
     def solve(self, b_global: np.ndarray, x0: np.ndarray | None = None,
               criteria: StoppingCriteria | None = None,
-              raise_on_divergence: bool = True, warmup: int = 0) -> np.ndarray:
+              raise_on_divergence: bool = True, warmup: int = 0,
+              host_result: bool = True) -> np.ndarray:
+        """``host_result=False`` skips the global gather and returns the
+        STACKED device array ((nparts, nmax_owned), sharded over the
+        mesh) -- callers that stream per-part windows to disk
+        (``--output`` distributed write) or feed another device
+        computation never materialise the full vector anywhere, the
+        point of the reference's rank-ordered distributed output
+        (``mtxfile_fwrite_mpi_double``)."""
         crit = criteria or StoppingCriteria()
         st = self.stats
         st.criteria = crit
@@ -823,11 +836,14 @@ class DistCGSolver:
         kwargs = dict(unbounded=crit.unbounded, needs_diff=crit.needs_diff)
         args = (la, ga, sidx, gsrc, gval, scnt, rcnt, b, x0, tols,
                 jnp.int32(crit.maxits))
+        # device_sync, not bare block_until_ready: see _platform (the
+        # tunneled backend's block has been observed not to wait)
+        from acg_tpu._platform import device_sync
         for _ in range(max(warmup, 0)):
-            self._program(*args, **kwargs)[0].block_until_ready()
+            device_sync(self._program(*args, **kwargs)[0])
         t0 = time.perf_counter()
         out = self._program(*args, **kwargs)
-        out[0].block_until_ready()
+        device_sync(out[0])
         st.tsolve += time.perf_counter() - t0
 
         x_st, k, rnrm2, r0nrm2, bnrm2, x0nrm2, dxnrm2, done = out
@@ -865,8 +881,17 @@ class DistCGSolver:
         halo_bytes = halo_total * dbl
         st.ops["halo"].add(niter + 1, 0.0, halo_bytes * (niter + 1))
 
-        x = prob.gather(get_global(x_st))
-        st.fexcept_arrays = [x]
+        if host_result:
+            x = prob.gather(get_global(x_st))
+            st.fexcept_arrays = [x]
+        else:
+            x = x_st
+            # device-side scans; only two bools cross the wire (the
+            # JaxCGSolver host_result=False convention)
+            has_nan = bool(jnp.isnan(x_st).any())
+            has_inf = bool(jnp.isinf(x_st).any())
+            st.fexcept_arrays = [np.asarray([np.nan if has_nan else 0.0,
+                                             np.inf if has_inf else 0.0])]
         if not st.converged and raise_on_divergence:
             raise NotConvergedError(
                 f"{niter} iterations, residual {st.rnrm2:.3e}")

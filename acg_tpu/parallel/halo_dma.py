@@ -140,6 +140,15 @@ def _exchange_kernel(axis, use_barrier, gate_by_counts, scnt_ref, rcnt_ref,
 def _exchange(sendbuf, send_counts, recv_counts, axis: str, interpret: bool,
               gate_by_counts: bool | None = None):
     nparts, maxcnt = sendbuf.shape
+    if nparts == 1:
+        # no neighbours, no puts: the receive plane is never written and
+        # every ghost gather is masked by ghost_valid.  Short-circuit
+        # instead of compiling the degenerate kernel -- measured on real
+        # hardware (2026-07-30): Mosaic SIGABRTs compiling the empty-put
+        # barrier kernel, while a 1-device kernel with an actual
+        # self-put + barrier compiles and runs correctly
+        # (scripts/dma_probe.py holds the repro of both).
+        return jnp.zeros_like(sendbuf)
     if gate_by_counts is None:
         gate_by_counts = not interpret
     kernel = functools.partial(_exchange_kernel, axis, not interpret,

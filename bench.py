@@ -129,6 +129,8 @@ def bandwidth_probe_gbs(refresh: bool = False) -> float:
     c = jnp.full((n,), 0.5, jnp.float32)
     a = jnp.ones((n,), jnp.float32)
 
+    from acg_tpu._platform import device_sync
+
     @functools.partial(jax.jit, static_argnames="k")
     def chain(a, c, k):
         # a = c + s*a: 2 reads + 1 write per step, data-dependent chain
@@ -136,11 +138,14 @@ def bandwidth_probe_gbs(refresh: bool = False) -> float:
             0, k, lambda _, v: c + jnp.float32(1.0000001) * v, a)
 
     def best(k, reps=3):
-        chain(a, c, k).block_until_ready()
+        # device_sync (not bare block_until_ready -- _platform): the
+        # fetch round-trip it may add is constant per call, which the
+        # two-point difference below cancels
+        device_sync(chain(a, c, k))
         ts = []
         for _ in range(reps):
             t0 = time.perf_counter()
-            chain(a, c, k).block_until_ready()
+            device_sync(chain(a, c, k))
             ts.append(time.perf_counter() - t0)
         return min(ts)
 
@@ -194,6 +199,11 @@ def _time_solver(solver, b, criteria_cls, repeats: int = TIMED_REPEATS,
     uncontended speed).  Slow configs time fewer iterations so the
     device program stays under the execution watchdog -- iters/s is
     trip-count-invariant."""
+    from acg_tpu._platform import block_until_ready_works
+    if not block_until_ready_works():
+        # fetch-sync timing carries per-dispatch round-trip jitter;
+        # more repeats tighten the min estimator
+        repeats = max(repeats, 2 * TIMED_REPEATS)
     solver.solve(b, criteria=criteria_cls(maxits=WARMUP_ITS), **solve_kwargs)
     solver.stats.tsolve = 0.0
     solver.solve(b, criteria=criteria_cls(maxits=WARMUP_ITS), **solve_kwargs)
@@ -235,6 +245,13 @@ def _roofline_context(row: dict, bytes_per_iter: float) -> dict:
     row["bw_gbs"] = round(bw, 1)
     row["roofline_frac"] = round(
         row["value"] * bytes_per_iter / (bw * 1e9), 3)
+    from acg_tpu._platform import block_until_ready_works
+    if not block_until_ready_works():
+        # timing had to fall back to scalar-fetch sync (the backend's
+        # block_until_ready does not wait -- _platform); dispatch
+        # round-trip jitter then biases every row LOW.  Mark the
+        # capture so the number is read as a lower bound.
+        row["block_sync_broken"] = True
     return row
 
 

@@ -106,6 +106,9 @@ def main(argv=None) -> int:
                "ratio": round(ra / rb, 3), "bw_gbs": round(bw, 1),
                "bw_gbs_after": round(bw2, 1), "pairs": args.pairs,
                "ts": round(time.time(), 1)}
+        from acg_tpu._platform import block_until_ready_works
+        if not block_until_ready_works():
+            row["block_sync_broken"] = True
         print(json.dumps(row))
         sys.stdout.flush()
         with open(RECORD, "a") as f:
@@ -199,7 +202,8 @@ def _fused_dot_solver(A):
             b = jnp.asarray(b, self.A.dtype)
             t0 = _t.perf_counter()
             x = self._prog(tuple(self.A.data), b, criteria.maxits)
-            x.block_until_ready()
+            from acg_tpu._platform import device_sync
+            device_sync(x)
             self.stats.tsolve += _t.perf_counter() - t0
             return x
 
@@ -251,7 +255,8 @@ def _fused_update_solver(A):
             b = jnp.asarray(b, self.A.dtype)
             t0 = _t.perf_counter()
             x = self._prog(tuple(self.A.data), b, criteria.maxits)
-            x.block_until_ready()
+            from acg_tpu._platform import device_sync
+            device_sync(x)
             self.stats.tsolve += _t.perf_counter() - t0
             return x
 

@@ -285,10 +285,19 @@ def test_cli_gen_spec_direct_device_path():
     assert float(r2.stderr.split("\nerror 2-norm: ")[1].split()[0]) < 1e-4
     # remaining restrictions still produce a clear error
     r3 = subprocess.run(
-        [sys.executable, "-m", "acg_tpu.cli", "gen:poisson3d:8", "--refine"],
+        [sys.executable, "-m", "acg_tpu.cli", "gen:poisson3d:8",
+         "--output-comm-matrix"],
         capture_output=True, text=True, env=env)
     assert r3.returncode != 0
     assert "does not support" in r3.stderr
+    # --refine is supported here since round 4 (sharded df64 route) but
+    # requires an f32-family storage dtype
+    r4 = subprocess.run(
+        [sys.executable, "-m", "acg_tpu.cli", "gen:poisson3d:8",
+         "--refine", "--dtype", "f64"],
+        capture_output=True, text=True, env=env)
+    assert r4.returncode != 0
+    assert "df64" in r4.stderr
 
 
 def test_cli_gen_spec_invalid():
@@ -326,3 +335,40 @@ def test_cli_replace_every_rejects_f32():
                  "--replace-every", "25", "--warmup", "0", "--quiet"])
     assert r.returncode != 0
     assert "bf16" in r.stderr
+
+
+def test_cli_output_file_all_paths(tmp_path, matrix_file):
+    """-o/--output writes a binary array vector on every path (not just
+    --distributed-read), regardless of --quiet."""
+    from acg_tpu.io.mtxfile import read_mtx
+
+    # replicated single-device path
+    out = tmp_path / "x1.bin.mtx"
+    r = run_cli("acg_tpu.cli", [str(matrix_file), "--nparts", "1",
+                                "--dtype", "f64", "--max-iterations", "500",
+                                "--residual-rtol", "1e-10", "--warmup", "0",
+                                "--quiet", "-o", str(out)])
+    assert r.returncode == 0, r.stderr
+    x = np.asarray(read_mtx(out, binary=True).vals).reshape(-1)
+    m = read_mtx(matrix_file)
+    import scipy.sparse as sp
+    rr, cc, vv = m.to_coo()
+    from acg_tpu.io.mtxfile import expand_symmetry
+    rr, cc, vv = expand_symmetry(rr, cc, vv, m.nrows)
+    A = sp.coo_matrix((vv, (rr, cc))).tocsr()
+    b = np.ones(m.nrows)
+    assert np.linalg.norm(b - A @ x) < 1e-8 * np.linalg.norm(b)
+
+    # gen-direct on-device path
+    out2 = tmp_path / "x2.bin.mtx"
+    import os, subprocess
+    env = dict(os.environ); env.update(ENV_KEYS)
+    env["ACG_TPU_GEN_DIRECT_MIN"] = "100"
+    r2 = subprocess.run(
+        [sys.executable, "-m", "acg_tpu.cli", "gen:poisson2d:16",
+         "--comm", "none", "--max-iterations", "400",
+         "--residual-rtol", "1e-6", "--warmup", "0", "--quiet",
+         "-o", str(out2)],
+        capture_output=True, text=True, env=env)
+    assert r2.returncode == 0, r2.stderr
+    assert read_mtx(out2, binary=True).nrows == 256

@@ -161,3 +161,78 @@ def test_cli_two_process_gen_direct():
     assert "total solver time" not in se1
     err = float(se0.split("\nerror 2-norm: ")[1].split()[0])
     assert err < 1e-4, se0
+
+
+# -- round 4: df64 refinement, independent oracle ------------------------
+
+def test_dia_mv_roll_df_matches_f64():
+    """The double-float roll SpMV must agree with numpy f64 to df64
+    class (~1e-14 relative), far beyond plain f32 (~1e-7)."""
+    from acg_tpu.parallel.sharded_dia import dia_mv_roll_df
+    from acg_tpu.ops.spmv import dia_from_csr
+
+    csr = _csr(16, 3)
+    A = dia_from_csr(csr, dtype=jnp.float32)
+    rng = np.random.default_rng(0)
+    x = rng.standard_normal(csr.shape[0]).astype(np.float32)
+    yh, yl = dia_mv_roll_df(A.data, A.offsets,
+                            jnp.asarray(x), jnp.zeros_like(jnp.asarray(x)))
+    y = np.asarray(yh, np.float64) + np.asarray(yl, np.float64)
+    ref = csr @ x.astype(np.float64)
+    rel = np.linalg.norm(y - ref) / np.linalg.norm(ref)
+    assert rel < 1e-13
+
+
+def test_sharded_refine_reaches_f64_class_error():
+    """gen-direct sharded --refine: df64 outer residual + f32 inner
+    solves reach 1e-9-class solution error (round-3 verdict item 3) --
+    the error a plain f32 solve caps at ~1e-6."""
+    s = build_sharded_poisson_solver(16, 3, nparts=8)
+    xsol, b = s.manufactured_df(seed=0)
+    xh, xl = s.solve_refined(b, criteria=StoppingCriteria(
+        maxits=20000, residual_rtol=1e-11), inner_rtol=1e-5)
+    err0, err = s.error_norms_df(xh, xl, xsol)
+    assert err0 == pytest.approx(1.0, rel=1e-5)
+    assert err < 1e-8
+    assert s.stats.nrefine >= 2
+    # and the refined solution satisfies the ORIGINAL system in f64
+    csr = _csr(16, 3)
+    x64 = np.asarray(xh, np.float64) + np.asarray(xl, np.float64)
+    b64 = (np.asarray(b[0], np.float64) + np.asarray(b[1], np.float64))
+    rel = np.linalg.norm(b64 - csr @ x64) / np.linalg.norm(b64)
+    assert rel < 1e-10
+
+
+def test_spot_check_catches_corrupt_b():
+    """The analytic-stencil spot check accepts a correct manufactured b
+    and rejects a corrupted one (the de-circularised oracle, round-3
+    verdict item 5)."""
+    from acg_tpu.parallel.sharded_dia import spot_check_manufactured
+
+    s = build_sharded_poisson_solver(12, 2, nparts=4)
+    xsol, b = s.manufactured(seed=1)
+    dev = spot_check_manufactured(s, xsol, b, nsample=64)
+    assert dev < 1e-6
+    bad = b.at[137].multiply(1.01)
+    dev_bad = spot_check_manufactured(s, xsol, bad, nsample=4096)
+    assert dev_bad > 1e-4
+
+
+def test_cli_sharded_refine(tmp_path):
+    """CLI end-to-end: gen: sharded path with --refine reports
+    1e-9-class error and the spot-check line."""
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["XLA_FLAGS"] = "--xla_force_host_platform_device_count=8"
+    env["ACG_TPU_GEN_DIRECT_MIN"] = "0"  # force the sharded direct route
+    r = subprocess.run(
+        [sys.executable, "-m", "acg_tpu.cli", "gen:poisson3d:16",
+         "--nparts", "8", "--refine", "--dtype", "f32",
+         "--manufactured-solution", "--max-iterations", "20000",
+         "--residual-rtol", "1e-11", "--warmup", "0", "--quiet"],
+        capture_output=True, text=True, env=env)
+    assert r.returncode == 0, r.stderr
+    assert "manufactured-b spot check" in r.stderr
+    err = float([ln for ln in r.stderr.splitlines()
+                 if ln.startswith("error 2-norm:")][0].split(":")[1])
+    assert err < 1e-8
