@@ -592,16 +592,10 @@ def _solve_distributed_read(args, jax, jnp, dtype, vec_dtype) -> int:
         err = np.linalg.norm(x - xsol)
         sys.stderr.write(f"initial error 2-norm: {err0:.15g}\n")
         sys.stderr.write(f"error 2-norm: {err:.15g}\n")
-    if not args.quiet:
-        # a partition-permuted matrix (mtx2bin --partition) solves in
-        # permuted row order; map the solution back to the input
-        # ordering via the perm sidecar so users see their own numbering
-        perm = _load_perm_sidecar(args.A, n)
-        if perm is not None:
-            xo = np.empty_like(np.asarray(x))
-            xo[perm] = np.asarray(x)
-            x = xo
-        write_mtx(sys.stdout.buffer, vector_mtx(x), numfmt=args.numfmt)
+    # a partition-permuted matrix (mtx2bin --partition) solves in
+    # permuted row order; the emitter maps the solution back to the
+    # input ordering via the perm sidecar
+    _emit_solution(args, x, _load_perm_sidecar(args.A, n))
     return 0
 
 
@@ -836,7 +830,16 @@ def _solve_generated_sharded(args, dim, n, N, jax, jnp, dtype,
     else:
         errs = solver.error_norms(x, xsol)
     want_x = not args.quiet or args.output is not None
-    x_host = np.asarray(get_global(x)) if want_x else None
+    x_host = None
+    if want_x:
+        if xl is not None:
+            # refined solutions live as a df64 (hi, lo) pair; emitting
+            # only the f32 hi part would silently discard the accuracy
+            # --refine just computed (~1e-7 vs the reported ~1e-9)
+            x_host = (np.asarray(get_global(x), np.float64)
+                      + np.asarray(get_global(xl), np.float64))
+        else:
+            x_host = np.asarray(get_global(x))
 
     if not is_primary():
         return 0

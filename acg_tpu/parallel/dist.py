@@ -838,7 +838,8 @@ class DistCGSolver:
                 jnp.int32(crit.maxits))
         # device_sync, not bare block_until_ready: see _platform (the
         # tunneled backend's block has been observed not to wait)
-        from acg_tpu._platform import device_sync
+        from acg_tpu._platform import block_until_ready_works, device_sync
+        block_until_ready_works()  # resolve the cached probe OUTSIDE timing
         for _ in range(max(warmup, 0)):
             device_sync(self._program(*args, **kwargs)[0])
         t0 = time.perf_counter()

@@ -705,7 +705,8 @@ class JaxCGSolver:
         # not bare block_until_ready: the tunneled backend has been
         # observed to return from block instantly while the program
         # still runs, which would zero every tsolve (_platform).
-        from acg_tpu._platform import device_sync
+        from acg_tpu._platform import block_until_ready_works, device_sync
+        block_until_ready_works()  # resolve the cached probe OUTSIDE timing
         for _ in range(max(warmup, 0)):
             device_sync(program(*args, **kwargs).x)
         t0 = time.perf_counter()

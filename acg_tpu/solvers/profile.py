@@ -54,11 +54,11 @@ def _best_time(fn, *args, reps: int = 10) -> float:
     reps = max(int(reps), 1)
     from acg_tpu._platform import device_sync
 
-    device_sync(jnp.ravel(jax.tree_util.tree_leaves(fn(*args))[0]))  # compile + warm
+    device_sync(jax.tree_util.tree_leaves(fn(*args))[0])  # compile + warm
     ts = []
     for _ in range(reps):
         t0 = time.perf_counter()
-        device_sync(jnp.ravel(jax.tree_util.tree_leaves(fn(*args))[0]))
+        device_sync(jax.tree_util.tree_leaves(fn(*args))[0])
         ts.append(time.perf_counter() - t0)
     # min: on a shared chip contention bursts inflate most samples; the
     # fastest run is the uncontended estimate (same estimator as bench)

@@ -200,29 +200,65 @@ def _time_solver(solver, b, criteria_cls, repeats: int = TIMED_REPEATS,
     device program stays under the execution watchdog -- iters/s is
     trip-count-invariant."""
     from acg_tpu._platform import block_until_ready_works
-    if not block_until_ready_works():
+    broken_sync = not block_until_ready_works()
+    if broken_sync:
         # fetch-sync timing carries per-dispatch round-trip jitter;
         # more repeats tighten the min estimator
         repeats = max(repeats, 2 * TIMED_REPEATS)
-    solver.solve(b, criteria=criteria_cls(maxits=WARMUP_ITS), **solve_kwargs)
-    solver.stats.tsolve = 0.0
-    solver.solve(b, criteria=criteria_cls(maxits=WARMUP_ITS), **solve_kwargs)
-    per_iter = solver.stats.tsolve / WARMUP_ITS
+
+    def timed(its: int) -> float:
+        solver.stats.tsolve = 0.0
+        solver.solve(b, criteria=criteria_cls(maxits=its), **solve_kwargs)
+        return solver.stats.tsolve
+
+    timed(WARMUP_ITS)  # compile + warm
+    # per-iteration estimate by TWO-POINT difference: a lying
+    # block_until_ready pushes a dispatch round-trip (seconds, on a
+    # degraded tunnel) into every measurement, which a single-shot
+    # estimate would bill per-iteration and wrongly trip the
+    # long-program guard (measured: 25 ms/iter "estimates" for a
+    # 0.2 ms/iter solve)
+    t_lo = min(timed(WARMUP_ITS) for _ in range(2))
+    t_hi = min(timed(4 * WARMUP_ITS) for _ in range(2))
+    if t_hi > t_lo:
+        per_iter = (t_hi - t_lo) / (3 * WARMUP_ITS)
+    else:
+        # jitter swamped the two-point difference; fall back to the
+        # round-trip-inflated single-shot estimate, which errs toward
+        # TRIPPING the long-program guard (the safe direction: a short
+        # program never meets the execution watchdog)
+        per_iter = t_hi / (4 * WARMUP_ITS)
+        print(f"# two-point per-iter estimate failed (t_lo {t_lo:.3f} >= "
+              f"t_hi {t_hi:.3f}); using conservative {per_iter * 1e3:.1f} "
+              f"ms/iter", file=sys.stderr)
     maxits = MAXITS
     if per_iter * MAXITS > MAX_PROGRAM_SECONDS:
         maxits = max(100, int(MAX_PROGRAM_SECONDS / per_iter))
         print(f"# long-program guard: timing {maxits} iterations "
               f"(~{per_iter * 1e3:.1f} ms/iter)", file=sys.stderr)
-    times = []
-    for _ in range(repeats):
-        solver.stats.tsolve = 0.0
-        solver.solve(b, criteria=criteria_cls(maxits=maxits), **solve_kwargs)
-        times.append(solver.stats.tsolve)
+    times = [timed(maxits) for _ in range(repeats)]
     if max(times) > 1.5 * min(times):
         print(f"# contention: solve times ranged "
               f"{min(times):.3f}-{max(times):.3f}s over {len(times)} runs",
               file=sys.stderr)
-    return min(times), maxits
+    tsolve = min(times)
+    if broken_sync:
+        # the raw times include the round-trip the fetch-sync adds; a
+        # second point at a shorter trip count subtracts it (same
+        # chained-difference rationale as the bandwidth probe).  Guard
+        # against jitter swamping the difference: only adopt the
+        # corrected figure when it is sane (positive, not faster than
+        # the raw time implies by >20x).
+        t_short = min(timed(max(maxits // 4, 1)) for _ in range(repeats))
+        dt = tsolve - t_short
+        its_dt = maxits - max(maxits // 4, 1)
+        if dt > 0 and tsolve / (dt / its_dt * maxits) < 20:
+            corrected = dt / its_dt * maxits
+            print(f"# two-point correction: raw {tsolve:.3f}s -> "
+                  f"{corrected:.3f}s for {maxits} its (dispatch "
+                  f"round-trip subtracted)", file=sys.stderr)
+            tsolve = corrected
+    return tsolve, maxits
 
 
 def _roofline_context(row: dict, bytes_per_iter: float) -> dict:

@@ -54,16 +54,26 @@ def _flagship():
 def _time_case(make_solver, b, its=1000, reps=3):
     import numpy as np
 
+    from acg_tpu._platform import block_until_ready_works
     from acg_tpu.solvers.stats import StoppingCriteria
 
     s = make_solver()
-    s.solve(b, criteria=StoppingCriteria(maxits=50))
-    s.solve(b, criteria=StoppingCriteria(maxits=50))
-    best = np.inf
-    for _ in range(reps):
+
+    def timed(n):
         s.stats.tsolve = 0.0
-        s.solve(b, criteria=StoppingCriteria(maxits=its))
-        best = min(best, s.stats.tsolve)
+        s.solve(b, criteria=StoppingCriteria(maxits=n))
+        return s.stats.tsolve
+
+    timed(50)
+    timed(50)
+    best = min(timed(its) for _ in range(reps))
+    if not block_until_ready_works():
+        # fetch-sync timing: subtract the dispatch round-trip via a
+        # second point (bench._time_solver rationale)
+        t_short = min(timed(its // 4) for _ in range(reps))
+        dt = best - t_short
+        if dt > 0 and best / (dt / (its - its // 4) * its) < 20:
+            best = dt / (its - its // 4) * its
     return its / best
 
 

@@ -42,8 +42,10 @@ def test_time_solver_full_trip_count_when_fast():
     tsolve, maxits = bench._time_solver(s, None, FakeCriteria, repeats=3)
     assert maxits == bench.MAXITS
     assert tsolve == pytest.approx(1e-4 * bench.MAXITS)
-    # warmup x2 (compile + rate estimate) then 3 timed runs
-    assert s.calls == [bench.WARMUP_ITS] * 2 + [bench.MAXITS] * 3
+    # compile warmup, then the TWO-POINT rate estimate (2x short + 2x
+    # long -- cancels any constant dispatch overhead), then 3 timed runs
+    assert s.calls == ([bench.WARMUP_ITS] * 3
+                       + [4 * bench.WARMUP_ITS] * 2 + [bench.MAXITS] * 3)
 
 
 def test_time_solver_reduces_trip_count_for_slow_configs():
