@@ -145,10 +145,11 @@ def make_parser() -> argparse.ArgumentParser:
                         "picks by sparsity structure")
     p.add_argument("--replace-every", type=int, default=0, metavar="K",
                    help="with --dtype bf16: periodic f32 residual "
-                        "replacement every K iterations (classic CG, "
-                        "single-device path) -- the sound-bf16 contract: "
-                        "f32-class residuals at ~2%% overhead (K=50 "
-                        "measured at flagship conditioning; 0 = off)")
+                        "replacement every K iterations (classic CG; "
+                        "single-device AND distributed/mesh paths) -- "
+                        "the sound-bf16 contract: f32-class residuals at "
+                        "~2%% overhead (K=50 measured at flagship "
+                        "conditioning; 0 = off)")
     p.add_argument("--precise-dots", action="store_true",
                    help="compensated (double-float) dot products for the "
                         "CG scalars; lets f32 storage converge past the "
@@ -460,7 +461,9 @@ def _solve_distributed_read(args, jax, jnp, dtype, vec_dtype) -> int:
         ("--output-comm-matrix", args.output_comm_matrix),
         ("--profile-ops", args.profile_ops is not None),
         ("--kernels fused (single-device only)", args.kernels == "fused"),
-        ("--replace-every (single-device only)", args.replace_every > 0),
+        ("--diff-* criteria with --replace-every",
+         args.replace_every > 0 and (args.diff_atol > 0
+                                     or args.diff_rtol > 0)),
         ("--comm dma", args.comm in ("dma", "nvshmem")),
     ] if on]
     if unsupported:
@@ -556,9 +559,15 @@ def _solve_distributed_read(args, jax, jnp, dtype, vec_dtype) -> int:
         maxits=args.max_iterations,
         residual_atol=args.residual_atol, residual_rtol=args.residual_rtol,
         diff_atol=args.diff_atol, diff_rtol=args.diff_rtol)
-    solver = DistCGSolver(prob, pipelined="pipelined" in args.solver,
-                          precise_dots=args.precise_dots,
-                          kernels=args.kernels)
+    try:
+        solver = DistCGSolver(prob, pipelined="pipelined" in args.solver,
+                              precise_dots=args.precise_dots,
+                              kernels=args.kernels,
+                              replace_every=args.replace_every)
+    except ValueError as e:
+        sys.stderr.write(f"acg-tpu: {e}\n")
+        _checkpoint(args, "solve", 1)
+        return 1
     t0 = time.perf_counter()
     if args.trace:
         jax.profiler.start_trace(args.trace)
@@ -661,6 +670,18 @@ def _distributed_write(args, solver, x_st, xsol, n: int) -> int:
     if not is_primary():
         return 0
     finalize_vector_file(args.output, n)
+    # permuted inputs (mtx2bin --partition) keep their on-disk ordering
+    # in the range-written output -- un-permuting would scatter every
+    # window and defeat the no-gather design.  Make the file
+    # self-describing: copy the perm sidecar next to it and say so.
+    perm_path = args.A + ".perm.mtx"
+    if os.path.exists(perm_path):
+        import shutil
+        shutil.copyfile(perm_path, args.output + ".perm.mtx")
+        sys.stderr.write(
+            f"acg-tpu: note: {args.output} is in the matrix's permuted "
+            f"row ordering; {args.output}.perm.mtx (copied) maps rows "
+            f"back to the original numbering\n")
     solver.stats.fwrite(sys.stderr)
     if err is not None:
         sys.stderr.write(f"initial error 2-norm: "
@@ -1055,12 +1076,17 @@ def _main(args) -> int:
     # trace -- that is when it is most needed)
     t0 = time.perf_counter()
     pipelined = "pipelined" in args.solver
-    if args.replace_every and (
-            args.solver in ("host", "host-native", "petsc")
-            or not (comm == "none" or nparts == 1)):
+    if args.replace_every and args.solver in ("host", "host-native",
+                                              "petsc"):
         sys.stderr.write("acg-tpu: --replace-every applies to the "
-                         "single-device bf16 solve only (use --refine "
-                         "for f64-grade accuracy elsewhere)\n")
+                         "device bf16 solvers (use --refine for "
+                         "f64-grade accuracy on host paths)\n")
+        checkpoint("solve", 1)
+        return 1
+    if args.replace_every and (args.diff_atol > 0 or args.diff_rtol > 0):
+        sys.stderr.write("acg-tpu: --replace-every supports residual "
+                         "criteria only (--diff-atol/--diff-rtol have "
+                         "no meaning across replacement segments)\n")
         checkpoint("solve", 1)
         return 1
     comm_mtx_out = None
@@ -1126,9 +1152,13 @@ def _main(args) -> int:
                                             subs=subs,
                                             vector_dtype=vec_dtype,
                                             owned_parts=owned)
-            solver = DistCGSolver(prob, pipelined=pipelined, comm=comm,
-                                  precise_dots=args.precise_dots,
-                                  kernels=args.kernels, mesh=mesh)
+            try:
+                solver = DistCGSolver(prob, pipelined=pipelined, comm=comm,
+                                      precise_dots=args.precise_dots,
+                                      kernels=args.kernels, mesh=mesh,
+                                      replace_every=args.replace_every)
+            except ValueError as e:
+                raise SystemExit(f"acg-tpu: {e}")
             if args.refine:
                 solver = RefinedSolver(solver, csr,
                                        inner_rtol=args.refine_rtol)

@@ -469,9 +469,10 @@ class DistributedProblem:
 
     # -- vector scatter/gather to the stacked padded layout ---------------
 
-    def scatter(self, x_global: np.ndarray) -> np.ndarray:
+    def scatter(self, x_global: np.ndarray, dtype=None) -> np.ndarray:
         out = np.zeros((self.nparts, self.nmax_owned),
-                       dtype=np.dtype(self.vdtype))
+                       dtype=np.dtype(dtype if dtype is not None
+                                      else self.vdtype))
         owned = (range(self.nparts) if self.owned_parts is None
                  else self.owned_parts)
         x_global = np.asarray(x_global)
@@ -572,7 +573,8 @@ class DistCGSolver:
 
     def __init__(self, problem: DistributedProblem, pipelined: bool = False,
                  mesh: Mesh | None = None, comm: str = "xla",
-                 precise_dots: bool = False, kernels: str = "auto"):
+                 precise_dots: bool = False, kernels: str = "auto",
+                 replace_every: int = 0, replace_restart: bool = True):
         if comm not in ("xla", "dma"):
             raise ValueError(f"unknown halo transport {comm!r}")
         if comm == "dma" and jax.process_count() > 1:
@@ -610,6 +612,25 @@ class DistCGSolver:
         if kernels not in ("xla", "pallas", "pallas-interpret"):
             raise ValueError(f"unknown kernels choice {kernels!r}")
         self.kernels = kernels
+        self.replace_every = int(replace_every)
+        self.replace_restart = bool(replace_restart)
+        if self.replace_every < 0:
+            raise ValueError("replace_every must be >= 0")
+        if self.replace_every:
+            # same contract as the single-device solver (jax_cg): the
+            # bf16 tier's periodic-f32-residual-replacement soundness
+            # mechanism, distributed
+            if np.dtype(problem.vdtype) != np.dtype(jnp.bfloat16):
+                raise ValueError(
+                    "replace_every is the bf16 tier's accuracy contract; "
+                    "build the problem with vector_dtype=bf16 (f32/f64 "
+                    "storage has no replacement drift to correct)")
+            if pipelined:
+                raise ValueError("replace_every implements classic CG")
+            if precise_dots:
+                raise ValueError("replace_every computes scalars in "
+                                 "plain f32; precise_dots needs the "
+                                 "direct programs")
         self._program = self._compile()
 
     # -- program construction ---------------------------------------------
@@ -617,6 +638,8 @@ class DistCGSolver:
     def _compile(self):
         prob = self.problem
         pipelined = self.pipelined
+        replace_every = self.replace_every
+        replace_restart = self.replace_restart
         axis = PARTS_AXIS
 
         comm = self.comm
@@ -696,6 +719,83 @@ class DistCGSolver:
                 return _iterate(iter_body, init_state, gamma_of, maxits,
                                 res_tol, diff_tol, dx_of, unbounded,
                                 init_gamma=init_gamma)
+
+            if replace_every and not pipelined:
+                # the sound-bf16 contract, distributed: inner bf16 CG
+                # segments over the mesh with a per-segment f32
+                # true-residual replacement (mixed-precision dist SpMV
+                # -- bf16 blocks x f32 vector).  Mirrors
+                # jax_cg._cg_replaced_program; b/x0 arrive in f32
+                # (solve scatters them wide), and every psum'd scalar
+                # is f32, so the convergence test per segment is
+                # grounded in the true residual on every shard.
+                vdt = jnp.bfloat16
+
+                def segment(x32, r32, p, its):
+                    r16 = r32.astype(vdt)
+                    seg_gamma = pdot(r16, r16)
+                    if replace_restart:
+                        p = r16
+                    else:
+                        pn = pdot(p, p)
+                        bad = ((~jnp.isfinite(pn))
+                               | (pn > jnp.asarray(1e24, sdt) * seg_gamma))
+                        p = jnp.where(bad, r16, p)
+                    nin = jnp.minimum(jnp.int32(replace_every), maxits - its)
+
+                    def ibody(j, st):
+                        d, rr, pp, g = st
+                        live = j < nin
+                        t = spmv(pp)
+                        pdott = pdot(pp, t)
+                        num = g if replace_restart else pdot(rr, pp)
+                        alpha = jnp.where(live & (pdott > 0), num / pdott,
+                                          jnp.zeros_like(g))
+                        d = (d.astype(sdt)
+                             + alpha * pp.astype(sdt)).astype(vdt)
+                        r_new = (rr.astype(sdt)
+                                 - alpha * t.astype(sdt)).astype(vdt)
+                        g_next = pdot(r_new, r_new)
+                        beta = jnp.where(g > 0, g_next / g,
+                                         jnp.zeros_like(g))
+                        pp = jnp.where(live,
+                                       (r_new.astype(sdt)
+                                        + beta * pp.astype(sdt)).astype(vdt),
+                                       pp)
+                        return (d, r_new, pp, g_next)
+
+                    d, _, p, _ = jax.lax.fori_loop(
+                        0, replace_every, ibody,
+                        (jnp.zeros_like(r16), r16, p, seg_gamma))
+                    x32 = x32 + d.astype(sdt)
+                    r32 = b - spmv(x32)
+                    return x32, r32, p, its + nin, pdot(r32, r32)
+
+                p0 = r.astype(vdt)
+                if unbounded:
+                    nouter = ((maxits + jnp.int32(replace_every) - 1)
+                              // jnp.int32(replace_every))
+
+                    def obody(_, carry):
+                        x32, r32, p, its, _ = carry
+                        return segment(x32, r32, p, its)
+
+                    x32, _, _, k, gamma_f = jax.lax.fori_loop(
+                        0, nouter, obody,
+                        (x0, r, p0, jnp.int32(0), gamma))
+                    done = jnp.asarray(True)
+                else:
+                    def wcond(c):
+                        return (c[4] >= res_tol * res_tol) & (c[3] < maxits)
+
+                    def wbody(c):
+                        return segment(*c[:4])
+
+                    x32, _, _, k, gamma_f = jax.lax.while_loop(
+                        wcond, wbody, (x0, r, p0, jnp.int32(0), gamma))
+                    done = gamma_f < res_tol * res_tol
+                return (x32[None], k, jnp.sqrt(gamma_f), r0nrm2, bnrm2,
+                        x0nrm2, inf, done)
 
             if not pipelined:
                 # dxsqr joins the carry only under a diff criterion (extra
@@ -791,12 +891,17 @@ class DistCGSolver:
                     x0: np.ndarray | None = None):
         """Scatter + place every solve input on the mesh (the upload
         stage of ``acgsolvercuda_init``, ``cgcuda.c:143-332``); shared
-        by :meth:`solve` and the per-op profiler."""
+        by :meth:`solve` and the per-op profiler.
+
+        Under ``replace_every`` the outer iteration owns b/x0 in f32
+        (scattering them to bf16 would bake a u_bf16 backward error
+        into every replaced residual)."""
         prob = self.problem
-        dtype = np.dtype(prob.vdtype)
+        dtype = np.dtype(np.float32 if self.replace_every
+                         else prob.vdtype)
         put = functools.partial(put_global, sharding=self._sharding)
-        b = put(prob.scatter(np.asarray(b_global)))
-        x0 = put(prob.scatter(np.asarray(x0))
+        b = put(prob.scatter(np.asarray(b_global), dtype=dtype))
+        x0 = put(prob.scatter(np.asarray(x0), dtype=dtype)
                  if x0 is not None
                  else np.zeros((prob.nparts, prob.nmax_owned), dtype=dtype))
         la = jax.tree.map(put, prob.local.arrays)
@@ -825,6 +930,9 @@ class DistCGSolver:
         st.criteria = crit
         prob = self.problem
         dtype = np.dtype(prob.vdtype)
+        if self.replace_every and crit.needs_diff:
+            raise ValueError("replace_every supports residual criteria "
+                             "only")
 
         b, x0, la, ga, sidx, gsrc, gval, scnt, rcnt = \
             self.device_args(b_global, x0)

@@ -245,19 +245,28 @@ def _time_solver(solver, b, criteria_cls, repeats: int = TIMED_REPEATS,
     if broken_sync:
         # the raw times include the round-trip the fetch-sync adds; a
         # second point at a shorter trip count subtracts it (same
-        # chained-difference rationale as the bandwidth probe).  Guard
-        # against jitter swamping the difference: only adopt the
-        # corrected figure when it is sane (positive, not faster than
-        # the raw time implies by >20x).
-        t_short = min(timed(max(maxits // 4, 1)) for _ in range(repeats))
-        dt = tsolve - t_short
-        its_dt = maxits - max(maxits // 4, 1)
-        if dt > 0 and tsolve / (dt / its_dt * maxits) < 20:
-            corrected = dt / its_dt * maxits
-            print(f"# two-point correction: raw {tsolve:.3f}s -> "
-                  f"{corrected:.3f}s for {maxits} its (dispatch "
-                  f"round-trip subtracted)", file=sys.stderr)
-            tsolve = corrected
+        # chained-difference rationale as the bandwidth probe).  The
+        # short run is taken IMMEDIATELY AFTER each long run so both
+        # points share a contention window (a batch of shorts after all
+        # longs measured 5x scatter in the corrected figure), and the
+        # estimator is the MEDIAN of per-pair differences -- min would
+        # keep the jitter tail's most optimistic pairing.
+        short_its = max(maxits // 4, 1)
+        its_dt = maxits - short_its
+        dts = []
+        for _ in range(repeats):
+            t_long = timed(maxits)
+            t_short = timed(short_its)
+            if t_long > t_short:
+                dts.append(t_long - t_short)
+        if dts:
+            import statistics
+            corrected = statistics.median(dts) / its_dt * maxits
+            if tsolve / corrected < 20:
+                print(f"# two-point correction: raw {tsolve:.3f}s -> "
+                      f"{corrected:.3f}s for {maxits} its (median of "
+                      f"{len(dts)} adjacent pairs)", file=sys.stderr)
+                tsolve = corrected
     return tsolve, maxits
 
 

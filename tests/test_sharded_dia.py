@@ -239,15 +239,11 @@ def test_cli_sharded_refine(tmp_path):
                  if ln.startswith("error 2-norm:")][0].split(":")[1])
     assert err < 1e-8
     # the EMITTED solution must carry the refined (df64) accuracy, not
-    # just the f32 hi part (~1e-7): check the true residual of the file
+    # just the f32 hi part: assert the written values are NOT everywhere
+    # f32-representable -- true only if the hi+lo df64 sum was emitted
+    # (the b is device-generated, so the file's residual itself is not
+    # reconstructable here; the df64 accuracy is pinned by
+    # test_sharded_refine_reaches_f64_class_error)
     from acg_tpu.io.mtxfile import read_mtx
     x = np.asarray(read_mtx(out, binary=True).vals).reshape(-1)
-    csr = _csr(16, 3)
-    rng = np.random.default_rng(42)  # the CLI's default --seed
-    # b is device-generated; check against the matrix instead: the
-    # residual of the emitted x for ITS OWN manufactured b is not
-    # reconstructable here, but ||A x|| structure is -- use xsol-free
-    # invariant: refined x must satisfy A x = b to ~1e-10 where b = A x
-    # is self-consistent; so instead assert the emitted dtype precision:
-    # the hi+lo sum cannot be exactly representable in f32 everywhere
     assert not np.array_equal(x, x.astype(np.float32).astype(np.float64))
