@@ -674,6 +674,7 @@ def _distributed_write(args, solver, x_st, xsol, n: int) -> int:
     # in the range-written output -- un-permuting would scatter every
     # window and defeat the no-gather design.  Make the file
     # self-describing: copy the perm sidecar next to it and say so.
+    import os
     perm_path = args.A + ".perm.mtx"
     if os.path.exists(perm_path):
         import shutil
