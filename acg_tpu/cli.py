@@ -161,6 +161,13 @@ def make_parser() -> argparse.ArgumentParser:
     p.add_argument("--refine-rtol", type=float, default=1e-5, metavar="TOL",
                    help="relative tolerance of each inner refinement solve "
                         "(default: 1e-5)")
+    p.add_argument("--refine-inner-maxits", type=int, default=None,
+                   metavar="N",
+                   help="cap each inner refinement solve at N iterations "
+                        "(bounds one device program's runtime -- needed "
+                        "at pod-filling sizes where a watchdog kills "
+                        "long programs; default: the remaining "
+                        "--max-iterations budget)")
     p.add_argument("--seed", type=int, default=42,
                    help="random seed for partitioning and manufactured solutions")
     p.add_argument("--numfmt", default="%.17g", metavar="FMT",
@@ -817,9 +824,9 @@ def _solve_generated_sharded(args, dim, n, N, jax, jnp, dtype,
         # device-resident result: the gather to host happens only when
         # the solution is actually written
         if args.refine:
-            xh, xl = solver.solve_refined(b, criteria=criteria,
-                                          inner_rtol=args.refine_rtol,
-                                          warmup=args.warmup)
+            xh, xl = solver.solve_refined(
+                b, criteria=criteria, inner_rtol=args.refine_rtol,
+                inner_maxits=args.refine_inner_maxits, warmup=args.warmup)
             x = xh
         else:
             x = solver.solve(b, criteria=criteria, warmup=args.warmup,

@@ -183,7 +183,8 @@ class ShardedDiaCGSolver(JaxCGSolver):
         return xsol, (bh, bl)
 
     def solve_refined(self, b, criteria=None, inner_rtol: float = 1e-5,
-                      warmup: int = 0, max_passes: int = 40):
+                      warmup: int = 0, max_passes: int = 40,
+                      inner_maxits: int | None = None):
         """Device-resident SHARDED iterative refinement: df64 outer
         residual (``dia_mv_roll_df`` over the same on-device planes --
         lossless promotion for stencil values), f32 inner CG solves,
@@ -244,8 +245,12 @@ class ShardedDiaCGSolver(JaxCGSolver):
         while (not converged and not stalled and npasses < max_passes
                and total_inner < crit.maxits):
             budget = crit.maxits - total_inner
-            inner_crit = StoppingCriteria(maxits=budget,
-                                          residual_rtol=inner_rtol)
+            # inner_maxits caps one pass's device program: at 512^3 a
+            # budget-sized inner while_loop would outrun the tunnel's
+            # ~25 s program watchdog (bench.MAX_PROGRAM_SECONDS notes)
+            inner_crit = StoppingCriteria(
+                maxits=min(inner_maxits or budget, budget),
+                residual_rtol=inner_rtol)
             self.stats = SolverStats_inner = type(st)(unknowns=st.unknowns)
             try:
                 d = super().solve(rh, criteria=inner_crit,
