@@ -33,7 +33,7 @@ ROWS=(
 
 for row in "${ROWS[@]}"; do
   echo "# ladder row: $row" >&2
-  timeout 900 python bench.py --full --row "$row" >> "$OUT"
+  timeout 1500 python bench.py --full --row "$row" >> "$OUT"
   rc=$?
   if [ $rc -ne 0 ]; then
     echo "{\"metric\": \"$row\", \"skipped\": true, \"rc\": $rc}" >> "$OUT"
