@@ -463,7 +463,12 @@ def _solve_distributed_read(args, jax, jnp, dtype, vec_dtype) -> int:
          not args.binary),
         (f"--solver {args.solver}",
          args.solver in ("host", "host-native", "petsc")),
-        ("b/x0 input files", bool(args.b or args.x0)),
+        ("b/x0 files with --manufactured-solution",
+         args.manufactured_solution and bool(args.b or args.x0)),
+        ("b/x0 files with a partition-permuted matrix (the window "
+         "reads would need the inverse permutation)",
+         bool(args.b or args.x0)
+         and os.path.exists(args.A + ".perm.mtx")),
         ("--refine", args.refine),
         ("--output-comm-matrix", args.output_comm_matrix),
         ("--profile-ops", args.profile_ops is not None),
@@ -559,8 +564,32 @@ def _solve_distributed_read(args, jax, jnp, dtype, vec_dtype) -> int:
                 bp = bp + s.A_ghost @ xsol[s.global_ids[s.nowned:]]
             b[lo:hi] = bp
         # b needs only the owned slices: scatter() reads owned parts only
+    elif args.b:
+        b = None
     else:
         b = np.ones(n)
+    x0 = None
+    if args.b or args.x0:
+        # per-controller WINDOW reads of binary array vectors (the
+        # input mirror of the distributed write): I/O stays O(local
+        # rows).  Host-local reads can fail one-sided, so agree at a
+        # checkpoint BEFORE entering the solve collective (the ingest
+        # checkpoint rationale).
+        rhs_rc = 0
+        try:
+            if args.b:
+                b = _read_vector_windows(args.b, prob)
+            if args.x0:
+                x0 = _read_vector_windows(args.x0, prob)
+        except (AcgError, OSError) as e:
+            sys.stderr.write(f"acg-tpu: {e}\n")
+            rhs_rc = 1
+        rc = _checkpoint(args, "rhs", rhs_rc)
+        if rc:
+            if not rhs_rc:
+                sys.stderr.write("acg-tpu: aborting: a peer controller "
+                                 "failed reading b/x0\n")
+            return rc
 
     criteria = StoppingCriteria(
         maxits=args.max_iterations,
@@ -579,7 +608,7 @@ def _solve_distributed_read(args, jax, jnp, dtype, vec_dtype) -> int:
     if args.trace:
         jax.profiler.start_trace(args.trace)
     try:
-        x = solver.solve(b, criteria=criteria, warmup=args.warmup,
+        x = solver.solve(b, x0=x0, criteria=criteria, warmup=args.warmup,
                          host_result=not args.output)
     except NotConvergedError as e:
         sys.stderr.write(f"acg-tpu: {e}\n")
@@ -613,6 +642,21 @@ def _solve_distributed_read(args, jax, jnp, dtype, vec_dtype) -> int:
     # input ordering via the perm sidecar
     _emit_solution(args, x, _load_perm_sidecar(args.A, n))
     return 0
+
+
+def _read_vector_windows(path, prob) -> np.ndarray:
+    """Assemble a global-length vector by reading ONLY this controller's
+    owned part windows from a binary array vector file
+    (:func:`acg_tpu.io.mtxfile.read_vector_window`) -- unowned entries
+    stay zero and are never read by the stacked scatter."""
+    from acg_tpu.io.mtxfile import read_vector_window
+
+    v = np.zeros(prob.n)
+    for p in prob.owned_parts:
+        lo, hi = prob.band_bounds[p], prob.band_bounds[p + 1]
+        v[lo:hi] = read_vector_window(path, int(lo), int(hi),
+                                      expect_nrows=prob.n)
+    return v
 
 
 def _distributed_write(args, solver, x_st, xsol, n: int) -> int:

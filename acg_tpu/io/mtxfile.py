@@ -549,6 +549,59 @@ def finalize_vector_file(path, n: int) -> None:
         os.close(fd)
 
 
+def read_vector_window(path, row_lo: int, row_hi: int,
+                       expect_nrows: int | None = None) -> np.ndarray:
+    """Read rows ``[row_lo, row_hi)`` of a BINARY array (dense vector)
+    file -- the input mirror of :func:`write_vector_window`: one seek +
+    one read of exactly the window, so per-controller right-hand-side
+    ingest is O(local rows) (the b/x0 half of the reference's
+    distributed file I/O, ``mtxfile.h:997-1087``).
+
+    ``expect_nrows`` pins the file's global length: window reads only
+    touch their slice, so without this check a wrong-sized vector
+    (wrong problem) would be silently accepted wherever the windows
+    happen to fit."""
+    if not (0 <= row_lo <= row_hi):
+        raise AcgError(ErrorCode.INVALID_VALUE,
+                       f"bad row range [{row_lo}, {row_hi})")
+    with open(path, "rb") as f:
+        if f.read(2) == b"\x1f\x8b":
+            raise AcgError(ErrorCode.NOT_SUPPORTED,
+                           f"{path}: gzipped vector files are not "
+                           f"seekable for window reads; decompress to a "
+                           f"raw binary array file first")
+        f.seek(0)
+        _, fmt, field, _, _, nrows, ncols, _ = _read_header_meta(f)
+        if expect_nrows is not None and nrows != expect_nrows:
+            raise AcgError(ErrorCode.INVALID_VALUE,
+                           f"{path}: vector has {nrows} rows, "
+                           f"need {expect_nrows}")
+        if fmt != "array" or ncols != 1:
+            raise AcgError(ErrorCode.INVALID_FORMAT,
+                           f"{path}: vector window reads need a dense "
+                           f"array vector file ({fmt} {ncols} cols)")
+        if field != "real":
+            raise AcgError(ErrorCode.NOT_SUPPORTED,
+                           f"{path}: vector windows read 'real'/'double' "
+                           f"fields (got {field!r})")
+        if row_hi > nrows:
+            raise AcgError(ErrorCode.INVALID_VALUE,
+                           f"window [{row_lo}, {row_hi}) outside "
+                           f"[0, {nrows})")
+        data_off = f.tell()
+        f.seek(0, os.SEEK_END)
+        if f.tell() != data_off + 8 * nrows:
+            raise AcgError(ErrorCode.INVALID_FORMAT,
+                           f"{path}: data section size does not match "
+                           f"the binary array layout for {nrows} rows "
+                           f"-- not a binary file?")
+        f.seek(data_off + 8 * row_lo)
+        buf = f.read(8 * (row_hi - row_lo))
+        if len(buf) != 8 * (row_hi - row_lo):
+            raise AcgError(ErrorCode.EOF, "binary vector truncated")
+        return np.frombuffer(buf, dtype=np.float64).copy()
+
+
 def vector_mtx(x: np.ndarray, field: str = "real") -> MtxFile:
     """Wrap a dense vector as a Matrix Market array file object."""
     x = np.asarray(x)

@@ -1,0 +1,28 @@
+#!/bin/bash
+# Hunt for an HONEST quiet window: only run the quiet_ab capture when
+# (a) block_until_ready actually waits (no fetch-RTT jitter in the
+# timings) and (b) the bandwidth probe clears the quiet threshold.
+# Sleeps between attempts; bounded total duration.
+#
+# Usage: scripts/quiet_hunt.sh [TOTAL_SECONDS] [SLEEP_SECONDS]
+set -u
+cd "$(dirname "$0")/.."
+TOTAL=${1:-14400}
+NAP=${2:-900}
+deadline=$(( $(date +%s) + TOTAL ))
+
+while [ "$(date +%s)" -lt "$deadline" ]; do
+  honest=$(timeout 300 python -c "
+from acg_tpu._platform import block_until_ready_works
+print('yes' if block_until_ready_works() else 'no')" 2>/dev/null | tail -1)
+  if [ "$honest" = "yes" ]; then
+    echo "# $(date -u +%H:%M:%S) block honest -- attempting capture" >&2
+    timeout 2400 python scripts/quiet_ab.py --min-bw 600 --pairs 3 \
+      --wait-budget 300 && exit 0
+  else
+    echo "# $(date -u +%H:%M:%S) backend still degraded (honest=$honest)" >&2
+  fi
+  sleep "$NAP"
+done
+echo "# quiet hunt: no honest window within budget" >&2
+exit 3
