@@ -480,7 +480,11 @@ def test_distributed_read_b_and_x0_files(binfile, csr, tmp_path):
         [sys.executable, "-m", "acg_tpu.cli", str(binfile),
          str(bfile), str(xfile), "--binary",
          "--distributed-read", "--nparts", "4", "--dtype", "f64",
-         "--max-iterations", "3000", "--residual-rtol", "1e-10",
+         # ABSOLUTE tolerance: x0 = exact solution makes r0 ~ 1e-13, so
+         # a relative-to-r0 tolerance would keep iterating; with atol
+         # the solve must stop immediately iff x0 actually arrived
+         "--max-iterations", "3000", "--residual-atol", "1e-8",
+         "--residual-rtol", "0",
          "--warmup", "0", "--quiet", "-o", str(out)],
         capture_output=True, text=True,
         env={**os.environ, "JAX_PLATFORMS": "cpu",
