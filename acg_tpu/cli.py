@@ -469,13 +469,12 @@ def _solve_distributed_read(args, jax, jnp, dtype, vec_dtype) -> int:
          "reads would need the inverse permutation)",
          bool(args.b or args.x0)
          and os.path.exists(args.A + ".perm.mtx")),
-        ("--refine", args.refine),
         ("--output-comm-matrix", args.output_comm_matrix),
         ("--profile-ops", args.profile_ops is not None),
         ("--kernels fused (single-device only)", args.kernels == "fused"),
-        ("--diff-* criteria with --replace-every",
-         args.replace_every > 0 and (args.diff_atol > 0
-                                     or args.diff_rtol > 0)),
+        ("--diff-* criteria with --replace-every or --refine",
+         (args.replace_every > 0 or args.refine)
+         and (args.diff_atol > 0 or args.diff_rtol > 0)),
         ("--comm dma", args.comm in ("dma", "nvshmem")),
     ] if on]
     if unsupported:
@@ -556,13 +555,7 @@ def _solve_distributed_read(args, jax, jnp, dtype, vec_dtype) -> int:
         xsol = rng.standard_normal(n)
         xsol /= np.linalg.norm(xsol)
         b = np.zeros(n)
-        for p in prob.owned_parts:
-            s = prob.subs[p]
-            lo, hi = prob.band_bounds[p], prob.band_bounds[p + 1]
-            bp = s.A_local @ xsol[lo:hi]
-            if s.nghost:
-                bp = bp + s.A_ghost @ xsol[s.global_ids[s.nowned:]]
-            b[lo:hi] = bp
+        _owned_spmv_windows(prob, xsol, b)
         # b needs only the owned slices: scatter() reads owned parts only
     elif args.b:
         b = None
@@ -604,12 +597,36 @@ def _solve_distributed_read(args, jax, jnp, dtype, vec_dtype) -> int:
         sys.stderr.write(f"acg-tpu: {e}\n")
         _checkpoint(args, "solve", 1)
         return 1
+    if args.refine:
+        # f64 outer residuals from THIS controller's host blocks only
+        # (no full matrix anywhere); inner --dtype solves on the mesh.
+        # The outer iteration needs a GLOBALLY consistent b (and x0):
+        # windowed per-controller vectors are combined, else each
+        # controller's residual norms -- and therefore the refinement
+        # control flow -- would diverge across the pod.
+        from acg_tpu.solvers.refine import RefinedSolver
+        if args.manufactured_solution or args.b:
+            b = _allgather_sum(b, prob)
+        if x0 is not None:
+            x0 = _allgather_sum(x0, prob)
+        solver = RefinedSolver(solver, _dist_host_matvec(prob), n=n,
+                               nnz=prob.nnz_total,
+                               inner_rtol=args.refine_rtol,
+                               inner_maxits=args.refine_inner_maxits)
     t0 = time.perf_counter()
     if args.trace:
         jax.profiler.start_trace(args.trace)
     try:
-        x = solver.solve(b, x0=x0, criteria=criteria, warmup=args.warmup,
-                         host_result=not args.output)
+        if args.refine:
+            # refined solutions come back as host f64 (the outer
+            # iteration lives there); the distributed write then
+            # range-writes host windows instead of device shards
+            x = solver.solve(b, x0=x0, criteria=criteria,
+                             warmup=args.warmup)
+        else:
+            x = solver.solve(b, x0=x0, criteria=criteria,
+                             warmup=args.warmup,
+                             host_result=not args.output)
     except NotConvergedError as e:
         sys.stderr.write(f"acg-tpu: {e}\n")
         if is_primary():
@@ -644,6 +661,71 @@ def _solve_distributed_read(args, jax, jnp, dtype, vec_dtype) -> int:
     return 0
 
 
+def _owned_spmv_windows(prob, x: np.ndarray, out: np.ndarray) -> None:
+    """``out[lo:hi] = (A @ x)[lo:hi]`` for every part this controller
+    owns, from its host blocks (f64 scipy): the per-part distributed
+    host SpMV shared by the manufactured-b assembly and the refinement
+    matvec (the ``acgsymcsrmatrix_dsymvmpi`` role,
+    ``cuda/acg-cuda.c:2115``)."""
+    for p in prob.owned_parts:
+        s = prob.subs[p]
+        lo, hi = prob.band_bounds[p], prob.band_bounds[p + 1]
+        yp = s.A_local @ x[lo:hi]
+        if s.nghost:
+            yp = yp + s.A_ghost @ x[s.global_ids[s.nowned:]]
+        out[lo:hi] = yp
+
+
+def _allgather_sum(y: np.ndarray, prob=None) -> np.ndarray:
+    """Combine per-controller owned-window vectors (zeros elsewhere)
+    into the global vector across processes.
+
+    With ``prob``, only each process's owned SPAN (bounding box of its
+    windows, padded to the mesh max) is exchanged -- O(N) total for
+    balanced contiguous assignments, instead of the O(P*N) a full
+    per-process allgather would cost (at 512^3 x 16 controllers that
+    difference is ~17 GB of host temporaries per call).  Rows outside a
+    process's windows are zero on that process, so overlapping spans
+    still sum correctly."""
+    import jax
+
+    if jax.process_count() == 1:
+        return y
+    from jax.experimental import multihost_utils
+
+    y = np.asarray(y)
+    if prob is None:
+        return np.sum(multihost_utils.process_allgather(y, tiled=False),
+                      axis=0)
+    lo = min(int(prob.band_bounds[p]) for p in prob.owned_parts)
+    hi = max(int(prob.band_bounds[p + 1]) for p in prob.owned_parts)
+    meta = multihost_utils.process_allgather(
+        np.asarray([lo, hi], np.int64), tiled=False)
+    span = int((meta[:, 1] - meta[:, 0]).max())
+    buf = np.zeros(span)
+    buf[: hi - lo] = y[lo:hi]
+    data = multihost_utils.process_allgather(buf, tiled=False)
+    out = np.zeros_like(y)
+    for (plo, phi), row in zip(meta, data):
+        out[plo:phi] += row[: phi - plo]
+    return out
+
+
+def _dist_host_matvec(prob):
+    """``matvec(x) -> A @ x`` in f64 from THIS controller's host blocks
+    only: per-part windows (:func:`_owned_spmv_windows`) combined by a
+    span-wise cross-process sum -- O(N) vector traffic, the MATRIX
+    never leaves its controller."""
+    def mv(x):
+        y = np.zeros(prob.n)
+        _owned_spmv_windows(prob, x, y)
+        # each row is owned by exactly one part/process; unowned rows
+        # are zero, so the element-wise sum assembles A @ x
+        return _allgather_sum(y, prob)
+
+    return mv
+
+
 def _read_vector_windows(path, prob) -> np.ndarray:
     """Assemble a global-length vector by reading ONLY this controller's
     owned part windows from a binary array vector file
@@ -673,25 +755,36 @@ def _distributed_write(args, solver, x_st, xsol, n: int) -> int:
     from acg_tpu.io.mtxfile import finalize_vector_file, write_vector_window
     from acg_tpu.parallel.multihost import is_primary
 
-    prob = solver.problem
+    prob = getattr(solver, "problem", None)
+    if prob is None:
+        prob = solver.inner.problem  # RefinedSolver wrapper (--refine)
     bounds = prob.band_bounds
     windows = []  # (row_lo, values) for this controller's parts
     wrc = 0
     try:
-        seen = set()
-        for sh in x_st.addressable_shards:
-            data = np.asarray(sh.data)
-            sl = sh.index[0]
-            start = (int(sl.start or 0) if isinstance(sl, slice)
-                     else int(sl))
-            for j in range(data.shape[0]):
-                p = start + j
-                s = prob.subs[p]
-                if p in seen or s is None or s.A_local is None:
-                    continue  # stub/duplicate row on this device
-                seen.add(p)
-                windows.append((int(bounds[p]),
-                                data[j, : s.nowned].astype(np.float64)))
+        if isinstance(x_st, np.ndarray):
+            # refined path: the outer iteration returns a host f64
+            # global vector; every controller still writes ONLY its
+            # owned windows
+            for p in prob.owned_parts:
+                lo, hi = int(bounds[p]), int(bounds[p + 1])
+                windows.append((lo, np.asarray(x_st[lo:hi], np.float64)))
+        else:
+            seen = set()
+            for sh in x_st.addressable_shards:
+                data = np.asarray(sh.data)
+                sl = sh.index[0]
+                start = (int(sl.start or 0) if isinstance(sl, slice)
+                         else int(sl))
+                for j in range(data.shape[0]):
+                    p = start + j
+                    s = prob.subs[p]
+                    if p in seen or s is None or s.A_local is None:
+                        continue  # stub/duplicate row on this device
+                    seen.add(p)
+                    windows.append((int(bounds[p]),
+                                    data[j, : s.nowned]
+                                    .astype(np.float64)))
         t0 = time.perf_counter()
         for lo, vals in windows:
             write_vector_window(args.output, n, lo, vals)

@@ -40,12 +40,28 @@ class RefinedSolver:
     """
 
     def __init__(self, inner, full_csr, inner_rtol: float = 1e-5,
-                 inner_maxits: int | None = None):
+                 inner_maxits: int | None = None, n: int | None = None,
+                 nnz: int | None = None):
+        """``full_csr`` may instead be a CALLABLE ``matvec(x) -> A @ x``
+        in f64 (pass ``n``, and ``nnz`` for flop accounting, then): the
+        distributed-read path supplies a per-part host SpMV over its
+        local blocks so the outer residual never needs the full matrix
+        on any controller."""
         self.inner = inner
-        self.csr = full_csr
+        if callable(full_csr) and not hasattr(full_csr, "shape"):
+            if n is None:
+                raise ValueError("matvec form needs n")
+            self._matvec = full_csr
+            self._n = int(n)
+            self._nnz2 = 2.0 * (nnz or 0)
+        else:
+            self.csr = full_csr
+            self._matvec = full_csr.__matmul__
+            self._n = full_csr.shape[0]
+            self._nnz2 = 2.0 * full_csr.nnz
         self.inner_rtol = float(inner_rtol)
         self.inner_maxits = inner_maxits
-        self.stats = SolverStats(unknowns=full_csr.shape[0])
+        self.stats = SolverStats(unknowns=self._n)
         self.stats.nrefine = 0
 
     def solve(self, b, x0=None, criteria: StoppingCriteria | None = None,
@@ -72,7 +88,7 @@ class RefinedSolver:
                              raise_on_divergence=False, warmup=warmup - 1)
             warmup = 0
         t0 = time.perf_counter()
-        r = b - self.csr @ x
+        r = b - self._matvec(x)
         r0nrm2 = float(np.linalg.norm(r))
         st.bnrm2 = float(np.linalg.norm(b))
         st.x0nrm2 = float(np.linalg.norm(x))
@@ -107,7 +123,7 @@ class RefinedSolver:
             x = x + dx
             npasses += 1
             total_inner += self.inner.stats.niterations
-            r = b - self.csr @ x
+            r = b - self._matvec(x)
             rnrm2 = float(np.linalg.norm(r))
             if rnrm2 > rnrm2_prev:
                 # diverging pass: keep the better previous iterate so the
@@ -130,7 +146,7 @@ class RefinedSolver:
         st.dxnrm2 = float("inf")
         st.converged = bool(converged)
         st.nflops += (self.inner.stats.nflops - inner_flops0
-                      + 2.0 * self.csr.nnz * npasses)
+                      + self._nnz2 * npasses)
         st.fexcept_arrays = [x]
         if not converged and raise_on_divergence:
             raise NotConvergedError(
