@@ -469,7 +469,6 @@ def _solve_distributed_read(args, jax, jnp, dtype, vec_dtype) -> int:
          "reads would need the inverse permutation)",
          bool(args.b or args.x0)
          and os.path.exists(args.A + ".perm.mtx")),
-        ("--output-comm-matrix", args.output_comm_matrix),
         ("--profile-ops", args.profile_ops is not None),
         ("--kernels fused (single-device only)", args.kernels == "fused"),
         ("--diff-* criteria with --replace-every or --refine",
@@ -544,6 +543,18 @@ def _solve_distributed_read(args, jax, jnp, dtype, vec_dtype) -> int:
     prob = DistributedProblem.assemble_local(
         subs, bounds, n_rows, nparts, owned, dtype=dtype,
         vector_dtype=vec_dtype)
+
+    comm_mtx_out = None
+    if args.output_comm_matrix:
+        # owned rows of the volume matrix are exact from local halo
+        # plans; the P x P allgather-sum fills the rest (tiny)
+        from acg_tpu.graph import comm_matrix as _cm
+        M = _cm([prob.subs[p] for p in prob.owned_parts], nparts)
+        if jax.process_count() > 1:
+            from jax.experimental import multihost_utils
+            M = np.sum(multihost_utils.process_allgather(M, tiled=False),
+                       axis=0).astype(np.int64)
+        comm_mtx_out = M
 
     n = prob.n
     rng = np.random.default_rng(args.seed)
@@ -643,6 +654,9 @@ def _solve_distributed_read(args, jax, jnp, dtype, vec_dtype) -> int:
                          "during the solve\n")
         return rc
 
+    if comm_mtx_out is not None and is_primary():
+        _write_comm_matrix(comm_mtx_out, nparts)
+
     if args.output:
         return _distributed_write(args, solver, x, xsol, n)
 
@@ -659,6 +673,21 @@ def _solve_distributed_read(args, jax, jnp, dtype, vec_dtype) -> int:
     # input ordering via the perm sidecar
     _emit_solution(args, x, _load_perm_sidecar(args.A, n))
     return 0
+
+
+def _write_comm_matrix(M: np.ndarray, nparts: int) -> None:
+    """Part-to-part communication volumes to stdout as Matrix Market
+    (``--output-comm-matrix``, ``cuda/acg-cuda.c:1712-1780``) -- shared
+    by the replicated and distributed-read paths so their formats
+    cannot diverge."""
+    from acg_tpu.io.mtxfile import MtxFile, write_mtx
+
+    nz = np.nonzero(M)
+    write_mtx(sys.stdout.buffer, MtxFile(
+        object="matrix", format="coordinate", field="integer",
+        symmetry="general", nrows=nparts, ncols=nparts,
+        nnz=len(nz[0]), rowidx=nz[0], colidx=nz[1],
+        vals=M[nz]), numfmt="%d")
 
 
 def _owned_spmv_windows(prob, x: np.ndarray, out: np.ndarray) -> None:
@@ -1352,12 +1381,7 @@ def _main(args) -> int:
 
     # stage 2d/10: communication matrix and solution output
     if comm_mtx_out is not None:
-        nz = np.nonzero(comm_mtx_out)
-        write_mtx(sys.stdout.buffer, MtxFile(
-            object="matrix", format="coordinate", field="integer",
-            symmetry="general", nrows=nparts, ncols=nparts, nnz=len(nz[0]),
-            rowidx=nz[0], colidx=nz[1], vals=comm_mtx_out[nz]),
-            numfmt="%d")
+        _write_comm_matrix(comm_mtx_out, nparts)
     _emit_solution(args, x, perm_sidecar)
     return 0
 

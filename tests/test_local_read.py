@@ -584,3 +584,35 @@ def test_cli_two_process_distributed_read_refine(binfile):
         assert p.returncode == 0, se
     err = float(outs[0][1].split("\nerror 2-norm: ")[1].split()[0])
     assert err < 1e-9
+
+
+def test_distributed_read_comm_matrix(binfile):
+    """--output-comm-matrix under --distributed-read: the volume matrix
+    assembled from owned halo plans matches the replicated path's."""
+    from io import BytesIO
+    from acg_tpu.io.mtxfile import read_mtx
+    env = {**os.environ, "JAX_PLATFORMS": "cpu",
+           "XLA_FLAGS": "--xla_force_host_platform_device_count=4"}
+    base = ["--nparts", "4", "--dtype", "f64", "--max-iterations", "50",
+            "--residual-rtol", "1e-6", "--warmup", "0", "--quiet",
+            "--output-comm-matrix"]
+    r1 = subprocess.run(
+        [sys.executable, "-m", "acg_tpu.cli", str(binfile), "--binary",
+         "--distributed-read"] + base,
+        capture_output=True, text=True, env=env)
+    assert r1.returncode == 0, r1.stderr
+    m1 = read_mtx(BytesIO(r1.stdout.encode()))
+    # replicated path on the same matrix with the same band partition
+    r2 = subprocess.run(
+        [sys.executable, "-m", "acg_tpu.cli", str(binfile), "--binary",
+         "--partition-method", "band"] + base,
+        capture_output=True, text=True, env=env)
+    assert r2.returncode == 0, r2.stderr
+    m2 = read_mtx(BytesIO(r2.stdout.encode()))
+    assert m1.nrows == m2.nrows == 4
+    np.testing.assert_array_equal(np.asarray(m1.rowidx),
+                                  np.asarray(m2.rowidx))
+    np.testing.assert_array_equal(np.asarray(m1.colidx),
+                                  np.asarray(m2.colidx))
+    np.testing.assert_array_equal(np.asarray(m1.vals),
+                                  np.asarray(m2.vals))
