@@ -465,10 +465,6 @@ def _solve_distributed_read(args, jax, jnp, dtype, vec_dtype) -> int:
          args.solver in ("host", "host-native", "petsc")),
         ("b/x0 files with --manufactured-solution",
          args.manufactured_solution and bool(args.b or args.x0)),
-        ("b/x0 files with a partition-permuted matrix (the window "
-         "reads would need the inverse permutation)",
-         bool(args.b or args.x0)
-         and os.path.exists(args.A + ".perm.mtx")),
         ("--profile-ops", args.profile_ops is not None),
         ("--kernels fused (single-device only)", args.kernels == "fused"),
         ("--diff-* criteria with --replace-every or --refine",
@@ -492,10 +488,20 @@ def _solve_distributed_read(args, jax, jnp, dtype, vec_dtype) -> int:
     if bounds_path is None and os.path.exists(args.A + ".bounds.mtx"):
         bounds_path = args.A + ".bounds.mtx"
     if bounds_path is not None:
+        # the bounds sidecar is TEXT by construction (mtx2bin writes it
+        # so); --partition-binary describes the original partition
+        # VECTOR, not this sidecar -- reusing it here turned a valid
+        # run into a parse failure (round-4 advisor finding).  Sniff
+        # binary as a fallback for hand-made sidecars.
         try:
-            bmtx = read_mtx(bounds_path, binary=args.partition_binary)
-        except AcgError as e:
-            raise SystemExit(f"acg-tpu: {bounds_path}: {e}")
+            # ValueError: the numpy-fallback text parser raises it (not
+            # AcgError) when the data section is actually binary
+            bmtx = read_mtx(bounds_path, binary=False)
+        except (AcgError, ValueError):
+            try:
+                bmtx = read_mtx(bounds_path, binary=True)
+            except AcgError as e:
+                raise SystemExit(f"acg-tpu: {bounds_path}: {e}")
         bounds = np.asarray(bmtx.vals).reshape(-1).astype(np.int64)
         try:
             from acg_tpu.io.mtxfile import read_mtx_sizes
@@ -580,11 +586,13 @@ def _solve_distributed_read(args, jax, jnp, dtype, vec_dtype) -> int:
         # checkpoint BEFORE entering the solve collective (the ingest
         # checkpoint rationale).
         rhs_rc = 0
+        perm_path = (args.A + ".perm.mtx"
+                     if os.path.exists(args.A + ".perm.mtx") else None)
         try:
             if args.b:
-                b = _read_vector_windows(args.b, prob)
+                b = _read_vector_windows(args.b, prob, perm_path)
             if args.x0:
-                x0 = _read_vector_windows(args.x0, prob)
+                x0 = _read_vector_windows(args.x0, prob, perm_path)
         except (AcgError, OSError) as e:
             sys.stderr.write(f"acg-tpu: {e}\n")
             rhs_rc = 1
@@ -755,18 +763,33 @@ def _dist_host_matvec(prob):
     return mv
 
 
-def _read_vector_windows(path, prob) -> np.ndarray:
+def _read_vector_windows(path, prob, perm_path=None) -> np.ndarray:
     """Assemble a global-length vector by reading ONLY this controller's
     owned part windows from a binary array vector file
     (:func:`acg_tpu.io.mtxfile.read_vector_window`) -- unowned entries
-    stay zero and are never read by the stacked scatter."""
-    from acg_tpu.io.mtxfile import read_vector_window
+    stay zero and are never read by the stacked scatter.
+
+    For a partition-PERMUTED matrix (``mtx2bin --partition``;
+    ``perm_path`` = its sidecar) the user's vector file is in the
+    ORIGINAL row ordering, so each owned permuted window [lo, hi) maps
+    through the perm sidecar -- itself window-read, O(local rows) -- to
+    scattered original rows, gathered with coalesced range reads
+    (:func:`acg_tpu.io.mtxfile.read_vector_rows`).  The full perm and
+    the full vector are never materialised on any controller (round-4
+    verdict item 6; ref ``mtxfile.h:997-1087``)."""
+    from acg_tpu.io.mtxfile import (read_vector_rows, read_vector_window)
 
     v = np.zeros(prob.n)
     for p in prob.owned_parts:
-        lo, hi = prob.band_bounds[p], prob.band_bounds[p + 1]
-        v[lo:hi] = read_vector_window(path, int(lo), int(hi),
+        lo, hi = int(prob.band_bounds[p]), int(prob.band_bounds[p + 1])
+        if perm_path is None:
+            v[lo:hi] = read_vector_window(path, lo, hi,
+                                          expect_nrows=prob.n)
+        else:
+            orig = read_vector_window(perm_path, lo, hi,
                                       expect_nrows=prob.n)
+            orig = orig.astype(np.int64) - 1  # sidecar rows are 1-based
+            v[lo:hi] = read_vector_rows(path, orig, expect_nrows=prob.n)
     return v
 
 
@@ -931,27 +954,38 @@ def _solve_generated_sharded(args, dim, n, N, jax, jnp, dtype,
             "acg-tpu: --profile-ops is not available on the sharded "
             "direct-assembly path (single-chip: drop --nparts/"
             "--manufactured-solution)")
-    if args.refine and args.dtype not in ("f32", "mixed"):
+    if (args.refine and args.dtype not in ("f32", "mixed")
+            and not (args.dtype == "bf16" and args.replace_every)):
+        # the natural rtol-1e-9 nest for bf16 storage: replacement-inner
+        # (sound bf16 CG) + df64-refine-outer -- solve_refined's inner
+        # calls route through JaxCGSolver.solve, which dispatches to the
+        # replacement program whenever replace_every is set
         raise SystemExit(
             "acg-tpu: sharded --refine runs df64 outer residuals over "
-            "f32 inner solves; use --dtype f32 or mixed")
+            "f32 inner solves; use --dtype f32/mixed, or --dtype bf16 "
+            "with --replace-every (sound-bf16 inner solves)")
     if args.kernels in ("pallas", "fused"):
         raise SystemExit(
             "acg-tpu: the sharded direct-assembly path pins the SpMV to "
             "the partitioner-friendly roll formulation; --kernels "
             f"{args.kernels} is not available here (use --nparts 1 "
             "without --manufactured-solution for the kernel tiers)")
-    if args.replace_every:
+    if args.replace_every and (args.diff_atol > 0 or args.diff_rtol > 0):
         raise SystemExit(
-            "acg-tpu: --replace-every is single-device only (the "
-            "sharded path's accuracy route is --refine)")
+            "acg-tpu: --replace-every supports residual criteria only "
+            "(--diff-atol/--diff-rtol have no meaning across "
+            "replacement segments)")
 
     nparts = args.nparts or len(jax.devices())
     t0 = time.perf_counter()
-    solver = build_sharded_poisson_solver(
-        n, dim, nparts=nparts, dtype=dtype, vector_dtype=vec_dtype,
-        pipelined="pipelined" in args.solver,
-        precise_dots=args.precise_dots, epsilon=args.epsilon)
+    try:
+        solver = build_sharded_poisson_solver(
+            n, dim, nparts=nparts, dtype=dtype, vector_dtype=vec_dtype,
+            pipelined="pipelined" in args.solver,
+            precise_dots=args.precise_dots, epsilon=args.epsilon,
+            replace_every=args.replace_every)
+    except ValueError as e:
+        raise SystemExit(f"acg-tpu: {e}")
     _log(args, f"assemble sharded DIA planes on device ({nparts} parts):",
          t0)
 
@@ -967,11 +1001,17 @@ def _solve_generated_sharded(args, dim, n, N, jax, jnp, dtype,
         _log(args, "manufactured solution (on device):", t0)
         if solver.stencil is not None:
             # independent oracle: analytic stencil rows recomputed on
-            # the host (shares NOTHING with the solve's SpMV)
+            # the host (shares NOTHING with the solve's SpMV).  The
+            # acceptance threshold follows the dtype b is STORED in:
+            # bf16 b is rounded to 8 mantissa bits by construction
+            # (measured max rel dev 3.3e-3 vs 5.8e-8 for f32), which is
+            # storage, not a manufacturing bug (round-4 advisor finding)
+            bh_ = b[0] if isinstance(b, tuple) else b
+            tol = 1e-2 if bh_.dtype == jnp.bfloat16 else 1e-5
             dev = spot_check_manufactured(solver, xsol, b)
             sys.stderr.write(f"manufactured-b spot check (analytic "
                              f"stencil rows): max rel dev {dev:.3e}\n")
-            if not dev < 1e-5:
+            if not dev < tol:
                 sys.stderr.write("acg-tpu: manufactured b FAILED the "
                                  "independent spot check\n")
                 _checkpoint(args, "solve", 1)
@@ -1222,7 +1262,13 @@ def _main(args) -> int:
         if args.x0:
             xmtx = read_mtx(args.x0, binary=args.binary)
             x0 = np.asarray(xmtx.vals, dtype=np.float64).reshape(-1)
-            if perm_sidecar is not None and x0.size == n:
+            if x0.size != n:
+                # fail like the b path above does -- folding the size
+                # check into the permute guard let a wrong-sized x0
+                # proceed unpermuted (round-4 advisor finding)
+                raise SystemExit(
+                    f"acg-tpu: x0 has {x0.size} entries, need {n}")
+            if perm_sidecar is not None:
                 x0 = x0[perm_sidecar]
         else:
             x0 = None

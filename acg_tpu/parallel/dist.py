@@ -74,17 +74,42 @@ class StackedLocalBlock:
     contiguous parts of a banded matrix (``partition_rows_band``) with
     owned rows in natural order.  ``"ell"``: row-padded gather planes
     ``(data, cols)``, the general fallback (scattered partitions).
+    ``"binnedell"``: the length-binned layout of
+    :class:`acg_tpu.ops.spmv.BinnedEllMatrix` stacked per part
+    (mesh-uniform per-bin row maxima + a padded COO hub tail) -- chosen
+    by the same histogram rule as the single-device ``auto`` when
+    plain-ELL padding waste blows past its limit (power-law /
+    SuiteSparse-class workloads; the reference's merge-CSR load-balance
+    goal, ``cg-kernels-cuda.cu:340-441``, round-4 verdict item 3).
     """
 
-    format: str      # "dia" | "ell"
+    format: str      # "dia" | "ell" | "binnedell"
     arrays: tuple    # dia: ndiags x (P, nrows); ell: (data (P,nrows,K), cols)
+    #                  binnedell: (bin_rows, bin_data, bin_cols tuples,
+    #                              tail_rows, tail_cols, tail_vals)
     offsets: tuple   # dia only: static diagonal offsets, ascending
     nrows: int
+    bin_ks: tuple = ()   # binnedell only: static K_b per bin
 
     def shard_mv(self, arrays, x):
         """y = A_local @ x for one shard (arrays = leading axis stripped)."""
         if self.format == "dia":
             return dia_mv(arrays, self.offsets, self.nrows, x)
+        if self.format == "binnedell":
+            bin_rows, bin_data, bin_cols, t_rows, t_cols, t_vals = arrays
+            adt = acc_dtype(x.dtype)
+            y = jnp.zeros((self.nrows,), dtype=adt)
+            for rows, data, cols in zip(bin_rows, bin_data, bin_cols):
+                contrib = jnp.einsum("mk,mk->m", data, x[cols],
+                                     preferred_element_type=adt)
+                # padding rows index nrows -> dropped by the jit
+                # scatter's OOB mode (NOT unique_indices: every padding
+                # row shares that id)
+                y = y.at[rows].add(contrib)
+            if t_vals.shape[-1]:
+                prod = t_vals.astype(adt) * x[t_cols].astype(adt)
+                y = y.at[t_rows].add(prod)
+            return y.astype(x.dtype)
         data, cols = arrays
         return _ell_mv(data, cols, x)
 
@@ -130,16 +155,24 @@ class UniformShapes:
     nmax_ghost: int         # max ghost count per part
     nnz_total: int
     halo_send_total: int = 0   # sum of per-part halo send entries
+    # binned-ELL sizing (round-4 verdict item 3): per-BELL_WIDTHS-bin
+    # max row count over all parts, and the max hub-tail nnz; None when
+    # the plain-ELL waste rule keeps the ell layout
+    bell_ms: tuple | None = None
+    bell_tail: int = 0
 
 
 def _agree_uniform_shapes(subs_owned, nparts: int,
                           max_diags: int = 80,
                           dia_waste_limit: float = 3.0,
+                          ell_waste_limit: float = 3.0,
                           nmax_owned: int = 0) -> UniformShapes:
     """Compute this controller's local stats and allgather-max/union them
     so every controller derives the IDENTICAL stacked shapes.  The
     payload is one fixed-size int64 vector per process."""
     import jax
+
+    from acg_tpu.ops.spmv import BELL_WIDTHS
 
     offs = np.unique(np.concatenate(
         [csr_diag_offsets(s.A_local) for s in subs_owned]
@@ -155,13 +188,19 @@ def _agree_uniform_shapes(subs_owned, nparts: int,
     nmax_ghost = max((s.nghost for s in subs_owned), default=0)
     nnz = sum(int(s.A_local.nnz + s.A_ghost.nnz) for s in subs_owned)
     send_total = sum(int(s.halo.total_send) for s in subs_owned)
+    # binned-ELL sizing: per-bin row-count max and hub-tail nnz max over
+    # this controller's parts (the bin histogram of each local block)
+    nbins = len(BELL_WIDTHS)
+    bell = _bell_histogram([s.A_local for s in subs_owned])
     cap = 2 * max_diags
     too_many = offs.size > cap
-    payload = np.full(cap + 8, np.iinfo(np.int64).min, dtype=np.int64)
+    payload = np.full(cap + 8 + nbins + 1, np.iinfo(np.int64).min,
+                      dtype=np.int64)
     payload[:min(offs.size, cap)] = offs[:cap]
     payload[cap:cap + 8] = (offs.size if not too_many else cap + 1,
                             Kl, bmax, Kg, maxcnt, nmax_ghost, nnz,
                             send_total)
+    payload[cap + 8:] = bell
     if jax.process_count() > 1:
         from jax.experimental import multihost_utils
 
@@ -180,14 +219,104 @@ def _agree_uniform_shapes(subs_owned, nparts: int,
     nmax_ghost = int(gathered[:, cap + 5].max())
     nnz_total = int(gathered[:, cap + 6].sum())
     halo_send_total = int(gathered[:, cap + 7].sum())
+    bell_all = gathered[:, cap + 8:].max(axis=0)
     dia_ok = (not (counts > cap).any() and all_offs.size <= max_diags
               and nnz_total
               and (all_offs.size * nmax_owned * nparts
                    <= dia_waste_limit * nnz_total))
+    # the single-device auto histogram rule (ops.spmv.device_matrix_
+    # from_csr): when plain-ELL padding waste blows its limit, take the
+    # binned layout.  Every controller computes this from the same
+    # agreed scalars, so the format decision is mesh-uniform.
+    bell_ok = (not dia_ok and nnz_total
+               and Kl * nmax_owned * nparts > ell_waste_limit * nnz_total)
     return UniformShapes(
         offsets=tuple(int(o) for o in all_offs) if dia_ok else None,
         Kl=Kl, bmax=bmax, Kg=Kg, maxcnt=maxcnt, nmax_ghost=nmax_ghost,
-        nnz_total=nnz_total, halo_send_total=halo_send_total)
+        nnz_total=nnz_total, halo_send_total=halo_send_total,
+        bell_ms=tuple(int(m) for m in bell_all[:nbins]) if bell_ok
+        else None,
+        bell_tail=int(bell_all[nbins]) if bell_ok else 0)
+
+
+def _bell_histogram(blocks) -> np.ndarray:
+    """``(len(BELL_WIDTHS) + 1,)`` int64: per-bin MAX row count over the
+    given local blocks, hub-tail max nnz last.  The one binning rule
+    shared by the uniform-shape agreement and the stacking itself --
+    they must stay bit-identical or the agreed bin sizes overflow on
+    the local-read flow."""
+    from acg_tpu.ops.spmv import BELL_WIDTHS
+
+    nbins = len(BELL_WIDTHS)
+    out = np.zeros(nbins + 1, dtype=np.int64)
+    widths = np.asarray(BELL_WIDTHS)
+    for b in blocks:
+        if b is None:
+            continue
+        row_nnz = np.diff(b.indptr)
+        bidx = np.searchsorted(widths, row_nnz)
+        cnt = np.bincount(np.minimum(bidx, nbins), minlength=nbins + 1)
+        out[:nbins] = np.maximum(out[:nbins], cnt[:nbins])
+        out[nbins] = max(out[nbins], int(row_nnz[bidx >= nbins].sum()))
+    return out
+
+
+def _stack_bell_blocks(blocks, nrows_pad: int, dtype,
+                       bin_ms, tail_max: int) -> StackedLocalBlock:
+    """Stack per-part local blocks in the length-binned ELL layout with
+    MESH-UNIFORM shapes: bin b holds ``bin_ms[b]`` row slots per part
+    (the max over parts; absent rows pad with row id ``nrows_pad`` ->
+    dropped by the scatter), the hub tail ``tail_max`` COO slots.  The
+    distributed restatement of :func:`acg_tpu.ops.spmv.
+    binned_ell_from_csr` (round-4 verdict item 3; ref
+    ``cg-kernels-cuda.cu:340-441``)."""
+    from acg_tpu.ops.spmv import BELL_WIDTHS
+
+    P = len(blocks)
+    npdtype = np.dtype(dtype)
+    widths = np.asarray(BELL_WIDTHS)
+    live = [b for b in range(widths.size) if bin_ms[b]]
+    bin_rows = [np.full((P, bin_ms[b]), nrows_pad, np.int32) for b in live]
+    bin_data = [np.zeros((P, bin_ms[b], widths[b]), npdtype) for b in live]
+    bin_cols = [np.zeros((P, bin_ms[b], widths[b]), np.int32) for b in live]
+    T = int(tail_max)
+    t_rows = np.full((P, T), nrows_pad, np.int32)
+    t_cols = np.zeros((P, T), np.int32)
+    t_vals = np.zeros((P, T), npdtype)
+    for p, blk in enumerate(blocks):
+        if blk is None:
+            continue
+        indptr = np.asarray(blk.indptr)
+        vals = np.asarray(blk.data)
+        colidx = np.asarray(blk.indices)
+        row_nnz = np.diff(indptr)
+        bidx = np.searchsorted(widths, row_nnz)
+        for i, b in enumerate(live):
+            rows_b = np.flatnonzero(bidx == b).astype(np.int32)
+            if rows_b.size == 0:
+                continue
+            nnz_b = row_nnz[rows_b]
+            flat_r = np.repeat(np.arange(rows_b.size), nnz_b)
+            flat_p = (np.arange(nnz_b.sum())
+                      - np.repeat(np.cumsum(nnz_b) - nnz_b, nnz_b))
+            src = (np.repeat(indptr[rows_b], nnz_b) + flat_p).astype(np.int64)
+            bin_rows[i][p, : rows_b.size] = rows_b
+            bin_data[i][p][flat_r, flat_p] = vals[src]
+            bin_cols[i][p][flat_r, flat_p] = colidx[src]
+        hub = np.flatnonzero(bidx >= widths.size)
+        if hub.size:
+            t_r = np.repeat(hub, row_nnz[hub]).astype(np.int32)
+            t_src = np.concatenate(
+                [np.arange(indptr[r], indptr[r + 1]) for r in hub])
+            t_rows[p, : t_r.size] = t_r
+            t_cols[p, : t_r.size] = colidx[t_src]
+            t_vals[p, : t_r.size] = vals[t_src]
+    return StackedLocalBlock(
+        format="binnedell",
+        arrays=(tuple(bin_rows), tuple(bin_data), tuple(bin_cols),
+                t_rows, t_cols, t_vals),
+        offsets=(), nrows=nrows_pad,
+        bin_ks=tuple(int(widths[b]) for b in live))
 
 
 def _stack_local_blocks(subs, nmax_owned: int, dtype,
@@ -195,6 +324,7 @@ def _stack_local_blocks(subs, nmax_owned: int, dtype,
                         # the union of per-part offset sets can exceed any
                         # single part's diagonal count
                         dia_waste_limit: float = 3.0,
+                        ell_waste_limit: float = 3.0,
                         global_csr=None,
                         uniform: UniformShapes | None = None
                         ) -> StackedLocalBlock:
@@ -211,11 +341,16 @@ def _stack_local_blocks(subs, nmax_owned: int, dtype,
     built = [b for b in blocks if b is not None]
     npdtype = np.dtype(dtype)
     if uniform is not None:
-        # local-read flow: shapes pre-agreed across controllers
+        # local-read flow: shapes (and the format decision) pre-agreed
+        # across controllers
         if uniform.offsets is not None:
             offs = np.asarray(uniform.offsets, dtype=np.int64)
             nnz = uniform.nnz_total
         else:
+            if uniform.bell_ms is not None:
+                return _stack_bell_blocks(blocks, nmax_owned, dtype,
+                                          uniform.bell_ms,
+                                          uniform.bell_tail)
             offs = np.zeros(0, np.int64)
             nnz = 0  # force the ELL path
         Kl = uniform.Kl
@@ -254,6 +389,18 @@ def _stack_local_blocks(subs, nmax_owned: int, dtype,
                                               for d in range(offs.size)),
                                  offsets=tuple(int(o) for o in offs),
                                  nrows=nmax_owned)
+    if (uniform is None and global_csr is None and nnz
+            and Kl * nmax_owned * len(blocks) > ell_waste_limit * nnz):
+        # the single-device auto histogram rule: plain-ELL padding waste
+        # past its limit -> length-binned layout.  (Restricted builds --
+        # global_csr set -- keep ELL: per-part LOCAL row widths are not
+        # derivable from global structure on the controllers that cannot
+        # see the blocks, so a mesh-uniform bin sizing does not exist
+        # there; the local-read flow agrees bins via its allgather.)
+        bell = _bell_histogram(built)
+        return _stack_bell_blocks(blocks, nmax_owned, dtype,
+                                  tuple(int(m) for m in bell[:-1]),
+                                  int(bell[-1]))
     Kl = max(Kl, 1)
     ld = np.zeros((len(blocks), nmax_owned, Kl), dtype=npdtype)
     lc = np.zeros((len(blocks), nmax_owned, Kl), dtype=np.int32)

@@ -108,11 +108,20 @@ class ShardedDiaCGSolver(JaxCGSolver):
 
     def __init__(self, A: DiaMatrix, mesh: Mesh | None = None,
                  pipelined: bool = False, precise_dots: bool = False,
-                 vector_dtype=None, stencil: tuple[int, int] | None = None):
+                 vector_dtype=None, stencil: tuple[int, int] | None = None,
+                 replace_every: int = 0, replace_restart: bool = True):
         if A.ncols_padded != A.nrows:
             raise ValueError("sharded DIA solve needs a square matrix")
+        # replace_every (the sound bf16 tier, _cg_replaced_program)
+        # composes with the roll SpMV unchanged: its inner bf16 and
+        # replacement f32 SpMVs shard into the same boundary
+        # collective-permutes as every other program here (round-4
+        # verdict item 1 -- the half-traffic accuracy contract on the
+        # north-star path; ref ``comm.h:180-183``, ``cgcuda.c:1941``)
         super().__init__(A, pipelined=pipelined, precise_dots=precise_dots,
-                         kernels="xla-roll", vector_dtype=vector_dtype)
+                         kernels="xla-roll", vector_dtype=vector_dtype,
+                         replace_every=replace_every,
+                         replace_restart=replace_restart)
         self.mesh = mesh if mesh is not None else solve_mesh()
         self.sharding = NamedSharding(self.mesh, P(PARTS_AXIS))
         # (n, dim) of the generating stencil, when known: enables the
@@ -136,6 +145,12 @@ class ShardedDiaCGSolver(JaxCGSolver):
         from acg_tpu.ops.spmv import dia_mv_roll
 
         dtype = self.vector_dtype or self.A.dtype
+        if self.replace_every:
+            # the replacement tier's OUTER iteration owns b/x in f32
+            # (solve() casts either way); manufacturing b in bf16 here
+            # would bake a u_bf16 backward error into every residual the
+            # replacement recomputes -- and fail the analytic spot check
+            dtype = jnp.float32
         sdt = jnp.promote_types(dtype, jnp.float32)
         offsets = self.A.offsets
         nrows = self.A.nrows
@@ -337,10 +352,14 @@ def spot_check_manufactured(solver, xsol, b, nsample: int = 64,
     need_idx = np.unique(np.concatenate(need))
 
     bh = b[0] if isinstance(b, tuple) else b
-    xv = np.asarray(jax.jit(lambda v, i: v[i])(
-        xsol, jnp.asarray(need_idx)), dtype=np.float64)
-    bv = np.asarray(jax.jit(lambda v, i: v[i])(
-        bh, jnp.asarray(rows)), dtype=np.float64)
+    # REPLICATED gather output: an unconstrained eager gather of a
+    # sharded vector is not guaranteed fully addressable per process
+    # under multi-controller runs -- exactly the scale this check is
+    # meant to validate (round-4 advisor finding)
+    gather = jax.jit(lambda v, i: v[i],
+                     out_shardings=NamedSharding(solver.mesh, P()))
+    xv = np.asarray(gather(xsol, jnp.asarray(need_idx)), dtype=np.float64)
+    bv = np.asarray(gather(bh, jnp.asarray(rows)), dtype=np.float64)
     lut = {int(g): k for k, g in enumerate(need_idx)}
     xs = np.array([xv[lut[int(i)]] for i in rows])
     expect = 2.0 * dim * xs
@@ -356,7 +375,9 @@ def build_sharded_poisson_solver(n: int, dim: int, nparts: int | None = None,
                                  dtype=jnp.float32, vector_dtype=None,
                                  pipelined: bool = False,
                                  precise_dots: bool = False,
-                                 epsilon: float = 0.0):
+                                 epsilon: float = 0.0,
+                                 replace_every: int = 0,
+                                 replace_restart: bool = True):
     """Assemble a sharded Poisson problem and its solver in one call
     (the gen-direct CLI path under ``--nparts``/``--multihost``)."""
     mesh = solve_mesh(nparts)
@@ -373,4 +394,6 @@ def build_sharded_poisson_solver(n: int, dim: int, nparts: int | None = None,
     return ShardedDiaCGSolver(A, mesh=mesh, pipelined=pipelined,
                               precise_dots=precise_dots,
                               vector_dtype=vector_dtype,
-                              stencil=(n, dim) if not epsilon else None)
+                              stencil=(n, dim) if not epsilon else None,
+                              replace_every=replace_every,
+                              replace_restart=replace_restart)

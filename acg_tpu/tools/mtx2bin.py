@@ -40,6 +40,10 @@ def main(argv=None) -> int:
                         "automatically to solution output)")
     p.add_argument("--partition-binary", action="store_true",
                    help="the --partition file is binary")
+    p.add_argument("--one-based", action="store_true",
+                   help="the --partition vector numbers parts from 1 "
+                        "(Fortran/METIS one-based output); shifted to "
+                        "0-based before applying")
     p.add_argument("-v", "--verbose", action="count", default=0)
     args = p.parse_args(argv)
 
@@ -78,8 +82,21 @@ def main(argv=None) -> int:
     if args.partition:
         pmtx = read_mtx(args.partition, binary=args.partition_binary)
         part = np.asarray(pmtx.vals).reshape(-1).astype(np.int64)
-        if part.size and part.min() == 1:
-            part = part - 1  # tolerate 1-based partition vectors
+        if args.one_based:
+            if part.size and part.min() < 1:
+                p.error(f"--one-based given but the partition vector "
+                        f"contains part {part.min()}")
+            part = part - 1
+        elif part.size and part.min() == 1:
+            # ambiguous: could be a 1-based vector OR a 0-based one
+            # whose part 0 happens to be empty.  Guessing silently
+            # renumbered every part (round-4 advisor finding); warn and
+            # leave the numbering alone.
+            sys.stderr.write(
+                "mtx2bin: warning: partition vector has min part 1 -- "
+                "if it is one-based (Fortran/METIS), rerun with "
+                "--one-based; treating it as 0-based with an empty "
+                "part 0\n")
         t0 = time.perf_counter()
         mtx, bounds, perm = apply_partition_rowsorted(mtx, part)
         write_mtx(args.output + ".bounds.mtx",

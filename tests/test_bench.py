@@ -39,9 +39,12 @@ class FakeCriteria:
 
 def test_time_solver_full_trip_count_when_fast():
     s = FakeSolver(1e-4)  # 1000 iters = 0.1s, far under the watchdog
-    tsolve, maxits = bench._time_solver(s, None, FakeCriteria, repeats=3)
+    tsolve, maxits, info = bench._time_solver(s, None, FakeCriteria,
+                                              repeats=3)
     assert maxits == bench.MAXITS
     assert tsolve == pytest.approx(1e-4 * bench.MAXITS)
+    assert info["raw"] == pytest.approx(tsolve)
+    assert info["budget_exhausted"] is False
     # compile warmup, then the TWO-POINT rate estimate (2x short + 2x
     # long -- cancels any constant dispatch overhead), then 3 timed runs
     assert s.calls == ([bench.WARMUP_ITS] * 3
@@ -50,7 +53,7 @@ def test_time_solver_full_trip_count_when_fast():
 
 def test_time_solver_reduces_trip_count_for_slow_configs():
     s = FakeSolver(0.13)  # 1000 iters = 130s >> MAX_PROGRAM_SECONDS
-    tsolve, maxits = bench._time_solver(s, None, FakeCriteria, repeats=2)
+    tsolve, maxits, _ = bench._time_solver(s, None, FakeCriteria, repeats=2)
     assert maxits < bench.MAXITS
     assert maxits >= 100
     # the timed program stays under the budget OR at the 100-iteration
@@ -60,6 +63,62 @@ def test_time_solver_reduces_trip_count_for_slow_configs():
     assert maxits == budget_its
     # iters/s is trip-count-invariant
     assert maxits / tsolve == pytest.approx(1 / 0.13)
+
+
+def test_time_solver_wall_clock_budget_stops_repeats():
+    """A slow config under a wall-clock budget keeps its first timed run
+    and skips the rest (round-4 verdict item 8: fewer repeats on a slow
+    row beats a dead row)."""
+    import time as _time
+
+    class SlowSolver(FakeSolver):
+        def solve(self, b, criteria=None, **kw):
+            super().solve(b, criteria=criteria)
+            _time.sleep(0.05)  # real wall clock, what the budget sees
+
+    s = SlowSolver(1e-4)
+    tsolve, maxits, info = bench._time_solver(
+        s, None, FakeCriteria, repeats=50, time_budget_s=0.01)
+    assert info["budget_exhausted"] is True
+    # warmup x3 + two-point x2 always run; then exactly ONE timed run
+    assert len(s.calls) == 6
+    assert maxits / tsolve == pytest.approx(1e4)
+
+
+def test_roofline_clamp_discards_impossible_correction(monkeypatch):
+    """A corrected value implying traffic far above the paired probe on
+    a working set too large for VMEM residency reverts to the raw time
+    (round-4 verdict item 2)."""
+    monkeypatch.setattr(bench, "bandwidth_probe_gbs", lambda refresh: 800.0)
+    # corrected 10,000 iters/s at 0.4 GB/iter -> 4 TB/s implied (5x probe)
+    bpi = 0.4e9
+    row = {"metric": "m", "value": 10_000.0, "vs_baseline": 2.0}
+    info = {"raw": 1000 / 4000.0, "corrected": True,
+            "budget_exhausted": False}  # raw = 4,000 iters/s
+    out = bench._roofline_context(
+        dict(row), bpi, info=info,
+        working_set_bytes=6e9, maxits=1000)
+    assert out["correction_discarded"] is True
+    assert out["value"] == pytest.approx(4000.0)
+    assert out["vs_baseline"] == pytest.approx(0.8)
+    assert out["roofline_frac"] == pytest.approx(
+        4000.0 * bpi / 800e9, rel=1e-3)
+
+    # same correction on a VMEM-scale working set is EXEMPT (the HBM
+    # traffic model does not bind there)
+    out2 = bench._roofline_context(
+        dict(row), bpi, info=info,
+        working_set_bytes=100e6, maxits=1000)
+    assert "correction_discarded" not in out2
+    assert out2["value"] == pytest.approx(10_000.0)
+
+    # an uncorrected row is never clamped, only annotated by its frac
+    info_raw = {"raw": 1000 / 10_000.0, "corrected": False,
+                "budget_exhausted": False}
+    out3 = bench._roofline_context(
+        dict(row), bpi, info=info_raw,
+        working_set_bytes=6e9, maxits=1000)
+    assert "correction_discarded" not in out3
 
 
 def test_time_solver_passes_solve_kwargs():
@@ -73,3 +132,17 @@ def test_time_solver_passes_solve_kwargs():
     s = KwSolver(1e-5)
     bench._time_solver(s, None, FakeCriteria, repeats=1, host_result=False)
     assert seen == {"host_result": False}
+
+
+def test_roofline_clamp_keeps_raw_only_when_slower(monkeypatch):
+    """The clamp only ever moves a row DOWN to the raw time -- a raw
+    value even faster than the corrected one (can't happen from the
+    estimator, but belt-and-braces) is not adopted."""
+    monkeypatch.setattr(bench, "bandwidth_probe_gbs", lambda refresh: 800.0)
+    row = {"metric": "m", "value": 10_000.0, "vs_baseline": 2.0}
+    info = {"raw": 1000 / 20_000.0, "corrected": True,
+            "budget_exhausted": False}
+    out = bench._roofline_context(dict(row), 0.4e9, info=info,
+                                  working_set_bytes=6e9, maxits=1000)
+    assert "correction_discarded" not in out
+    assert out["value"] == pytest.approx(10_000.0)

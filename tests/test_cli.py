@@ -47,6 +47,52 @@ def test_mtx2bin_roundtrip(matrix_file, tmp_path):
     np.testing.assert_allclose(binm.vals, orig.vals)
 
 
+def test_mtx2bin_one_based_partition(matrix_file, tmp_path):
+    """--one-based shifts a Fortran/METIS-style partition vector; a
+    0-based vector whose part 0 is empty is no longer silently
+    renumbered (round-4 advisor finding), only warned about."""
+    from acg_tpu.io.mtxfile import vector_mtx
+
+    n = 144
+    rng = np.random.default_rng(0)
+    part1 = rng.integers(1, 4, size=n)  # 1-based: parts 1..3
+    pf = tmp_path / "part.mtx"
+    write_mtx(pf, vector_mtx(part1.astype(np.int64), field="integer"),
+              numfmt="%d")
+
+    out = tmp_path / "ob.bin.mtx"
+    r = run_cli("acg_tpu.tools.mtx2bin",
+                [str(matrix_file), str(out), "--expand",
+                 "--partition", str(pf), "--one-based"])
+    assert r.returncode == 0, r.stderr
+    bounds = np.asarray(read_mtx(str(out) + ".bounds.mtx").vals).reshape(-1)
+    counts = np.bincount(part1 - 1, minlength=3)
+    np.testing.assert_array_equal(bounds,
+                                  np.concatenate([[0], np.cumsum(counts)]))
+
+    # ambiguous (min part == 1) without the flag: warn, do NOT shift
+    out2 = tmp_path / "amb.bin.mtx"
+    r2 = run_cli("acg_tpu.tools.mtx2bin",
+                 [str(matrix_file), str(out2), "--expand",
+                  "--partition", str(pf)])
+    assert r2.returncode == 0, r2.stderr
+    assert "one-based" in r2.stderr  # the warning names the flag
+    b2 = np.asarray(read_mtx(str(out2) + ".bounds.mtx").vals).reshape(-1)
+    # part 0 empty -> 4 parts with a zero-width first window
+    np.testing.assert_array_equal(
+        b2, np.concatenate([[0, 0], np.cumsum(counts)]))
+
+    # --one-based on a vector containing part 0 is an error
+    part0 = part1 - 1
+    pf0 = tmp_path / "part0.mtx"
+    write_mtx(pf0, vector_mtx(part0.astype(np.int64), field="integer"),
+              numfmt="%d")
+    r3 = run_cli("acg_tpu.tools.mtx2bin",
+                 [str(matrix_file), str(tmp_path / "z.bin.mtx"),
+                  "--expand", "--partition", str(pf0), "--one-based"])
+    assert r3.returncode != 0
+
+
 def test_mtxpartition_tool(matrix_file, tmp_path):
     r = run_cli("acg_tpu.tools.mtxpartition",
                 [str(matrix_file), "--parts", "4", "-v"])

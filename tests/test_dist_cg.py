@@ -218,6 +218,68 @@ def test_dist_cg_pallas_falls_back_on_ell(problem2d):
     assert np.linalg.norm(x - xsol) < 1e-8
 
 
+def test_dist_binned_ell_local_blocks():
+    """Power-law (SuiteSparse-class) workloads trigger the length-binned
+    local-block layout on the mesh (round-4 verdict item 3): plain-ELL
+    hub-row padding would blow the waste limit.  Solve must match the
+    serial oracle, and the format must report binnedell."""
+    from acg_tpu.io.generators import irregular_spd_coo
+    from acg_tpu.matrix import SymCsrMatrix
+
+    r, c, v, N = irregular_spd_coo(3000, avg_degree=8.0, seed=0)
+    csr = SymCsrMatrix.from_coo(N, r, c, v).to_csr()
+    Kmax = int(np.diff(csr.indptr).max())
+    assert Kmax * N > 3.0 * csr.nnz  # the workload really is skewed
+    xsol, b = manufactured(csr, seed=1)
+    iters = []
+    for nparts in (1, 4, 8):
+        part = partition_rows(csr, nparts, seed=0, method="graph")
+        prob = DistributedProblem.build(csr, part, nparts,
+                                        dtype=jnp.float64)
+        assert prob.local.format == "binnedell"
+        # mesh-uniform: every bin array's leading axis is nparts and
+        # every part's padding rows are out-of-bounds sentinels
+        bin_rows = prob.local.arrays[0]
+        assert all(a.shape[0] == nparts for a in bin_rows)
+        solver = DistCGSolver(prob)
+        x = solver.solve(b, criteria=StoppingCriteria(
+            maxits=4000, residual_rtol=1e-10))
+        assert np.linalg.norm(x - xsol) < 1e-6
+        iters.append(solver.stats.niterations)
+    # partition-invariant iteration counts (up to rounding)
+    assert max(iters) - min(iters) <= max(2, int(0.02 * max(iters)))
+
+
+def test_dist_binned_ell_matches_ell_spmv():
+    """The binned stacked SpMV is numerically the same operator as the
+    plain-ELL stacked SpMV on the same problem (format is a layout
+    choice, not an arithmetic one)."""
+    from acg_tpu.io.generators import irregular_spd_coo
+    from acg_tpu.matrix import SymCsrMatrix
+    from acg_tpu.parallel.dist import _stack_local_blocks
+
+    r, c, v, N = irregular_spd_coo(1000, avg_degree=6.0, seed=3)
+    csr = SymCsrMatrix.from_coo(N, r, c, v).to_csr()
+    part = partition_rows(csr, 4, seed=0, method="graph")
+    prob = DistributedProblem.build(csr, part, 4, dtype=jnp.float64)
+    assert prob.local.format == "binnedell"
+    # force the plain-ELL stacking of the same subdomains
+    ell = _stack_local_blocks(prob.subs, prob.nmax_owned, jnp.float64,
+                              ell_waste_limit=1e9)
+    assert ell.format == "ell"
+    rng = np.random.default_rng(0)
+    for p in range(4):
+        x = rng.standard_normal(prob.nmax_owned)
+        y_bell = prob.local.shard_mv(
+            jax.tree.map(lambda a: jnp.asarray(a[p]), prob.local.arrays),
+            jnp.asarray(x))
+        y_ell = ell.shard_mv(
+            jax.tree.map(lambda a: jnp.asarray(a[p]), ell.arrays),
+            jnp.asarray(x))
+        np.testing.assert_allclose(np.asarray(y_bell), np.asarray(y_ell),
+                                   rtol=0, atol=1e-12)
+
+
 def test_refined_distributed_solver(problem2d):
     """Mixed-precision refinement over the DISTRIBUTED solver (the CLI's
     --refine --nparts N path): f32 device CG + f64 host residual reaches

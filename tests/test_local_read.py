@@ -317,7 +317,9 @@ def test_partitioned_local_read_solves_to_original(part_binfile, irregular):
                       ).reshape(-1).astype(np.int64) - 1
     prob = DistributedProblem.build_local_read(out, 3, dtype=jnp.float64,
                                                bounds=bounds)
-    assert prob.local.format == "ell"  # irregular: no DIA structure
+    # irregular: no DIA structure; skewed row lengths select the
+    # length-binned layout via the agreed uniform shapes (round 5)
+    assert prob.local.format == "binnedell"
     solver = DistCGSolver(prob)
     n = irregular.shape[0]
     b_orig = np.ones(n)
@@ -357,6 +359,74 @@ def test_cli_two_process_partitioned_distributed_read(part_binfile):
     (so0, se0), (so1, se1) = outs
     err = float(se0.split("\nerror 2-norm: ")[1].split()[0])
     assert err < 1e-6, se0
+
+
+def test_read_vector_rows_gather(tmp_path):
+    """Scattered-row gather of a binary vector file: any order,
+    duplicates, coalesced runs -- the permuted-b/x0 primitive."""
+    from acg_tpu.io.mtxfile import read_vector_rows, vector_mtx
+
+    n = 500
+    x = np.linspace(0, 1, n)
+    p = tmp_path / "v.bin.mtx"
+    write_mtx(p, vector_mtx(x), binary=True)
+    rng = np.random.default_rng(0)
+    rows = rng.integers(0, n, size=137)
+    rows[10] = rows[20]  # duplicate
+    got = read_vector_rows(p, rows, expect_nrows=n)
+    np.testing.assert_array_equal(got, x[rows])
+    assert read_vector_rows(p, np.zeros(0, np.int64)).size == 0
+    from acg_tpu.errors import AcgError
+    with pytest.raises(AcgError):
+        read_vector_rows(p, np.asarray([n]), expect_nrows=n)
+
+
+def test_cli_two_process_permuted_b_x0_files(part_binfile, irregular,
+                                             tmp_path_factory):
+    """b/x0 FILES with a METIS-permuted matrix under --distributed-read
+    (round-4 verdict item 6): each controller window-reads the perm
+    sidecar for its owned rows and gathers the original-ordering b/x0
+    entries; the emitted solution (original ordering) must satisfy the
+    ORIGINAL system."""
+    from acg_tpu.io.mtxfile import vector_mtx
+
+    out, part = part_binfile
+    d = tmp_path_factory.mktemp("pbx")
+    n = irregular.shape[0]
+    rng = np.random.default_rng(5)
+    b_orig = rng.standard_normal(n)
+    x0_orig = 0.1 * rng.standard_normal(n)
+    bf, xf = d / "b.bin.mtx", d / "x0.bin.mtx"
+    write_mtx(bf, vector_mtx(b_orig), binary=True)
+    write_mtx(xf, vector_mtx(x0_orig), binary=True)
+
+    port = _free_port()
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["XLA_FLAGS"] = "--xla_force_host_platform_device_count=2"
+
+    def launch(pid):
+        argv = [sys.executable, "-m", "acg_tpu.cli", str(out),
+                str(bf), str(xf),
+                "--binary", "--distributed-read",
+                "--max-iterations", "3000", "--residual-rtol", "1e-10",
+                "--dtype", "f64", "--warmup", "0",
+                "--coordinator", f"localhost:{port}",
+                "--num-processes", "2", "--process-id", str(pid)]
+        return subprocess.Popen(argv, stdout=subprocess.PIPE,
+                                stderr=subprocess.PIPE, text=True, env=env)
+
+    procs = [launch(i) for i in range(2)]
+    outs = [p.communicate(timeout=600) for p in procs]
+    for p, (_, se) in zip(procs, outs):
+        assert p.returncode == 0, se
+    from io import BytesIO
+    so = outs[0][0]
+    mtx_text = so[so.index("%%MatrixMarket"):]  # Gloo may log to stdout
+    x = np.asarray(read_mtx(BytesIO(mtx_text.encode())).vals).reshape(-1)
+    rel = (np.linalg.norm(b_orig - irregular @ x)
+           / np.linalg.norm(b_orig))
+    assert rel < 1e-8
 
 
 def test_cli_singledevice_permuted_output_original_order(part_binfile,
