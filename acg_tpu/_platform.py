@@ -37,6 +37,21 @@ def host_device_count_flags(flags: str, n_devices: int) -> str:
     return flags
 
 
+def honour_jax_platforms() -> None:
+    """Re-apply the ``JAX_PLATFORMS`` env var through ``jax.config``.
+
+    Platform plugins (the axon TPU tunnel) override the env var at
+    import time, so a subprocess launched with ``JAX_PLATFORMS=cpu``
+    still initialises the tunneled backend -- and HANGS for minutes
+    when the tunnel is down.  Call before the first backend query
+    (no-op when the var is unset)."""
+    plat = os.environ.get("JAX_PLATFORMS")
+    if plat:
+        import jax
+
+        jax.config.update("jax_platforms", plat)
+
+
 def provision_host_mesh(n_devices: int):
     """Force the CPU platform with >= ``n_devices`` virtual devices.
 

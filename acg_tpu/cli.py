@@ -245,7 +245,10 @@ def _buildinfo(out) -> int:
     import jaxlib
 
     from acg_tpu import _native, __version__
+    from acg_tpu._platform import honour_jax_platforms
     from acg_tpu.partition import metis_available
+
+    honour_jax_platforms()
 
     plat = "unavailable"
     try:
@@ -1091,7 +1094,10 @@ def main(argv=None) -> int:
     if argv is None:
         argv = sys.argv[1:]
     if "--buildinfo" in argv:
-        return _buildinfo(sys.stdout)
+        try:
+            return _buildinfo(sys.stdout)
+        except BrokenPipeError:
+            return 0  # stdout consumer (head, grep -m) closed early
     args = make_parser().parse_args(argv)
     args.numfmt = _validate_numfmt(args.numfmt)
     try:
@@ -1107,10 +1113,9 @@ def _main(args) -> int:
     import os
 
     import jax
-    # honour JAX_PLATFORMS even when a platform plugin overrides it
-    plat = os.environ.get("JAX_PLATFORMS")
-    if plat:
-        jax.config.update("jax_platforms", plat)
+
+    from acg_tpu._platform import honour_jax_platforms
+    honour_jax_platforms()
     if args.dtype == "f64":
         jax.config.update("jax_enable_x64", True)
     # persistent compile cache (semantics-neutral; see _platform;

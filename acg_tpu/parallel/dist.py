@@ -796,8 +796,18 @@ class DistCGSolver:
         dist_spmv = make_dist_spmv(prob, comm, interpret,
                                    kernels=self.kernels)
 
+        # commsize==1 parity (the reference's explicit special case,
+        # ``cgcuda.c:403``): on a 1-shard mesh every psum is an identity
+        # -- but XLA does NOT elide a 1-device all-reduce, and on this
+        # runtime each one costs a fixed per-op launch overhead INSIDE
+        # the iteration loop (measured round 5: 2 all-reduces/iteration
+        # made the nparts=1 program 27x slower than the single-chip
+        # solver, the LADDER_r04 `cg_dist1` collapse).  The whole
+        # shard_map wrapper is bypassed below for the same reason.
+        single_shard = self.mesh.devices.size == 1
+
         def psum(v):
-            return lax.psum(v, axis)
+            return v if single_shard else lax.psum(v, axis)
 
         def shard_body(la, ga, sidx, gsrc, gval, scnt, rcnt, b, x0,
                        tols, maxits, unbounded, needs_diff):
@@ -1009,6 +1019,23 @@ class DistCGSolver:
 
             dxnrm2 = jnp.sqrt(dxsqr)
             return x[None], k, rnrm2, r0nrm2, bnrm2, x0nrm2, dxnrm2, done
+
+        if single_shard and not prob.halo.has_ghosts:
+            # one shard, no halo: shard_body runs as a PLAIN jit program
+            # (the stacked (1, ...) leading axes are stripped inside it
+            # either way).  Skipping shard_map avoids its manual-
+            # sharding boundary entirely, so XLA optimises the loop
+            # exactly like the single-chip solver's.
+            @functools.partial(jax.jit,
+                               static_argnames=("unbounded", "needs_diff"))
+            def program(la, ga, sidx, gsrc, gval, scnt, rcnt, b, x0,
+                        tols, maxits, unbounded, needs_diff):
+                return shard_body(la, ga, sidx, gsrc, gval, scnt, rcnt,
+                                  b, x0, tols, maxits,
+                                  unbounded=unbounded,
+                                  needs_diff=needs_diff)
+
+            return program
 
         pspec = P(PARTS_AXIS)
         rspec = P()

@@ -29,11 +29,13 @@ ROWS=(
   cg_iters_per_sec_poisson3d_n128_hostnative_f64
   cg_iters_per_sec_poisson3d_n512_f32_dia
   cg_iters_per_sec_poisson3d_n512_mixed_dia
+  cg_iters_per_sec_poisson3d_n512_bf16rr_dia
+  cg_iters_per_sec_poisson3d_n256_bf16rr_dia
 )
 
 for row in "${ROWS[@]}"; do
   echo "# ladder row: $row" >&2
-  timeout 1500 python bench.py --full --row "$row" >> "$OUT"
+  timeout 900 python bench.py --full --row "$row" >> "$OUT"
   rc=$?
   if [ $rc -ne 0 ]; then
     echo "{\"metric\": \"$row\", \"skipped\": true, \"rc\": $rc}" >> "$OUT"

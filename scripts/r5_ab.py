@@ -1,0 +1,289 @@
+"""Round-5 targeted same-window A/Bs (VERDICT r4 items 3, 4, 5).
+
+Each comparison interleaves its two cases A,B,A,B,... so both sides
+share one contention window (the round-2 methodology that established
+nparts=1 parity), and reports the median ratio plus before/after probe
+readings.  Selectable via --only so a flaky tunnel cannot take out the
+whole set:
+
+  * ``dist1``   -- the nparts=1 distributed program vs the single-chip
+    solver on the flagship 2D config: LADDER_r04 recorded 0.07x where
+    round 2 measured 0.96x; adjudicate regression vs contention
+    artifact (VERDICT item 4).
+  * ``mixed3d`` -- mixed vs f32 on the 3D clustered-kernel path (256^3
+    by default, 512^3 with --big): the mixed tier lost at 3D two
+    rounds running despite a ~1.3x traffic model (VERDICT item 5).
+  * ``bell``    -- distributed binned-ELL local blocks vs plain-ELL
+    blocks on the 500k power-law workload, nparts=1 mesh (VERDICT
+    item 3's measurement half).
+
+Appends JSON rows to QUIET_AB.jsonl like quiet_ab.py.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+ROOT = __file__.rsplit("/", 2)[0]
+sys.path.insert(0, ROOT)
+RECORD = os.path.join(ROOT, "QUIET_AB.jsonl")
+
+
+def _timer(solver, b, its, host_result=None):
+    """One timed unbounded solve at ``its`` iterations; two-point
+    corrected when the completion signal is broken (bench rationale)."""
+    from acg_tpu._platform import block_until_ready_works
+    from acg_tpu.solvers.stats import StoppingCriteria
+
+    kw = {} if host_result is None else {"host_result": host_result}
+
+    def timed(n):
+        solver.stats.tsolve = 0.0
+        solver.solve(b, criteria=StoppingCriteria(maxits=n), **kw)
+        return solver.stats.tsolve
+
+    timed(50)  # compile + warm
+    best = timed(its)
+    if not block_until_ready_works():
+        t_short = timed(max(its // 4, 1))
+        dt = best - t_short
+        n_dt = its - max(its // 4, 1)
+        if dt > 0 and best / (dt / n_dt * its) < 20:
+            best = dt / n_dt * its
+    return its / best
+
+
+def _ab_row(name, mk_a, mk_b, label_a, label_b, b, its, pairs,
+            host_result=None, extra=None):
+    import numpy as np
+
+    from bench import bandwidth_probe_gbs
+
+    try:
+        bw0 = bandwidth_probe_gbs(refresh=True)
+    except Exception:
+        bw0 = 0.0
+    va, vb = [], []
+    for _ in range(pairs):
+        va.append(_timer(mk_a(), b, its, host_result))
+        vb.append(_timer(mk_b(), b, its, host_result))
+    try:
+        bw1 = bandwidth_probe_gbs(refresh=True)
+    except Exception:
+        bw1 = 0.0
+    ra, rb = float(np.median(va)), float(np.median(vb))
+    row = {"ab": name, label_a: round(ra, 1), label_b: round(rb, 1),
+           "ratio": round(ra / rb, 3), "bw_gbs": round(bw0, 1),
+           "bw_gbs_after": round(bw1, 1), "pairs": pairs,
+           "ts": round(time.time(), 1)}
+    if extra:
+        row.update(extra)
+    from acg_tpu._platform import block_until_ready_works
+    if not block_until_ready_works():
+        row["block_sync_broken"] = True
+    print(json.dumps(row))
+    sys.stdout.flush()
+    with open(RECORD, "a") as f:
+        f.write(json.dumps(row) + "\n")
+    return row
+
+
+def ab_dist1(pairs):
+    import numpy as np
+    import jax.numpy as jnp
+
+    from acg_tpu.io.generators import poisson2d_coo
+    from acg_tpu.matrix import SymCsrMatrix
+    from acg_tpu.ops.spmv import device_matrix_from_csr
+    from acg_tpu.parallel.dist import DistCGSolver, DistributedProblem
+    from acg_tpu.partition import partition_rows
+    from acg_tpu.solvers.jax_cg import JaxCGSolver
+
+    r, c, v, N = poisson2d_coo(2048)
+    csr = SymCsrMatrix.from_coo(N, r, c, v).to_csr()
+    b = np.ones(N, dtype=np.float32)
+    part = partition_rows(csr, 1, seed=0)
+    prob = DistributedProblem.build(csr, part, 1, dtype=jnp.float32)
+    A = device_matrix_from_csr(csr, dtype=jnp.float32)
+    _ab_row("dist1_vs_single_2d2048_f32",
+            lambda: DistCGSolver(prob, kernels="xla"),
+            lambda: JaxCGSolver(A, kernels="xla"),
+            "dist1", "single", b, 1000, pairs,
+            extra={"local_format": prob.local.format})
+
+
+def ab_mixed3d(pairs, side):
+    import jax
+    import jax.numpy as jnp
+
+    from acg_tpu.io.generators import poisson_dia_device
+    from acg_tpu.ops.spmv import DiaMatrix
+    from acg_tpu.solvers.jax_cg import JaxCGSolver
+
+    mats = {}
+    for name, dt, vdt in (("f32", jnp.float32, jnp.float32),
+                          ("mixed", jnp.bfloat16, jnp.float32)):
+        planes, offsets, N = poisson_dia_device(side, 3, dtype=dt)
+        mats[name] = DiaMatrix(data=tuple(planes), offsets=offsets,
+                               nrows=N, ncols_padded=N)
+    b = jnp.ones(mats["f32"].nrows, dtype=jnp.float32)
+    its = 400 if side >= 512 else 1000
+    row = _ab_row(f"mixed_vs_f32_3d{side}_dia",
+                  lambda: JaxCGSolver(mats["mixed"], kernels="auto",
+                                      vector_dtype=jnp.float32),
+                  lambda: JaxCGSolver(mats["f32"], kernels="auto"),
+                  "mixed", "f32", b, its, pairs, host_result=False,
+                  extra={"side": side})
+    return row
+
+
+def ab_roll3d(pairs, side):
+    """Clustered-Pallas vs xla-roll at the north-star 3D size: the
+    sharded route pins its SpMV to the roll formulation (cli.py), so
+    this gap IS the cost of that pin on one chip (VERDICT item 7 --
+    'measure and document', with the shard_map wrapper as the follow-up
+    if the gap is real)."""
+    import jax.numpy as jnp
+
+    from acg_tpu.io.generators import poisson_dia_device
+    from acg_tpu.ops.spmv import DiaMatrix
+    from acg_tpu.solvers.jax_cg import JaxCGSolver
+
+    planes, offsets, N = poisson_dia_device(side, 3, dtype=jnp.float32)
+    A = DiaMatrix(data=tuple(planes), offsets=offsets,
+                  nrows=N, ncols_padded=N)
+    b = jnp.ones(N, dtype=jnp.float32)
+    its = 400 if side >= 512 else 1000
+    _ab_row(f"pallas_vs_roll_3d{side}_f32_dia",
+            lambda: JaxCGSolver(A, kernels="pallas"),
+            lambda: JaxCGSolver(A, kernels="xla-roll"),
+            "pallas", "roll", b, its, pairs, host_result=False,
+            extra={"side": side})
+
+
+def ab_bell(pairs):
+    """Chained-SpMV throughput of the two stacked local-block layouts on
+    the 500k power-law workload (the SpMV is where the layouts differ;
+    whole-CG dist solves would fold in the unrelated dist-program
+    overhead under diagnosis as `dist1`).  Normalising each application
+    keeps the chain data-dependent without overflow."""
+    import functools
+
+    import numpy as np
+    import jax
+    import jax.numpy as jnp
+
+    from acg_tpu._platform import block_until_ready_works, device_sync
+    from acg_tpu.io.generators import irregular_spd_coo
+    from acg_tpu.matrix import SymCsrMatrix
+    from acg_tpu.parallel.dist import DistributedProblem, _stack_local_blocks
+    from acg_tpu.partition import partition_rows
+    from bench import bandwidth_probe_gbs
+
+    r, c, v, N = irregular_spd_coo(500_000, avg_degree=16.0, seed=0)
+    csr = SymCsrMatrix.from_coo(N, r, c, v).to_csr()
+    part = partition_rows(csr, 1, seed=0, method="graph")
+    prob = DistributedProblem.build(csr, part, 1, dtype=jnp.float32)
+    assert prob.local.format == "binnedell", prob.local.format
+    ell = _stack_local_blocks(prob.subs, prob.nmax_owned, jnp.float32,
+                              ell_waste_limit=1e30)
+    assert ell.format == "ell"
+
+    def chained(block):
+        arrays0 = jax.tree.map(lambda a: jnp.asarray(a[0]), block.arrays)
+
+        @functools.partial(jax.jit, static_argnames="k")
+        def prog(arrays, x, k):
+            def body(_, v):
+                y = block.shard_mv(arrays, v)
+                return y / jnp.linalg.norm(y)
+
+            return jax.lax.fori_loop(0, k, body, x)
+
+        x0 = jnp.ones(prob.nmax_owned, jnp.float32)
+
+        def rate(k=200):
+            device_sync(prog(arrays0, x0, 50))  # compile both sizes + warm
+            device_sync(prog(arrays0, x0, k))
+            t0 = time.time()
+            device_sync(prog(arrays0, x0, k))
+            t_long = time.time() - t0
+            t0 = time.time()
+            device_sync(prog(arrays0, x0, 50))
+            t_short = time.time() - t0
+            if not block_until_ready_works() and t_long > t_short:
+                return (k - 50) / (t_long - t_short)
+            return k / t_long
+
+        return rate
+
+    rate_bell, rate_ell = chained(prob.local), chained(ell)
+    try:
+        bw0 = bandwidth_probe_gbs(refresh=True)
+    except Exception:
+        bw0 = 0.0
+    va, vb = [], []
+    for _ in range(pairs):
+        va.append(rate_bell())
+        vb.append(rate_ell())
+    try:
+        bw1 = bandwidth_probe_gbs(refresh=True)
+    except Exception:
+        bw1 = 0.0
+    ra, rb = float(np.median(va)), float(np.median(vb))
+    row = {"ab": "dist_bell_vs_ell_spmv_irregular500k",
+           "binnedell": round(ra, 1), "ell": round(rb, 1),
+           "ratio": round(ra / rb, 3), "unit": "spmv/s",
+           "bw_gbs": round(bw0, 1), "bw_gbs_after": round(bw1, 1),
+           "pairs": pairs, "ts": round(time.time(), 1),
+           "ell_K": int(np.diff(csr.indptr).max())}
+    from acg_tpu._platform import block_until_ready_works as _bw
+    if not _bw():
+        row["block_sync_broken"] = True
+    print(json.dumps(row))
+    sys.stdout.flush()
+    with open(RECORD, "a") as f:
+        f.write(json.dumps(row) + "\n")
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--only", default=None,
+                    help="comma list of: dist1,mixed3d,bell,roll3d")
+    ap.add_argument("--pairs", type=int, default=4)
+    ap.add_argument("--big", action="store_true",
+                    help="mixed3d at 512^3 instead of 256^3")
+    args = ap.parse_args(argv)
+    only = set(args.only.split(",")) if args.only else None
+
+    from acg_tpu._platform import enable_compile_cache
+    enable_compile_cache()
+    from bench import bandwidth_probe_gbs
+    try:
+        print(f"# probe: {bandwidth_probe_gbs(refresh=True):.0f} GB/s",
+              file=sys.stderr)
+    except Exception as e:
+        print(f"# probe failed: {e}", file=sys.stderr)
+
+    for key, fn in (("dist1", lambda: ab_dist1(args.pairs)),
+                    ("bell", lambda: ab_bell(args.pairs)),
+                    ("mixed3d", lambda: ab_mixed3d(
+                        args.pairs, 512 if args.big else 256)),
+                    ("roll3d", lambda: ab_roll3d(
+                        args.pairs, 512 if args.big else 256))):
+        if only is not None and key not in only:
+            continue
+        try:
+            fn()
+        except Exception as e:  # noqa: BLE001 -- keep the rest of the set
+            print(f"# {key} failed: {type(e).__name__}: "
+                  f"{str(e).splitlines()[0][:200]}", file=sys.stderr)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

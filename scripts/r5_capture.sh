@@ -1,0 +1,51 @@
+#!/bin/bash
+# Round-5 capture queue: wait for the tunneled backend to come back,
+# then run the round's measurement set in priority order, one step at a
+# time (the capture discipline: no concurrent host/TPU load), each step
+# timeboxed and logged, continuing past failures.
+#
+# Usage: scripts/r5_capture.sh [LOGDIR]
+set -u
+cd "$(dirname "$0")/.."
+LOG=${1:-/tmp/r5_capture}
+mkdir -p "$LOG"
+
+probe() {
+  timeout 120 python -c "
+import jax, jax.numpy as jnp
+jax.devices(); print(float(jnp.sum(jnp.ones(8))))" >/dev/null 2>&1
+}
+
+wait_backend() {
+  while ! probe; do
+    echo "# $(date -u +%H:%M:%S) backend unavailable; napping 300s" >&2
+    sleep 300
+  done
+  echo "# $(date -u +%H:%M:%S) backend up" >&2
+}
+
+step() {  # step NAME TIMEOUT CMD...
+  local name=$1 tmo=$2; shift 2
+  wait_backend
+  echo "# $(date -u +%H:%M:%S) step $name" >&2
+  timeout "$tmo" "$@" > "$LOG/$name.log" 2>&1
+  echo "# $(date -u +%H:%M:%S) step $name rc=$?" >&2
+}
+
+# 1. on-chip proof of the dist1 parity fix + re-measured ratio
+step diag_dist1 1800 python -u scripts/diag_dist1.py
+step ab_dist1   2400 python -u scripts/r5_ab.py --only dist1 --pairs 3
+# 2. the open tier verdicts
+step ab_bell    2400 python -u scripts/r5_ab.py --only bell --pairs 3
+step ab_mixed3d 2400 python -u scripts/r5_ab.py --only mixed3d --pairs 3
+step ab_roll3d  2400 python -u scripts/r5_ab.py --only roll3d --pairs 3
+step ab_big     3600 python -u scripts/r5_ab.py --only mixed3d,roll3d \
+  --pairs 2 --big
+# 3. flagship capture (probe-gated internally) + full ladder
+step flagship   2400 python -u bench.py
+step ladder     99999 bash scripts/ladder.sh LADDER_r05.jsonl
+# 4. the quiet-window fused adjudication sweep (exit 3 = contended; the
+#    hunt loop keeps trying for an honest window afterwards)
+step quiet_ab   3600 python -u scripts/quiet_ab.py --min-bw 600 --pairs 3 \
+  --wait-budget 600
+echo "# r5 capture queue complete" >&2

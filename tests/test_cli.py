@@ -18,6 +18,9 @@ def run_cli(module, argv, **kw):
     import os
     env = dict(os.environ)
     env.update(ENV_KEYS)
+    # a hard timeout so a wedged accelerator tunnel fails ONE test
+    # instead of hanging the whole suite (observed round 5)
+    kw.setdefault("timeout", 600)
     return subprocess.run([sys.executable, "-m", module, *argv],
                           capture_output=True, text=True, env=env, **kw)
 

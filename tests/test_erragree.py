@@ -84,9 +84,13 @@ def test_dead_peer_trips_watchdog(matrix_file):
     in --err-timeout seconds.  This test pins the second tier."""
     port = _free_port()
     p0 = _cli(matrix_file, port, 0, timeout_s="8")
-    code = ("from acg_tpu.parallel.multihost import initialize; "
+    # jax.config, not just the env var: the axon TPU plugin overrides
+    # JAX_PLATFORMS in raw subprocesses, and with the tunnel down the
+    # backend init HANGS instead of failing (observed round 5)
+    code = ("import jax; jax.config.update('jax_platforms', 'cpu'); "
+            "from acg_tpu.parallel.multihost import initialize; "
             f"initialize('localhost:{port}', 2, 1); "
-            "import jax; jax.devices(); "   # complete the device exchange
+            "jax.devices(); "   # complete the device exchange
             "import os; os._exit(42)")
     p1 = subprocess.Popen([sys.executable, "-c", code], env=_env())
     t0 = time.monotonic()
