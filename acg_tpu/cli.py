@@ -967,12 +967,14 @@ def _solve_generated_sharded(args, dim, n, N, jax, jnp, dtype,
             "acg-tpu: sharded --refine runs df64 outer residuals over "
             "f32 inner solves; use --dtype f32/mixed, or --dtype bf16 "
             "with --replace-every (sound-bf16 inner solves)")
-    if args.kernels in ("pallas", "fused"):
+    if args.kernels == "fused":
         raise SystemExit(
-            "acg-tpu: the sharded direct-assembly path pins the SpMV to "
-            "the partitioner-friendly roll formulation; --kernels "
-            f"{args.kernels} is not available here (use --nparts 1 "
-            "without --manufactured-solution for the kernel tiers)")
+            "acg-tpu: the sharded direct-assembly path supports "
+            "--kernels auto/xla (roll formulation) or pallas (per-shard "
+            "clustered kernel + ppermute halo); 'fused' is single-device "
+            "only")
+    sharded_kernels = ("pallas-roll" if args.kernels == "pallas"
+                       else "xla-roll")
     if args.replace_every and (args.diff_atol > 0 or args.diff_rtol > 0):
         raise SystemExit(
             "acg-tpu: --replace-every supports residual criteria only "
@@ -986,7 +988,7 @@ def _solve_generated_sharded(args, dim, n, N, jax, jnp, dtype,
             n, dim, nparts=nparts, dtype=dtype, vector_dtype=vec_dtype,
             pipelined="pipelined" in args.solver,
             precise_dots=args.precise_dots, epsilon=args.epsilon,
-            replace_every=args.replace_every)
+            replace_every=args.replace_every, kernels=sharded_kernels)
     except ValueError as e:
         raise SystemExit(f"acg-tpu: {e}")
     _log(args, f"assemble sharded DIA planes on device ({nparts} parts):",

@@ -66,6 +66,122 @@ def dia_mv_roll_df(planes, offsets, xh, xl):
     return yh, yl
 
 
+def _halo_sizes(offsets, nloc: int):
+    """(Lh, Rh): per-shard halo widths for the pallas-roll SpMV, rounded
+    up to the kernel row tile when that keeps the fast route's
+    tile-divisibility (the window length ``nloc + Lh + Rh`` then stays a
+    tile multiple whenever ``nloc`` is); tiny shards keep the exact band
+    (their windows take the kernel's fallback routes anyway)."""
+    from acg_tpu.ops.pallas_kernels import TILE
+
+    L = max(0, -min(offsets))
+    R = max(0, max(offsets))
+    Lh = L + (-L) % TILE
+    Rh = R + (-R) % TILE
+    if Lh > nloc or Rh > nloc:
+        Lh, Rh = L, R
+    return Lh, Rh
+
+
+class PallasRollSpmv:
+    """Sharded DIA SpMV running the clustered Pallas kernel PER SHARD
+    under ``shard_map``, with the halo exchanged explicitly by
+    ``lax.ppermute`` (round-4 verdict item 7: bring the kernel tier that
+    wins single-chip to the sharded gen-direct route).
+
+    The square kernel (:func:`acg_tpu.ops.pallas_kernels.dia_spmv`) is
+    reused UNCHANGED: each shard's planes are stored pre-PADDED to the
+    halo'd window length (``[0]*Lh + plane_loc + [0]*Rh`` --
+    :func:`sharded_poisson_dia_padded`, built once at assembly), so the
+    kernel's ``y[i] = sum_d plane[d][i] * x[i + off_d]`` over the window
+    ``[halo_L | x_loc | halo_R]`` produces exactly the local rows at
+    window positions ``[Lh, Lh + nloc)`` and structural zeros elsewhere
+    (discarded by the slice).  Edge shards zero-fill their missing halo
+    -- correctness-neutral for the same structural-zero reason as the
+    roll formulation's wraparound.
+
+    Instances are used as the ``kernels`` static argument of the jitted
+    solve programs (identity-hashed: one compile per solver)."""
+
+    name = "pallas-roll"
+
+    def __init__(self, mesh: Mesh, nloc: int, Lh: int, Rh: int,
+                 offsets, interpret: bool = False):
+        self.mesh = mesh
+        self.nloc, self.Lh, self.Rh = int(nloc), int(Lh), int(Rh)
+        self.offsets = tuple(int(o) for o in offsets)
+        self.interpret = bool(interpret)
+        nparts = int(np.prod(tuple(mesh.shape.values())))
+        self._fwd = [(i, i + 1) for i in range(nparts - 1)]
+        self._bwd = [(i + 1, i) for i in range(nparts - 1)]
+
+    def __call__(self, A, x):
+        from acg_tpu.ops.pallas_kernels import dia_spmv
+
+        nloc, Lh, Rh = self.nloc, self.Lh, self.Rh
+        offsets = self.offsets
+        interpret = self.interpret
+
+        def shard(planes, xl):
+            parts = []
+            if Lh:
+                # left halo = left neighbour's TAIL; shard 0 (no
+                # source pair) receives ppermute's zero fill
+                parts.append(jax.lax.ppermute(xl[nloc - Lh:], PARTS_AXIS,
+                                              self._fwd))
+            parts.append(xl)
+            if Rh:
+                parts.append(jax.lax.ppermute(xl[:Rh], PARTS_AXIS,
+                                              self._bwd))
+            xwin = jnp.concatenate(parts) if len(parts) > 1 else xl
+            y = dia_spmv(planes, offsets, xwin, interpret=interpret)
+            return jax.lax.slice(y, (Lh,), (Lh + nloc,))
+
+        spec = P(PARTS_AXIS)
+        return jax.shard_map(shard, mesh=self.mesh,
+                             in_specs=(spec, spec), out_specs=spec,
+                             check_vma=False)(A.data, x)
+
+
+def sharded_poisson_dia_padded(n: int, dim: int, mesh: Mesh, nloc: int,
+                               Lh: int, Rh: int, dtype=jnp.float32):
+    """Poisson DIA planes in the PER-SHARD-PADDED layout consumed by
+    :class:`PallasRollSpmv`: each plane is a ``(nparts * nwin,)`` array
+    (``nwin = Lh + nloc + Rh``) sharded over the mesh, whose shard ``s``
+    holds ``[0]*Lh + plane[s*nloc : (s+1)*nloc] + [0]*Rh``.  Pure iota
+    arithmetic like :func:`sharded_poisson_dia` -- no host data, no
+    communication, and the ~(Lh+Rh)/nloc extra zeros are built once at
+    assembly (not per SpMV)."""
+    nparts = int(np.prod(tuple(mesh.shape.values())))
+    nwin = Lh + nloc + Rh
+    N = n ** dim
+    sh = NamedSharding(mesh, P(PARTS_AXIS))
+
+    @jax.jit
+    def build():
+        g = jax.lax.iota(jnp.int32, nparts * nwin)
+        s = g // nwin
+        j = g % nwin - Lh               # local row, negative in the halo
+        row = jnp.clip(s * nloc + j, 0, N - 1)
+        valid = (j >= 0) & (j < nloc)
+        planes = []
+        for a in range(dim):
+            stride = n ** a
+            coord = (row // stride) % n
+            planes.append(jnp.where(valid & (coord > 0),
+                                    -1.0, 0.0).astype(dtype))
+            planes.append(jnp.where(valid & (coord < n - 1),
+                                    -1.0, 0.0).astype(dtype))
+        planes.append(jnp.where(valid, float(2 * dim), 0.0).astype(dtype))
+        return [jax.lax.with_sharding_constraint(p, sh) for p in planes]
+
+    offsets = [s for a in range(dim) for s in (-(n ** a), n ** a)] + [0]
+    order = np.argsort(offsets)
+    planes = build()
+    return ([planes[i] for i in order],
+            tuple(int(offsets[i]) for i in order), nwin)
+
+
 def sharded_poisson_dia(n: int, dim: int, mesh: Mesh, dtype=jnp.float32):
     """Poisson DIA planes assembled on device, sharded over ``mesh``.
 
@@ -127,6 +243,40 @@ class ShardedDiaCGSolver(JaxCGSolver):
         # (n, dim) of the generating stencil, when known: enables the
         # independent analytic spot check of manufactured systems
         self.stencil = stencil
+
+    def use_pallas_roll(self, n: int, dim: int) -> None:
+        """Switch the solve programs to the per-shard Pallas kernel tier
+        (:class:`PallasRollSpmv`): validates the shard geometry, then
+        assembles the per-shard-padded plane twin
+        (:func:`sharded_poisson_dia_padded`) the windowed kernel
+        consumes.  ``self.A`` keeps the clean (N,) planes for every
+        non-program consumer (manufactured systems, df64 refinement
+        residuals, the analytic spot check)."""
+        nparts = int(np.prod(tuple(self.mesh.shape.values())))
+        N = self.A.nrows
+        if N % nparts:
+            raise ValueError(
+                f"pallas-roll needs evenly sharded rows "
+                f"(N={N} % nparts={nparts} != 0); use kernels='xla-roll'")
+        nloc = N // nparts
+        Lh, Rh = _halo_sizes(self.A.offsets, nloc)
+        if max(Lh, Rh) > nloc:
+            # band wider than a shard: the single-neighbour ppermute
+            # halo cannot reach offset targets two shards away
+            raise ValueError(
+                f"pallas-roll halo ({max(Lh, Rh)}) exceeds the shard "
+                f"size ({nloc}); use kernels='xla-roll'")
+        padded, off2, _nwin = sharded_poisson_dia_padded(
+            n, dim, self.mesh, nloc, Lh, Rh, dtype=self.A.dtype)
+        if off2 != self.A.offsets:
+            raise ValueError(f"padded assembly offsets {off2} disagree "
+                             f"with the solver's {self.A.offsets}")
+        interpret = self.mesh.devices.flat[0].platform != "tpu"
+        self.kernels = PallasRollSpmv(self.mesh, nloc, Lh, Rh,
+                                      self.A.offsets, interpret=interpret)
+        self._A_program = DiaMatrix(data=tuple(padded),
+                                    offsets=self.A.offsets,
+                                    nrows=N, ncols_padded=N)
 
     def ones_b(self, dtype=None) -> jax.Array:
         """A sharded all-ones right-hand side (the CLI default b)."""
@@ -377,12 +527,25 @@ def build_sharded_poisson_solver(n: int, dim: int, nparts: int | None = None,
                                  precise_dots: bool = False,
                                  epsilon: float = 0.0,
                                  replace_every: int = 0,
-                                 replace_restart: bool = True):
+                                 replace_restart: bool = True,
+                                 kernels: str = "xla-roll"):
     """Assemble a sharded Poisson problem and its solver in one call
-    (the gen-direct CLI path under ``--nparts``/``--multihost``)."""
+    (the gen-direct CLI path under ``--nparts``/``--multihost``).
+
+    ``kernels="pallas-roll"`` runs the per-shard clustered Pallas SpMV
+    with an explicit ppermute halo (:class:`PallasRollSpmv`) instead of
+    the GSPMD-partitioned roll formulation; incompatible with
+    ``epsilon`` (the padded assembly bakes the pure stencil)."""
+    if kernels not in ("xla-roll", "pallas-roll"):
+        raise ValueError(f"unknown sharded kernels choice {kernels!r} "
+                         f"(xla-roll or pallas-roll)")
     mesh = solve_mesh(nparts)
     planes, offsets, N = sharded_poisson_dia(n, dim, mesh, dtype=dtype)
     if epsilon:
+        if kernels == "pallas-roll":
+            raise ValueError("kernels='pallas-roll' does not support "
+                             "--epsilon (the padded assembly bakes the "
+                             "pure stencil); use kernels='xla-roll'")
         d = offsets.index(0)
         sh = NamedSharding(mesh, P(PARTS_AXIS))
         planes = list(planes)
@@ -391,9 +554,12 @@ def build_sharded_poisson_solver(n: int, dim: int, nparts: int | None = None,
             out_shardings=sh)(planes[d])
     A = DiaMatrix(data=tuple(planes), offsets=offsets,
                   nrows=N, ncols_padded=N)
-    return ShardedDiaCGSolver(A, mesh=mesh, pipelined=pipelined,
-                              precise_dots=precise_dots,
-                              vector_dtype=vector_dtype,
-                              stencil=(n, dim) if not epsilon else None,
-                              replace_every=replace_every,
-                              replace_restart=replace_restart)
+    solver = ShardedDiaCGSolver(A, mesh=mesh, pipelined=pipelined,
+                                precise_dots=precise_dots,
+                                vector_dtype=vector_dtype,
+                                stencil=(n, dim) if not epsilon else None,
+                                replace_every=replace_every,
+                                replace_restart=replace_restart)
+    if kernels == "pallas-roll":
+        solver.use_pallas_roll(n, dim)
+    return solver

@@ -117,14 +117,19 @@ def profile_ops(solver, b, reps: int = 10) -> dict[str, float]:
 def _profile_single(solver, b, reps: int) -> dict[str, float]:
     from acg_tpu.solvers.jax_cg import _spmv_fn
 
-    A = solver.A
+    # the matrix the PROGRAMS consume: for the pallas-roll tier this is
+    # the per-shard-padded twin its callable kernel expects (the clean
+    # solver.A would feed it mis-shaped planes)
+    A = solver._A_program
     dtype = (A.dtype if hasattr(A, "dtype")
              else A.data.dtype if hasattr(A, "data") else A.vals.dtype)
     # b may already live on device (gen-direct path): no host round-trip
     x = jnp.asarray(b, dtype=dtype)
     # the fused tier's gemv replay uses the closest standalone kernel
-    # (its phase kernels have no standalone-SpMV form)
-    spmv_f = _spmv_fn("pallas" if solver.kernels.startswith("fused")
+    # (its phase kernels have no standalone-SpMV form); callable tiers
+    # (PallasRollSpmv) pass through _spmv_fn unchanged
+    spmv_f = _spmv_fn("pallas" if (isinstance(solver.kernels, str)
+                                   and solver.kernels.startswith("fused"))
                       else solver.kernels)
     if solver.precise_dots:
         from acg_tpu.ops.precision import dot_compensated

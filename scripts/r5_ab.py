@@ -165,6 +165,31 @@ def ab_roll3d(pairs, side):
             extra={"side": side})
 
 
+def ab_proll(pairs, side):
+    """xla-roll vs pallas-roll on the SHARDED solver itself (nparts=1
+    here; the shard-level program is identical at any nparts up to the
+    ppermute halo): the decision measurement for the sharded route's
+    kernel pin (VERDICT item 7)."""
+    import jax.numpy as jnp
+
+    from acg_tpu.parallel.sharded_dia import build_sharded_poisson_solver
+
+    s_pal = build_sharded_poisson_solver(side, 3, nparts=1,
+                                         kernels="pallas-roll")
+    # drop the clean-plane set: the bench only runs the programs (which
+    # consume the padded twin), and at 512^3 a third ~3.8 GB plane set
+    # would push the interleaved pair toward OOM.  spmv_flops over the
+    # padded planes counts the same nonzeros (padding is zeros).
+    s_pal.A = s_pal._A_program
+    s_xla = build_sharded_poisson_solver(side, 3, nparts=1)
+    b = s_xla.ones_b()
+    its = 400 if side >= 512 else 1000
+    _ab_row(f"sharded_pallasroll_vs_xlaroll_3d{side}",
+            lambda: s_pal, lambda: s_xla,
+            "pallas_roll", "xla_roll", b, its, pairs, host_result=False,
+            extra={"side": side})
+
+
 def ab_bell(pairs):
     """Chained-SpMV throughput of the two stacked local-block layouts on
     the 500k power-law workload (the SpMV is where the layouts differ;
@@ -253,7 +278,7 @@ def ab_bell(pairs):
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser()
     ap.add_argument("--only", default=None,
-                    help="comma list of: dist1,mixed3d,bell,roll3d")
+                    help="comma list of: dist1,mixed3d,bell,roll3d,proll")
     ap.add_argument("--pairs", type=int, default=4)
     ap.add_argument("--big", action="store_true",
                     help="mixed3d at 512^3 instead of 256^3")
@@ -274,6 +299,8 @@ def main(argv=None) -> int:
                     ("mixed3d", lambda: ab_mixed3d(
                         args.pairs, 512 if args.big else 256)),
                     ("roll3d", lambda: ab_roll3d(
+                        args.pairs, 512 if args.big else 256)),
+                    ("proll", lambda: ab_proll(
                         args.pairs, 512 if args.big else 256))):
         if only is not None and key not in only:
             continue

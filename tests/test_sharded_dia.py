@@ -218,6 +218,75 @@ def test_spot_check_catches_corrupt_b():
     assert dev_bad > 1e-4
 
 
+# -- round 5: the per-shard Pallas kernel tier on the sharded route -----
+
+def test_pallas_roll_spmv_matches_scipy():
+    """The shard_map + ppermute-halo Pallas SpMV (padded per-shard
+    planes) computes the same operator as scipy, interpret mode on the
+    CPU mesh (round-4 verdict item 7)."""
+    from acg_tpu.parallel.sharded_dia import (PallasRollSpmv, _halo_sizes,
+                                              sharded_poisson_dia_padded)
+    from acg_tpu.ops.spmv import DiaMatrix
+
+    n, dim, nparts = 16, 3, 8
+    mesh = solve_mesh(nparts)
+    N = n ** dim
+    nloc = N // nparts
+    offsets = tuple(sorted([s for a in range(dim)
+                            for s in (-(n ** a), n ** a)] + [0]))
+    Lh, Rh = _halo_sizes(offsets, nloc)
+    padded, offs, nwin = sharded_poisson_dia_padded(n, dim, mesh, nloc,
+                                                    Lh, Rh)
+    assert offs == offsets and nwin == Lh + nloc + Rh
+    A2 = DiaMatrix(data=tuple(padded), offsets=offs, nrows=N,
+                   ncols_padded=N)
+    f = PallasRollSpmv(mesh, nloc, Lh, Rh, offs, interpret=True)
+    x = np.random.default_rng(0).standard_normal(N).astype(np.float32)
+    xs = jax.device_put(x, jax.sharding.NamedSharding(
+        mesh, jax.sharding.PartitionSpec("parts")))
+    y = np.asarray(jax.jit(lambda v: f(A2, v))(xs), np.float64)
+    y_ref = _csr(n, dim) @ x.astype(np.float64)
+    assert np.linalg.norm(y - y_ref) <= 1e-5 * np.linalg.norm(y_ref)
+
+
+def test_sharded_pallas_roll_solver_matches_xla_roll():
+    """build_sharded_poisson_solver(kernels='pallas-roll') solves the
+    same system to the same answer as the xla-roll route."""
+    n, dim = 24, 2
+    crit = StoppingCriteria(maxits=2000, residual_rtol=1e-6)
+    sp = build_sharded_poisson_solver(n, dim, nparts=8,
+                                      kernels="pallas-roll")
+    assert getattr(sp.kernels, "name", None) == "pallas-roll"
+    xsol, b = sp.manufactured(seed=5)
+    xp = np.asarray(sp.solve(b, criteria=crit, host_result=False),
+                    np.float64)
+    sx = build_sharded_poisson_solver(n, dim, nparts=8)
+    xx = np.asarray(sx.solve(b, criteria=crit, host_result=False),
+                    np.float64)
+    assert sp.stats.converged and sx.stats.converged
+    bnrm = float(np.linalg.norm(np.asarray(b, np.float64)))
+    assert np.linalg.norm(xp - xx) <= 1e-4 * bnrm
+    err = np.linalg.norm(xp - np.asarray(xsol, np.float64))
+    assert err < 1e-3
+
+
+def test_sharded_pallas_roll_with_bf16rr():
+    """The kernel tier composes with the sound-bf16 replacement
+    programs (the 512^3 target configuration: pallas-roll + bf16rr)."""
+    sp = build_sharded_poisson_solver(
+        32, 2, nparts=8, dtype=jnp.bfloat16, vector_dtype=jnp.bfloat16,
+        replace_every=25, kernels="pallas-roll")
+    xsol, b = sp.manufactured(seed=1)
+    x = sp.solve(b, criteria=StoppingCriteria(maxits=800,
+                                              residual_rtol=1e-5),
+                 host_result=False, raise_on_divergence=False)
+    csr = _csr(32, 2)
+    b64 = np.asarray(b, np.float64)
+    rel = (np.linalg.norm(b64 - csr @ np.asarray(x, np.float64))
+           / np.linalg.norm(b64))
+    assert rel < 1e-4
+
+
 # -- round 5: the sound bf16 tier on the north-star (sharded) path ------
 
 def test_sharded_bf16rr_sound_at_high_kappa():
