@@ -57,6 +57,73 @@ def _timer(solver, b, its, host_result=None):
     return its / best
 
 
+def _chained_rate(run, k_long: int) -> float:
+    """Rate (units/s) of a chained device program: ``run(k)`` executes
+    and syncs a k-step chain.  Under a broken completion signal the
+    dispatch round-trip is subtracted by a two-point difference, with
+    the same <20x plausibility guard as ``bench._time_solver`` -- a
+    contention spike in the short leg can shrink the difference
+    arbitrarily and record an unboundedly inflated rate."""
+    from acg_tpu._platform import block_until_ready_works
+
+    k_short = max(k_long // 4, 1)
+    run(k_short)  # compile + warm both sizes
+    run(k_long)
+    t0 = time.time()
+    run(k_long)
+    t_long = time.time() - t0
+    raw = k_long / t_long
+    if block_until_ready_works():
+        return raw
+    t0 = time.time()
+    run(k_short)
+    t_short = time.time() - t0
+    dt = t_long - t_short
+    if dt > 0:
+        corrected = (k_long - k_short) / dt
+        if corrected / raw < 20:
+            return corrected
+    return raw
+
+
+def _emit_interleaved(name, rate_a, rate_b, label_a, label_b, pairs,
+                      unit="spmv/s", extra=None):
+    """Interleave two rate callables A,B,A,B,... in one contention
+    window; emit + append the median-ratio row (shared by the
+    chained-SpMV A/Bs)."""
+    import numpy as np
+
+    from bench import bandwidth_probe_gbs
+
+    try:
+        bw0 = bandwidth_probe_gbs(refresh=True)
+    except Exception:
+        bw0 = 0.0
+    va, vb = [], []
+    for _ in range(pairs):
+        va.append(rate_a())
+        vb.append(rate_b())
+    try:
+        bw1 = bandwidth_probe_gbs(refresh=True)
+    except Exception:
+        bw1 = 0.0
+    ra, rb = float(np.median(va)), float(np.median(vb))
+    row = {"ab": name, label_a: round(ra, 2), label_b: round(rb, 2),
+           "ratio": round(ra / rb, 3), "unit": unit,
+           "bw_gbs": round(bw0, 1), "bw_gbs_after": round(bw1, 1),
+           "pairs": pairs, "ts": round(time.time(), 1)}
+    if extra:
+        row.update(extra)
+    from acg_tpu._platform import block_until_ready_works
+    if not block_until_ready_works():
+        row["block_sync_broken"] = True
+    print(json.dumps(row))
+    sys.stdout.flush()
+    with open(RECORD, "a") as f:
+        f.write(json.dumps(row) + "\n")
+    return row
+
+
 def _ab_row(name, mk_a, mk_b, label_a, label_b, b, its, pairs,
             host_result=None, extra=None):
     import numpy as np
@@ -190,6 +257,50 @@ def ab_proll(pairs, side):
             extra={"side": side})
 
 
+def ab_planes3d(pairs, side):
+    """Chained SpMV-only A/B: f32 planes vs bf16 planes, BOTH with f32
+    vectors, on the 3D clustered kernel.  Isolates the mixed tier's
+    3D loss (VERDICT item 5) to the kernel's bf16-plane path: the
+    traffic model says bf16 planes should win ~1.3x; two rounds of
+    ladders measured the opposite inside the full CG loop."""
+    import functools
+
+    import numpy as np
+    import jax
+    import jax.numpy as jnp
+
+    from acg_tpu._platform import device_sync
+    from acg_tpu.io.generators import poisson_dia_device
+    from acg_tpu.ops.pallas_kernels import dia_spmv
+
+    chains = {}
+    for name, dt in (("f32", jnp.float32), ("bf16", jnp.bfloat16)):
+        planes, offsets, N = poisson_dia_device(side, 3, dtype=dt)
+
+        @functools.partial(jax.jit, static_argnames=("k", "offs"))
+        def prog(planes, x, k, offs):
+            def body(_, v):
+                y = dia_spmv(planes, offs, v)
+                return y / jnp.linalg.norm(y)
+
+            return jax.lax.fori_loop(0, k, body, x)
+
+        x0 = jnp.ones(N, jnp.float32)
+        chains[name] = (prog, tuple(planes), x0, offsets)
+
+    k_long = 60 if side >= 512 else 200
+
+    def rate(name):
+        prog, planes, x0, offs = chains[name]
+        return _chained_rate(
+            lambda k: device_sync(prog(planes, x0, k, offs)), k_long)
+
+    _emit_interleaved(f"bf16planes_vs_f32planes_spmv_3d{side}",
+                      lambda: rate("bf16"), lambda: rate("f32"),
+                      "bf16_planes", "f32_planes", pairs,
+                      extra={"side": side})
+
+
 def ab_bell(pairs):
     """Chained-SpMV throughput of the two stacked local-block layouts on
     the 500k power-law workload (the SpMV is where the layouts differ;
@@ -202,12 +313,11 @@ def ab_bell(pairs):
     import jax
     import jax.numpy as jnp
 
-    from acg_tpu._platform import block_until_ready_works, device_sync
+    from acg_tpu._platform import device_sync
     from acg_tpu.io.generators import irregular_spd_coo
     from acg_tpu.matrix import SymCsrMatrix
     from acg_tpu.parallel.dist import DistributedProblem, _stack_local_blocks
     from acg_tpu.partition import partition_rows
-    from bench import bandwidth_probe_gbs
 
     r, c, v, N = irregular_spd_coo(500_000, avg_degree=16.0, seed=0)
     csr = SymCsrMatrix.from_coo(N, r, c, v).to_csr()
@@ -230,55 +340,20 @@ def ab_bell(pairs):
             return jax.lax.fori_loop(0, k, body, x)
 
         x0 = jnp.ones(prob.nmax_owned, jnp.float32)
+        return lambda: _chained_rate(
+            lambda k: device_sync(prog(arrays0, x0, k)), 200)
 
-        def rate(k=200):
-            device_sync(prog(arrays0, x0, 50))  # compile both sizes + warm
-            device_sync(prog(arrays0, x0, k))
-            t0 = time.time()
-            device_sync(prog(arrays0, x0, k))
-            t_long = time.time() - t0
-            t0 = time.time()
-            device_sync(prog(arrays0, x0, 50))
-            t_short = time.time() - t0
-            if not block_until_ready_works() and t_long > t_short:
-                return (k - 50) / (t_long - t_short)
-            return k / t_long
-
-        return rate
-
-    rate_bell, rate_ell = chained(prob.local), chained(ell)
-    try:
-        bw0 = bandwidth_probe_gbs(refresh=True)
-    except Exception:
-        bw0 = 0.0
-    va, vb = [], []
-    for _ in range(pairs):
-        va.append(rate_bell())
-        vb.append(rate_ell())
-    try:
-        bw1 = bandwidth_probe_gbs(refresh=True)
-    except Exception:
-        bw1 = 0.0
-    ra, rb = float(np.median(va)), float(np.median(vb))
-    row = {"ab": "dist_bell_vs_ell_spmv_irregular500k",
-           "binnedell": round(ra, 1), "ell": round(rb, 1),
-           "ratio": round(ra / rb, 3), "unit": "spmv/s",
-           "bw_gbs": round(bw0, 1), "bw_gbs_after": round(bw1, 1),
-           "pairs": pairs, "ts": round(time.time(), 1),
-           "ell_K": int(np.diff(csr.indptr).max())}
-    from acg_tpu._platform import block_until_ready_works as _bw
-    if not _bw():
-        row["block_sync_broken"] = True
-    print(json.dumps(row))
-    sys.stdout.flush()
-    with open(RECORD, "a") as f:
-        f.write(json.dumps(row) + "\n")
+    _emit_interleaved("dist_bell_vs_ell_spmv_irregular500k",
+                      chained(prob.local), chained(ell),
+                      "binnedell", "ell", pairs,
+                      extra={"ell_K": int(np.diff(csr.indptr).max())})
 
 
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser()
     ap.add_argument("--only", default=None,
-                    help="comma list of: dist1,mixed3d,bell,roll3d,proll")
+                    help="comma list of: dist1,mixed3d,bell,roll3d,"
+                         "proll,planes3d")
     ap.add_argument("--pairs", type=int, default=4)
     ap.add_argument("--big", action="store_true",
                     help="mixed3d at 512^3 instead of 256^3")
@@ -301,6 +376,8 @@ def main(argv=None) -> int:
                     ("roll3d", lambda: ab_roll3d(
                         args.pairs, 512 if args.big else 256)),
                     ("proll", lambda: ab_proll(
+                        args.pairs, 512 if args.big else 256)),
+                    ("planes3d", lambda: ab_planes3d(
                         args.pairs, 512 if args.big else 256))):
         if only is not None and key not in only:
             continue

@@ -38,6 +38,7 @@ step ab_dist1   2400 python -u scripts/r5_ab.py --only dist1 --pairs 3
 # 2. the open tier verdicts
 step ab_bell    2400 python -u scripts/r5_ab.py --only bell --pairs 3
 step ab_mixed3d 2400 python -u scripts/r5_ab.py --only mixed3d --pairs 3
+step ab_planes  2400 python -u scripts/r5_ab.py --only planes3d --pairs 3
 step ab_roll3d  2400 python -u scripts/r5_ab.py --only roll3d --pairs 3
 step ab_proll   2400 python -u scripts/r5_ab.py --only proll --pairs 3
 step ab_big     4800 python -u scripts/r5_ab.py --only mixed3d,roll3d,proll \
