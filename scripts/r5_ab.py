@@ -126,37 +126,12 @@ def _emit_interleaved(name, rate_a, rate_b, label_a, label_b, pairs,
 
 def _ab_row(name, mk_a, mk_b, label_a, label_b, b, its, pairs,
             host_result=None, extra=None):
-    import numpy as np
-
-    from bench import bandwidth_probe_gbs
-
-    try:
-        bw0 = bandwidth_probe_gbs(refresh=True)
-    except Exception:
-        bw0 = 0.0
-    va, vb = [], []
-    for _ in range(pairs):
-        va.append(_timer(mk_a(), b, its, host_result))
-        vb.append(_timer(mk_b(), b, its, host_result))
-    try:
-        bw1 = bandwidth_probe_gbs(refresh=True)
-    except Exception:
-        bw1 = 0.0
-    ra, rb = float(np.median(va)), float(np.median(vb))
-    row = {"ab": name, label_a: round(ra, 1), label_b: round(rb, 1),
-           "ratio": round(ra / rb, 3), "bw_gbs": round(bw0, 1),
-           "bw_gbs_after": round(bw1, 1), "pairs": pairs,
-           "ts": round(time.time(), 1)}
-    if extra:
-        row.update(extra)
-    from acg_tpu._platform import block_until_ready_works
-    if not block_until_ready_works():
-        row["block_sync_broken"] = True
-    print(json.dumps(row))
-    sys.stdout.flush()
-    with open(RECORD, "a") as f:
-        f.write(json.dumps(row) + "\n")
-    return row
+    """Interleaved whole-solve A/B: one fresh solver per rep per side."""
+    return _emit_interleaved(
+        name,
+        lambda: _timer(mk_a(), b, its, host_result),
+        lambda: _timer(mk_b(), b, its, host_result),
+        label_a, label_b, pairs, unit="iters/s", extra=extra)
 
 
 def ab_dist1(pairs):

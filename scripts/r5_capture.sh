@@ -9,6 +9,9 @@ set -u
 cd "$(dirname "$0")/.."
 LOG=${1:-/tmp/r5_capture}
 mkdir -p "$LOG"
+# wait_backend just proved the backend alive before every step; skip
+# bench.py's own (redundant, full-backend-init) probe child
+export ACG_TPU_SKIP_BACKEND_PROBE=1
 
 probe() {
   timeout 120 python -c "
