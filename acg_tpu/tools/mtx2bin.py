@@ -44,8 +44,17 @@ def main(argv=None) -> int:
                    help="the --partition vector numbers parts from 1 "
                         "(Fortran/METIS one-based output); shifted to "
                         "0-based before applying")
+    # reference-parity flags (mtx2bin/mtx2bin.c:367-387)
+    dt = p.add_mutually_exclusive_group()
+    dt.add_argument("--double", dest="datatype", action="store_const",
+                    const="real", help="treat values as double (real)")
+    dt.add_argument("--integer", dest="datatype", action="store_const",
+                    const="integer", help="treat values as integers")
+    from acg_tpu.tools import add_parity_flags, apply_quiet
+    add_parity_flags(p, "acg-tpu-mtx2bin")
     p.add_argument("-v", "--verbose", action="count", default=0)
     args = p.parse_args(argv)
+    apply_quiet(args)
 
     import numpy as np
 
@@ -62,6 +71,16 @@ def main(argv=None) -> int:
 
     t0 = time.perf_counter()
     mtx = read_mtx(args.input)
+    if args.datatype and args.datatype != mtx.field:
+        # reference --double/--integer: force the value datatype.
+        # Pattern matrices have implicit unit values -- materialise them
+        # (flipping the field while leaving vals=None would write a
+        # value-typed header with no value bytes: a malformed file)
+        import dataclasses
+        vdt = np.float64 if args.datatype == "real" else np.int32
+        vals = (np.ones(mtx.nnz, dtype=vdt) if mtx.vals is None
+                else np.asarray(mtx.vals).astype(vdt))
+        mtx = dataclasses.replace(mtx, field=args.datatype, vals=vals)
     if args.verbose:
         sys.stderr.write(f"read: {time.perf_counter() - t0:.6f} s "
                          f"({mtx.nrows}x{mtx.ncols}, {mtx.nnz} nnz)\n")

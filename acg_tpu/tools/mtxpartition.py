@@ -38,8 +38,14 @@ def main(argv=None) -> int:
                    choices=["kway", "recursive"],
                    help="METIS algorithm (METIS_PartGraphKway or "
                         "METIS_PartGraphRecursive, metis.h:39-43)")
+    p.add_argument("--numfmt", default="%d", metavar="FMT",
+                   help="output number format (reference flag; default "
+                        "%%d)")
+    from acg_tpu.tools import add_parity_flags, apply_quiet
+    add_parity_flags(p, "acg-tpu-mtxpartition")
     p.add_argument("-v", "--verbose", action="count", default=0)
     args = p.parse_args(argv)
+    apply_quiet(args)
 
     from acg_tpu.io.mtxfile import MtxFile, read_mtx, write_mtx
     from acg_tpu.matrix import SymCsrMatrix
@@ -65,7 +71,8 @@ def main(argv=None) -> int:
     out = MtxFile(object="vector", format="array", field="integer",
                   symmetry="general", nrows=part.size, ncols=1,
                   nnz=part.size, vals=part.astype(np.int32))
-    write_mtx(sys.stdout.buffer, out, binary=args.output_binary, numfmt="%d")
+    write_mtx(sys.stdout.buffer, out, binary=args.output_binary,
+              numfmt=args.numfmt)
     return 0
 
 
