@@ -7,9 +7,14 @@ Times stripped-down CG-shaped loops at the flagship size (n=2048^2,
   single      plain jit fori: spmv(DiaMatrix) + jnp.dot      (control)
   single_dia  plain jit fori: dia_mv (the dist shard formulation)
   smap_local  shard_map(1-device): dia_mv + LOCAL dots (no psum)
-  smap_psum   shard_map(1-device): dia_mv + psum dots (the dist program)
+  smap_psum   shard_map(1-device): dia_mv + psum dots (the PRE-FIX
+              dist program shape: the 2-all-reduces-per-iteration
+              pathology)
   smap_pad    shard_map(1-device): the dist layout (leading parts axis,
-              stripped inside the shard), psum dots -- closest to dist
+              stripped inside the shard), psum dots
+  dist_fixed  the REAL DistCGSolver at nparts=1, post-fix: with the
+              commsize==1 parity bypass (parallel/dist.py) it should
+              time within noise of `single` -- the fix's on-chip proof
 
 Per-iteration rate comes from the (400 - 100)-iteration difference of
 two program sizes, so the broken-completion-signal dispatch round-trip
@@ -30,14 +35,21 @@ sys.path.insert(0, ROOT)
 def main() -> int:
     import jax
     import jax.numpy as jnp
+    import numpy as np
     from jax import lax
     from jax.sharding import NamedSharding, PartitionSpec as P
 
-    from acg_tpu._platform import device_sync, enable_compile_cache
-    from acg_tpu.io.generators import poisson_dia_device
+    from acg_tpu._platform import (device_sync, enable_compile_cache,
+                                   honour_jax_platforms)
+    from acg_tpu.io.generators import poisson2d_coo, poisson_dia_device
+    from acg_tpu.matrix import SymCsrMatrix
     from acg_tpu.ops.spmv import DiaMatrix, dia_mv, spmv
+    from acg_tpu.parallel.dist import DistCGSolver, DistributedProblem
     from acg_tpu.parallel.mesh import PARTS_AXIS, solve_mesh
+    from acg_tpu.partition import partition_rows
+    from acg_tpu.solvers.stats import StoppingCriteria
 
+    honour_jax_platforms()  # JAX_PLATFORMS=cpu debug runs stay CPU
     enable_compile_cache()
     n = 2048
     planes, offsets, N = poisson_dia_device(n, 2, dtype=jnp.float32)
@@ -81,13 +93,13 @@ def main() -> int:
                 Ad = DiaMatrix(data=planes, offsets=offsets,
                                nrows=N, ncols_padded=N)
                 return cg_loop(lambda v: spmv(Ad, v), fdot, b, its)
-            return lambda its: prog(A.data, b, its)
+            return lambda its: device_sync(prog(A.data, b, its))
         if variant == "single_dia":
             @functools.partial(jax.jit, static_argnames="its")
             def prog(planes, b, its):
                 return cg_loop(lambda v: dia_mv(planes, offsets, N, v),
                                fdot, b, its)
-            return lambda its: prog(A.data, b, its)
+            return lambda its: device_sync(prog(A.data, b, its))
         if variant in ("smap_local", "smap_psum"):
             dot = fdot if variant == "smap_local" else pdot
 
@@ -98,7 +110,7 @@ def main() -> int:
                         lambda v: dia_mv(p_, offsets, N, v), dot, b_, its),
                     mesh=mesh, in_specs=(P(PARTS_AXIS), P(PARTS_AXIS)),
                     out_specs=P(PARTS_AXIS), check_vma=False)(planes, b)
-            return lambda its: prog(planes_sh, b_sh, its)
+            return lambda its: device_sync(prog(planes_sh, b_sh, its))
         if variant == "smap_pad":
             def shard(p_, b_, its):
                 p_ = tuple(q[0] for q in p_)
@@ -112,19 +124,34 @@ def main() -> int:
                     functools.partial(shard, its=its),
                     mesh=mesh, in_specs=(P(PARTS_AXIS), P(PARTS_AXIS)),
                     out_specs=P(PARTS_AXIS), check_vma=False)(planes, b)
-            return lambda its: prog(planes_st, b_st, its)
+            return lambda its: device_sync(prog(planes_st, b_st, its))
+        if variant == "dist_fixed":
+            rr, cc, vv, _ = poisson2d_coo(n)
+            csr = SymCsrMatrix.from_coo(N, rr, cc, vv).to_csr()
+            part = partition_rows(csr, 1, seed=0)
+            prob = DistributedProblem.build(csr, part, 1,
+                                            dtype=jnp.float32)
+            solver = DistCGSolver(prob, kernels="xla")
+            b_host = np.ones(N, np.float32)
+
+            def run(its):
+                # solve() device_syncs its result internally
+                solver.solve(b_host,
+                             criteria=StoppingCriteria(maxits=its),
+                             host_result=False)
+            return run
         raise ValueError(variant)
 
     for name in ("single", "single_dia", "smap_local", "smap_psum",
-                 "smap_pad"):
+                 "smap_pad", "dist_fixed"):
         run = make(name)
 
-        def timed(its):
-            device_sync(run(its))  # compile + warm
+        def timed(its, run=run):
+            run(its)  # compile + warm
             ts = []
             for _ in range(3):
                 t0 = time.perf_counter()
-                device_sync(run(its))
+                run(its)
                 ts.append(time.perf_counter() - t0)
             return min(ts)
 
