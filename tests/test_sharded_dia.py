@@ -183,11 +183,14 @@ def test_dia_mv_roll_df_matches_f64():
     assert rel < 1e-13
 
 
-def test_sharded_refine_reaches_f64_class_error():
+@pytest.mark.parametrize("kernels", ["xla-roll", "pallas-roll"])
+def test_sharded_refine_reaches_f64_class_error(kernels):
     """gen-direct sharded --refine: df64 outer residual + f32 inner
     solves reach 1e-9-class solution error (round-3 verdict item 3) --
-    the error a plain f32 solve caps at ~1e-6."""
-    s = build_sharded_poisson_solver(16, 3, nparts=8)
+    the error a plain f32 solve caps at ~1e-6.  Parametrized over the
+    kernel tiers so the pallas-roll inner solves carry the identical
+    refine contract (round 5)."""
+    s = build_sharded_poisson_solver(16, 3, nparts=8, kernels=kernels)
     xsol, b = s.manufactured_df(seed=0)
     xh, xl = s.solve_refined(b, criteria=StoppingCriteria(
         maxits=20000, residual_rtol=1e-11), inner_rtol=1e-5)
@@ -220,15 +223,13 @@ def test_spot_check_catches_corrupt_b():
 
 # -- round 5: the per-shard Pallas kernel tier on the sharded route -----
 
-def test_pallas_roll_spmv_matches_scipy():
-    """The shard_map + ppermute-halo Pallas SpMV (padded per-shard
-    planes) computes the same operator as scipy, interpret mode on the
-    CPU mesh (round-4 verdict item 7)."""
+def _build_pallas_roll(n, dim, nparts):
+    """(f, A2, sharding): the windowed kernel callable and its padded
+    plane twin -- the one construction both pallas-roll unit tests pin."""
     from acg_tpu.parallel.sharded_dia import (PallasRollSpmv, _halo_sizes,
                                               sharded_poisson_dia_padded)
     from acg_tpu.ops.spmv import DiaMatrix
 
-    n, dim, nparts = 16, 3, 8
     mesh = solve_mesh(nparts)
     N = n ** dim
     nloc = N // nparts
@@ -241,9 +242,19 @@ def test_pallas_roll_spmv_matches_scipy():
     A2 = DiaMatrix(data=tuple(padded), offsets=offs, nrows=N,
                    ncols_padded=N)
     f = PallasRollSpmv(mesh, nloc, Lh, Rh, offs, interpret=True)
+    return f, A2, jax.sharding.NamedSharding(
+        mesh, jax.sharding.PartitionSpec("parts"))
+
+
+def test_pallas_roll_spmv_matches_scipy():
+    """The shard_map + ppermute-halo Pallas SpMV (padded per-shard
+    planes) computes the same operator as scipy, interpret mode on the
+    CPU mesh (round-4 verdict item 7)."""
+    n, dim = 16, 3
+    f, A2, sh = _build_pallas_roll(n, dim, 8)
+    N = A2.nrows
     x = np.random.default_rng(0).standard_normal(N).astype(np.float32)
-    xs = jax.device_put(x, jax.sharding.NamedSharding(
-        mesh, jax.sharding.PartitionSpec("parts")))
+    xs = jax.device_put(x, sh)
     y = np.asarray(jax.jit(lambda v: f(A2, v))(xs), np.float64)
     y_ref = _csr(n, dim) @ x.astype(np.float64)
     assert np.linalg.norm(y - y_ref) <= 1e-5 * np.linalg.norm(y_ref)
@@ -268,6 +279,18 @@ def test_sharded_pallas_roll_solver_matches_xla_roll():
     assert np.linalg.norm(xp - xx) <= 1e-4 * bnrm
     err = np.linalg.norm(xp - np.asarray(xsol, np.float64))
     assert err < 1e-3
+
+
+def test_pallas_roll_hlo_permutes_no_gathers():
+    """The pallas-roll tier's compiled SpMV must exchange its halo via
+    exactly two collective-permutes (left + right edge slices) and no
+    all-gathers -- the same scaling property the xla-roll HLO test pins
+    for the GSPMD-derived halo."""
+    f, A2, sh = _build_pallas_roll(16, 3, 8)
+    x = jax.device_put(np.ones(A2.nrows, np.float32), sh)
+    hlo = jax.jit(lambda v: f(A2, v)).lower(x).compile().as_text()
+    assert len(re.findall(r"collective-permute", hlo)) == 2
+    assert not re.search(r"all-gather", hlo)
 
 
 def test_sharded_pallas_roll_with_bf16rr():
