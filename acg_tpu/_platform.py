@@ -19,8 +19,42 @@ from __future__ import annotations
 
 import os
 import re
+import sys
 
 _COUNT_FLAG = "--xla_force_host_platform_device_count"
+
+
+def shard_map(f, mesh, in_specs, out_specs):
+    """``jax.shard_map`` across jax versions: the top-level binding
+    (``check_vma``) only exists on newer runtimes; older ones ship it as
+    ``jax.experimental.shard_map.shard_map`` (``check_rep``).  Replicated-
+    output checking is disabled either way -- the solve programs return
+    psum'd scalars whose replication the checker cannot always prove."""
+    import jax
+
+    if hasattr(jax, "shard_map"):
+        return jax.shard_map(f, mesh=mesh, in_specs=in_specs,
+                             out_specs=out_specs, check_vma=False)
+    from jax.experimental.shard_map import shard_map as _sm
+
+    return _sm(f, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+               check_rep=False)
+
+
+def distributed_initialized() -> bool:
+    """Whether ``jax.distributed.initialize`` already ran in this
+    process, across jax versions (``is_initialized`` is missing on older
+    runtimes; fall back to the internal client handle)."""
+    import jax
+
+    if hasattr(jax.distributed, "is_initialized"):
+        return bool(jax.distributed.is_initialized())
+    try:
+        from jax._src.distributed import global_state
+
+        return global_state.client is not None
+    except Exception:  # noqa: BLE001 -- conservative: let initialize raise
+        return False
 
 
 def host_device_count_flags(flags: str, n_devices: int) -> str:
@@ -89,6 +123,124 @@ def enable_compile_cache(path: str | None = None) -> None:
         jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
     except Exception:  # noqa: BLE001 -- an optimisation, never fatal
         pass
+
+
+# -- bounded backend liveness probe ------------------------------------
+#
+# The tunneled TPU plugin's backend init has been observed to hang for
+# minutes (round 5: a bare ``jax.devices()`` wedged ``dryrun_multichip``
+# >90 s with the tunnel down, and bench runs ate ~15 minutes before
+# raising UNAVAILABLE).  A signal alarm cannot interrupt the stuck
+# C-level init in-process, so the probe runs ``jax.devices()`` in a
+# CHILD process under a hard timeout -- the parent learns backend
+# liveness without ever risking its own wedge.  Lifted from bench.py
+# (round 5) so every entry point (bench, CLI, dryrun) shares one probe.
+
+_probe_cache: tuple[bool, str] | None = None
+
+
+def _accelerator_plugin_present() -> bool:
+    """Whether any PJRT accelerator plugin is importable -- only plugin
+    inits (the tunneled TPU one in particular) can hang; a plugin-free
+    CPU install has nothing worth probing.  Conservative: unknown means
+    True (a missed probe risks a multi-minute wedge, a spurious one
+    costs seconds)."""
+    import importlib.util
+
+    try:
+        if (importlib.util.find_spec("libtpu") is not None
+                or importlib.util.find_spec("jax_plugins") is not None):
+            return True
+        import importlib.metadata as md
+
+        eps = md.entry_points()
+        try:
+            group = eps.select(group="jax_plugins")
+        except AttributeError:          # pre-3.10 dict-style API
+            group = eps.get("jax_plugins", [])
+        return bool(list(group))
+    except Exception:  # noqa: BLE001 -- cannot enumerate: assume present
+        return True
+
+
+def backend_probe_needed() -> bool:
+    """Whether a bounded liveness probe is worth its child-process cost.
+
+    Skipped when: the operator opted out (``ACG_TPU_SKIP_BACKEND_PROBE``),
+    the requested platform is plain CPU (in-process init cannot hang),
+    no accelerator plugin is importable (nothing to hang), or this
+    process already created a backend (``jax.devices()`` would return
+    instantly either way)."""
+    if os.environ.get("ACG_TPU_SKIP_BACKEND_PROBE"):
+        return False
+    plat = os.environ.get("JAX_PLATFORMS", "")
+    names = [p.strip() for p in plat.split(",") if p.strip()]
+    if names and all(n == "cpu" for n in names):
+        return False
+    if not _accelerator_plugin_present():
+        return False
+    if "jax" in sys.modules:
+        try:
+            from jax._src import xla_bridge
+
+            if getattr(xla_bridge, "_backends", None):
+                return False
+        except Exception:  # noqa: BLE001 -- internal API; probe anyway
+            pass
+    return True
+
+
+def probe_timeout() -> float:
+    """The probe's wait bound (seconds); ``ACG_TPU_PROBE_TIMEOUT``
+    overrides the 240 s default (sized to the tunneled backend's slow
+    but *live* inits, minutes under shared-service load)."""
+    return float(os.environ.get("ACG_TPU_PROBE_TIMEOUT", "240"))
+
+
+def probe_backend(timeout: float | None = None) -> tuple[bool, str]:
+    """Bounded child-process backend liveness probe.
+
+    Returns ``(ok, detail)``: ``ok`` means a child process completed a
+    full backend init (``jax.devices()``) within ``timeout`` seconds.
+    The child honours ``JAX_PLATFORMS`` (CPU debug runs probe CPU) and
+    the fault injector's ``backend:hang`` site (acg_tpu.faults), so
+    tunnel-down behaviour is testable without a tunnel.  Results are
+    cached for the process lifetime -- backend liveness is decided once.
+
+    ``ACG_TPU_SKIP_BACKEND_PROBE=1`` skips the probe entirely (drivers
+    that just proved the backend alive themselves)."""
+    global _probe_cache
+    if os.environ.get("ACG_TPU_SKIP_BACKEND_PROBE"):
+        return True, "probe skipped (ACG_TPU_SKIP_BACKEND_PROBE)"
+    if _probe_cache is not None:
+        return _probe_cache
+    import subprocess
+
+    if timeout is None:
+        timeout = probe_timeout()
+    root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    env = dict(os.environ)
+    env["PYTHONPATH"] = root + os.pathsep + env.get("PYTHONPATH", "")
+    code = ("import acg_tpu.faults as _f; _f.maybe_hang_backend(); "
+            "from acg_tpu._platform import honour_jax_platforms; "
+            "honour_jax_platforms(); "
+            "import jax; jax.devices(); print('ok')")
+    try:
+        proc = subprocess.run([sys.executable, "-c", code],
+                              capture_output=True, text=True,
+                              timeout=timeout, env=env)
+    except subprocess.TimeoutExpired:
+        _probe_cache = (False, f"backend init exceeded {timeout:.0f}s "
+                               f"(tunnel down?)")
+        return _probe_cache
+    if proc.stdout.strip().endswith("ok"):
+        _probe_cache = (True, "ok")
+    else:
+        tail = (proc.stderr or "").strip().splitlines()
+        _probe_cache = (False, f"backend init failed (rc="
+                               f"{proc.returncode})"
+                               + (f": {tail[-1]}" if tail else ""))
+    return _probe_cache
 
 
 _block_broken: bool | None = None

@@ -195,6 +195,31 @@ def make_parser() -> argparse.ArgumentParser:
                         "subgraph scatter, graph.c:1529-1897, without "
                         "the root).  Uses a contiguous equal-rows band "
                         "partition")
+    p.add_argument("--recover", action="store_true",
+                   help="arm breakdown detection + bounded restart "
+                        "recovery in the device solve loops: non-finite "
+                        "residuals / non-positive p^T A p exit the loop, "
+                        "the solver restarts from the recomputed true "
+                        "residual (--max-restarts, --restart-backoff), "
+                        "falls back dma->xla halo transport, then the "
+                        "host reference solver -- every event in the "
+                        "stats block")
+    p.add_argument("--max-restarts", type=int, default=2, metavar="N",
+                   help="with --recover/--fault-inject: bounded restarts "
+                        "per solve before falling back (default: 2)")
+    p.add_argument("--restart-backoff", type=float, default=0.0,
+                   metavar="SECONDS",
+                   help="sleep SECONDS * 2^(n-1) before the n-th restart "
+                        "(transient environmental faults get time to "
+                        "clear; default: 0 -- numerical breakdowns "
+                        "restart immediately)")
+    p.add_argument("--fault-inject", metavar="SPEC", default=None,
+                   help="arm the deterministic fault injector "
+                        "(acg_tpu.faults): SITE:MODE[@ITER][:KEY=VAL] "
+                        "-- e.g. spmv:nan@7, halo:inf@3:part=2, "
+                        "dot:neg@5, peer:dead:proc=1, backend:hang:"
+                        "secs=120.  Implies breakdown detection; "
+                        "recovery knobs as with --recover")
     p.add_argument("--err-timeout", type=float, default=120.0,
                    metavar="SECONDS",
                    help="multi-controller error-agreement watchdog: how "
@@ -354,7 +379,8 @@ def _solve_generated_direct(args, dim, n, N, jax, jnp, dtype,
     multi-GB upload (BASELINE.md round-2 notes)."""
     import numpy as np
 
-    from acg_tpu.errors import NotConvergedError
+    from acg_tpu.errors import (AcgError, BreakdownError,
+                                NotConvergedError)
     from acg_tpu.io.generators import poisson_dia_device
     from acg_tpu.io.mtxfile import vector_mtx, write_mtx
     from acg_tpu.ops.spmv import DiaMatrix
@@ -404,7 +430,8 @@ def _solve_generated_direct(args, dim, n, N, jax, jnp, dtype,
         solver = JaxCGSolver(A, pipelined="pipelined" in args.solver,
                              precise_dots=args.precise_dots,
                              kernels=args.kernels, vector_dtype=vec_dtype,
-                             replace_every=args.replace_every)
+                             replace_every=args.replace_every,
+                             recovery=getattr(args, "_recovery", None))
     except ValueError as e:
         raise SystemExit(f"acg-tpu: {e}")
     b = jnp.ones(N, dtype=vec_dtype)
@@ -418,9 +445,12 @@ def _solve_generated_direct(args, dim, n, N, jax, jnp, dtype,
     try:
         x = solver.solve(b, criteria=criteria, warmup=args.warmup,
                          host_result=bool(not args.quiet or args.output))
-    except NotConvergedError as e:
+    except (NotConvergedError, BreakdownError) as e:
         sys.stderr.write(f"acg-tpu: {e}\n")
         solver.stats.fwrite(sys.stderr)
+        return 1
+    except AcgError as e:
+        sys.stderr.write(f"acg-tpu: {e}\n")
         return 1
     finally:
         if args.trace:
@@ -453,7 +483,8 @@ def _solve_distributed_read(args, jax, jnp, dtype, vec_dtype) -> int:
     by design (no full matrix exists anywhere to share code with)."""
     import os
 
-    from acg_tpu.errors import AcgError, NotConvergedError
+    from acg_tpu.errors import (AcgError, BreakdownError,
+                                NotConvergedError)
     from acg_tpu.io.mtxfile import read_mtx, vector_mtx, write_mtx
     from acg_tpu.parallel.dist import DistCGSolver, DistributedProblem
     from acg_tpu.parallel.multihost import is_primary
@@ -614,7 +645,8 @@ def _solve_distributed_read(args, jax, jnp, dtype, vec_dtype) -> int:
         solver = DistCGSolver(prob, pipelined="pipelined" in args.solver,
                               precise_dots=args.precise_dots,
                               kernels=args.kernels,
-                              replace_every=args.replace_every)
+                              replace_every=args.replace_every,
+                              recovery=getattr(args, "_recovery", None))
     except ValueError as e:
         sys.stderr.write(f"acg-tpu: {e}\n")
         _checkpoint(args, "solve", 1)
@@ -649,10 +681,18 @@ def _solve_distributed_read(args, jax, jnp, dtype, vec_dtype) -> int:
             x = solver.solve(b, x0=x0, criteria=criteria,
                              warmup=args.warmup,
                              host_result=not args.output)
-    except NotConvergedError as e:
+    except (NotConvergedError, BreakdownError) as e:
+        # the stats block carries the resilience event log -- most
+        # needed exactly when recovery failed
         sys.stderr.write(f"acg-tpu: {e}\n")
         if is_primary():
             solver.stats.fwrite(sys.stderr)
+        _checkpoint(args, "solve", 1)
+        return 1
+    except AcgError as e:
+        # solve-time configuration refusals (e.g. replace_every + an
+        # armed fault injector) carry typed AcgErrors
+        sys.stderr.write(f"acg-tpu: {e}\n")
         _checkpoint(args, "solve", 1)
         return 1
     finally:
@@ -789,9 +829,30 @@ def _read_vector_windows(path, prob, perm_path=None) -> np.ndarray:
             v[lo:hi] = read_vector_window(path, lo, hi,
                                           expect_nrows=prob.n)
         else:
-            orig = read_vector_window(perm_path, lo, hi,
-                                      expect_nrows=prob.n)
+            from acg_tpu.errors import AcgError, ErrorCode
+            try:
+                orig = read_vector_window(perm_path, lo, hi,
+                                          expect_nrows=prob.n)
+            except AcgError as e:
+                # name the sidecar's required convention directly: a
+                # hand-made or text perm file fails deep in the window
+                # reader with a message about the VECTOR file otherwise
+                raise AcgError(
+                    e.code,
+                    f"{perm_path}: not a readable perm sidecar -- "
+                    f"mtx2bin --partition writes it as a BINARY integer "
+                    f"array of 1-based original row numbers, one per "
+                    f"permuted row ({e})")
             orig = orig.astype(np.int64) - 1  # sidecar rows are 1-based
+            if orig.size and (orig.min() < 0 or orig.max() >= prob.n):
+                oob = int(orig.min() + 1) if orig.min() < 0 \
+                    else int(orig.max() + 1)
+                raise AcgError(
+                    ErrorCode.INVALID_VALUE,
+                    f"{perm_path}: sidecar entry {oob} outside the "
+                    f"1-based row range [1, {prob.n}] -- stale or "
+                    f"hand-made sidecar?  (mtx2bin --partition writes "
+                    f"1-based original row numbers)")
             v[lo:hi] = read_vector_rows(path, orig, expect_nrows=prob.n)
     return v
 
@@ -945,7 +1006,8 @@ def _solve_generated_sharded(args, dim, n, N, jax, jnp, dtype,
     rather than ported)."""
     import numpy as np
 
-    from acg_tpu.errors import NotConvergedError
+    from acg_tpu.errors import (AcgError, BreakdownError,
+                                NotConvergedError)
     from acg_tpu.io.mtxfile import vector_mtx, write_mtx
     from acg_tpu.parallel.multihost import get_global, is_primary
     from acg_tpu.parallel.sharded_dia import (build_sharded_poisson_solver,
@@ -988,7 +1050,8 @@ def _solve_generated_sharded(args, dim, n, N, jax, jnp, dtype,
             n, dim, nparts=nparts, dtype=dtype, vector_dtype=vec_dtype,
             pipelined="pipelined" in args.solver,
             precise_dots=args.precise_dots, epsilon=args.epsilon,
-            replace_every=args.replace_every, kernels=sharded_kernels)
+            replace_every=args.replace_every, kernels=sharded_kernels,
+            recovery=getattr(args, "_recovery", None))
     except ValueError as e:
         raise SystemExit(f"acg-tpu: {e}")
     _log(args, f"assemble sharded DIA planes on device ({nparts} parts):",
@@ -1043,10 +1106,18 @@ def _solve_generated_sharded(args, dim, n, N, jax, jnp, dtype,
             x = solver.solve(b, criteria=criteria, warmup=args.warmup,
                              host_result=False)
             xl = None
-    except NotConvergedError as e:
+    except (NotConvergedError, BreakdownError) as e:
+        # the stats block carries the resilience event log -- most
+        # needed exactly when recovery failed
         sys.stderr.write(f"acg-tpu: {e}\n")
         if is_primary():
             solver.stats.fwrite(sys.stderr)
+        _checkpoint(args, "solve", 1)
+        return 1
+    except AcgError as e:
+        # solve-time configuration refusals (e.g. replace_every + an
+        # armed fault injector) carry typed AcgErrors
+        sys.stderr.write(f"acg-tpu: {e}\n")
         _checkpoint(args, "solve", 1)
         return 1
     finally:
@@ -1099,20 +1170,105 @@ def main(argv=None) -> int:
         try:
             return _buildinfo(sys.stdout)
         except BrokenPipeError:
-            return 0  # stdout consumer (head, grep -m) closed early
+            # stdout consumer (head, grep -m) closed early.  Complete
+            # the SIGPIPE recipe: the interpreter flushes sys.stdout
+            # once more at exit, and with the pipe still broken that
+            # flush would print an "Exception ignored" traceback AFTER
+            # this clean return -- point the fd at devnull so it cannot
+            import os
+            os.dup2(os.open(os.devnull, os.O_WRONLY), sys.stdout.fileno())
+            return 0
     args = make_parser().parse_args(argv)
     args.numfmt = _validate_numfmt(args.numfmt)
+    import os
+
+    from acg_tpu import faults
+    prev_fault_env = os.environ.get(faults.ENV_VAR)
     try:
         return _main(args)
     except OSError as e:
         sys.stderr.write(f"acg-tpu: {e}\n")
         return 1
+    finally:
+        if args.fault_inject:
+            # _main exports the spec (env var = how children inherit it)
+            # and installs it process-wide; both are scoped to THIS
+            # invocation -- in-process callers (tests, library use) must
+            # not stay armed after main returns
+            faults.install(None)
+            if prev_fault_env is None:
+                os.environ.pop(faults.ENV_VAR, None)
+            else:
+                os.environ[faults.ENV_VAR] = prev_fault_env
 
 
 def _main(args) -> int:
 
     # stage 0: runtime init (the MPI/NCCL/NVSHMEM init stage)
     import os
+
+    # fault injector + recovery policy (the resilience tier), armed
+    # BEFORE the backend probe so backend:hang specs actually reach the
+    # probe children.  The spec installs process-wide for the in-process
+    # solver layers AND exports as the env var -- the env var is how
+    # every child (probe, dryrun, multi-controller peers) inherits it
+    recovery = None
+    env_spec = os.environ.get("ACG_TPU_FAULT_INJECT")
+    if env_spec and not args.fault_inject:
+        # validate the env-var route EARLY: parsed lazily, a malformed
+        # spec would otherwise crash the probe child and be misreported
+        # as "backend unavailable"
+        from acg_tpu import faults
+        try:
+            faults.parse_fault_spec(env_spec)
+        except ValueError as e:
+            raise SystemExit(f"acg-tpu: {faults.ENV_VAR}: {e}")
+    if args.fault_inject:
+        from acg_tpu import faults
+        try:
+            faults.install(faults.parse_fault_spec(args.fault_inject))
+        except ValueError as e:
+            raise SystemExit(f"acg-tpu: {e}")
+        os.environ[faults.ENV_VAR] = args.fault_inject
+        if (faults.device_fault() is not None
+                and args.solver in ("host-native", "petsc")):
+            # no injection sites in the native/petsc oracles: an armed
+            # injector that can never fire must refuse (the
+            # replace_every rationale), not report a clean solve
+            raise SystemExit(
+                f"acg-tpu: --fault-inject has no injection sites in "
+                f"--solver {args.solver}; use --solver host or the "
+                f"device solvers")
+
+    # stage 0a: BOUNDED backend liveness probe, before anything can
+    # touch jax.devices(): the tunneled backend's init has been observed
+    # to hang ~15 minutes when the tunnel is down (round 5).  Skipped
+    # for plain-CPU platforms, already-initialised processes, and under
+    # ACG_TPU_SKIP_BACKEND_PROBE (_platform.backend_probe_needed), so
+    # tests and CPU debugging never pay the child-process cost.
+    from acg_tpu._platform import backend_probe_needed, probe_backend
+    if backend_probe_needed():
+        ok, detail = probe_backend()
+        if not ok:
+            sys.stderr.write(
+                f"acg-tpu: backend unavailable: {detail}.  Fix the "
+                f"accelerator runtime (or tunnel), run with "
+                f"JAX_PLATFORMS=cpu for a host-platform debug solve, or "
+                f"set ACG_TPU_SKIP_BACKEND_PROBE=1 to wait out a slow "
+                f"init\n")
+            return 3
+
+    if args.recover or args.fault_inject:
+        from acg_tpu.solvers.resilience import RecoveryPolicy
+        recovery = RecoveryPolicy(max_restarts=max(args.max_restarts, 0),
+                                  backoff=max(args.restart_backoff, 0.0),
+                                  agree_timeout=args.err_timeout)
+        if args.recover and args.solver in ("host-native", "petsc"):
+            sys.stderr.write(
+                f"acg-tpu: warning: --recover has no effect for "
+                f"--solver {args.solver} (the external oracles have no "
+                f"breakdown detection)\n")
+    args._recovery = recovery
 
     import jax
 
@@ -1131,7 +1287,8 @@ def _main(args) -> int:
                    f"{jax.process_count()}, {len(jax.local_devices())} local "
                    f"/ {len(jax.devices())} global devices")
     import jax.numpy as jnp
-    from acg_tpu.errors import AcgError, NotConvergedError
+    from acg_tpu.errors import (AcgError, BreakdownError,
+                                NotConvergedError)
     from acg_tpu.parallel.multihost import is_primary
     from acg_tpu.graph import comm_matrix, partition_matrix
     from acg_tpu.io.mtxfile import MtxFile, read_mtx, write_mtx, vector_mtx
@@ -1332,11 +1489,26 @@ def _main(args) -> int:
             if nparts > 1 and comm != "none":
                 # the acgsolver_solvempi analog (cg.c:408): same
                 # partitioned layout as the device path, pure host
+                from acg_tpu import faults
+                from acg_tpu.errors import ErrorCode
                 from acg_tpu.graph import partition_matrix as _pm
                 from acg_tpu.solvers.host_cg import HostDistCGSolver
+                if faults.device_fault() is not None:
+                    # the distributed host oracle has no injection
+                    # sites either: refuse (replace_every rationale)
+                    raise AcgError(
+                        ErrorCode.INVALID_VALUE,
+                        "fault injection has no injection sites in the "
+                        "multi-part host solver; use the serial host "
+                        "solver (--nparts 1) or the device solvers")
+                if args._recovery is not None:
+                    sys.stderr.write(
+                        "acg-tpu: warning: --recover has no effect on "
+                        "the multi-part host solver (no breakdown "
+                        "detection there)\n")
                 solver = HostDistCGSolver(_pm(csr, part, nparts))
             else:
-                solver = HostCGSolver(csr)
+                solver = HostCGSolver(csr, recovery=args._recovery)
             x = solver.solve(b, x0=x0, criteria=criteria)
         elif args.solver == "petsc":
             # external cross-implementation oracle (the KSPCG role,
@@ -1352,7 +1524,9 @@ def _main(args) -> int:
                                      precise_dots=args.precise_dots,
                                      kernels=args.kernels,
                                      vector_dtype=vec_dtype,
-                                     replace_every=args.replace_every)
+                                     replace_every=args.replace_every,
+                                     recovery=args._recovery,
+                                     host_matrix=csr)
             except ValueError as e:
                 raise SystemExit(f"acg-tpu: {e}")
             if args.refine:
@@ -1383,7 +1557,8 @@ def _main(args) -> int:
                 solver = DistCGSolver(prob, pipelined=pipelined, comm=comm,
                                       precise_dots=args.precise_dots,
                                       kernels=args.kernels, mesh=mesh,
-                                      replace_every=args.replace_every)
+                                      replace_every=args.replace_every,
+                                      recovery=args._recovery)
             except ValueError as e:
                 raise SystemExit(f"acg-tpu: {e}")
             if args.refine:
@@ -1391,7 +1566,7 @@ def _main(args) -> int:
                                        inner_rtol=args.refine_rtol)
             x = solver.solve(b, x0=x0, criteria=criteria,
                              warmup=args.warmup)
-    except NotConvergedError as e:
+    except (NotConvergedError, BreakdownError) as e:
         sys.stderr.write(f"acg-tpu: {e}\n")
         if is_primary():  # stats block from "rank 0" only
             solver.stats.fwrite(sys.stderr)

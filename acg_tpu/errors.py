@@ -37,6 +37,7 @@ class ErrorCode(enum.IntEnum):
     METIS = 15
     MPI = 16
     NOT_CONVERGED_INDEFINITE_MATRIX = 17
+    BREAKDOWN = 18
 
 
 _ERRSTR = {
@@ -59,6 +60,7 @@ _ERRSTR = {
     ErrorCode.MPI: "distributed runtime error",
     ErrorCode.NOT_CONVERGED_INDEFINITE_MATRIX:
         "not converged (indefinite matrix)",
+    ErrorCode.BREAKDOWN: "solver breakdown",
 }
 
 
@@ -92,6 +94,17 @@ class IndefiniteMatrixError(AcgError):
 
     def __init__(self, detail: str = ""):
         super().__init__(ErrorCode.NOT_CONVERGED_INDEFINITE_MATRIX, detail)
+
+
+class BreakdownError(AcgError):
+    """Raised when the breakdown detectors (non-finite residual,
+    non-positive (p, Ap) -- acg_tpu.solvers.resilience) flag a solve and
+    the recovery policy is exhausted or absent: the numerical state is
+    junk and iterating further would only launder NaNs into a
+    plausible-looking answer."""
+
+    def __init__(self, detail: str = ""):
+        super().__init__(ErrorCode.BREAKDOWN, detail)
 
 
 def fexcept_str(*arrays) -> str:

@@ -685,6 +685,13 @@ def read_vector_rows(path, rows: np.ndarray,
     # (open + parse + seek-to-end) would multiply the syscall count
     with open(path, "rb") as f:
         nrows, data_off, vdt = _open_vector_binary(f, path, expect_nrows)
+        # validate against the PARSED row count too (expect_nrows is
+        # optional): an out-of-range gather row is named directly here
+        # instead of surfacing as a window-range error mid-read
+        if uniq[-1] >= nrows:
+            raise AcgError(ErrorCode.INVALID_VALUE,
+                           f"{path}: gather row {int(uniq[-1])} outside "
+                           f"the file's [0, {nrows}) rows")
         for s, e in zip(starts, ends):
             lo, hi = int(uniq[s]), int(uniq[e - 1]) + 1
             chunk = _read_window_at(f, path, nrows, data_off, vdt, lo, hi)

@@ -42,7 +42,9 @@ import numpy as np
 from jax import lax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
-from acg_tpu.errors import NotConvergedError
+from acg_tpu._platform import shard_map as _shard_map
+from acg_tpu.errors import (AcgError, BreakdownError, ErrorCode,
+                            NotConvergedError)
 from acg_tpu.graph import (Subdomain, partition_matrix, reorder_owned_natural,
                            scatter_vector)
 from acg_tpu.ops.precision import dot_compensated
@@ -52,7 +54,7 @@ from acg_tpu.parallel.halo import DeviceHaloPlan, build_device_halo, halo_exchan
 from acg_tpu.parallel.halo_dma import halo_exchange_dma
 from acg_tpu.parallel.mesh import PARTS_AXIS, solve_mesh
 from acg_tpu.parallel.multihost import get_global, put_global
-from acg_tpu.solvers.jax_cg import _iterate
+from acg_tpu.solvers.jax_cg import _breakdown_guard, _iterate
 from acg_tpu.solvers.stats import (SolverStats, StoppingCriteria,
                                    cg_flops_per_iteration)
 
@@ -187,6 +189,13 @@ def _agree_uniform_shapes(subs_owned, nparts: int,
                  default=0)
     nmax_ghost = max((s.nghost for s in subs_owned), default=0)
     nnz = sum(int(s.A_local.nnz + s.A_ghost.nnz) for s in subs_owned)
+    # LOCAL-block-only nnz, agreed separately: the ELL/binned-ELL waste
+    # ratio concerns the local block's padding against its own nnz, and
+    # the full-view flow (_stack_local_blocks) computes it that way --
+    # using the ghost-inclusive total here made borderline matrices pick
+    # plain ELL in the local-read flow while the full-view flow binned
+    # them (ADVICE r5)
+    nnz_local = sum(int(s.A_local.nnz) for s in subs_owned)
     send_total = sum(int(s.halo.total_send) for s in subs_owned)
     # binned-ELL sizing: per-bin row-count max and hub-tail nnz max over
     # this controller's parts (the bin histogram of each local block)
@@ -194,13 +203,13 @@ def _agree_uniform_shapes(subs_owned, nparts: int,
     bell = _bell_histogram([s.A_local for s in subs_owned])
     cap = 2 * max_diags
     too_many = offs.size > cap
-    payload = np.full(cap + 8 + nbins + 1, np.iinfo(np.int64).min,
+    payload = np.full(cap + 9 + nbins + 1, np.iinfo(np.int64).min,
                       dtype=np.int64)
     payload[:min(offs.size, cap)] = offs[:cap]
-    payload[cap:cap + 8] = (offs.size if not too_many else cap + 1,
+    payload[cap:cap + 9] = (offs.size if not too_many else cap + 1,
                             Kl, bmax, Kg, maxcnt, nmax_ghost, nnz,
-                            send_total)
-    payload[cap + 8:] = bell
+                            send_total, nnz_local)
+    payload[cap + 9:] = bell
     if jax.process_count() > 1:
         from jax.experimental import multihost_utils
 
@@ -219,7 +228,8 @@ def _agree_uniform_shapes(subs_owned, nparts: int,
     nmax_ghost = int(gathered[:, cap + 5].max())
     nnz_total = int(gathered[:, cap + 6].sum())
     halo_send_total = int(gathered[:, cap + 7].sum())
-    bell_all = gathered[:, cap + 8:].max(axis=0)
+    nnz_local_total = int(gathered[:, cap + 8].sum())
+    bell_all = gathered[:, cap + 9:].max(axis=0)
     dia_ok = (not (counts > cap).any() and all_offs.size <= max_diags
               and nnz_total
               and (all_offs.size * nmax_owned * nparts
@@ -227,9 +237,13 @@ def _agree_uniform_shapes(subs_owned, nparts: int,
     # the single-device auto histogram rule (ops.spmv.device_matrix_
     # from_csr): when plain-ELL padding waste blows its limit, take the
     # binned layout.  Every controller computes this from the same
-    # agreed scalars, so the format decision is mesh-uniform.
-    bell_ok = (not dia_ok and nnz_total
-               and Kl * nmax_owned * nparts > ell_waste_limit * nnz_total)
+    # agreed scalars, so the format decision is mesh-uniform -- and the
+    # waste ratio uses the LOCAL-block nnz, the same definition the
+    # full-view flow applies, so borderline matrices pick the same
+    # format on both ingest paths (ADVICE r5)
+    bell_ok = (not dia_ok and nnz_local_total
+               and Kl * nmax_owned * nparts
+               > ell_waste_limit * nnz_local_total)
     return UniformShapes(
         offsets=tuple(int(o) for o in all_offs) if dia_ok else None,
         Kl=Kl, bmax=bmax, Kg=Kg, maxcnt=maxcnt, nmax_ghost=nmax_ghost,
@@ -669,7 +683,8 @@ class DistributedProblem:
 
 
 def make_dist_spmv(prob: "DistributedProblem", comm: str, interpret: bool,
-                   kernels: str = "xla", axis: str = PARTS_AXIS):
+                   kernels: str = "xla", axis: str = PARTS_AXIS,
+                   fault=None):
     """Shard-level distributed SpMV: halo(x) || local SpMV, then
     off-diagonal SpMV -- call stack 3.2's overlap pattern
     (``cgcuda.c:855-899``), scheduled by XLA instead of streams.
@@ -679,9 +694,14 @@ def make_dist_spmv(prob: "DistributedProblem", comm: str, interpret: bool,
     ``solvempi``, ``cgcuda.c:871``); non-DIA local blocks and the small
     ghost block stay on the XLA path.
 
-    Returns ``f(x_loc, la, ga, sidx, gsrc, gval, scnt, rcnt)`` for use
-    inside ``shard_map`` (shared by the solve program and the per-op
-    profiling tier)."""
+    Returns ``f(x_loc, la, ga, sidx, gsrc, gval, scnt, rcnt, k=None,
+    pidx=None)`` for use inside ``shard_map`` (shared by the solve
+    program and the per-op profiling tier).  ``fault`` (a static
+    acg_tpu.faults.FaultSpec) arms in-loop injection: ``k`` is the
+    iteration index and ``pidx`` the shard's part index, so a
+    ``halo:*``/``spmv:*`` spec poisons exactly one part's payload at
+    exactly one iteration -- callers that never pass ``k`` (setup SpMVs,
+    the profiler) are injection-free."""
     halo = prob.halo
     local_block = prob.local
     ghost_block = prob.ghost
@@ -690,7 +710,8 @@ def make_dist_spmv(prob: "DistributedProblem", comm: str, interpret: bool,
     if use_pallas:
         from acg_tpu.ops.pallas_kernels import dia_spmv
 
-    def dist_spmv(x_loc, la, ga, sidx, gsrc, gval, scnt, rcnt):
+    def dist_spmv(x_loc, la, ga, sidx, gsrc, gval, scnt, rcnt,
+                  k=None, pidx=None):
         if use_pallas:
             y = dia_spmv(la, local_block.offsets, x_loc,
                          interpret=pallas_interpret)
@@ -703,7 +724,11 @@ def make_dist_spmv(prob: "DistributedProblem", comm: str, interpret: bool,
                                           axis, interpret=interpret)
             else:
                 ghost = halo_exchange(x_loc, sidx, gsrc, axis)
+            if fault is not None and k is not None:
+                ghost = fault.apply_halo(ghost, k, pidx)
             y = y + ghost_block.shard_mv(ga, ghost)
+        if fault is not None and k is not None:
+            y = fault.apply_spmv(y, k, pidx)
         return y
 
     return dist_spmv
@@ -721,7 +746,14 @@ class DistCGSolver:
     def __init__(self, problem: DistributedProblem, pipelined: bool = False,
                  mesh: Mesh | None = None, comm: str = "xla",
                  precise_dots: bool = False, kernels: str = "auto",
-                 replace_every: int = 0, replace_restart: bool = True):
+                 replace_every: int = 0, replace_restart: bool = True,
+                 recovery=None):
+        """``recovery`` (acg_tpu.solvers.resilience.RecoveryPolicy) arms
+        in-loop breakdown detection plus the host-side restart ladder:
+        bounded restarts from the recomputed true residual, the
+        dma -> xla halo-transport fallback, and (full single-controller
+        builds) the distributed host solver -- with every restart/abort
+        decision error-agreed across controllers."""
         if comm not in ("xla", "dma"):
             raise ValueError(f"unknown halo transport {comm!r}")
         if comm == "dma" and jax.process_count() > 1:
@@ -778,11 +810,27 @@ class DistCGSolver:
                 raise ValueError("replace_every computes scalars in "
                                  "plain f32; precise_dots needs the "
                                  "direct programs")
+        self.recovery = recovery
         self._program = self._compile()
+
+    def _program_for(self, fault):
+        """The solve program matching the current comm + fault state:
+        armed faults always get a solve-local compile; the pristine
+        program is cached (and lazily rebuilt after a transport
+        fallback invalidates it)."""
+        if fault is not None:
+            return self._compile(fault=fault)
+        if self._program is None:
+            self._program = self._compile()
+        return self._program
 
     # -- program construction ---------------------------------------------
 
-    def _compile(self):
+    def _compile(self, fault=None):
+        """Build the whole-solve program.  ``fault`` (a static
+        acg_tpu.faults.FaultSpec) bakes the injector into the loop --
+        the armed program is a solve-local temporary, never cached on
+        ``self``, so clean solves keep the pristine compilation."""
         prob = self.problem
         pipelined = self.pipelined
         replace_every = self.replace_every
@@ -794,7 +842,7 @@ class DistCGSolver:
         precise = self.precise_dots
 
         dist_spmv = make_dist_spmv(prob, comm, interpret,
-                                   kernels=self.kernels)
+                                   kernels=self.kernels, fault=fault)
 
         # commsize==1 parity (the reference's explicit special case,
         # ``cgcuda.c:403``): on a 1-shard mesh every psum is an identity
@@ -810,7 +858,7 @@ class DistCGSolver:
             return v if single_shard else lax.psum(v, axis)
 
         def shard_body(la, ga, sidx, gsrc, gval, scnt, rcnt, b, x0,
-                       tols, maxits, unbounded, needs_diff):
+                       tols, maxits, unbounded, needs_diff, detect=False):
             # shard_map keeps the sharded parts axis as a leading size-1 dim
             la, ga = (jax.tree.map(lambda a: a[0], t) for t in (la, ga))
             sidx, gsrc, gval, scnt, rcnt, b, x0 = (
@@ -824,9 +872,17 @@ class DistCGSolver:
             store = ((lambda v: v.astype(dtype)) if sdt != dtype
                      else (lambda v: v))
             res_atol, res_rtol, diff_atol, diff_rtol = tols
+            # the part index a vector fault targets; only derivable from
+            # the mesh axis inside shard_map (the plain-jit bypass below
+            # is single-part by construction)
+            pidx = None
+            if fault is not None:
+                pidx = (jnp.int32(0) if single_shard
+                        else lax.axis_index(axis))
 
-            def spmv(x):
-                return dist_spmv(x, la, ga, sidx, gsrc, gval, scnt, rcnt)
+            def spmv(x, k=None):
+                return dist_spmv(x, la, ga, sidx, gsrc, gval, scnt, rcnt,
+                                 k=k, pidx=pidx)
 
             def ldot(a, c):
                 return jnp.dot(a, c, preferred_element_type=sdt)
@@ -872,10 +928,10 @@ class DistCGSolver:
             # psum'd, so `done` is identical on every shard and the while
             # predicates agree across the mesh.
             def run_iter(iter_body, init_state, gamma_of, dx_of,
-                         init_gamma=None):
+                         init_gamma=None, bad_of=None):
                 return _iterate(iter_body, init_state, gamma_of, maxits,
                                 res_tol, diff_tol, dx_of, unbounded,
-                                init_gamma=init_gamma)
+                                init_gamma=init_gamma, bad_of=bad_of)
 
             if replace_every and not pipelined:
                 # the sound-bf16 contract, distributed: inner bf16 CG
@@ -940,9 +996,12 @@ class DistCGSolver:
                     x32, _, _, k, gamma_f = jax.lax.fori_loop(
                         0, nouter, obody,
                         (x0, r, p0, jnp.int32(0), gamma))
-                    done = jnp.asarray(True)
+                    done = jnp.isfinite(gamma_f)
                 else:
                     def wcond(c):
+                        # NaN >= x is False: a non-finite recomputed
+                        # residual exits here -- the segment boundary
+                        # doubles as the breakdown detector for free
                         return (c[4] >= res_tol * res_tol) & (c[3] < maxits)
 
                     def wbody(c):
@@ -952,73 +1011,125 @@ class DistCGSolver:
                         wcond, wbody, (x0, r, p0, jnp.int32(0), gamma))
                     done = gamma_f < res_tol * res_tol
                 return (x32[None], k, jnp.sqrt(gamma_f), r0nrm2, bnrm2,
-                        x0nrm2, inf, done)
+                        x0nrm2, inf, done, ~jnp.isfinite(gamma_f))
 
             if not pipelined:
                 # dxsqr joins the carry only under a diff criterion (extra
                 # loop-carried scalars measurably slow the TPU loop)
-                def body(state):
+                def body(k, state):
                     x, r, p, gamma = state[:4]
-                    t = spmv(p)
+                    t = spmv(p, k)
                     pdott = pdot(p, t)
-                    alpha = gamma / pdott
-                    x = store(x + alpha * p)
-                    r = store(r - alpha * t)
+                    if fault is not None:
+                        pdott = fault.apply_dot(pdott, k)
+                    if detect:
+                        # breakdown detection mirrors jax_cg._cg_program
+                        # (shared predicate; the deferred gamma_next
+                        # term below too): every flagged scalar is
+                        # psum'd, so `bad` is identical on all shards
+                        # and the early exit is mesh-uniform
+                        bad, alpha = _breakdown_guard(gamma, pdott)
+                        x = store(jnp.where(bad, x, x + alpha * p))
+                        r = store(jnp.where(bad, r, r - alpha * t))
+                    else:
+                        alpha = gamma / pdott
+                        x = store(x + alpha * p)
+                        r = store(r - alpha * t)
                     gamma_next = pdot(r, r)
                     beta = gamma_next / gamma
                     p_next = store(r + beta * p)
+                    out = (x, r, p_next, gamma_next)
                     if needs_diff:
-                        return (x, r, p_next, gamma_next,
-                                alpha * alpha * psum(ldot(p, p)))
-                    return (x, r, p_next, gamma_next)
+                        dx = alpha * alpha * psum(ldot(p, p))
+                        if detect:
+                            # freeze dx on breakdown (jax_cg rationale):
+                            # alpha = 0 must not fake the diff criterion
+                            dx = jnp.where(bad, state[4], dx)
+                        out = out + (dx,)
+                    if detect:
+                        out = out + (bad | (~jnp.isfinite(gamma_next)),)
+                    return out
 
                 init_state = (x0, r, r, gamma) + ((inf,) if needs_diff else ())
+                if detect:
+                    init_state = init_state + (jnp.asarray(False),)
                 k, state, done = run_iter(
                     body, init_state, lambda s: s[3],
-                    (lambda s: s[4]) if needs_diff else (lambda s: inf))
+                    (lambda s: s[4]) if needs_diff else (lambda s: inf),
+                    bad_of=(lambda s: s[-1]) if detect else None)
                 x, r_fin, gamma_fin = state[0], state[1], state[3]
                 dxsqr = state[4] if needs_diff else inf
+                breakdown = state[-1] if detect else jnp.asarray(False)
                 rnrm2 = jnp.sqrt(gamma_fin)
             else:
                 w = spmv(r)
                 zeros = jnp.zeros_like(b)
 
-                def body(state):
+                def body(k, state):
                     x, r, w, p, t, z, gamma_prev, alpha_prev = state[:8]
                     # the pipelined variant's single fused allreduce:
                     # both scalars in one psum (cgcuda.c:1730-1737)
                     # single fused allreduce of both scalars
                     gamma, delta = pdot2_fused(r, r, w, r)
-                    q = spmv(w)  # overlaps the psum under XLA's scheduler
+                    if fault is not None:
+                        delta = fault.apply_dot(delta, k)
+                    q = spmv(w, k)  # overlaps the psum under XLA's scheduler
                     beta = gamma / gamma_prev
-                    alpha = gamma / (delta - beta * (gamma / alpha_prev))
+                    denom = delta - beta * (gamma / alpha_prev)
+                    if detect:
+                        # jax_cg._cg_pipelined_program's guard: the
+                        # flag is NOT gamma_next-deferred here (the
+                        # pipelined poison surfaces in the next
+                        # iteration's (w, r) reduction instead)
+                        bad, alpha = _breakdown_guard(gamma, denom)
+                    else:
+                        alpha = gamma / denom
                     z = store(q + beta * z)
                     t = store(w + beta * t)
                     p = store(r + beta * p)
-                    x = store(x + alpha * p)
-                    r = store(r - alpha * t)
-                    w = store(w - alpha * z)
+                    if detect:
+                        x = store(jnp.where(bad, x, x + alpha * p))
+                        r = store(jnp.where(bad, r, r - alpha * t))
+                        w = store(jnp.where(bad, w, w - alpha * z))
+                    else:
+                        x = store(x + alpha * p)
+                        r = store(r - alpha * t)
+                        w = store(w - alpha * z)
+                    out = (x, r, w, p, t, z, gamma, alpha)
                     if needs_diff:
-                        return (x, r, w, p, t, z, gamma, alpha,
-                                alpha * alpha * psum(ldot(p, p)))
-                    return (x, r, w, p, t, z, gamma, alpha)
+                        dx = alpha * alpha * psum(ldot(p, p))
+                        if detect:
+                            dx = jnp.where(bad, state[8], dx)
+                        out = out + (dx,)
+                    if detect:
+                        out = out + (bad,)
+                    return out
 
                 # stale-gamma convergence test (see jax_cg): s[6] is the
                 # psum'd ||r||^2 from before the update
                 init_state = (x0, r, w, zeros, zeros, zeros, inf, inf) + (
                     (inf,) if needs_diff else ())
+                if detect:
+                    init_state = init_state + (jnp.asarray(False),)
                 k, state, done = run_iter(
                     body, init_state, lambda s: s[6],
                     (lambda s: s[8]) if needs_diff else (lambda s: inf),
-                    init_gamma=gamma)
+                    init_gamma=gamma,
+                    bad_of=(lambda s: s[-1]) if detect else None)
                 x, r_fin = state[0], state[1]
                 dxsqr = state[8] if needs_diff else inf
+                breakdown = state[-1] if detect else jnp.asarray(False)
                 rnrm2 = jnp.sqrt(pdot(r_fin, r_fin))
                 # stale-test consistency: see jax_cg._cg_pipelined_program
                 done = jnp.logical_or(done, rnrm2 <= res_tol)
 
+            # breakdown-at-the-floor consistency (jax_cg rationale): a
+            # flagged exit whose residual already meets tolerance is
+            # convergence, not breakdown
+            breakdown = breakdown & ~done
             dxnrm2 = jnp.sqrt(dxsqr)
-            return x[None], k, rnrm2, r0nrm2, bnrm2, x0nrm2, dxnrm2, done
+            return (x[None], k, rnrm2, r0nrm2, bnrm2, x0nrm2, dxnrm2,
+                    done, breakdown)
 
         if single_shard and not prob.halo.has_ghosts:
             # one shard, no halo: shard_body runs as a PLAIN jit program
@@ -1027,13 +1138,15 @@ class DistCGSolver:
             # sharding boundary entirely, so XLA optimises the loop
             # exactly like the single-chip solver's.
             @functools.partial(jax.jit,
-                               static_argnames=("unbounded", "needs_diff"))
+                               static_argnames=("unbounded", "needs_diff",
+                                                "detect"))
             def program(la, ga, sidx, gsrc, gval, scnt, rcnt, b, x0,
-                        tols, maxits, unbounded, needs_diff):
+                        tols, maxits, unbounded, needs_diff,
+                        detect=False):
                 return shard_body(la, ga, sidx, gsrc, gval, scnt, rcnt,
                                   b, x0, tols, maxits,
                                   unbounded=unbounded,
-                                  needs_diff=needs_diff)
+                                  needs_diff=needs_diff, detect=detect)
 
             return program
 
@@ -1044,17 +1157,18 @@ class DistCGSolver:
                     pspec, pspec, pspec, pspec, pspec,         # halo, counts
                     pspec, pspec,                              # b, x0
                     rspec, rspec)                              # tols, maxits
-        out_specs = (pspec,) + (rspec,) * 7
+        out_specs = (pspec,) + (rspec,) * 8
 
         @functools.partial(jax.jit,
-                           static_argnames=("unbounded", "needs_diff"))
+                           static_argnames=("unbounded", "needs_diff",
+                                            "detect"))
         def program(la, ga, sidx, gsrc, gval, scnt, rcnt, b, x0,
-                    tols, maxits, unbounded, needs_diff):
-            return jax.shard_map(
+                    tols, maxits, unbounded, needs_diff, detect=False):
+            return _shard_map(
                 functools.partial(shard_body,
-                                  unbounded=unbounded, needs_diff=needs_diff),
+                                  unbounded=unbounded, needs_diff=needs_diff,
+                                  detect=detect),
                 mesh=self.mesh, in_specs=in_specs, out_specs=out_specs,
-                check_vma=False,
             )(la, ga, sidx, gsrc, gval, scnt, rcnt, b, x0, tols, maxits)
 
         return program
@@ -1108,14 +1222,46 @@ class DistCGSolver:
             raise ValueError("replace_every supports residual criteria "
                              "only")
 
+        from acg_tpu import faults
+        fault = faults.device_fault()
+        if (fault is not None and fault.site == "halo"
+                and not prob.halo.has_ghosts):
+            # this topology performs no halo exchange: the armed
+            # injector could never fire (the replace_every rationale)
+            raise AcgError(
+                ErrorCode.INVALID_VALUE,
+                "halo fault injection needs a topology with ghost "
+                "exchange; this problem has no halo (single part or "
+                "fully decoupled partition)")
+        if fault is not None and fault.part >= prob.nparts:
+            raise AcgError(
+                ErrorCode.INVALID_VALUE,
+                f"fault spec targets part {fault.part}, but this mesh "
+                f"has {prob.nparts} parts -- the fault could never "
+                f"fire")
+        if fault is not None and self.replace_every:
+            # the replacement segments call the dist SpMV without the
+            # global iteration index: an armed injector would silently
+            # never fire (jax_cg rationale) -- refuse instead
+            raise AcgError(
+                ErrorCode.INVALID_VALUE,
+                "fault injection does not reach the replacement-segment "
+                "program (replace_every); inject into the direct "
+                "classic/pipelined programs instead")
+        detect = self.recovery is not None or fault is not None
+        # an armed injector bakes into a solve-local program; the cached
+        # pristine program serves every clean solve
+        program = self._program_for(fault)
+
         b, x0, la, ga, sidx, gsrc, gval, scnt, rcnt = \
             self.device_args(b_global, x0)
         # tolerances in the scalar dtype (f32 for bf16 storage) so a 1e-9
         # rtol is not pre-rounded to 8 mantissa bits
+        sdt = acc_dtype(dtype)
         tols = jnp.asarray([crit.residual_atol, crit.residual_rtol,
-                            crit.diff_atol, crit.diff_rtol],
-                           dtype=acc_dtype(dtype))
-        kwargs = dict(unbounded=crit.unbounded, needs_diff=crit.needs_diff)
+                            crit.diff_atol, crit.diff_rtol], dtype=sdt)
+        kwargs = dict(unbounded=crit.unbounded, needs_diff=crit.needs_diff,
+                      detect=detect)
         args = (la, ga, sidx, gsrc, gval, scnt, rcnt, b, x0, tols,
                 jnp.int32(crit.maxits))
         # device_sync, not bare block_until_ready: see _platform (the
@@ -1123,20 +1269,99 @@ class DistCGSolver:
         from acg_tpu._platform import block_until_ready_works, device_sync
         block_until_ready_works()  # resolve the cached probe OUTSIDE timing
         for _ in range(max(warmup, 0)):
-            device_sync(self._program(*args, **kwargs)[0])
+            device_sync(program(*args, **kwargs)[0])
         t0 = time.perf_counter()
-        out = self._program(*args, **kwargs)
+        out = program(*args, **kwargs)
         device_sync(out[0])
+        niter = int(out[1])
+        first_norms = None
+        if detect and bool(out[8]):
+            # the recovery ladder (solvers.resilience): bounded restarts
+            # from the recomputed true residual; a recurring breakdown
+            # under the dma transport retires it for the xla
+            # collectives; the final rung re-solves on the distributed
+            # host oracle.  Multi-controller, every restart/abort
+            # decision is error-agreed (erragree.agree_status inside the
+            # driver), so the pod acts in unison.
+            from acg_tpu.solvers.resilience import RecoveryDriver
+            driver = RecoveryDriver(self.recovery, st, "dist-cg")
+            pol = self.recovery
+            x0_dev = args[8]
+            # stats report the ORIGINAL solve's norms (jax_cg rationale)
+            first_norms = (float(out[4]), float(out[5]), float(out[3]))
+            abs_tol = max(crit.residual_atol,
+                          crit.residual_rtol * float(out[3]))
+            rtols = jnp.asarray([abs_tol, 0.0, crit.diff_atol,
+                                 crit.diff_rtol], dtype=sdt)
+            def restart_args(x_next):
+                if not bool(jnp.isfinite(x_next).all()):
+                    driver.record("iterate non-finite; restarting "
+                                  "from the initial guess")
+                    x_next = x0_dev
+                remaining = max(crit.maxits - niter, 1)
+                return (args[:8] + (x_next, rtols)
+                        + (jnp.int32(remaining),))
+
+            while bool(out[8]):
+                k_done = int(out[1])
+                if (self.comm == "dma" and driver.restarts >= 1
+                        and pol is not None and pol.fallback_comm):
+                    # a restart did not cure it: suspect the one-sided
+                    # transport and retire it for this solver.  The
+                    # fallback is its OWN rung -- it gets an attempt on
+                    # the new transport without consuming the restart
+                    # budget (otherwise max_restarts=1 would retire the
+                    # transport and give up before ever trying it).
+                    # The pristine program is invalidated and rebuilt
+                    # LAZILY -- eagerly compiling it here alongside the
+                    # fault-armed one would waste a whole multi-second
+                    # XLA compile inside the recovery path
+                    st.nbreakdowns += 1
+                    driver.on_fallback("fallback: halo transport "
+                                       "dma -> xla")
+                    self.comm = "xla"
+                    self._program = None
+                    if fault is not None:
+                        fault = fault.shift(k_done)
+                    program = self._program_for(fault)
+                    args = restart_args(out[0])
+                    out = program(*args, **kwargs)
+                    device_sync(out[0])
+                    niter += int(out[1])
+                    continue
+                if driver.on_breakdown(k_done):
+                    x_next = out[0]
+                    if fault is not None:
+                        fault = fault.shift(k_done)
+                        program = self._program_for(fault)
+                    args = restart_args(x_next)
+                    out = program(*args, **kwargs)
+                    device_sync(out[0])
+                    niter += int(out[1])
+                    continue
+                can_host = (pol is not None and pol.fallback_host
+                            and prob.owned_parts is None
+                            and all(s.A_local is not None
+                                    for s in prob.subs))
+                if can_host:
+                    driver.on_fallback("fallback: distributed host "
+                                       "reference solver")
+                    st.tsolve += time.perf_counter() - t0
+                    return self._host_fallback(b_global, crit,
+                                               raise_on_divergence,
+                                               host_result)
+                st.tsolve += time.perf_counter() - t0
+                st.converged = False
+                raise driver.give_up(niter, float(out[2]))
         st.tsolve += time.perf_counter() - t0
 
-        x_st, k, rnrm2, r0nrm2, bnrm2, x0nrm2, dxnrm2, done = out
-        niter = int(k)
+        x_st, k, rnrm2, r0nrm2, bnrm2, x0nrm2, dxnrm2, done = out[:8]
         st.nsolves += 1
         st.niterations = niter
         st.ntotaliterations += niter
-        st.bnrm2 = float(bnrm2)
-        st.x0nrm2 = float(x0nrm2)
-        st.r0nrm2 = float(r0nrm2)
+        st.bnrm2, st.x0nrm2, st.r0nrm2 = (
+            first_norms if first_norms is not None
+            else (float(bnrm2), float(x0nrm2), float(r0nrm2)))
         st.rnrm2 = float(rnrm2)
         st.dxnrm2 = float(dxnrm2)
         st.converged = bool(done) or crit.unbounded
@@ -1179,3 +1404,26 @@ class DistCGSolver:
             raise NotConvergedError(
                 f"{niter} iterations, residual {st.rnrm2:.3e}")
         return x
+
+    def _host_fallback(self, b_global, crit, raise_on_divergence: bool,
+                       host_result: bool):
+        """The last recovery rung: re-solve on the distributed host
+        oracle (HostDistCGSolver, same subdomain layout, f64 numpy) from
+        the original b.  Only reachable on full single-controller builds
+        -- restricted (multi-controller) problems hold other
+        controllers' blocks as stubs, so the ladder ends at the raise
+        there."""
+        from acg_tpu import faults
+        from acg_tpu.solvers.host_cg import HostDistCGSolver
+        from acg_tpu.solvers.resilience import adopt_host_stats
+
+        hs = HostDistCGSolver(self.problem.subs)
+        with faults.suppressed():
+            x = hs.solve(np.asarray(b_global, np.float64), criteria=crit,
+                         raise_on_divergence=raise_on_divergence)
+        adopt_host_stats(self.stats, hs.stats)
+        if host_result:
+            return x
+        # callers expecting the stacked device layout still get it
+        from acg_tpu.parallel.multihost import put_global
+        return put_global(self.problem.scatter(x), sharding=self._sharding)

@@ -18,6 +18,7 @@ scheduler's global timeout.
 
 from __future__ import annotations
 
+import itertools
 import os
 import sys
 import threading
@@ -27,6 +28,51 @@ import numpy as np
 # exit code for "a peer never reached the checkpoint" (distinct from any
 # ErrorCode value; chosen in the 64..113 hole left by shell conventions)
 PEER_LOST_EXIT = 97
+
+# per-process sequence number making each checkpoint's KV keys unique;
+# stays in lockstep across controllers because every agree_status call
+# site is a symmetric stage boundary (the documented contract)
+_seq = itertools.count()
+
+
+def _gather_codes(code: int, seq: int, timeout: float) -> list[int]:
+    """All processes' status codes, via the coordination-service KV
+    store when available -- plain gRPC to the coordinator, no device
+    collective, so it works on backends whose multiprocess computations
+    are unsupported (older CPU runtimes) and cannot be wedged by a
+    poisoned accelerator.  Falls back to the allgather."""
+    import jax
+
+    n = jax.process_count()
+    me = jax.process_index()
+    client = None
+    try:
+        from jax._src.distributed import global_state
+
+        client = global_state.client
+    except Exception:  # noqa: BLE001 -- internal API: fall back
+        client = None
+    if (client is not None and hasattr(client, "key_value_set")
+            and hasattr(client, "blocking_key_value_get")):
+        base = f"acg_tpu/erragree/{seq}"
+        client.key_value_set(f"{base}/{me}", str(int(code)))
+        ms = max(int(timeout * 1000), 1)
+        codes = [int(client.blocking_key_value_get(f"{base}/{q}", ms))
+                 for q in range(n)]
+        # bound coordinator memory on long-lived pods: generation seq-1
+        # is finished on every controller (they could not be at seq
+        # otherwise), so its keys are safe to drop -- deleting THIS
+        # generation here would race peers still reading it
+        if seq > 0 and hasattr(client, "key_value_delete"):
+            try:
+                client.key_value_delete(f"acg_tpu/erragree/{seq - 1}")
+            except Exception:  # noqa: BLE001 -- cleanup, never fatal
+                pass
+        return codes
+    from jax.experimental import multihost_utils
+
+    return [int(c) for c in np.asarray(multihost_utils.process_allgather(
+        np.int32(code), tiled=False)).ravel()]
 
 
 def agree_status(code: int, what: str = "", timeout: float = 120.0) -> int:
@@ -53,8 +99,14 @@ def agree_status(code: int, what: str = "", timeout: float = 120.0) -> int:
     if jax.process_count() == 1:
         return int(code)
 
-    from jax.experimental import multihost_utils
+    # fault injector (acg_tpu.faults): a ``peer:dead``/``peer:stall``
+    # spec makes the targeted controller die or stall HERE, before the
+    # collective -- the exact failure shape the watchdog exists for,
+    # reproducible on the CPU pod without killing real processes
+    from acg_tpu.faults import maybe_fail_peer
+    maybe_fail_peer(what)
 
+    seq = next(_seq)
     done = threading.Event()
 
     def _abort():
@@ -73,8 +125,7 @@ def agree_status(code: int, what: str = "", timeout: float = 120.0) -> int:
     watchdog.daemon = True
     watchdog.start()
     try:
-        codes = multihost_utils.process_allgather(
-            np.int32(code), tiled=False)
+        codes = _gather_codes(code, seq, timeout)
         done.set()
     except Exception as e:  # noqa: BLE001 -- a failed collective here
         # means a peer died mid-connection; same teardown as a timeout

@@ -66,13 +66,28 @@ from jax.experimental.pallas import tpu as pltpu
 from acg_tpu.parallel.mesh import PARTS_AXIS
 
 
+def _compiler_params(**kwargs):
+    """Mosaic compiler params across jax versions: the class was renamed
+    TPUCompilerParams -> CompilerParams and older ones lack
+    ``has_side_effects`` (safe to drop -- the exchange output is consumed
+    by the unpack gather, so the kernel is never dead code)."""
+    import dataclasses
+
+    cls = getattr(pltpu, "CompilerParams", None) or pltpu.TPUCompilerParams
+    fields = {f.name for f in dataclasses.fields(cls)}
+    return cls(**{k: v for k, v in kwargs.items() if k in fields})
+
+
 def _exchange_kernel(axis, use_barrier, gate_by_counts, scnt_ref, rcnt_ref,
                      sendbuf_ref, recvbuf_ref, send_sem, recv_sem):
     """Per-shard kernel: neighbourhood barrier, start every gated put
     (nbi-style, all in flight at once), then wait for sends and
     receives."""
     me = lax.axis_index(axis)
-    nparts = lax.axis_size(axis)  # static mesh size
+    # static mesh size; lax.axis_size is missing on older runtimes, where
+    # psum of a Python scalar is the (statically folded) idiom
+    nparts = (lax.axis_size(axis) if hasattr(lax, "axis_size")
+              else lax.psum(1, axis))
 
     def want_send(q):
         if gate_by_counts:
@@ -166,8 +181,8 @@ def _exchange(sendbuf, send_counts, recv_counts, axis: str, interpret: bool,
             pltpu.SemaphoreType.DMA(()),             # send (shared)
             pltpu.SemaphoreType.DMA(()),             # recv (shared)
         ],
-        compiler_params=pltpu.CompilerParams(has_side_effects=True,
-                                             collective_id=0),
+        compiler_params=_compiler_params(has_side_effects=True,
+                                         collective_id=0),
         interpret=interpret,
     )(send_counts, recv_counts, sendbuf)
 

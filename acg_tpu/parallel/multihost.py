@@ -43,7 +43,9 @@ def initialize(coordinator: str | None = None,
     """
     import jax
 
-    if jax.distributed.is_initialized():
+    from acg_tpu._platform import distributed_initialized
+
+    if distributed_initialized():
         return
     kwargs = {}
     if coordinator is not None:
@@ -81,9 +83,15 @@ def put_global(arr, sharding):
     arr = np.asarray(arr)
     # dtype must be explicit: a process whose devices are all outside the
     # mesh holds no addressable shards to infer it from
-    return jax.make_array_from_callback(arr.shape, sharding,
-                                        lambda idx: arr[idx],
-                                        dtype=arr.dtype)
+    try:
+        return jax.make_array_from_callback(arr.shape, sharding,
+                                            lambda idx: arr[idx],
+                                            dtype=arr.dtype)
+    except TypeError:
+        # older jax: no dtype kwarg -- inference from the local shards
+        # still covers every process that addresses part of the mesh
+        return jax.make_array_from_callback(arr.shape, sharding,
+                                            lambda idx: arr[idx])
 
 
 def get_global(x) -> np.ndarray:

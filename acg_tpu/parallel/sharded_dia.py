@@ -138,9 +138,9 @@ class PallasRollSpmv:
             return jax.lax.slice(y, (Lh,), (Lh + nloc,))
 
         spec = P(PARTS_AXIS)
-        return jax.shard_map(shard, mesh=self.mesh,
-                             in_specs=(spec, spec), out_specs=spec,
-                             check_vma=False)(A.data, x)
+        from acg_tpu._platform import shard_map as _shard_map
+        return _shard_map(shard, mesh=self.mesh,
+                          in_specs=(spec, spec), out_specs=spec)(A.data, x)
 
 
 def sharded_poisson_dia_padded(n: int, dim: int, mesh: Mesh, nloc: int,
@@ -225,7 +225,8 @@ class ShardedDiaCGSolver(JaxCGSolver):
     def __init__(self, A: DiaMatrix, mesh: Mesh | None = None,
                  pipelined: bool = False, precise_dots: bool = False,
                  vector_dtype=None, stencil: tuple[int, int] | None = None,
-                 replace_every: int = 0, replace_restart: bool = True):
+                 replace_every: int = 0, replace_restart: bool = True,
+                 recovery=None):
         if A.ncols_padded != A.nrows:
             raise ValueError("sharded DIA solve needs a square matrix")
         # replace_every (the sound bf16 tier, _cg_replaced_program)
@@ -237,8 +238,12 @@ class ShardedDiaCGSolver(JaxCGSolver):
         super().__init__(A, pipelined=pipelined, precise_dots=precise_dots,
                          kernels="xla-roll", vector_dtype=vector_dtype,
                          replace_every=replace_every,
-                         replace_restart=replace_restart)
+                         replace_restart=replace_restart,
+                         recovery=recovery)
         self.mesh = mesh if mesh is not None else solve_mesh()
+        # fault-injection diagnosis hook (JaxCGSolver.solve): this tier
+        # is multi-part but still cannot honour part= targeting
+        self._fault_nparts = int(self.mesh.devices.size)
         self.sharding = NamedSharding(self.mesh, P(PARTS_AXIS))
         # (n, dim) of the generating stencil, when known: enables the
         # independent analytic spot check of manufactured systems
@@ -528,7 +533,8 @@ def build_sharded_poisson_solver(n: int, dim: int, nparts: int | None = None,
                                  epsilon: float = 0.0,
                                  replace_every: int = 0,
                                  replace_restart: bool = True,
-                                 kernels: str = "xla-roll"):
+                                 kernels: str = "xla-roll",
+                                 recovery=None):
     """Assemble a sharded Poisson problem and its solver in one call
     (the gen-direct CLI path under ``--nparts``/``--multihost``).
 
@@ -559,7 +565,8 @@ def build_sharded_poisson_solver(n: int, dim: int, nparts: int | None = None,
                                 vector_dtype=vector_dtype,
                                 stencil=(n, dim) if not epsilon else None,
                                 replace_every=replace_every,
-                                replace_restart=replace_restart)
+                                replace_restart=replace_restart,
+                                recovery=recovery)
     if kernels == "pallas-roll":
         solver.use_pallas_roll(n, dim)
     return solver

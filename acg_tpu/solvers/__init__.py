@@ -1,2 +1,3 @@
 from acg_tpu.solvers.stats import SolverStats, StoppingCriteria  # noqa: F401
 from acg_tpu.solvers.host_cg import HostCGSolver, HostDistCGSolver  # noqa: F401
+from acg_tpu.solvers.resilience import RecoveryPolicy  # noqa: F401

@@ -40,12 +40,21 @@ def as_csr(A: SymCsrMatrix | sp.spmatrix, epsilon: float = 0.0) -> sp.csr_matrix
 
 
 class HostCGSolver:
-    """Serial host CG over a :class:`SymCsrMatrix` (the ``acgsolver`` role)."""
+    """Serial host CG over a :class:`SymCsrMatrix` (the ``acgsolver`` role).
 
-    def __init__(self, A: SymCsrMatrix | sp.spmatrix, epsilon: float = 0.0):
+    ``recovery`` (acg_tpu.solvers.resilience.RecoveryPolicy) arms
+    breakdown detection -- non-finite residual or non-positive (p, Ap)
+    -- with eager in-place restart: the true residual is recomputed from
+    the last finite iterate and the Krylov space rebuilt, the same
+    policy the compiled solvers run host-side.  Detection also arms
+    while the fault injector (acg_tpu.faults) is active."""
+
+    def __init__(self, A: SymCsrMatrix | sp.spmatrix, epsilon: float = 0.0,
+                 recovery=None):
         self.A = as_csr(A, epsilon)
         self.n = self.A.shape[0]
         self.nnz_full = self.A.nnz
+        self.recovery = recovery
         self.stats = SolverStats(unknowns=self.n)
 
     def _op(self, name, t, n_bytes, flops):
@@ -62,6 +71,20 @@ class HostCGSolver:
         b = np.asarray(b, dtype=np.float64)
         x = np.array(x0, dtype=np.float64, copy=True) if x0 is not None else np.zeros(n)
         dbl = 8
+        from acg_tpu import faults
+        fault = faults.device_fault()
+        if fault is not None and (fault.site == "halo" or fault.part > 0):
+            from acg_tpu.errors import AcgError, ErrorCode
+            raise AcgError(
+                ErrorCode.INVALID_VALUE,
+                "the serial host solver has no halo and only part 0: "
+                "this fault spec could never fire")
+        pol = self.recovery
+        detect = pol is not None or fault is not None
+        driver = None
+        if detect:
+            from acg_tpu.solvers.resilience import RecoveryDriver
+            driver = RecoveryDriver(pol, st, "host-cg")
 
         tstart = time.perf_counter()
         st.bnrm2 = float(np.linalg.norm(b))
@@ -86,15 +109,50 @@ class HostCGSolver:
         st.nsolves += 1
         converged = (not crit.unbounded) and self._test(crit, st, res_tol)
         k = 0
+
+        def _breakdown(why: str):
+            """Detected-breakdown restart (eager twin of the compiled
+            solvers' recovery, same RecoveryDriver bookkeeping):
+            recompute the true residual from the last finite iterate and
+            rebuild the Krylov space; raise once the policy's restarts
+            are exhausted."""
+            nonlocal x, r, p, gamma
+            if not driver.on_breakdown(k):
+                st.tsolve += time.perf_counter() - tstart
+                st.converged = False
+                st.fexcept_arrays = [x, r]
+                raise driver.give_up(k, st.rnrm2)
+            if not np.isfinite(x).all():
+                x = (np.array(x0, dtype=np.float64, copy=True)
+                     if x0 is not None else np.zeros(n))
+                driver.record("iterate non-finite; restarting from the "
+                              "initial guess")
+            r = b - A @ x
+            p = r.copy()
+            gamma = float(r @ r)
+            st.rnrm2 = float(np.sqrt(gamma))
+
         while not converged and k < crit.maxits:
             t0 = time.perf_counter()
             t = A @ p
+            if fault is not None:
+                t = fault.apply_spmv_np(t, k)
             self._op("gemv", time.perf_counter() - t0,
                      self.nnz_full * (dbl + 4) + 2 * n * dbl, 3.0 * self.nnz_full)
 
             t0 = time.perf_counter()
             pdott = float(p @ t)
+            if fault is not None:
+                pdott = fault.apply_dot_np(pdott, k)
             self._op("dot", time.perf_counter() - t0, 2 * n * dbl, 2.0 * n)
+            if detect and (not np.isfinite(pdott)
+                           or (pdott <= 0.0 and gamma > 0.0)):
+                k += 1
+                st.niterations = k
+                st.ntotaliterations += 1
+                _breakdown("non-finite or non-positive p^T A p")
+                converged = self._test(crit, st, res_tol)
+                continue
             if pdott == 0.0:
                 if gamma == 0.0:
                     # r = p = 0: exactly converged (reachable in
@@ -119,6 +177,13 @@ class HostCGSolver:
             t0 = time.perf_counter()
             gamma_next = float(r @ r)
             self._op("nrm2", time.perf_counter() - t0, n * dbl, 2.0 * n)
+            if detect and not np.isfinite(gamma_next):
+                k += 1
+                st.niterations = k
+                st.ntotaliterations += 1
+                _breakdown("non-finite residual")
+                converged = self._test(crit, st, res_tol)
+                continue
             beta = gamma_next / gamma
             gamma = gamma_next
             if crit.needs_diff:

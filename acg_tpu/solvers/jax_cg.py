@@ -33,7 +33,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from acg_tpu.errors import NotConvergedError
+from acg_tpu.errors import AcgError, ErrorCode, NotConvergedError
 from acg_tpu.ops.precision import dot2
 from acg_tpu.ops.spmv import (DeviceMatrix, DiaMatrix, acc_dtype,
                               matrix_dtype, matrix_index_bytes, spmv,
@@ -105,11 +105,17 @@ def _scalar_setup(dtype, precise: bool):
 
 @functools.partial(jax.tree_util.register_dataclass,
                    data_fields=["x", "niterations", "rnrm2", "r0nrm2",
-                                "bnrm2", "x0nrm2", "dxnrm2", "converged"],
+                                "bnrm2", "x0nrm2", "dxnrm2", "converged",
+                                "breakdown"],
                    meta_fields=[])
 @dataclasses.dataclass
 class CGResult:
-    """Device-resident solve result (one host transfer at the end)."""
+    """Device-resident solve result (one host transfer at the end).
+
+    ``breakdown`` is the detector flag (``detect=True`` programs): the
+    loop exited because the residual went non-finite or (p, Ap)
+    non-positive -- the host recovery policy (solvers.resilience)
+    decides restart-vs-abort.  Always False when detection is off."""
 
     x: jax.Array
     niterations: jax.Array
@@ -119,6 +125,7 @@ class CGResult:
     x0nrm2: jax.Array
     dxnrm2: jax.Array
     converged: jax.Array
+    breakdown: jax.Array
 
 
 def _tolerances(crit: StoppingCriteria, r0nrm2, x0nrm2, dtype):
@@ -137,9 +144,24 @@ def _converged(rnrm2sqr, dxnrm2sqr, res_tol, diff_tol):
     return ok
 
 
+def _breakdown_guard(gamma, denom):
+    """``(bad, alpha)``: the ONE breakdown predicate every detecting
+    loop shares -- non-finite gamma/denominator, or a non-positive
+    denominator while progress remains -- and the guarded step size
+    (a jnp.where select, NOT a zeroed multiply: 0 * inf is NaN, so a
+    multiplied-out alpha would still poison the frozen vectors)."""
+    bad = ((~jnp.isfinite(denom)) | (~jnp.isfinite(gamma))
+           | ((denom <= 0) & (gamma > 0)))
+    return bad, jnp.where(bad, jnp.zeros_like(gamma), gamma / denom)
+
+
 def _iterate(iter_body, init_state, gamma_of, maxits, res_tol,
-             diff_tol, dx_of, unbounded: bool, init_gamma=None):
+             diff_tol, dx_of, unbounded: bool, init_gamma=None,
+             bad_of=None):
     """Run the CG iteration to maxits (traced scalar) or convergence.
+
+    ``iter_body(k, state)`` receives the 0-based iteration index -- the
+    hook the deterministic fault injector (acg_tpu.faults) keys on.
 
     Loop-structure choice, measured on TPU v5e (poisson2d n=2048, f32):
       * `fori_loop` with a *traced* bound and a minimal carry runs at the
@@ -155,38 +177,54 @@ def _iterate(iter_body, init_state, gamma_of, maxits, res_tol,
     (``cgcuda.c:980-1052``) -- and tolerance-driven solves pay for the
     per-iteration device-side test exactly like the reference's
     device-initiated variant (``cg-kernels-cuda.cu:948-957``).
+
+    ``bad_of`` (breakdown detection, ``detect=True`` programs) reads the
+    carried breakdown flag; a flagged state exits the loop early so the
+    host recovery policy can act.  Detection forces the while path even
+    for unbounded solves -- the ~+0.2 ms/iter predicate cost is the
+    price of early exit, paid only when recovery is requested.
     """
-    if unbounded:
-        state = jax.lax.fori_loop(0, maxits,
-                                  lambda _, s: iter_body(s), init_state)
+    if unbounded and bad_of is None:
+        state = jax.lax.fori_loop(0, maxits, iter_body, init_state)
         return maxits, state, jnp.asarray(True)
 
     def body(carry):
         k, state, _ = carry
-        state = iter_body(state)
-        done = _converged(gamma_of(state), dx_of(state), res_tol, diff_tol)
+        state = iter_body(k, state)
+        done = (jnp.asarray(False) if unbounded else
+                _converged(gamma_of(state), dx_of(state), res_tol, diff_tol))
         return (k + 1, state, done)
 
     def cond(carry):
-        return (~carry[2]) & (carry[0] < maxits)
+        go = (~carry[2]) & (carry[0] < maxits)
+        if bad_of is not None:
+            go = go & (~bad_of(carry[1]))
+        return go
 
     # init_gamma overrides the carried value for the entry test: the
     # pipelined recurrence carries gamma_prev = inf at entry, but an
     # already-converged start (r0 = 0) must return x0 in 0 iterations,
     # not divide 0/0 in the first update.
-    init_done = _converged(
+    init_done = (jnp.asarray(False) if unbounded else _converged(
         gamma_of(init_state) if init_gamma is None else init_gamma,
-        dx_of(init_state), res_tol, diff_tol)
-    return jax.lax.while_loop(cond, body,
-                              (jnp.int32(0), init_state, init_done))
+        dx_of(init_state), res_tol, diff_tol))
+    k, state, done = jax.lax.while_loop(cond, body,
+                                        (jnp.int32(0), init_state,
+                                         init_done))
+    if unbounded:
+        # unbounded semantics: "converged" = ran the full budget without
+        # a detected breakdown (the only early exit on this path)
+        done = ~bad_of(state)
+    return k, state, done
 
 
 @functools.partial(jax.jit,
                    static_argnames=("unbounded", "needs_diff", "precise",
-                                    "kernels"))
+                                    "kernels", "detect", "fault"))
 def _cg_program(A: DeviceMatrix, b, x0, res_atol, res_rtol, diff_atol,
                 diff_rtol, maxits, unbounded: bool, needs_diff: bool,
-                precise: bool = False, kernels: str = "xla"):
+                precise: bool = False, kernels: str = "xla",
+                detect: bool = False, fault=None):
     """Whole classic-CG solve as one XLA program.
 
     ``precise`` switches the CG scalars' dot products to the compensated
@@ -194,7 +232,14 @@ def _cg_program(A: DeviceMatrix, b, x0, res_atol, res_rtol, diff_atol,
     (p, t), which is what lets plain-f32 storage converge past the
     ~1e-6 relative-residual stall.  bf16 storage keeps every scalar in
     f32 (``_scalar_setup``) and rounds the updated vectors once on
-    store, so only half-width bytes cross HBM."""
+    store, so only half-width bytes cross HBM.
+
+    ``detect`` (the resilience tier) carries a breakdown flag: a
+    non-finite gamma or non-positive (p, Ap) FREEZES the iterate --
+    alpha/beta would otherwise launder the poison into x -- and exits
+    the loop so the host recovery policy can restart from the last good
+    x.  ``fault`` is a static acg_tpu.faults.FaultSpec the injector
+    threads into the loop (None compiles the unchanged program)."""
     dtype = b.dtype
     dot, sdt = _scalar_setup(dtype, precise)
     store = (lambda v: v.astype(dtype)) if sdt != dtype else (lambda v: v)
@@ -211,7 +256,7 @@ def _cg_program(A: DeviceMatrix, b, x0, res_atol, res_rtol, diff_atol,
 
     # dxsqr joins the carry only when a diff criterion is active: every
     # extra loop-carried scalar measurably slows the TPU loop (~0.1 ms/it)
-    def body(state):
+    def body(k, state):
         x, r, p, gamma = state[:4]
         # NOT the fused dia_spmv_dot: measured in-loop, the in-kernel
         # (p,t) scalar costs ~15% (1,355 vs 1,589 iters/s interleaved
@@ -219,28 +264,57 @@ def _cg_program(A: DeviceMatrix, b, x0, res_atol, res_rtol, diff_atol,
         # the updates, the same verdict as the fused 6-vector update
         # (BASELINE.md)
         t = spmv_(A, p)
+        if fault is not None:
+            t = fault.apply_spmv(t, k)
         pdott = dot(p, t)
-        alpha = gamma / pdott
-        x = store(x + alpha * p)
-        r = store(r - alpha * t)
+        if fault is not None:
+            pdott = fault.apply_dot(pdott, k)
+        if detect:
+            # breakdown BEFORE the updates: a non-finite t/pdott or an
+            # indefiniteness signal must not reach x
+            bad, alpha = _breakdown_guard(gamma, pdott)
+            x = store(jnp.where(bad, x, x + alpha * p))
+            r = store(jnp.where(bad, r, r - alpha * t))
+        else:
+            alpha = gamma / pdott
+            x = store(x + alpha * p)
+            r = store(r - alpha * t)
         gamma_next = dot(r, r)
         beta = gamma_next / gamma
         p_next = store(r + beta * p)
+        out = (x, r, p_next, gamma_next)
         if needs_diff:
-            return (x, r, p_next, gamma_next,
-                    alpha * alpha * dot(p, p))
-        return (x, r, p_next, gamma_next)
+            dx = alpha * alpha * dot(p, p)
+            if detect:
+                # freeze dx too: a zeroed alpha would make the frozen
+                # iteration "satisfy" the diff criterion and launder the
+                # breakdown into a converged exit
+                dx = jnp.where(bad, state[4], dx)
+            out = out + (dx,)
+        if detect:
+            # a poison that slipped past pdott (e.g. a NaN row of t with
+            # a finite dot) lands in r: flag it one iteration deferred
+            out = out + (bad | (~jnp.isfinite(gamma_next)),)
+        return out
 
     init_state = (x0, r, p, gamma) + ((inf,) if needs_diff else ())
+    if detect:
+        init_state = init_state + (jnp.asarray(False),)
     k, state, done = _iterate(
         body, init_state, lambda s: s[3], maxits,
         res_tol, diff_tol, (lambda s: s[4]) if needs_diff else (lambda s: inf),
-        unbounded)
+        unbounded, bad_of=(lambda s: s[-1]) if detect else None)
     x, r, p, gamma = state[:4]
     dxsqr = state[4] if needs_diff else inf
+    breakdown = state[-1] if detect else jnp.asarray(False)
+    # a breakdown flagged on the same iteration the tolerance was met is
+    # convergence, not breakdown: at the f32 floor the (p, Ap) scalar
+    # legitimately rounds to <= 0 once progress is exhausted
+    breakdown = breakdown & ~done
     return CGResult(x=x, niterations=k, rnrm2=jnp.sqrt(gamma),
                     r0nrm2=r0nrm2, bnrm2=bnrm2, x0nrm2=x0nrm2,
-                    dxnrm2=jnp.sqrt(dxsqr), converged=done)
+                    dxnrm2=jnp.sqrt(dxsqr), converged=done,
+                    breakdown=breakdown)
 
 
 @functools.partial(jax.jit,
@@ -380,9 +454,13 @@ def _cg_replaced_program(A: DeviceMatrix, b, x0, res_atol, res_rtol,
 
         x32, r32f, _, its, gamma_f = jax.lax.fori_loop(
             0, nouter, obody, (x0, r32, p0, jnp.int32(0), gamma32))
+        # per-segment true-residual breakdown flag: the replacement
+        # machinery IS this tier's detector (a poisoned segment leaves a
+        # non-finite recomputed residual), no in-loop cost
         return CGResult(x=x32, niterations=its, rnrm2=jnp.sqrt(gamma_f),
                         r0nrm2=r0nrm2, bnrm2=bnrm2, x0nrm2=x0nrm2,
-                        dxnrm2=inf, converged=jnp.asarray(True))
+                        dxnrm2=inf, converged=jnp.isfinite(gamma_f),
+                        breakdown=~jnp.isfinite(gamma_f))
 
     def wcond(carry):
         _, _, _, its, gamma = carry
@@ -394,9 +472,12 @@ def _cg_replaced_program(A: DeviceMatrix, b, x0, res_atol, res_rtol,
 
     x32, r32f, _, its, gamma_f = jax.lax.while_loop(
         wcond, wbody, (x0, r32, p0, jnp.int32(0), gamma32))
+    # a non-finite recomputed residual exits wcond (NaN >= x is False):
+    # the segment boundary doubles as the breakdown detector for free
     return CGResult(x=x32, niterations=its, rnrm2=jnp.sqrt(gamma_f),
                     r0nrm2=r0nrm2, bnrm2=bnrm2, x0nrm2=x0nrm2,
-                    dxnrm2=inf, converged=gamma_f < res_tol * res_tol)
+                    dxnrm2=inf, converged=gamma_f < res_tol * res_tol,
+                    breakdown=~jnp.isfinite(gamma_f))
 
 
 @functools.partial(jax.jit,
@@ -454,17 +535,25 @@ def _cg_fused_program(A: DeviceMatrix, b, x0, res_atol, res_rtol,
     x, r_fin, _, gamma_fin, _ = state
     return CGResult(x=x, niterations=k, rnrm2=jnp.sqrt(gamma_fin),
                     r0nrm2=r0nrm2, bnrm2=bnrm2, x0nrm2=x0nrm2,
-                    dxnrm2=inf, converged=done)
+                    dxnrm2=inf, converged=done,
+                    breakdown=jnp.asarray(False))
 
 
 @functools.partial(jax.jit,
                    static_argnames=("unbounded", "needs_diff", "precise",
-                                    "kernels"))
+                                    "kernels", "detect", "fault"))
 def _cg_pipelined_program(A: DeviceMatrix, b, x0, res_atol, res_rtol,
                           diff_atol, diff_rtol, maxits, unbounded: bool,
                           needs_diff: bool, precise: bool = False,
-                          kernels: str = "xla"):
-    """Whole pipelined-CG (Ghysels-Vanroose) solve as one XLA program."""
+                          kernels: str = "xla", detect: bool = False,
+                          fault=None):
+    """Whole pipelined-CG (Ghysels-Vanroose) solve as one XLA program.
+
+    ``detect``/``fault`` as in :func:`_cg_program`.  The pipelined
+    recurrences are the brittle ones (deep pipelining amplifies rounding
+    -- Cornelis & Vanroose, arXiv:1801.04728), and a poisoned q/w shows
+    up one iteration deferred in the (w, r) reduction: detection here is
+    inherently one iteration stale, like the convergence test."""
     dtype = b.dtype
     dot, sdt = _scalar_setup(dtype, precise)
     store = (lambda v: v.astype(dtype)) if sdt != dtype else (lambda v: v)
@@ -479,16 +568,26 @@ def _cg_pipelined_program(A: DeviceMatrix, b, x0, res_atol, res_rtol,
     inf = jnp.asarray(jnp.inf, sdt)
     zeros = jnp.zeros_like(b)
 
-    def body(state):
+    def body(k, state):
         x, r, w, p, t, z, gamma_prev, alpha_prev = state[:8]
         # both reductions of the iteration, fused (one allreduce on a mesh)
         gamma = dot(r, r)
         delta = dot(w, r)
+        if fault is not None:
+            delta = fault.apply_dot(delta, k)
         # SpMV overlaps the allreduce in the reference (cgcuda.c:1750-1790);
         # under XLA the scheduler owns that overlap.
         q = spmv_(A, w)
+        if fault is not None:
+            q = fault.apply_spmv(q, k)
         beta = gamma / gamma_prev               # inf -> 0 on first iteration
-        alpha = gamma / (delta - beta * (gamma / alpha_prev))
+        denom = delta - beta * (gamma / alpha_prev)
+        if detect:
+            # the alpha denominator plays the (p, Ap) role here; freeze
+            # x/r/w on breakdown (p/t/z are scratch once the loop exits)
+            bad, alpha = _breakdown_guard(gamma, denom)
+        else:
+            alpha = gamma / denom
         # the 6-vector update stays in XLA even under kernels="pallas":
         # the hand-written fused kernel (ops.pallas_kernels.
         # fused_pipelined_update) wins in isolation (~1.35x) but inside
@@ -498,33 +597,53 @@ def _cg_pipelined_program(A: DeviceMatrix, b, x0, res_atol, res_rtol,
         z = store(q + beta * z)
         t = store(w + beta * t)
         p = store(r + beta * p)
-        x = store(x + alpha * p)
-        r = store(r - alpha * t)
-        w = store(w - alpha * z)
+        if detect:
+            x = store(jnp.where(bad, x, x + alpha * p))
+            r = store(jnp.where(bad, r, r - alpha * t))
+            w = store(jnp.where(bad, w, w - alpha * z))
+        else:
+            x = store(x + alpha * p)
+            r = store(r - alpha * t)
+            w = store(w - alpha * z)
+        out = (x, r, w, p, t, z, gamma, alpha)
         if needs_diff:
-            return (x, r, w, p, t, z, gamma, alpha,
-                    alpha * alpha * dot(p, p))
-        return (x, r, w, p, t, z, gamma, alpha)
+            dx = alpha * alpha * dot(p, p)
+            if detect:
+                # freeze dx on breakdown (see _cg_program): alpha = 0
+                # must not fake the diff criterion
+                dx = jnp.where(bad, state[8], dx)
+            out = out + (dx,)
+        if detect:
+            out = out + (bad,)
+        return out
 
     # convergence tests the carried gamma = ||r||^2 from *before* the
     # update -- one iteration stale, the reference's deferred test
     # (cgcuda.c:1798-1810); saves a fresh dot per iteration
     init_state = (x0, r, w, zeros, zeros, zeros, inf, inf) + (
         (inf,) if needs_diff else ())
+    if detect:
+        init_state = init_state + (jnp.asarray(False),)
     k, state, done = _iterate(
         body, init_state, lambda s: s[6], maxits,
         res_tol, diff_tol, (lambda s: s[8]) if needs_diff else (lambda s: inf),
-        unbounded, init_gamma=r0nrm2 * r0nrm2)
+        unbounded, init_gamma=r0nrm2 * r0nrm2,
+        bad_of=(lambda s: s[-1]) if detect else None)
     x, r = state[0], state[1]
     dxsqr = state[8] if needs_diff else inf
+    breakdown = state[-1] if detect else jnp.asarray(False)
     rnrm2 = jnp.sqrt(dot(r, r))
     # the in-loop test is one iteration stale; at the maxits boundary a
     # solve whose final *fresh* residual meets tolerance must not report
     # converged=False with a below-tolerance rnrm2 in the same stats block
     done = jnp.logical_or(done, rnrm2 <= res_tol)
+    # ... and a breakdown whose frozen residual already meets tolerance
+    # is convergence: near the floor the pipelined denominator
+    # legitimately rounds <= 0 (the recurrences' known brittleness)
+    breakdown = breakdown & ~done
     return CGResult(x=x, niterations=k, rnrm2=rnrm2, r0nrm2=r0nrm2,
                     bnrm2=bnrm2, x0nrm2=x0nrm2, dxnrm2=jnp.sqrt(dxsqr),
-                    converged=done)
+                    converged=done, breakdown=breakdown)
 
 
 class JaxCGSolver:
@@ -538,8 +657,17 @@ class JaxCGSolver:
     def __init__(self, A: DeviceMatrix, pipelined: bool = False,
                  precise_dots: bool = False, kernels: str = "auto",
                  vector_dtype=None, replace_every: int = 0,
-                 replace_restart: bool = True):
-        """``vector_dtype`` decouples vector storage from matrix storage
+                 replace_restart: bool = True, recovery=None,
+                 host_matrix=None):
+        """``recovery`` (a :class:`acg_tpu.solvers.resilience.
+        RecoveryPolicy`) arms breakdown detection in the compiled loop
+        plus the host-side restart policy; ``host_matrix`` (scipy CSR)
+        additionally enables the final host-solver fallback rung.
+        Detection also arms automatically while the fault injector
+        (acg_tpu.faults) is active, so injected faults are never
+        silently laundered into a returned x.
+
+        ``vector_dtype`` decouples vector storage from matrix storage
         (default: the matrix dtype).  The supported mix is bf16 matrix +
         f32 vectors (``--dtype mixed``): for matrices whose entries are
         exactly representable in bf16 (Poisson stencils: -1, 4, 6) the
@@ -634,6 +762,8 @@ class JaxCGSolver:
                                  "two-phase iteration has no replacement "
                                  "hook)")
         self.kernels = kernels
+        self.recovery = recovery
+        self.host_matrix = host_matrix
         self.stats = SolverStats(unknowns=A.nrows)
         # the matrix the solve PROGRAMS consume; defaults to A.  The
         # sharded pallas-roll tier swaps in a per-shard-padded twin
@@ -665,6 +795,39 @@ class JaxCGSolver:
         crit = criteria or StoppingCriteria()
         st = self.stats
         st.criteria = crit
+        from acg_tpu import faults
+        fault = faults.device_fault()
+        if fault is not None and fault.site == "halo":
+            # no halo exists on the single-device solver: an armed
+            # injector that can never fire must refuse, not report a
+            # clean "fault-tested" solve (the replace_every rationale)
+            raise AcgError(
+                ErrorCode.INVALID_VALUE,
+                "halo fault injection needs a distributed problem with "
+                "ghost exchange (DistCGSolver, nparts > 1); the "
+                "single-device solver has no halo to poison")
+        if fault is not None and fault.part > 0:
+            # _fault_nparts distinguishes the true single-device solver
+            # from multi-part subclasses that reuse this solve (the
+            # sharded roll tier): NEITHER can honour part targeting --
+            # these programs apply faults to the global vector -- but
+            # the diagnosis must name the right reason
+            if getattr(self, "_fault_nparts", 1) == 1:
+                raise AcgError(
+                    ErrorCode.INVALID_VALUE,
+                    f"fault spec targets part {fault.part}, but the "
+                    f"single-device solver has only part 0 -- the fault "
+                    f"could never fire")
+            raise AcgError(
+                ErrorCode.INVALID_VALUE,
+                f"the sharded single-program tier applies faults to the "
+                f"global vector and cannot target part {fault.part}; "
+                f"drop part= or use the partitioned DistCGSolver path "
+                f"for part-targeted injection")
+        # detection arms with the recovery policy OR an active injector
+        # (an injected fault must surface, never launder into x); the
+        # detect=False programs stay byte-identical to the seed's
+        detect = self.recovery is not None or fault is not None
         dtype = matrix_dtype(self.A)
         if self.vector_dtype is not None:
             dtype = jnp.dtype(self.vector_dtype)
@@ -683,6 +846,16 @@ class JaxCGSolver:
                 raise ValueError("replace_every supports residual "
                                  "criteria only (the diff criterion has "
                                  "no meaning across replacement segments)")
+            if fault is not None:
+                # the replacement program's inner fori does not thread a
+                # global iteration index, so an armed injector would
+                # silently never fire -- refuse rather than report a
+                # clean solve the operator believes was fault-tested
+                raise AcgError(
+                    ErrorCode.INVALID_VALUE,
+                    "fault injection does not reach the replacement-"
+                    "segment program (replace_every); inject into the "
+                    "direct classic/pipelined programs instead")
             program = _cg_replaced_program
             args = (self._A_program, b, x0,
                     jnp.asarray(crit.residual_atol, sdt),
@@ -696,6 +869,14 @@ class JaxCGSolver:
             if crit.needs_diff:
                 raise ValueError("kernels='fused' supports residual "
                                  "criteria only")
+            if detect:
+                raise AcgError(
+                    ErrorCode.INVALID_VALUE,
+                    "kernels='fused' folds its scalars into "
+                                 "the two streamed kernels and has no "
+                                 "breakdown-detection hook; recovery/"
+                                 "fault injection need kernels='xla'/"
+                                 "'pallas'")
             program = _cg_fused_program
             args = (self._A_program, b, x0,
                     jnp.asarray(crit.residual_atol, sdt),
@@ -713,7 +894,8 @@ class JaxCGSolver:
                     jnp.int32(crit.maxits))
             kwargs = dict(unbounded=crit.unbounded,
                           needs_diff=crit.needs_diff,
-                          precise=self.precise_dots, kernels=self.kernels)
+                          precise=self.precise_dots, kernels=self.kernels,
+                          detect=detect, fault=fault)
         # warmup solves outside the timed region (the reference warms up
         # each op class before timing, cgcuda.c:612-710).  device_sync,
         # not bare block_until_ready: the tunneled backend has been
@@ -726,15 +908,64 @@ class JaxCGSolver:
         t0 = time.perf_counter()
         res = program(*args, **kwargs)
         device_sync(res.x)
-        st.tsolve += time.perf_counter() - t0
-
         niter = int(res.niterations)
+        first_norms = None
+        if detect and bool(res.breakdown):
+            # host-side recovery (solvers.resilience): bounded restarts
+            # from the last finite iterate -- the program's setup
+            # recomputes the TRUE residual r = b - A x0, so each restart
+            # discards the poisoned recurrence state -- then the host-
+            # solver fallback, then a diagnosis-carrying raise
+            from acg_tpu.solvers.resilience import RecoveryDriver
+            driver = RecoveryDriver(self.recovery, st, "jax-cg")
+            x0_dev = args[2]
+            # the stats block reports the ORIGINAL solve's norms; the
+            # restarted attempts' r0/x0 are recovery internals
+            first_norms = (float(res.bnrm2), float(res.x0nrm2),
+                           float(res.r0nrm2))
+            # restarts keep the FIRST attempt's residual target: the
+            # rtol baseline is r0 of the original x0, not of the restart
+            # (re-baselining would demand an unreachable 1e-6 reduction
+            # of an already-small restart residual)
+            abs_tol = max(crit.residual_atol,
+                          crit.residual_rtol * float(res.r0nrm2))
+            while bool(res.breakdown):
+                k_done = int(res.niterations)
+                if driver.on_breakdown(k_done):
+                    x_next = res.x
+                    if not bool(jnp.isfinite(x_next).all()):
+                        driver.record("iterate non-finite; restarting "
+                                      "from the initial guess")
+                        x_next = x0_dev
+                    if fault is not None and "fault" in kwargs:
+                        fault = fault.shift(k_done)
+                        kwargs["fault"] = fault
+                    remaining = max(crit.maxits - niter, 1)
+                    args = (args[:2] + (x_next,)
+                            + (jnp.asarray(abs_tol, sdt),
+                               jnp.asarray(0.0, sdt)) + args[5:-1]
+                            + (jnp.int32(remaining),))
+                    res = program(*args, **kwargs)
+                    device_sync(res.x)
+                    niter += int(res.niterations)
+                    continue
+                pol = self.recovery
+                if (pol is not None and pol.fallback_host
+                        and self.host_matrix is not None):
+                    driver.on_fallback("fallback: host reference solver")
+                    st.tsolve += time.perf_counter() - t0
+                    return self._host_fallback(
+                        b, crit, raise_on_divergence, host_result)
+                st.tsolve += time.perf_counter() - t0
+                st.converged = False
+                raise driver.give_up(niter, float(res.rnrm2))
+        st.tsolve += time.perf_counter() - t0
         st.nsolves += 1
         st.niterations = niter
         st.ntotaliterations += niter
-        st.bnrm2 = float(res.bnrm2)
-        st.x0nrm2 = float(res.x0nrm2)
-        st.r0nrm2 = float(res.r0nrm2)
+        st.bnrm2, st.x0nrm2, st.r0nrm2 = (
+            first_norms if first_norms is not None
+            else (float(res.bnrm2), float(res.x0nrm2), float(res.r0nrm2)))
         st.rnrm2 = float(res.rnrm2)
         st.dxnrm2 = float(res.dxnrm2)
         st.converged = bool(res.converged) or crit.unbounded
@@ -793,3 +1024,20 @@ class JaxCGSolver:
             raise NotConvergedError(
                 f"{niter} iterations, residual {st.rnrm2:.3e}")
         return x
+
+    def _host_fallback(self, b, crit, raise_on_divergence: bool,
+                       host_result: bool):
+        """The last recovery rung: re-solve on the host reference solver
+        (f64 numpy) from the ORIGINAL b -- the device state is junk by
+        definition here.  Stats for the last solve reflect the host run;
+        the op-class byte accounting keeps the device attempts."""
+        from acg_tpu import faults
+        from acg_tpu.solvers.host_cg import HostCGSolver
+        from acg_tpu.solvers.resilience import adopt_host_stats
+
+        hs = HostCGSolver(self.host_matrix)
+        with faults.suppressed():
+            x = hs.solve(np.asarray(b, np.float64), criteria=crit,
+                         raise_on_divergence=raise_on_divergence)
+        adopt_host_stats(self.stats, hs.stats)
+        return x if host_result else jnp.asarray(x)

@@ -174,9 +174,11 @@ def _profile_dist(solver, b, reps: int) -> dict[str, float]:
 
     tiny = jnp.asarray(1e-30, prob.vdtype)
 
+    from acg_tpu._platform import shard_map as _shard_map
+
     def smap(body, in_specs):
-        return jax.shard_map(body, mesh=mesh, in_specs=in_specs,
-                             out_specs=pspec, check_vma=False)
+        return _shard_map(body, mesh=mesh, in_specs=in_specs,
+                          out_specs=pspec)
 
     # every op is expressed as x -> x' (shape/sharding preserved) so
     # _chain can amortise INNER executions inside one program; scalarish

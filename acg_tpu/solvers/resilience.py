@@ -1,0 +1,147 @@
+"""Breakdown-recovery policy for the CG solvers.
+
+Pipelined and reduced-precision CG are numerically brittle: deep
+pipelining and rounded recurrences can drive the residual non-finite or
+(p, Ap) non-positive mid-solve (Cornelis & Vanroose, arXiv:1801.04728;
+Cools et al., arXiv:1905.06850), and on a mesh a flaky transport can
+inject the same poison from outside the arithmetic.  The standard
+hardening move is detected-breakdown restart: the jitted loops flag the
+breakdown in solver state (``detect=True`` programs in
+:mod:`acg_tpu.solvers.jax_cg` / :mod:`acg_tpu.parallel.dist`), exit
+early, and a HOST-side policy -- this module -- decides what happens
+next:
+
+  1. bounded restarts with backoff: re-enter the solve from the last
+     finite iterate; the program's setup recomputes the TRUE residual
+     ``r = b - A x0``, so the restart discards the poisoned recurrence
+     state the same way the bf16 tier's replacement segments do;
+  2. transport fallback (distributed): a second breakdown under
+     ``comm="dma"`` retires the one-sided transport for the solve and
+     rebuilds the program on the ``"xla"`` collectives;
+  3. final fallback to the host reference solver when a matrix is
+     available there;
+  4. multi-controller: every restart/abort decision passes through the
+     error-agreement checkpoint (:func:`acg_tpu.parallel.erragree.
+     agree_status`), so all controllers restart or abort in unison
+     instead of one looping while its peers wedge in a collective.
+
+Every detection, restart, and fallback is counted on
+:class:`acg_tpu.solvers.stats.SolverStats` and surfaced in the CLI
+stats block.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import sys
+import time
+
+from acg_tpu.errors import BreakdownError
+
+
+@dataclasses.dataclass(frozen=True)
+class RecoveryPolicy:
+    """Host-side knobs for detected-breakdown recovery.
+
+    ``max_restarts`` bounds the re-entries per solve (0 = detect only:
+    a breakdown raises immediately).  ``backoff`` sleeps before the
+    n-th restart for ``backoff * 2**(n-1)`` seconds -- transient
+    environmental faults (a flaky link) get time to clear, numerical
+    breakdowns restart immediately at the default 0.  ``fallback_comm``
+    allows retiring the DMA halo transport for XLA collectives;
+    ``fallback_host`` allows the final host-solver rung.
+    ``agree_timeout`` bounds the multi-controller restart agreement
+    (the ``--err-timeout`` role at recovery checkpoints)."""
+
+    max_restarts: int = 2
+    backoff: float = 0.0
+    fallback_comm: bool = True
+    fallback_host: bool = True
+    agree_timeout: float = 120.0
+
+
+def adopt_host_stats(st, host_stats) -> None:
+    """Fold a host-fallback solve's last-solve stats into the device
+    solver's accumulated stats -- shared by both fallback rungs so their
+    reports cannot drift apart."""
+    st.nsolves += 1
+    st.niterations = host_stats.niterations
+    st.ntotaliterations += host_stats.niterations
+    # the host re-solve usually DOMINATES the wall time of a
+    # fallen-back solve; dropping it would corrupt the timing evidence
+    st.tsolve += host_stats.tsolve
+    for f in ("bnrm2", "x0nrm2", "r0nrm2", "rnrm2", "dxnrm2",
+              "converged"):
+        setattr(st, f, getattr(host_stats, f))
+    st.fexcept_arrays = host_stats.fexcept_arrays
+
+
+class RecoveryDriver:
+    """Per-solve bookkeeping shared by the device solvers' restart loops.
+
+    Owns the attempt counter, the backoff sleeps, the stats counters,
+    and the cross-controller agreement; the solvers own program
+    re-invocation (their argument layouts differ)."""
+
+    def __init__(self, policy: RecoveryPolicy | None, stats, what: str):
+        self.policy = policy
+        self.stats = stats
+        self.what = what
+        self.restarts = 0
+
+    def record(self, event: str) -> None:
+        self.stats.recovery_log.append(event)
+        sys.stderr.write(f"acg-tpu: {self.what}: {event}\n")
+
+    def on_breakdown(self, niter: int) -> bool:
+        """Account one detected breakdown; returns True when the policy
+        grants a restart (after the backoff sleep), False when retries
+        are exhausted (caller falls back or raises).  Multi-controller,
+        the decision is ERROR-AGREED first: if any controller is out of
+        retries (or dead), every controller refuses the restart
+        together."""
+        st = self.stats
+        st.nbreakdowns += 1
+        pol = self.policy
+        want_restart = pol is not None and self.restarts < pol.max_restarts
+        if not self._agree(0 if want_restart else 1):
+            if want_restart:
+                self.record("restart vetoed: a peer controller cannot "
+                            "continue")
+            return False
+        if not want_restart:
+            return False
+        self.restarts += 1
+        st.nrestarts += 1
+        if pol.backoff > 0:
+            time.sleep(pol.backoff * (2 ** (self.restarts - 1)))
+        self.record(f"breakdown detected at iteration {niter}; "
+                    f"restart {self.restarts}/{pol.max_restarts} from "
+                    f"the recomputed true residual")
+        return True
+
+    def on_fallback(self, event: str) -> None:
+        self.stats.nfallbacks += 1
+        self.record(event)
+
+    def _agree(self, code: int) -> bool:
+        """Cross-controller restart-vs-abort agreement; True = every
+        controller can restart.  Single-process: the local verdict."""
+        import jax
+
+        if jax.process_count() == 1:
+            return code == 0
+        from acg_tpu.parallel.erragree import agree_status
+
+        timeout = (self.policy.agree_timeout if self.policy is not None
+                   else 120.0)
+        return agree_status(code, what=f"{self.what} recovery",
+                            timeout=timeout) == 0
+
+    def give_up(self, niter: int, rnrm2: float):
+        """The no-rungs-left exit: a diagnosis-carrying exception."""
+        return BreakdownError(
+            f"{self.what}: breakdown (non-finite residual or "
+            f"non-positive p^T A p) at iteration {niter}, residual "
+            f"{rnrm2:.3e}; {self.stats.nrestarts} restart(s) exhausted "
+            f"and no fallback available")

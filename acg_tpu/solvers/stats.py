@@ -91,6 +91,13 @@ class SolverStats:
     ops: dict = dataclasses.field(
         default_factory=lambda: {k: OpStats() for k in OP_CLASSES})
     fexcept_arrays: list = dataclasses.field(default_factory=list)
+    # resilience tier (solvers.resilience): detected breakdowns,
+    # host-policy restarts, and transport/solver fallbacks, with a
+    # human-readable event log surfaced in the report
+    nbreakdowns: int = 0
+    nrestarts: int = 0
+    nfallbacks: int = 0
+    recovery_log: list = dataclasses.field(default_factory=list)
 
     def fwrite(self, f=None, indent: int = 0) -> str:
         """Solver report, line-compatible with ``acgsolvercuda_fwrite``."""
@@ -130,6 +137,13 @@ class SolverStats:
         p(f"  residual 2-norm: {self.rnrm2:.15g}")
         p(f"  difference in solution iterates 2-norm: {self.dxnrm2:.15g}")
         p(f"  floating-point exceptions: {fexcept_str(*self.fexcept_arrays)}")
+        # resilience lines appear only when something happened, so the
+        # report stays byte-identical to the reference's on clean solves
+        if self.nbreakdowns or self.nrestarts or self.nfallbacks:
+            p(f"  resilience: {self.nbreakdowns} breakdowns detected, "
+              f"{self.nrestarts} restarts, {self.nfallbacks} fallbacks")
+            for ev in self.recovery_log:
+                p(f"    {ev}")
         text = out.getvalue()
         if f is not None:
             f.write(text)

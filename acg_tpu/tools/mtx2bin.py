@@ -40,10 +40,16 @@ def main(argv=None) -> int:
                         "automatically to solution output)")
     p.add_argument("--partition-binary", action="store_true",
                    help="the --partition file is binary")
-    p.add_argument("--one-based", action="store_true",
-                   help="the --partition vector numbers parts from 1 "
-                        "(Fortran/METIS one-based output); shifted to "
-                        "0-based before applying")
+    nb = p.add_mutually_exclusive_group()
+    nb.add_argument("--one-based", action="store_true",
+                    help="the --partition vector numbers parts from 1 "
+                         "(Fortran/METIS one-based output); shifted to "
+                         "0-based before applying")
+    nb.add_argument("--zero-based", action="store_true",
+                    help="the --partition vector numbers parts from 0; "
+                         "only needed when its minimum part is 1 (an "
+                         "empty part 0), which is otherwise ambiguous "
+                         "with one-based numbering and a hard error")
     # reference-parity flags (mtx2bin/mtx2bin.c:367-387)
     dt = p.add_mutually_exclusive_group()
     dt.add_argument("--double", dest="datatype", action="store_const",
@@ -106,16 +112,18 @@ def main(argv=None) -> int:
                 p.error(f"--one-based given but the partition vector "
                         f"contains part {part.min()}")
             part = part - 1
-        elif part.size and part.min() == 1:
+        elif part.size and part.min() == 1 and not args.zero_based:
             # ambiguous: could be a 1-based vector OR a 0-based one
             # whose part 0 happens to be empty.  Guessing silently
-            # renumbered every part (round-4 advisor finding); warn and
-            # leave the numbering alone.
-            sys.stderr.write(
-                "mtx2bin: warning: partition vector has min part 1 -- "
-                "if it is one-based (Fortran/METIS), rerun with "
-                "--one-based; treating it as 0-based with an empty "
-                "part 0\n")
+            # renumbered every part (round-4 advisor finding), and the
+            # round-5 advice upgraded the easy-to-miss warning to a
+            # hard error: the two readings permute the matrix
+            # differently, so the user must say which they mean.
+            p.error(
+                "partition vector has min part 1: ambiguous between "
+                "one-based numbering (Fortran/METIS) and 0-based with "
+                "an empty part 0 -- rerun with --one-based or "
+                "--zero-based")
         t0 = time.perf_counter()
         mtx, bounds, perm = apply_partition_rowsorted(mtx, part)
         write_mtx(args.output + ".bounds.mtx",

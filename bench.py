@@ -787,36 +787,21 @@ def main(argv=None) -> int:
     # fail FAST when the tunneled backend is dead: its init has been
     # observed to hang ~15 minutes before raising UNAVAILABLE (round 5),
     # which would silently eat the driver's whole capture budget.  The
-    # probe runs in a CHILD process (a signal alarm cannot interrupt
-    # the stuck C-level init in-process); the emitted row
-    # self-describes the failure.
-    import subprocess
+    # bounded child-process probe now lives in _platform.probe_backend
+    # (shared with the CLI and dryrun_multichip); the emitted row
+    # self-describes the failure.  ACG_TPU_SKIP_BACKEND_PROBE opts out
+    # (drivers that just proved the backend alive themselves,
+    # scripts/r5_capture.sh -- the probe child is a full backend init,
+    # minutes of redundant wall-clock per ladder row over a tunnel).
+    from acg_tpu._platform import honour_jax_platforms, probe_backend
 
-    from acg_tpu._platform import honour_jax_platforms
-
-    if not os.environ.get("ACG_TPU_SKIP_BACKEND_PROBE"):
-        # opt-out for drivers that just proved the backend alive
-        # themselves (scripts/r5_capture.sh): the probe child is a full
-        # backend init, minutes of redundant wall-clock per ladder row
-        # over a tunneled chip
-        try:
-            probe = subprocess.run(
-                [sys.executable, "-c",
-                 "from acg_tpu._platform import honour_jax_platforms; "
-                 "honour_jax_platforms(); "  # CPU debug runs probe CPU
-                 "import jax; jax.devices(); print('ok')"],
-                capture_output=True, text=True, timeout=240,
-                cwd=os.path.dirname(os.path.abspath(__file__)))
-            backend_ok = probe.stdout.strip().endswith("ok")
-        except subprocess.TimeoutExpired:
-            backend_ok = False
-        if not backend_ok:
-            print(json.dumps({"metric": "bench_backend_unavailable",
-                              "value": 0, "unit": "iters/s",
-                              "error": "backend init failed or exceeded "
-                                       "240s (tunnel down?)"}))
-            sys.stdout.flush()
-            return 2
+    backend_ok, detail = probe_backend()
+    if not backend_ok:
+        print(json.dumps({"metric": "bench_backend_unavailable",
+                          "value": 0, "unit": "iters/s",
+                          "error": detail}))
+        sys.stdout.flush()
+        return 2
     # the PARENT must honour JAX_PLATFORMS too, or it initialises a
     # different backend than the one the probe just validated (the axon
     # plugin overrides the env var at import time)

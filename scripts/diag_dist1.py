@@ -37,6 +37,7 @@ def main() -> int:
     import jax.numpy as jnp
     import numpy as np
     from jax import lax
+    from acg_tpu._platform import shard_map as _shard_map
     from jax.sharding import NamedSharding, PartitionSpec as P
 
     from acg_tpu._platform import (device_sync, enable_compile_cache,
@@ -105,11 +106,11 @@ def main() -> int:
 
             @functools.partial(jax.jit, static_argnames="its")
             def prog(planes, b, its):
-                return jax.shard_map(
+                return _shard_map(
                     lambda p_, b_: cg_loop(
                         lambda v: dia_mv(p_, offsets, N, v), dot, b_, its),
                     mesh=mesh, in_specs=(P(PARTS_AXIS), P(PARTS_AXIS)),
-                    out_specs=P(PARTS_AXIS), check_vma=False)(planes, b)
+                    out_specs=P(PARTS_AXIS))(planes, b)
             return lambda its: device_sync(prog(planes_sh, b_sh, its))
         if variant == "smap_pad":
             def shard(p_, b_, its):
@@ -120,10 +121,10 @@ def main() -> int:
 
             @functools.partial(jax.jit, static_argnames="its")
             def prog(planes, b, its):
-                return jax.shard_map(
+                return _shard_map(
                     functools.partial(shard, its=its),
                     mesh=mesh, in_specs=(P(PARTS_AXIS), P(PARTS_AXIS)),
-                    out_specs=P(PARTS_AXIS), check_vma=False)(planes, b)
+                    out_specs=P(PARTS_AXIS))(planes, b)
             return lambda its: device_sync(prog(planes_st, b_st, its))
         if variant == "dist_fixed":
             rr, cc, vv, _ = poisson2d_coo(n)

@@ -41,7 +41,8 @@ def main() -> int:
     from jax.experimental.pallas import tpu as pltpu
     from jax.sharding import Mesh, PartitionSpec as P
 
-    shard_map = jax.shard_map
+    from acg_tpu._platform import shard_map  # version compat
+    from acg_tpu.parallel.halo_dma import _compiler_params
 
     d = jax.devices()[0]
     print(f"# platform: {d.platform} {d.device_kind}", file=sys.stderr)
@@ -72,12 +73,12 @@ def main() -> int:
             out_specs=pl.BlockSpec(memory_space=pl.ANY),
             scratch_shapes=[pltpu.SemaphoreType.DMA(()),
                             pltpu.SemaphoreType.DMA(())],
-            compiler_params=pltpu.CompilerParams(has_side_effects=True,
-                                                 collective_id=1),
+            compiler_params=_compiler_params(has_side_effects=True,
+                                             collective_id=1),
             interpret=False)(x)
 
     f = shard_map(selfput, mesh=mesh, in_specs=P("parts"),
-                  out_specs=P("parts"), check_vma=False)
+                  out_specs=P("parts"))
     x = jnp.arange(8 * 128, dtype=jnp.float32).reshape(1, 8, 128)
     out = jax.jit(f)(x)
     out.block_until_ready()

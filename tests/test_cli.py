@@ -52,8 +52,9 @@ def test_mtx2bin_roundtrip(matrix_file, tmp_path):
 
 def test_mtx2bin_one_based_partition(matrix_file, tmp_path):
     """--one-based shifts a Fortran/METIS-style partition vector; a
-    0-based vector whose part 0 is empty is no longer silently
-    renumbered (round-4 advisor finding), only warned about."""
+    vector whose min part is 1 is AMBIGUOUS and must be disambiguated
+    explicitly (--one-based / --zero-based) -- the round-4 silent
+    renumbering became a warning, the round-5 advice a hard error."""
     from acg_tpu.io.mtxfile import vector_mtx
 
     n = 144
@@ -73,15 +74,22 @@ def test_mtx2bin_one_based_partition(matrix_file, tmp_path):
     np.testing.assert_array_equal(bounds,
                                   np.concatenate([[0], np.cumsum(counts)]))
 
-    # ambiguous (min part == 1) without the flag: warn, do NOT shift
+    # ambiguous (min part == 1) without a flag: hard error naming both
+    # disambiguation flags
     out2 = tmp_path / "amb.bin.mtx"
     r2 = run_cli("acg_tpu.tools.mtx2bin",
                  [str(matrix_file), str(out2), "--expand",
                   "--partition", str(pf)])
-    assert r2.returncode == 0, r2.stderr
-    assert "one-based" in r2.stderr  # the warning names the flag
+    assert r2.returncode != 0
+    assert "--one-based" in r2.stderr and "--zero-based" in r2.stderr
+
+    # the same vector with --zero-based: accepted, numbering untouched
+    # (part 0 empty -> 4 parts with a zero-width first window)
+    r2b = run_cli("acg_tpu.tools.mtx2bin",
+                  [str(matrix_file), str(out2), "--expand",
+                   "--partition", str(pf), "--zero-based"])
+    assert r2b.returncode == 0, r2b.stderr
     b2 = np.asarray(read_mtx(str(out2) + ".bounds.mtx").vals).reshape(-1)
-    # part 0 empty -> 4 parts with a zero-width first window
     np.testing.assert_array_equal(
         b2, np.concatenate([[0, 0], np.cumsum(counts)]))
 

@@ -20,6 +20,7 @@ from acg_tpu.parallel.halo_dma import _exchange
 from acg_tpu.parallel.mesh import PARTS_AXIS, solve_mesh
 from acg_tpu.partition import partition_rows
 from acg_tpu.solvers.stats import StoppingCriteria
+from acg_tpu._platform import shard_map as _shard_map
 
 NDEV = len(jax.devices())
 
@@ -40,8 +41,8 @@ def test_exchange_routes_all_pairs():
     def body(sbuf, sc, rc):
         return _exchange(sbuf[0], sc[0], rc[0], PARTS_AXIS, True)[None]
 
-    f = jax.jit(jax.shard_map(body, mesh=mesh, in_specs=(pspec,) * 3,
-                              out_specs=pspec, check_vma=False))
+    f = jax.jit(_shard_map(body, mesh=mesh, in_specs=(pspec,) * 3,
+                              out_specs=pspec))
     out = np.asarray(f(jnp.asarray(sb), scnt, scnt))
     for p in range(nparts):
         for q in range(nparts):
@@ -72,8 +73,8 @@ def test_exchange_count_gating_ring():
         return _exchange(sbuf[0], sc[0], rc[0], PARTS_AXIS, True,
                          gate_by_counts=True)[None]
 
-    f = jax.jit(jax.shard_map(body, mesh=mesh, in_specs=(pspec,) * 3,
-                              out_specs=pspec, check_vma=False))
+    f = jax.jit(_shard_map(body, mesh=mesh, in_specs=(pspec,) * 3,
+                              out_specs=pspec))
     out = np.asarray(f(jnp.asarray(sb), jnp.asarray(scnt),
                        jnp.asarray(rcnt)))
     for p in range(nparts):
@@ -151,8 +152,8 @@ def test_exchange_count_gating_distance2():
         return _exchange(sbuf[0], sc[0], rc[0], PARTS_AXIS, True,
                          gate_by_counts=True)[None]
 
-    f = jax.jit(jax.shard_map(body, mesh=mesh, in_specs=(pspec,) * 3,
-                              out_specs=pspec, check_vma=False))
+    f = jax.jit(_shard_map(body, mesh=mesh, in_specs=(pspec,) * 3,
+                              out_specs=pspec))
     out = np.asarray(f(jnp.asarray(sb), jnp.asarray(scnt),
                        jnp.asarray(rcnt)))
     for p in range(nparts):
