@@ -232,6 +232,36 @@ def make_parser() -> argparse.ArgumentParser:
                         "filesystem can stagger 'ingest' arrivals by "
                         "minutes -- raise this accordingly or a healthy "
                         "but slow peer gets the pod aborted")
+    p.add_argument("--convergence-log", metavar="FILE", default=None,
+                   help="record per-iteration (rnrm2, alpha, beta, pAp) "
+                        "in a device-side ring buffer riding the "
+                        "compiled solve loop (fetched once with the "
+                        "result -- zero extra host transfers per "
+                        "iteration) and write it to FILE as JSONL: one "
+                        "meta line (wrap/truncation marked), one record "
+                        "per surviving iteration.  Window size: "
+                        "--telemetry-window.  Render with "
+                        "scripts/plot_convergence.py")
+    p.add_argument("--telemetry-window", type=int, default=512,
+                   metavar="N",
+                   help="ring-buffer capacity (iterations) for "
+                        "--convergence-log (default: 512; the trailing "
+                        "N iterations survive a longer solve)")
+    p.add_argument("--progress", type=int, default=0, metavar="K",
+                   help="heartbeat: print the residual 2-norm to stderr "
+                        "every K iterations FROM INSIDE the compiled "
+                        "solve loop (jax.debug callback) -- the "
+                        "liveness signal for long solves (default: off)")
+    p.add_argument("--stats-json", metavar="FILE", default=None,
+                   help="write a schema-versioned machine-readable twin "
+                        "of the stats block to FILE: run manifest "
+                        "(backend, mesh, kernel tier, comm transport, "
+                        "jax versions, matrix id, partition/halo "
+                        "sizes), per-op counters, phase timings, "
+                        "timestamped resilience/fault events, the "
+                        "convergence trace, and on multihost runs the "
+                        "cross-rank min/median/max + imbalance "
+                        "aggregation")
     p.add_argument("--profile-ops", nargs="?", const=10, type=int,
                    default=None, metavar="REPS",
                    help="fill the stats block's per-op seconds/GB/s by "
@@ -281,6 +311,8 @@ def _buildinfo(out) -> int:
         plat = f"{devs[0].platform} x{len(devs)} ({devs[0].device_kind})"
     except Exception as e:  # noqa: BLE001 -- report, don't crash
         plat = f"unavailable ({type(e).__name__})"
+    from acg_tpu.telemetry import CONVERGENCE_SCHEMA, STATS_SCHEMA
+
     rows = [
         ("acg-tpu", __version__),
         ("jax", jax.__version__),
@@ -291,6 +323,12 @@ def _buildinfo(out) -> int:
         ("libmetis", "yes" if metis_available() else
          "no (built-in bisection fallback)"),
         ("float64", "emulated on TPU (use --refine / --precise-dots)"),
+        ("telemetry", f"--convergence-log (in-loop ring buffer, "
+         f"{CONVERGENCE_SCHEMA}), --progress (in-loop heartbeat), "
+         f"--stats-json ({STATS_SCHEMA}, phase timings + cross-rank "
+         f"aggregation)"),
+        ("profiling", "--profile-ops (per-op replay), --trace "
+         "(jax.profiler Perfetto, acg:* phase annotations)"),
     ]
     for k, v in rows:
         out.write(f"{k}: {v}\n")
@@ -425,13 +463,15 @@ def _solve_generated_direct(args, dim, n, N, jax, jnp, dtype,
     A = DiaMatrix(data=tuple(planes), offsets=offsets,
                   nrows=N, ncols_padded=N)
     _log(args, "assemble DIA planes on device:", t0)
+    args._phases.add("ingest", time.perf_counter() - t0)
 
     try:
         solver = JaxCGSolver(A, pipelined="pipelined" in args.solver,
                              precise_dots=args.precise_dots,
                              kernels=args.kernels, vector_dtype=vec_dtype,
                              replace_every=args.replace_every,
-                             recovery=getattr(args, "_recovery", None))
+                             recovery=getattr(args, "_recovery", None),
+                             trace=args._trace, progress=args.progress)
     except ValueError as e:
         raise SystemExit(f"acg-tpu: {e}")
     b = jnp.ones(N, dtype=vec_dtype)
@@ -447,7 +487,9 @@ def _solve_generated_direct(args, dim, n, N, jax, jnp, dtype,
                          host_result=bool(not args.quiet or args.output))
     except (NotConvergedError, BreakdownError) as e:
         sys.stderr.write(f"acg-tpu: {e}\n")
+        _fold_phases(args, solver)
         solver.stats.fwrite(sys.stderr)
+        _emit_telemetry(args, solver, matrix_id=args.A, collective=False)
         return 1
     except AcgError as e:
         sys.stderr.write(f"acg-tpu: {e}\n")
@@ -460,8 +502,12 @@ def _solve_generated_direct(args, dim, n, N, jax, jnp, dtype,
     if args.profile_ops is not None:
         from acg_tpu.solvers.profile import profile_ops
         profile_ops(solver, b, reps=max(args.profile_ops, 1))
+    _fold_phases(args, solver)
     solver.stats.fwrite(sys.stderr)
+    t_wb = time.perf_counter()
     _emit_solution(args, x)
+    args._phases.add("writeback", time.perf_counter() - t_wb)
+    _emit_telemetry(args, solver, matrix_id=args.A)
     return 0
 
 
@@ -474,6 +520,129 @@ def _checkpoint(args, stage: str, code: int = 0) -> int:
         return int(code)
     from acg_tpu.parallel.erragree import agree_status
     return agree_status(code, what=stage, timeout=args.err_timeout)
+
+
+def _inner_solver(solver):
+    """Unwrap --refine's RefinedSolver down to the device solver that
+    carries the telemetry (trace, timings, problem layout)."""
+    while hasattr(solver, "inner"):
+        solver = solver.inner
+    return solver
+
+
+def _fold_phases(args, solver) -> None:
+    """Fold the CLI's phase timer plus the inner solver's self-recorded
+    phases (transfer/compile/solve) into the stats that are about to be
+    printed -- idempotent (the timer consumes on merge), so error paths
+    and the post-writeback stats-json both call it safely."""
+    timer = getattr(args, "_phases", None)
+    if timer is None:
+        return
+    st = solver.stats
+    inner = _inner_solver(solver)
+    if inner is not solver:
+        # --refine: the wrapper's stats block is the one printed; adopt
+        # the device solver's phases and trace
+        for k, v in inner.stats.timings.items():
+            st.timings[k] = st.timings.get(k, 0.0) + v
+        inner.stats.timings.clear()
+        if st.trace is None and inner.stats.trace is not None:
+            st.trace = inner.stats.trace
+    timer.merge_into(st.timings)
+
+
+def _emit_telemetry(args, solver, *, matrix_id, nparts=1,
+                    comm=None, collective=True) -> None:
+    """The telemetry sinks: --convergence-log JSONL, the cross-rank
+    aggregation, and the --stats-json document.  The rank gather is a
+    COLLECTIVE (every controller calls it; argv -- and so the gating
+    flags -- are identical across controllers), the file writes are
+    primary-only.  Error paths pass ``collective=False``: a possibly
+    one-sided failure must not enter a gather its peers may never
+    reach (the erragree mismatched-collective rationale)."""
+    if not (args.convergence_log or args.stats_json):
+        return
+    from acg_tpu import telemetry
+    from acg_tpu.parallel.multihost import is_primary
+
+    _fold_phases(args, solver)
+    inner = _inner_solver(solver)
+    st = solver.stats
+    trace = st.trace if st.trace is not None else inner.stats.trace
+    if args.convergence_log and is_primary():
+        try:
+            if trace is not None:
+                trace.write_jsonl(args.convergence_log)
+            else:
+                sys.stderr.write(
+                    f"acg-tpu: --convergence-log: no convergence trace "
+                    f"was recorded (--solver {args.solver} has no "
+                    f"in-loop telemetry hooks)\n")
+        except OSError as e:
+            sys.stderr.write(f"acg-tpu: {args.convergence_log}: {e}\n")
+    if not args.stats_json:
+        return
+    ranks = None
+    payloads = None
+    try:
+        payload = telemetry.rank_payload(inner)
+    except Exception as e:  # noqa: BLE001 -- telemetry must never sink
+        # a solve that succeeded.  A STUB payload keeps the collective
+        # below symmetric: skipping the gather on this rank alone would
+        # leave the peers blocked on this rank's missing key (and
+        # desynchronise the blob-gather generation counter)
+        sys.stderr.write(f"acg-tpu: rank stats payload failed "
+                         f"({type(e).__name__})\n")
+        import jax
+        payload = {"process": int(jax.process_index()),
+                   "error": type(e).__name__}
+    if collective:
+        # gather_rank_stats owns the gather's failure handling
+        # (reports + returns None)
+        payloads = telemetry.gather_rank_stats(
+            payload, timeout=args.err_timeout)
+    else:
+        import jax
+        if jax.process_count() == 1:
+            payloads = [payload]
+    if payloads is not None:
+        agg = telemetry.aggregate_ranks(payloads)
+        ranks = {"per_rank": payloads, "aggregate": agg}
+        if is_primary() and len(payloads) > 1:
+            sys.stderr.write("acg-tpu: "
+                             + telemetry.format_rank_report(agg) + "\n")
+    if not is_primary():
+        return
+    extra = {"matrix": str(matrix_id), "solver": args.solver,
+             "comm": comm, "nparts": int(nparts), "dtype": args.dtype,
+             "argv": list(sys.argv[1:])}
+    kern = getattr(inner, "kernels", None)
+    extra["kernels"] = kern if isinstance(kern, str) else args.kernels
+    mesh = getattr(inner, "mesh", None)
+    if mesh is not None:
+        try:
+            extra["mesh"] = {str(k): int(v)
+                             for k, v in dict(mesh.shape).items()}
+        except Exception:  # noqa: BLE001
+            pass
+    prob = getattr(inner, "problem", None)
+    if prob is not None:
+        extra["partition"] = {
+            "nparts": int(prob.nparts),
+            "nmax_owned": int(prob.nmax_owned),
+            "local_format": prob.local.format,
+            "nnz_total": int(prob.nnz_total),
+            "halo_send_total": int(getattr(prob, "halo_send_total", 0)
+                                   or 0),
+            "nmax_ghost": int(prob.halo.nmax_ghost)
+            if hasattr(prob.halo, "nmax_ghost") else None,
+        }
+    try:
+        telemetry.write_stats_json(args.stats_json, st,
+                                   manifest=telemetry.run_manifest(**extra),
+                                   ranks=ranks)
+    except OSError as e:
+        sys.stderr.write(f"acg-tpu: {args.stats_json}: {e}\n")
 
 
 def _solve_distributed_read(args, jax, jnp, dtype, vec_dtype) -> int:
@@ -580,9 +749,12 @@ def _solve_distributed_read(args, jax, jnp, dtype, vec_dtype) -> int:
                              "during ingest\n")
         return rc
     subs, bounds, n_rows, owned = state
+    t_part = time.perf_counter()
     prob = DistributedProblem.assemble_local(
         subs, bounds, n_rows, nparts, owned, dtype=dtype,
         vector_dtype=vec_dtype)
+    args._phases.add("ingest", t_part - t0)
+    args._phases.add("partition", time.perf_counter() - t_part)
 
     comm_mtx_out = None
     if args.output_comm_matrix:
@@ -646,7 +818,8 @@ def _solve_distributed_read(args, jax, jnp, dtype, vec_dtype) -> int:
                               precise_dots=args.precise_dots,
                               kernels=args.kernels,
                               replace_every=args.replace_every,
-                              recovery=getattr(args, "_recovery", None))
+                              recovery=getattr(args, "_recovery", None),
+                              trace=args._trace, progress=args.progress)
     except ValueError as e:
         sys.stderr.write(f"acg-tpu: {e}\n")
         _checkpoint(args, "solve", 1)
@@ -685,8 +858,11 @@ def _solve_distributed_read(args, jax, jnp, dtype, vec_dtype) -> int:
         # the stats block carries the resilience event log -- most
         # needed exactly when recovery failed
         sys.stderr.write(f"acg-tpu: {e}\n")
+        _fold_phases(args, solver)
         if is_primary():
             solver.stats.fwrite(sys.stderr)
+        _emit_telemetry(args, solver, matrix_id=args.A, nparts=nparts,
+                        collective=False)
         _checkpoint(args, "solve", 1)
         return 1
     except AcgError as e:
@@ -709,9 +885,15 @@ def _solve_distributed_read(args, jax, jnp, dtype, vec_dtype) -> int:
         _write_comm_matrix(comm_mtx_out, nparts)
 
     if args.output:
-        return _distributed_write(args, solver, x, xsol, n)
+        rc = _distributed_write(args, solver, x, xsol, n)
+        if rc == 0:
+            _emit_telemetry(args, solver, matrix_id=args.A,
+                            nparts=nparts)
+        return rc
 
+    _fold_phases(args, solver)
     if not is_primary():
+        _emit_telemetry(args, solver, matrix_id=args.A, nparts=nparts)
         return 0
     solver.stats.fwrite(sys.stderr)
     if xsol is not None:
@@ -722,7 +904,10 @@ def _solve_distributed_read(args, jax, jnp, dtype, vec_dtype) -> int:
     # a partition-permuted matrix (mtx2bin --partition) solves in
     # permuted row order; the emitter maps the solution back to the
     # input ordering via the perm sidecar
+    t_wb = time.perf_counter()
     _emit_solution(args, x, _load_perm_sidecar(args.A, n))
+    args._phases.add("writeback", time.perf_counter() - t_wb)
+    _emit_telemetry(args, solver, matrix_id=args.A, nparts=nparts)
     return 0
 
 
@@ -905,6 +1090,7 @@ def _distributed_write(args, solver, x_st, xsol, n: int) -> int:
         for lo, vals in windows:
             write_vector_window(args.output, n, lo, vals)
         _log(args, f"range-write {len(windows)} owned windows:", t0)
+        args._phases.add("writeback", time.perf_counter() - t0)
     except OSError as e:
         sys.stderr.write(f"acg-tpu: {args.output}: {e}\n")
         wrc = 1
@@ -927,6 +1113,7 @@ def _distributed_write(args, solver, x_st, xsol, n: int) -> int:
                 np.float64(part_sq), tiled=False)))
         err = np.sqrt(part_sq)
 
+    _fold_phases(args, solver)
     if not is_primary():
         return 0
     finalize_vector_file(args.output, n)
@@ -1051,11 +1238,13 @@ def _solve_generated_sharded(args, dim, n, N, jax, jnp, dtype,
             pipelined="pipelined" in args.solver,
             precise_dots=args.precise_dots, epsilon=args.epsilon,
             replace_every=args.replace_every, kernels=sharded_kernels,
-            recovery=getattr(args, "_recovery", None))
+            recovery=getattr(args, "_recovery", None),
+            trace=args._trace, progress=args.progress)
     except ValueError as e:
         raise SystemExit(f"acg-tpu: {e}")
     _log(args, f"assemble sharded DIA planes on device ({nparts} parts):",
          t0)
+    args._phases.add("ingest", time.perf_counter() - t0)
 
     xsol = None
     if args.manufactured_solution:
@@ -1110,8 +1299,11 @@ def _solve_generated_sharded(args, dim, n, N, jax, jnp, dtype,
         # the stats block carries the resilience event log -- most
         # needed exactly when recovery failed
         sys.stderr.write(f"acg-tpu: {e}\n")
+        _fold_phases(args, solver)
         if is_primary():
             solver.stats.fwrite(sys.stderr)
+        _emit_telemetry(args, solver, matrix_id=args.A, nparts=nparts,
+                        collective=False)
         _checkpoint(args, "solve", 1)
         return 1
     except AcgError as e:
@@ -1152,14 +1344,19 @@ def _solve_generated_sharded(args, dim, n, N, jax, jnp, dtype,
         else:
             x_host = np.asarray(get_global(x))
 
+    _fold_phases(args, solver)
     if not is_primary():
+        _emit_telemetry(args, solver, matrix_id=args.A, nparts=nparts)
         return 0
     solver.stats.fwrite(sys.stderr)
     if errs is not None:
         sys.stderr.write(f"initial error 2-norm: {errs[0]:.15g}\n")
         sys.stderr.write(f"error 2-norm: {errs[1]:.15g}\n")
+    t_wb = time.perf_counter()
     if x_host is not None:
         _emit_solution(args, x_host)
+    args._phases.add("writeback", time.perf_counter() - t_wb)
+    _emit_telemetry(args, solver, matrix_id=args.A, nparts=nparts)
     return 0
 
 
@@ -1206,6 +1403,26 @@ def _main(args) -> int:
 
     # stage 0: runtime init (the MPI/NCCL/NVSHMEM init stage)
     import os
+
+    # telemetry tier: the always-on phase timer (ingest -> partition ->
+    # transfer -> compile -> solve -> writeback, reported in the stats
+    # block's timings: section), and the in-loop trace/progress knobs
+    from acg_tpu.telemetry import PhaseTimer
+    args._phases = PhaseTimer()
+    if args.telemetry_window <= 0:
+        raise SystemExit("acg-tpu: --telemetry-window must be positive")
+    if args.progress < 0:
+        raise SystemExit("acg-tpu: --progress must be >= 0")
+    # the ring buffer arms only when the JSONL sink will read it
+    # (--stats-json alone stays compatible with every solver tier,
+    # including replace_every/fused which refuse in-loop telemetry)
+    args._trace = args.telemetry_window if args.convergence_log else 0
+    if ((args.convergence_log or args.progress)
+            and args.solver in ("host-native", "petsc")):
+        sys.stderr.write(
+            f"acg-tpu: warning: --convergence-log/--progress have no "
+            f"in-loop hooks in --solver {args.solver} (the external "
+            f"oracles); --stats-json still works\n")
 
     # fault injector + recovery policy (the resilience tier), armed
     # BEFORE the backend probe so backend:hang specs actually reach the
@@ -1328,6 +1545,7 @@ def _main(args) -> int:
     # controller can fail alone; the checkpoint below is the last
     # point before the first collective
     ingest_rc = 0
+    t_ingest = time.perf_counter()
     try:
         # stage 1: read (or synthesize) the matrix
         t0 = time.perf_counter()
@@ -1361,6 +1579,7 @@ def _main(args) -> int:
         t0 = time.perf_counter()
         csr = A.to_csr(epsilon=args.epsilon)
         _log(args, "assemble symmetric CSR:", t0)
+        args._phases.add("ingest", time.perf_counter() - t_ingest)
 
         n = A.nrows
         # partition-permuted input (mtx2bin --partition): the matrix on
@@ -1404,6 +1623,7 @@ def _main(args) -> int:
                     method = "graph"
             part = partition_rows(csr, nparts, seed=args.seed, method=method)
         _log(args, f"partition rows into {nparts} parts:", t0)
+        args._phases.add("partition", time.perf_counter() - t0)
 
         # stage 4: right-hand side and initial guess
         rng = np.random.default_rng(args.seed)
@@ -1506,9 +1726,16 @@ def _main(args) -> int:
                         "acg-tpu: warning: --recover has no effect on "
                         "the multi-part host solver (no breakdown "
                         "detection there)\n")
+                if args._trace or args.progress:
+                    sys.stderr.write(
+                        "acg-tpu: warning: --convergence-log/--progress "
+                        "have no hooks in the multi-part host solver; "
+                        "use --nparts 1 or the device solvers\n")
                 solver = HostDistCGSolver(_pm(csr, part, nparts))
             else:
-                solver = HostCGSolver(csr, recovery=args._recovery)
+                solver = HostCGSolver(csr, recovery=args._recovery,
+                                      trace=args._trace,
+                                      progress=args.progress)
             x = solver.solve(b, x0=x0, criteria=criteria)
         elif args.solver == "petsc":
             # external cross-implementation oracle (the KSPCG role,
@@ -1526,7 +1753,9 @@ def _main(args) -> int:
                                      vector_dtype=vec_dtype,
                                      replace_every=args.replace_every,
                                      recovery=args._recovery,
-                                     host_matrix=csr)
+                                     host_matrix=csr,
+                                     trace=args._trace,
+                                     progress=args.progress)
             except ValueError as e:
                 raise SystemExit(f"acg-tpu: {e}")
             if args.refine:
@@ -1558,7 +1787,9 @@ def _main(args) -> int:
                                       precise_dots=args.precise_dots,
                                       kernels=args.kernels, mesh=mesh,
                                       replace_every=args.replace_every,
-                                      recovery=args._recovery)
+                                      recovery=args._recovery,
+                                      trace=args._trace,
+                                      progress=args.progress)
             except ValueError as e:
                 raise SystemExit(f"acg-tpu: {e}")
             if args.refine:
@@ -1568,8 +1799,14 @@ def _main(args) -> int:
                              warmup=args.warmup)
     except (NotConvergedError, BreakdownError) as e:
         sys.stderr.write(f"acg-tpu: {e}\n")
+        _fold_phases(args, solver)
         if is_primary():  # stats block from "rank 0" only
             solver.stats.fwrite(sys.stderr)
+        # the convergence log is most needed exactly when the solve
+        # failed: the trailing window shows the trajectory into the
+        # divergence/breakdown (no collective gather on this path)
+        _emit_telemetry(args, solver, matrix_id=args.A, nparts=nparts,
+                        comm=comm, collective=False)
         checkpoint("solve", 1)
         return 1
     except AcgError as e:
@@ -1594,7 +1831,12 @@ def _main(args) -> int:
 
     # every controller solves; only "rank 0" speaks (the reference's
     # fwritempi / mtxfile_fwrite_mpi_double root-rank output convention)
+    # -- but the telemetry rank gather is COLLECTIVE, so non-primary
+    # controllers contribute their payload before returning
+    _fold_phases(args, solver)
     if not is_primary():
+        _emit_telemetry(args, solver, matrix_id=args.A, nparts=nparts,
+                        comm=comm)
         return 0
 
     # stage 9: statistics block (grep-compatible with the reference)
@@ -1610,7 +1852,13 @@ def _main(args) -> int:
     # stage 2d/10: communication matrix and solution output
     if comm_mtx_out is not None:
         _write_comm_matrix(comm_mtx_out, nparts)
+    t_wb = time.perf_counter()
     _emit_solution(args, x, perm_sidecar)
+    args._phases.add("writeback", time.perf_counter() - t_wb)
+    # the structured sink is written LAST so it includes the writeback
+    # phase (the text block above, printed before output, cannot)
+    _emit_telemetry(args, solver, matrix_id=args.A, nparts=nparts,
+                    comm=comm)
     return 0
 
 

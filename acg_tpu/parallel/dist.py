@@ -747,13 +747,20 @@ class DistCGSolver:
                  mesh: Mesh | None = None, comm: str = "xla",
                  precise_dots: bool = False, kernels: str = "auto",
                  replace_every: int = 0, replace_restart: bool = True,
-                 recovery=None):
+                 recovery=None, trace: int = 0, progress: int = 0):
         """``recovery`` (acg_tpu.solvers.resilience.RecoveryPolicy) arms
         in-loop breakdown detection plus the host-side restart ladder:
         bounded restarts from the recomputed true residual, the
         dma -> xla halo-transport fallback, and (full single-controller
         builds) the distributed host solver -- with every restart/abort
-        decision error-agreed across controllers."""
+        decision error-agreed across controllers.
+
+        ``trace``/``progress`` (acg_tpu.telemetry, 0 = off) arm the
+        in-loop convergence ring buffer / the heartbeat in the SPMD
+        loop.  Every recorded scalar is already psum'd, so the buffer
+        is replicated across shards and leaves the mesh as ONE
+        rank-independent fetch per solve; the heartbeat fires on part 0
+        only."""
         if comm not in ("xla", "dma"):
             raise ValueError(f"unknown halo transport {comm!r}")
         if comm == "dma" and jax.process_count() > 1:
@@ -811,6 +818,20 @@ class DistCGSolver:
                                  "plain f32; precise_dots needs the "
                                  "direct programs")
         self.recovery = recovery
+        self.trace = int(trace)
+        self.progress = int(progress)
+        if self.trace < 0 or self.progress < 0:
+            raise ValueError("trace/progress must be >= 0 (iteration "
+                             "counts; 0 disables)")
+        if self.replace_every and (self.trace or self.progress):
+            # the replacement segments' inner fori threads no global
+            # iteration index: the telemetry hooks would silently
+            # record nothing (the fault-injector refusal rationale)
+            raise ValueError(
+                "convergence telemetry (trace/progress) does not reach "
+                "the replacement-segment program (replace_every); use "
+                "the direct classic/pipelined programs")
+        self.last_trace = None
         self._program = self._compile()
 
     def _program_for(self, fault):
@@ -840,6 +861,10 @@ class DistCGSolver:
         comm = self.comm
         interpret = self._interpret
         precise = self.precise_dots
+        trace = self.trace
+        progress = self.progress
+        if trace or progress:
+            from acg_tpu import telemetry
 
         dist_spmv = make_dist_spmv(prob, comm, interpret,
                                    kernels=self.kernels, fault=fault)
@@ -1013,10 +1038,18 @@ class DistCGSolver:
                 return (x32[None], k, jnp.sqrt(gamma_f), r0nrm2, bnrm2,
                         x0nrm2, inf, done, ~jnp.isfinite(gamma_f))
 
+            # heartbeat emits from part 0 only: every recorded scalar is
+            # psum'd (mesh-uniform), so one part speaks for the mesh
+            leader = None
+            if progress and not single_shard:
+                leader = lax.axis_index(axis) == jnp.int32(0)
+
             if not pipelined:
                 # dxsqr joins the carry only under a diff criterion (extra
                 # loop-carried scalars measurably slow the TPU loop)
                 def body(k, state):
+                    if trace:
+                        buf, state = state[-1], state[:-1]
                     x, r, p, gamma = state[:4]
                     t = spmv(p, k)
                     pdott = pdot(p, t)
@@ -1048,24 +1081,39 @@ class DistCGSolver:
                         out = out + (dx,)
                     if detect:
                         out = out + (bad | (~jnp.isfinite(gamma_next)),)
+                    if trace:
+                        # psum'd scalars: the ring is replicated, one
+                        # rank-independent fetch per solve
+                        out = out + (telemetry.ring_record(
+                            buf, k, gamma_next, alpha, beta, pdott),)
+                    if progress:
+                        telemetry.heartbeat(k, gamma_next, progress,
+                                            leader=leader, what="dist-cg")
                     return out
 
                 init_state = (x0, r, r, gamma) + ((inf,) if needs_diff else ())
                 if detect:
                     init_state = init_state + (jnp.asarray(False),)
+                if trace:
+                    init_state = init_state + (telemetry.ring_init(trace,
+                                                                   sdt),)
+                bad_i = -2 if trace else -1
                 k, state, done = run_iter(
                     body, init_state, lambda s: s[3],
                     (lambda s: s[4]) if needs_diff else (lambda s: inf),
-                    bad_of=(lambda s: s[-1]) if detect else None)
+                    bad_of=(lambda s: s[bad_i]) if detect else None)
                 x, r_fin, gamma_fin = state[0], state[1], state[3]
                 dxsqr = state[4] if needs_diff else inf
-                breakdown = state[-1] if detect else jnp.asarray(False)
+                breakdown = state[bad_i] if detect else jnp.asarray(False)
+                tbuf = state[-1] if trace else None
                 rnrm2 = jnp.sqrt(gamma_fin)
             else:
                 w = spmv(r)
                 zeros = jnp.zeros_like(b)
 
                 def body(k, state):
+                    if trace:
+                        buf, state = state[-1], state[:-1]
                     x, r, w, p, t, z, gamma_prev, alpha_prev = state[:8]
                     # the pipelined variant's single fused allreduce:
                     # both scalars in one psum (cgcuda.c:1730-1737)
@@ -1103,6 +1151,15 @@ class DistCGSolver:
                         out = out + (dx,)
                     if detect:
                         out = out + (bad,)
+                    if trace:
+                        # carried gamma (stale by one, like the
+                        # convergence test); alpha denominator in the
+                        # pAp slot (jax_cg._cg_pipelined_program)
+                        out = out + (telemetry.ring_record(
+                            buf, k, gamma, alpha, beta, denom),)
+                    if progress:
+                        telemetry.heartbeat(k, gamma, progress,
+                                            leader=leader, what="dist-cg")
                     return out
 
                 # stale-gamma convergence test (see jax_cg): s[6] is the
@@ -1111,14 +1168,19 @@ class DistCGSolver:
                     (inf,) if needs_diff else ())
                 if detect:
                     init_state = init_state + (jnp.asarray(False),)
+                if trace:
+                    init_state = init_state + (telemetry.ring_init(trace,
+                                                                   sdt),)
+                bad_i = -2 if trace else -1
                 k, state, done = run_iter(
                     body, init_state, lambda s: s[6],
                     (lambda s: s[8]) if needs_diff else (lambda s: inf),
                     init_gamma=gamma,
-                    bad_of=(lambda s: s[-1]) if detect else None)
+                    bad_of=(lambda s: s[bad_i]) if detect else None)
                 x, r_fin = state[0], state[1]
                 dxsqr = state[8] if needs_diff else inf
-                breakdown = state[-1] if detect else jnp.asarray(False)
+                breakdown = state[bad_i] if detect else jnp.asarray(False)
+                tbuf = state[-1] if trace else None
                 rnrm2 = jnp.sqrt(pdot(r_fin, r_fin))
                 # stale-test consistency: see jax_cg._cg_pipelined_program
                 done = jnp.logical_or(done, rnrm2 <= res_tol)
@@ -1128,8 +1190,9 @@ class DistCGSolver:
             # convergence, not breakdown
             breakdown = breakdown & ~done
             dxnrm2 = jnp.sqrt(dxsqr)
-            return (x[None], k, rnrm2, r0nrm2, bnrm2, x0nrm2, dxnrm2,
-                    done, breakdown)
+            out = (x[None], k, rnrm2, r0nrm2, bnrm2, x0nrm2, dxnrm2,
+                   done, breakdown)
+            return out + ((tbuf,) if trace else ())
 
         if single_shard and not prob.halo.has_ghosts:
             # one shard, no halo: shard_body runs as a PLAIN jit program
@@ -1157,7 +1220,8 @@ class DistCGSolver:
                     pspec, pspec, pspec, pspec, pspec,         # halo, counts
                     pspec, pspec,                              # b, x0
                     rspec, rspec)                              # tols, maxits
-        out_specs = (pspec,) + (rspec,) * 8
+        # the telemetry ring is built from psum'd scalars -> replicated
+        out_specs = (pspec,) + (rspec,) * (9 if trace else 8)
 
         @functools.partial(jax.jit,
                            static_argnames=("unbounded", "needs_diff",
@@ -1249,12 +1313,20 @@ class DistCGSolver:
                 "program (replace_every); inject into the direct "
                 "classic/pipelined programs instead")
         detect = self.recovery is not None or fault is not None
+        from acg_tpu import telemetry
+        if fault is not None:
+            telemetry.record_event(st, "fault-armed",
+                                   f"{fault.site}:{fault.mode}"
+                                   f"@{fault.iteration}")
         # an armed injector bakes into a solve-local program; the cached
         # pristine program serves every clean solve
         program = self._program_for(fault)
 
-        b, x0, la, ga, sidx, gsrc, gval, scnt, rcnt = \
-            self.device_args(b_global, x0)
+        t_xfer = time.perf_counter()
+        with telemetry.annotate("transfer"):
+            b, x0, la, ga, sidx, gsrc, gval, scnt, rcnt = \
+                self.device_args(b_global, x0)
+        telemetry.add_timing(st, "transfer", time.perf_counter() - t_xfer)
         # tolerances in the scalar dtype (f32 for bf16 storage) so a 1e-9
         # rtol is not pre-rounded to 8 mantissa bits
         sdt = acc_dtype(dtype)
@@ -1268,11 +1340,31 @@ class DistCGSolver:
         # tunneled backend's block has been observed not to wait)
         from acg_tpu._platform import block_until_ready_works, device_sync
         block_until_ready_works()  # resolve the cached probe OUTSIDE timing
-        for _ in range(max(warmup, 0)):
-            device_sync(program(*args, **kwargs)[0])
+        t_warm = time.perf_counter()
+        with telemetry.annotate("compile"):
+            for _ in range(max(warmup, 0)):
+                device_sync(program(*args, **kwargs)[0])
+        if warmup > 0:
+            telemetry.add_timing(st, "compile",
+                                 time.perf_counter() - t_warm)
+
+        def attempt_trace(out):
+            """The ONE extra host fetch of a traced solve: the ring is
+            replicated (psum'd scalars), so any controller's copy is
+            the mesh's."""
+            if not self.trace:
+                return None
+            # rspec output -> fully replicated: every process holds a
+            # complete copy, np.asarray reads the local one
+            return telemetry.ConvergenceTrace.from_ring(
+                np.asarray(out[9]), int(out[1]),
+                solver="dist-cg-pipelined" if self.pipelined
+                else "dist-cg")
+
         t0 = time.perf_counter()
-        out = program(*args, **kwargs)
-        device_sync(out[0])
+        with telemetry.annotate("solve"):
+            out = program(*args, **kwargs)
+            device_sync(out[0])
         niter = int(out[1])
         first_norms = None
         if detect and bool(out[8]):
@@ -1304,6 +1396,10 @@ class DistCGSolver:
 
             while bool(out[8]):
                 k_done = int(out[1])
+                if self.trace:
+                    # the trajectory that led INTO the breakdown
+                    st.trace = self.last_trace = attempt_trace(out)
+                    driver.log_trace_window(st.trace)
                 if (self.comm == "dma" and driver.restarts >= 1
                         and pol is not None and pol.fallback_comm):
                     # a restart did not cure it: suspect the one-sided
@@ -1353,7 +1449,11 @@ class DistCGSolver:
                 st.tsolve += time.perf_counter() - t0
                 st.converged = False
                 raise driver.give_up(niter, float(out[2]))
-        st.tsolve += time.perf_counter() - t0
+        t_solve = time.perf_counter() - t0
+        st.tsolve += t_solve
+        telemetry.add_timing(st, "solve", t_solve)
+        if self.trace:
+            st.trace = self.last_trace = attempt_trace(out)
 
         x_st, k, rnrm2, r0nrm2, bnrm2, x0nrm2, dxnrm2, done = out[:8]
         st.nsolves += 1
@@ -1376,8 +1476,16 @@ class DistCGSolver:
         st.ops["gemv"].add(niter + 1, 0.0,
                            (prob.nnz_total * (mat_dbl + idx_b)
                             + 2 * n * dbl) * (niter + 1))
-        st.ops["dot"].add(2 * niter, 0.0, 2 * n * dbl * 2 * niter)
+        # op census matching the single-device/eager accounting
+        # (jax_cg.solve / host_cg.solve): the convergence test's (r, r)
+        # is the nrm2 class, classic CG's p = r setup the one copy --
+        # these were the permanently-zero stats rows (the reference
+        # fills both, cgcuda.c:1942-1957)
+        st.ops["dot"].add(niter, 0.0, 2 * n * dbl * niter)
+        st.ops["nrm2"].add(niter + 1, 0.0, n * dbl * (niter + 1))
         st.ops["axpy"].add(3 * niter, 0.0, 3 * n * dbl * 3 * niter)
+        if not self.pipelined:
+            st.ops["copy"].add(1, 0.0, 2 * n * dbl)
         st.ops["allreduce"].add((1 if self.pipelined else 2) * niter, 0.0,
                                 8 * (1 if self.pipelined else 2) * niter)
         # local-read problems carry the allgathered total (summing subs

@@ -35,25 +35,32 @@ PEER_LOST_EXIT = 97
 _seq = itertools.count()
 
 
-def _gather_codes(code: int, seq: int, timeout: float) -> list[int]:
-    """All processes' status codes, via the coordination-service KV
-    store when available -- plain gRPC to the coordinator, no device
-    collective, so it works on backends whose multiprocess computations
-    are unsupported (older CPU runtimes) and cannot be wedged by a
-    poisoned accelerator.  Falls back to the allgather."""
-    import jax
-
-    n = jax.process_count()
-    me = jax.process_index()
-    client = None
+def _coord_client():
+    """The coordination-service KV client, or None -- plain gRPC to the
+    coordinator, no device collective, so it works on backends whose
+    multiprocess computations are unsupported (older CPU runtimes) and
+    cannot be wedged by a poisoned accelerator."""
     try:
         from jax._src.distributed import global_state
 
         client = global_state.client
     except Exception:  # noqa: BLE001 -- internal API: fall back
-        client = None
+        return None
     if (client is not None and hasattr(client, "key_value_set")
             and hasattr(client, "blocking_key_value_get")):
+        return client
+    return None
+
+
+def _gather_codes(code: int, seq: int, timeout: float) -> list[int]:
+    """All processes' status codes, via the coordination-service KV
+    store when available; falls back to the allgather."""
+    import jax
+
+    n = jax.process_count()
+    me = jax.process_index()
+    client = _coord_client()
+    if client is not None:
         base = f"acg_tpu/erragree/{seq}"
         client.key_value_set(f"{base}/{me}", str(int(code)))
         ms = max(int(timeout * 1000), 1)
@@ -73,6 +80,56 @@ def _gather_codes(code: int, seq: int, timeout: float) -> list[int]:
 
     return [int(c) for c in np.asarray(multihost_utils.process_allgather(
         np.int32(code), tiled=False)).ravel()]
+
+
+# telemetry blob-gather generations, separate from the checkpoint
+# sequence: telemetry gathers are OPTIONAL call sites (gated on the same
+# CLI flags on every controller, so still symmetric) and must not
+# perturb the erragree key lockstep
+_blob_seq = itertools.count()
+
+
+def allgather_blobs(blob: str, tag: str = "blob",
+                    timeout: float = 120.0) -> list[str]:
+    """Allgather one small UTF-8 string per process (the telemetry
+    tier's cross-rank stats gather rides this).  Uses the erragree KV
+    plumbing when the coordination service is up; falls back to a
+    padded-bytes device allgather.  Every controller must call this at
+    the same point (the ``agree_status`` contract); payloads should be
+    kilobytes, not megabytes -- they transit the coordinator.
+    """
+    import jax
+
+    n = jax.process_count()
+    if n == 1:
+        return [blob]
+    me = jax.process_index()
+    seq = next(_blob_seq)
+    client = _coord_client()
+    if client is not None:
+        base = f"acg_tpu/{tag}/{seq}"
+        client.key_value_set(f"{base}/{me}", blob)
+        ms = max(int(timeout * 1000), 1)
+        blobs = [client.blocking_key_value_get(f"{base}/{q}", ms)
+                 for q in range(n)]
+        if seq > 0 and hasattr(client, "key_value_delete"):
+            try:
+                client.key_value_delete(f"acg_tpu/{tag}/{seq - 1}")
+            except Exception:  # noqa: BLE001 -- cleanup, never fatal
+                pass
+        return blobs
+    # fallback: two fixed-shape allgathers (lengths, then padded bytes)
+    from jax.experimental import multihost_utils
+
+    data = np.frombuffer(blob.encode("utf-8"), dtype=np.uint8)
+    lens = np.asarray(multihost_utils.process_allgather(
+        np.int64(data.size), tiled=False)).ravel()
+    width = int(lens.max(initial=1)) or 1
+    buf = np.zeros(width, dtype=np.uint8)
+    buf[: data.size] = data
+    rows = np.asarray(multihost_utils.process_allgather(buf, tiled=False))
+    return [bytes(rows[q, : int(lens[q])]).decode("utf-8")
+            for q in range(n)]
 
 
 def agree_status(code: int, what: str = "", timeout: float = 120.0) -> int:

@@ -226,7 +226,7 @@ class ShardedDiaCGSolver(JaxCGSolver):
                  pipelined: bool = False, precise_dots: bool = False,
                  vector_dtype=None, stencil: tuple[int, int] | None = None,
                  replace_every: int = 0, replace_restart: bool = True,
-                 recovery=None):
+                 recovery=None, trace: int = 0, progress: int = 0):
         if A.ncols_padded != A.nrows:
             raise ValueError("sharded DIA solve needs a square matrix")
         # replace_every (the sound bf16 tier, _cg_replaced_program)
@@ -234,12 +234,15 @@ class ShardedDiaCGSolver(JaxCGSolver):
         # replacement f32 SpMVs shard into the same boundary
         # collective-permutes as every other program here (round-4
         # verdict item 1 -- the half-traffic accuracy contract on the
-        # north-star path; ref ``comm.h:180-183``, ``cgcuda.c:1941``)
+        # north-star path; ref ``comm.h:180-183``, ``cgcuda.c:1941``).
+        # trace/progress (the telemetry tier) ride the same programs:
+        # the CG scalars are global reductions, so the recorded ring is
+        # replicated by GSPMD exactly like the result scalars
         super().__init__(A, pipelined=pipelined, precise_dots=precise_dots,
                          kernels="xla-roll", vector_dtype=vector_dtype,
                          replace_every=replace_every,
                          replace_restart=replace_restart,
-                         recovery=recovery)
+                         recovery=recovery, trace=trace, progress=progress)
         self.mesh = mesh if mesh is not None else solve_mesh()
         # fault-injection diagnosis hook (JaxCGSolver.solve): this tier
         # is multi-part but still cannot honour part= targeting
@@ -534,7 +537,8 @@ def build_sharded_poisson_solver(n: int, dim: int, nparts: int | None = None,
                                  replace_every: int = 0,
                                  replace_restart: bool = True,
                                  kernels: str = "xla-roll",
-                                 recovery=None):
+                                 recovery=None, trace: int = 0,
+                                 progress: int = 0):
     """Assemble a sharded Poisson problem and its solver in one call
     (the gen-direct CLI path under ``--nparts``/``--multihost``).
 
@@ -566,7 +570,8 @@ def build_sharded_poisson_solver(n: int, dim: int, nparts: int | None = None,
                                 stencil=(n, dim) if not epsilon else None,
                                 replace_every=replace_every,
                                 replace_restart=replace_restart,
-                                recovery=recovery)
+                                recovery=recovery, trace=trace,
+                                progress=progress)
     if kernels == "pallas-roll":
         solver.use_pallas_roll(n, dim)
     return solver

@@ -50,11 +50,18 @@ class HostCGSolver:
     while the fault injector (acg_tpu.faults) is active."""
 
     def __init__(self, A: SymCsrMatrix | sp.spmatrix, epsilon: float = 0.0,
-                 recovery=None):
+                 recovery=None, trace: int = 0, progress: int = 0):
         self.A = as_csr(A, epsilon)
         self.n = self.A.shape[0]
         self.nnz_full = self.A.nnz
         self.recovery = recovery
+        # telemetry tier (acg_tpu.telemetry): the eager twin of the
+        # compiled solvers' device ring -- same (rnrm2, alpha, beta,
+        # pAp) tuple, same capacity/wrap semantics, recorded per
+        # iteration in plain Python
+        self.trace = int(trace)
+        self.progress = int(progress)
+        self.last_trace = None
         self.stats = SolverStats(unknowns=self.n)
 
     def _op(self, name, t, n_bytes, flops):
@@ -85,6 +92,15 @@ class HostCGSolver:
         if detect:
             from acg_tpu.solvers.resilience import RecoveryDriver
             driver = RecoveryDriver(pol, st, "host-cg")
+        recorder = None
+        if self.trace:
+            from acg_tpu.telemetry import EagerTraceRecorder
+            recorder = EagerTraceRecorder(self.trace)
+
+        def finish_trace():
+            if recorder is not None:
+                st.trace = self.last_trace = recorder.finish()
+            return st.trace
 
         tstart = time.perf_counter()
         st.bnrm2 = float(np.linalg.norm(b))
@@ -117,6 +133,7 @@ class HostCGSolver:
             rebuild the Krylov space; raise once the policy's restarts
             are exhausted."""
             nonlocal x, r, p, gamma
+            driver.log_trace_window(finish_trace())
             if not driver.on_breakdown(k):
                 st.tsolve += time.perf_counter() - tstart
                 st.converged = False
@@ -150,6 +167,11 @@ class HostCGSolver:
                 k += 1
                 st.niterations = k
                 st.ntotaliterations += 1
+                if recorder is not None:
+                    # the poisoned scalar stays visible in the window
+                    # the recovery log quotes; no update ran -> no
+                    # alpha/beta for this iteration
+                    recorder.record(st.rnrm2, np.nan, np.nan, pdott)
                 _breakdown("non-finite or non-positive p^T A p")
                 converged = self._test(crit, st, res_tol)
                 continue
@@ -164,6 +186,7 @@ class HostCGSolver:
                 st.tsolve += time.perf_counter() - tstart
                 st.converged = False
                 st.fexcept_arrays = [x, r]
+                finish_trace()
                 raise IndefiniteMatrixError(
                     f"(p, Ap) = 0 at iteration {k}")
             alpha = gamma / pdott
@@ -181,6 +204,10 @@ class HostCGSolver:
                 k += 1
                 st.niterations = k
                 st.ntotaliterations += 1
+                if recorder is not None:
+                    recorder.record(np.sqrt(gamma_next)
+                                    if gamma_next >= 0 else gamma_next,
+                                    alpha, np.nan, pdott)
                 _breakdown("non-finite residual")
                 converged = self._test(crit, st, res_tol)
                 continue
@@ -198,12 +225,22 @@ class HostCGSolver:
             st.niterations = k
             st.ntotaliterations += 1
             st.rnrm2 = float(np.sqrt(gamma))
+            if recorder is not None:
+                recorder.record(st.rnrm2, alpha, beta, pdott)
+            if self.progress and k % self.progress == 0:
+                import sys
+                sys.stderr.write(f"acg-tpu: host-cg: iteration {k}: "
+                                 f"residual 2-norm {st.rnrm2:.6e}\n")
             if not crit.unbounded:
                 converged = self._test(crit, st, res_tol)
 
-        st.tsolve += time.perf_counter() - tstart
+        t_solve = time.perf_counter() - tstart
+        st.tsolve += t_solve
+        from acg_tpu.telemetry import add_timing
+        add_timing(st, "solve", t_solve)
         st.converged = converged or crit.unbounded
         st.fexcept_arrays = [x, r]
+        finish_trace()
         if not st.converged and raise_on_divergence:
             raise NotConvergedError(
                 f"{k} iterations, residual {st.rnrm2:.3e} > {res_tol:.3e}")

@@ -220,11 +220,13 @@ def _iterate(iter_body, init_state, gamma_of, maxits, res_tol,
 
 @functools.partial(jax.jit,
                    static_argnames=("unbounded", "needs_diff", "precise",
-                                    "kernels", "detect", "fault"))
+                                    "kernels", "detect", "fault", "trace",
+                                    "progress"))
 def _cg_program(A: DeviceMatrix, b, x0, res_atol, res_rtol, diff_atol,
                 diff_rtol, maxits, unbounded: bool, needs_diff: bool,
                 precise: bool = False, kernels: str = "xla",
-                detect: bool = False, fault=None):
+                detect: bool = False, fault=None, trace: int = 0,
+                progress: int = 0):
     """Whole classic-CG solve as one XLA program.
 
     ``precise`` switches the CG scalars' dot products to the compensated
@@ -239,7 +241,15 @@ def _cg_program(A: DeviceMatrix, b, x0, res_atol, res_rtol, diff_atol,
     alpha/beta would otherwise launder the poison into x -- and exits
     the loop so the host recovery policy can restart from the last good
     x.  ``fault`` is a static acg_tpu.faults.FaultSpec the injector
-    threads into the loop (None compiles the unchanged program)."""
+    threads into the loop (None compiles the unchanged program).
+
+    ``trace`` (the telemetry tier, acg_tpu.telemetry) rides a
+    ``(trace, 4)`` ring buffer of per-iteration ``(||r||^2, alpha,
+    beta, pAp)`` in the loop carry -- recorded device-side, fetched
+    ONCE with the result, no per-iteration host traffic -- and makes
+    the program return ``(CGResult, buffer)``.  ``progress`` emits a
+    host heartbeat every that-many iterations (jax.debug.callback).
+    Both are static: 0 compiles the byte-identical pristine program."""
     dtype = b.dtype
     dot, sdt = _scalar_setup(dtype, precise)
     store = (lambda v: v.astype(dtype)) if sdt != dtype else (lambda v: v)
@@ -254,9 +264,14 @@ def _cg_program(A: DeviceMatrix, b, x0, res_atol, res_rtol, diff_atol,
     diff_tol = jnp.maximum(diff_atol, diff_rtol * x0nrm2)
     inf = jnp.asarray(jnp.inf, sdt)
 
+    if trace or progress:
+        from acg_tpu import telemetry
+
     # dxsqr joins the carry only when a diff criterion is active: every
     # extra loop-carried scalar measurably slows the TPU loop (~0.1 ms/it)
     def body(k, state):
+        if trace:
+            buf, state = state[-1], state[:-1]
         x, r, p, gamma = state[:4]
         # NOT the fused dia_spmv_dot: measured in-loop, the in-kernel
         # (p,t) scalar costs ~15% (1,355 vs 1,589 iters/s interleaved
@@ -295,26 +310,40 @@ def _cg_program(A: DeviceMatrix, b, x0, res_atol, res_rtol, diff_atol,
             # a poison that slipped past pdott (e.g. a NaN row of t with
             # a finite dot) lands in r: flag it one iteration deferred
             out = out + (bad | (~jnp.isfinite(gamma_next)),)
+        if trace:
+            # record the RAW scalars (a poisoned pdott/gamma_next stays
+            # visible in the window the recovery log quotes)
+            out = out + (telemetry.ring_record(buf, k, gamma_next, alpha,
+                                               beta, pdott),)
+        if progress:
+            telemetry.heartbeat(k, gamma_next, progress)
         return out
 
+    # the ring buffer rides LAST in the carry so every existing index
+    # (dx at [4], the deferred-bad freeze reads) is untouched; only the
+    # tail accessors below shift by one
     init_state = (x0, r, p, gamma) + ((inf,) if needs_diff else ())
     if detect:
         init_state = init_state + (jnp.asarray(False),)
+    if trace:
+        init_state = init_state + (telemetry.ring_init(trace, sdt),)
+    bad_i = -2 if trace else -1
     k, state, done = _iterate(
         body, init_state, lambda s: s[3], maxits,
         res_tol, diff_tol, (lambda s: s[4]) if needs_diff else (lambda s: inf),
-        unbounded, bad_of=(lambda s: s[-1]) if detect else None)
+        unbounded, bad_of=(lambda s: s[bad_i]) if detect else None)
     x, r, p, gamma = state[:4]
     dxsqr = state[4] if needs_diff else inf
-    breakdown = state[-1] if detect else jnp.asarray(False)
+    breakdown = state[bad_i] if detect else jnp.asarray(False)
     # a breakdown flagged on the same iteration the tolerance was met is
     # convergence, not breakdown: at the f32 floor the (p, Ap) scalar
     # legitimately rounds to <= 0 once progress is exhausted
     breakdown = breakdown & ~done
-    return CGResult(x=x, niterations=k, rnrm2=jnp.sqrt(gamma),
-                    r0nrm2=r0nrm2, bnrm2=bnrm2, x0nrm2=x0nrm2,
-                    dxnrm2=jnp.sqrt(dxsqr), converged=done,
-                    breakdown=breakdown)
+    res = CGResult(x=x, niterations=k, rnrm2=jnp.sqrt(gamma),
+                   r0nrm2=r0nrm2, bnrm2=bnrm2, x0nrm2=x0nrm2,
+                   dxnrm2=jnp.sqrt(dxsqr), converged=done,
+                   breakdown=breakdown)
+    return (res, state[-1]) if trace else res
 
 
 @functools.partial(jax.jit,
@@ -541,19 +570,25 @@ def _cg_fused_program(A: DeviceMatrix, b, x0, res_atol, res_rtol,
 
 @functools.partial(jax.jit,
                    static_argnames=("unbounded", "needs_diff", "precise",
-                                    "kernels", "detect", "fault"))
+                                    "kernels", "detect", "fault", "trace",
+                                    "progress"))
 def _cg_pipelined_program(A: DeviceMatrix, b, x0, res_atol, res_rtol,
                           diff_atol, diff_rtol, maxits, unbounded: bool,
                           needs_diff: bool, precise: bool = False,
                           kernels: str = "xla", detect: bool = False,
-                          fault=None):
+                          fault=None, trace: int = 0, progress: int = 0):
     """Whole pipelined-CG (Ghysels-Vanroose) solve as one XLA program.
 
-    ``detect``/``fault`` as in :func:`_cg_program`.  The pipelined
-    recurrences are the brittle ones (deep pipelining amplifies rounding
-    -- Cornelis & Vanroose, arXiv:1801.04728), and a poisoned q/w shows
-    up one iteration deferred in the (w, r) reduction: detection here is
-    inherently one iteration stale, like the convergence test."""
+    ``detect``/``fault``/``trace``/``progress`` as in
+    :func:`_cg_program`.  The pipelined recurrences are the brittle ones
+    (deep pipelining amplifies rounding -- Cornelis & Vanroose,
+    arXiv:1801.04728), and a poisoned q/w shows up one iteration
+    deferred in the (w, r) reduction: detection here is inherently one
+    iteration stale, like the convergence test.  The telemetry window
+    records the CARRIED gamma = ||r||^2 from before the update (the
+    same one-iteration-stale quantity the convergence test uses) and
+    the alpha denominator in the pAp slot -- exactly the recurrence
+    scalars whose drift the deep-pipelining literature plots."""
     dtype = b.dtype
     dot, sdt = _scalar_setup(dtype, precise)
     store = (lambda v: v.astype(dtype)) if sdt != dtype else (lambda v: v)
@@ -567,8 +602,12 @@ def _cg_pipelined_program(A: DeviceMatrix, b, x0, res_atol, res_rtol,
     diff_tol = jnp.maximum(diff_atol, diff_rtol * x0nrm2)
     inf = jnp.asarray(jnp.inf, sdt)
     zeros = jnp.zeros_like(b)
+    if trace or progress:
+        from acg_tpu import telemetry
 
     def body(k, state):
+        if trace:
+            buf, state = state[-1], state[:-1]
         x, r, w, p, t, z, gamma_prev, alpha_prev = state[:8]
         # both reductions of the iteration, fused (one allreduce on a mesh)
         gamma = dot(r, r)
@@ -615,6 +654,13 @@ def _cg_pipelined_program(A: DeviceMatrix, b, x0, res_atol, res_rtol,
             out = out + (dx,)
         if detect:
             out = out + (bad,)
+        if trace:
+            # the carried gamma (stale by one, like the convergence
+            # test) and the alpha denominator in the pAp slot
+            out = out + (telemetry.ring_record(buf, k, gamma, alpha,
+                                               beta, denom),)
+        if progress:
+            telemetry.heartbeat(k, gamma, progress)
         return out
 
     # convergence tests the carried gamma = ||r||^2 from *before* the
@@ -624,14 +670,17 @@ def _cg_pipelined_program(A: DeviceMatrix, b, x0, res_atol, res_rtol,
         (inf,) if needs_diff else ())
     if detect:
         init_state = init_state + (jnp.asarray(False),)
+    if trace:
+        init_state = init_state + (telemetry.ring_init(trace, sdt),)
+    bad_i = -2 if trace else -1
     k, state, done = _iterate(
         body, init_state, lambda s: s[6], maxits,
         res_tol, diff_tol, (lambda s: s[8]) if needs_diff else (lambda s: inf),
         unbounded, init_gamma=r0nrm2 * r0nrm2,
-        bad_of=(lambda s: s[-1]) if detect else None)
+        bad_of=(lambda s: s[bad_i]) if detect else None)
     x, r = state[0], state[1]
     dxsqr = state[8] if needs_diff else inf
-    breakdown = state[-1] if detect else jnp.asarray(False)
+    breakdown = state[bad_i] if detect else jnp.asarray(False)
     rnrm2 = jnp.sqrt(dot(r, r))
     # the in-loop test is one iteration stale; at the maxits boundary a
     # solve whose final *fresh* residual meets tolerance must not report
@@ -641,9 +690,10 @@ def _cg_pipelined_program(A: DeviceMatrix, b, x0, res_atol, res_rtol,
     # is convergence: near the floor the pipelined denominator
     # legitimately rounds <= 0 (the recurrences' known brittleness)
     breakdown = breakdown & ~done
-    return CGResult(x=x, niterations=k, rnrm2=rnrm2, r0nrm2=r0nrm2,
-                    bnrm2=bnrm2, x0nrm2=x0nrm2, dxnrm2=jnp.sqrt(dxsqr),
-                    converged=done, breakdown=breakdown)
+    res = CGResult(x=x, niterations=k, rnrm2=rnrm2, r0nrm2=r0nrm2,
+                   bnrm2=bnrm2, x0nrm2=x0nrm2, dxnrm2=jnp.sqrt(dxsqr),
+                   converged=done, breakdown=breakdown)
+    return (res, state[-1]) if trace else res
 
 
 class JaxCGSolver:
@@ -658,7 +708,7 @@ class JaxCGSolver:
                  precise_dots: bool = False, kernels: str = "auto",
                  vector_dtype=None, replace_every: int = 0,
                  replace_restart: bool = True, recovery=None,
-                 host_matrix=None):
+                 host_matrix=None, trace: int = 0, progress: int = 0):
         """``recovery`` (a :class:`acg_tpu.solvers.resilience.
         RecoveryPolicy`) arms breakdown detection in the compiled loop
         plus the host-side restart policy; ``host_matrix`` (scipy CSR)
@@ -666,6 +716,15 @@ class JaxCGSolver:
         Detection also arms automatically while the fault injector
         (acg_tpu.faults) is active, so injected faults are never
         silently laundered into a returned x.
+
+        ``trace`` (iterations; 0 = off) arms the in-loop convergence
+        telemetry ring (acg_tpu.telemetry): the last solve's trailing
+        window lands on ``self.last_trace`` / ``stats.trace`` with one
+        extra device fetch per solve.  ``progress`` (iterations; 0 =
+        off) emits an in-loop heartbeat to stderr.  Both reach the
+        direct classic/pipelined programs only -- the replacement and
+        fused tiers refuse at solve time rather than silently record
+        nothing (the fault-injector rationale).
 
         ``vector_dtype`` decouples vector storage from matrix storage
         (default: the matrix dtype).  The supported mix is bf16 matrix +
@@ -764,6 +823,14 @@ class JaxCGSolver:
         self.kernels = kernels
         self.recovery = recovery
         self.host_matrix = host_matrix
+        self.trace = int(trace)
+        self.progress = int(progress)
+        if self.trace < 0 or self.progress < 0:
+            raise ValueError("trace/progress must be >= 0 (iteration "
+                             "counts; 0 disables)")
+        # the last solve's ConvergenceTrace (telemetry tier), also on
+        # stats.trace; None until a traced solve ran
+        self.last_trace = None
         self.stats = SolverStats(unknowns=A.nrows)
         # the matrix the solve PROGRAMS consume; defaults to A.  The
         # sharded pallas-roll tier swaps in a per-shard-padded twin
@@ -836,16 +903,40 @@ class JaxCGSolver:
             # here would bake a u_bf16-sized backward error into every
             # residual the replacement recomputes
             dtype = jnp.dtype(jnp.float32)
-        b = jnp.asarray(b, dtype=dtype)
-        x0 = jnp.zeros_like(b) if x0 is None else jnp.asarray(x0, dtype=dtype)
+        from acg_tpu import telemetry
+        if fault is not None:
+            # timestamped twin of the injector's stderr line for the
+            # structured sink (--stats-json)
+            telemetry.record_event(st, "fault-armed",
+                                   f"{fault.site}:{fault.mode}"
+                                   f"@{fault.iteration}")
+        t_xfer = time.perf_counter()
+        with telemetry.annotate("transfer"):
+            b = jnp.asarray(b, dtype=dtype)
+            x0 = (jnp.zeros_like(b) if x0 is None
+                  else jnp.asarray(x0, dtype=dtype))
+        telemetry.add_timing(st, "transfer",
+                             time.perf_counter() - t_xfer)
         # tolerances ride in the scalar dtype (f32 for bf16 storage) so a
         # 1e-9 rtol is not pre-rounded to 8 mantissa bits
         sdt = acc_dtype(dtype)
+        telem = self.trace or self.progress
         if self.replace_every:
             if crit.needs_diff:
                 raise ValueError("replace_every supports residual "
                                  "criteria only (the diff criterion has "
                                  "no meaning across replacement segments)")
+            if telem:
+                # the replacement program's inner fori does not thread
+                # a global iteration index, so the telemetry hooks
+                # would silently record nothing -- refuse (the fault-
+                # injector rationale)
+                raise AcgError(
+                    ErrorCode.INVALID_VALUE,
+                    "convergence telemetry (trace/progress) does not "
+                    "reach the replacement-segment program "
+                    "(replace_every); use the direct classic/pipelined "
+                    "programs")
             if fault is not None:
                 # the replacement program's inner fori does not thread a
                 # global iteration index, so an armed injector would
@@ -877,6 +968,12 @@ class JaxCGSolver:
                                  "breakdown-detection hook; recovery/"
                                  "fault injection need kernels='xla'/"
                                  "'pallas'")
+            if telem:
+                raise AcgError(
+                    ErrorCode.INVALID_VALUE,
+                    "kernels='fused' keeps its scalars in SMEM inside "
+                    "the two streamed kernels; convergence telemetry "
+                    "(trace/progress) needs kernels='xla'/'pallas'")
             program = _cg_fused_program
             args = (self._A_program, b, x0,
                     jnp.asarray(crit.residual_atol, sdt),
@@ -895,7 +992,26 @@ class JaxCGSolver:
             kwargs = dict(unbounded=crit.unbounded,
                           needs_diff=crit.needs_diff,
                           precise=self.precise_dots, kernels=self.kernels,
-                          detect=detect, fault=fault)
+                          detect=detect, fault=fault,
+                          trace=self.trace, progress=self.progress)
+        tr = self.trace and not (self.replace_every
+                                 or (isinstance(self.kernels, str)
+                                     and self.kernels.startswith("fused")))
+
+        def run(*a, **kw):
+            """One program invocation, normalised to (CGResult, ring)."""
+            out = program(*a, **kw)
+            return out if tr else (out, None)
+
+        def attempt_trace(res, tbuf):
+            """The ONE host fetch of a traced solve: un-rotate this
+            attempt's ring against its iteration count."""
+            if tbuf is None:
+                return None
+            return telemetry.ConvergenceTrace.from_ring(
+                np.asarray(tbuf), int(res.niterations),
+                solver="cg-pipelined" if self.pipelined else "cg")
+
         # warmup solves outside the timed region (the reference warms up
         # each op class before timing, cgcuda.c:612-710).  device_sync,
         # not bare block_until_ready: the tunneled backend has been
@@ -903,11 +1019,19 @@ class JaxCGSolver:
         # still runs, which would zero every tsolve (_platform).
         from acg_tpu._platform import block_until_ready_works, device_sync
         block_until_ready_works()  # resolve the cached probe OUTSIDE timing
-        for _ in range(max(warmup, 0)):
-            device_sync(program(*args, **kwargs).x)
+        t_warm = time.perf_counter()
+        with telemetry.annotate("compile"):
+            for _ in range(max(warmup, 0)):
+                device_sync(run(*args, **kwargs)[0].x)
+        if warmup > 0:
+            # warmup absorbs the compile; with warmup=0 it lands in the
+            # solve phase (documented in the README observability notes)
+            telemetry.add_timing(st, "compile",
+                                 time.perf_counter() - t_warm)
         t0 = time.perf_counter()
-        res = program(*args, **kwargs)
-        device_sync(res.x)
+        with telemetry.annotate("solve"):
+            res, tbuf = run(*args, **kwargs)
+            device_sync(res.x)
         niter = int(res.niterations)
         first_norms = None
         if detect and bool(res.breakdown):
@@ -931,6 +1055,11 @@ class JaxCGSolver:
                           crit.residual_rtol * float(res.r0nrm2))
             while bool(res.breakdown):
                 k_done = int(res.niterations)
+                if tr:
+                    # the trajectory that led INTO the breakdown -- the
+                    # evidence the post-hoc stats block cannot show
+                    st.trace = self.last_trace = attempt_trace(res, tbuf)
+                    driver.log_trace_window(st.trace)
                 if driver.on_breakdown(k_done):
                     x_next = res.x
                     if not bool(jnp.isfinite(x_next).all()):
@@ -945,7 +1074,7 @@ class JaxCGSolver:
                             + (jnp.asarray(abs_tol, sdt),
                                jnp.asarray(0.0, sdt)) + args[5:-1]
                             + (jnp.int32(remaining),))
-                    res = program(*args, **kwargs)
+                    res, tbuf = run(*args, **kwargs)
                     device_sync(res.x)
                     niter += int(res.niterations)
                     continue
@@ -959,7 +1088,13 @@ class JaxCGSolver:
                 st.tsolve += time.perf_counter() - t0
                 st.converged = False
                 raise driver.give_up(niter, float(res.rnrm2))
-        st.tsolve += time.perf_counter() - t0
+        t_solve = time.perf_counter() - t0
+        st.tsolve += t_solve
+        telemetry.add_timing(st, "solve", t_solve)
+        if tr:
+            # the ONE extra host fetch of a traced solve (acceptance
+            # contract: zero additional transfers per iteration)
+            st.trace = self.last_trace = attempt_trace(res, tbuf)
         st.nsolves += 1
         st.niterations = niter
         st.ntotaliterations += niter
@@ -1004,10 +1139,19 @@ class JaxCGSolver:
                                (mat_bytes + 4 * n * dbl) * (niter + 1))
             st.ops["axpy"].add(niter, 0.0, 6 * n * dbl * niter)
         else:
+            # per-iteration op census matching the eager host solver's
+            # (host_cg.solve): the convergence test's (r, r) is the nrm2
+            # class -- niter in-loop + 1 at setup -- and classic CG's
+            # p = r setup is the one copy.  These were the permanently-
+            # zero rows of the compiled solvers' stats block (the
+            # reference fills both, cgcuda.c:1942-1957).
             st.ops["gemv"].add(niter + 1, 0.0,
                                (mat_bytes + 2 * n * dbl) * (niter + 1))
-            st.ops["dot"].add(2 * niter, 0.0, 2 * n * dbl * 2 * niter)
+            st.ops["dot"].add(niter, 0.0, 2 * n * dbl * niter)
+            st.ops["nrm2"].add(niter + 1, 0.0, n * dbl * (niter + 1))
             st.ops["axpy"].add(3 * niter, 0.0, 3 * n * dbl * 3 * niter)
+            if not self.pipelined:
+                st.ops["copy"].add(1, 0.0, 2 * n * dbl)
         if host_result:
             x = np.asarray(res.x)
             st.fexcept_arrays = [x]

@@ -108,10 +108,37 @@ def profile_ops(solver, b, reps: int = 10) -> dict[str, float]:
         s = solver.stats.ops[op]
         s.t = t * s.n
     # per-program dispatch latency, reported for context (the in-loop
-    # ops pay it once per solve, not once per op)
-    noop = jax.jit(lambda v: v + 1.0)
-    per_call["dispatch"] = _best_time(noop, jnp.zeros((8,)), reps=reps)
+    # ops pay it once per solve, not once per op).  The noop rides the
+    # SOLVER'S value dtype, not the default: under x64 the default
+    # would dispatch an f64 program while the solve runs f32 (and
+    # vice versa for bf16 tiers) -- the measurement must match the
+    # solve's programs
+    vdt = _value_dtype(solver)
+    noop = jax.jit(lambda v: v + jnp.asarray(1, v.dtype))
+    per_call["dispatch"] = _best_time(noop, jnp.zeros((8,), vdt),
+                                      reps=reps)
     return per_call
+
+
+def _value_dtype(solver):
+    """The dtype of the solve's VECTORS (they differ from the matrix
+    dtype under --dtype mixed; replacement solves run f32 outer)."""
+    import numpy as _np
+
+    if getattr(solver, "replace_every", 0):
+        return jnp.float32
+    vdt = getattr(solver, "vector_dtype", None)
+    if vdt is not None:
+        return jnp.dtype(vdt)
+    prob = getattr(solver, "problem", None)
+    if prob is not None:
+        return jnp.dtype(prob.vdtype)
+    A = solver.A
+    dt = (A.dtype if hasattr(A, "dtype")
+          else A.data.dtype if hasattr(A, "data")
+          else A.vals.dtype if hasattr(A, "vals")  # CooMatrix
+          else _np.float32)
+    return jnp.dtype(dt)
 
 
 def _profile_single(solver, b, reps: int) -> dict[str, float]:
@@ -150,8 +177,17 @@ def _profile_single(solver, b, reps: int) -> dict[str, float]:
         "gemv": _time_op(lambda v, M: spmv_f(M, v), x, A, reps=reps),
         "dot": _time_op(lambda v, c: v + tiny * _dot(v, c), x, x,
                         reps=reps),
+        # the convergence test's (r, r): one vector read (vs the dot
+        # class's two) -- its counters are now filled analytically by
+        # the solvers, so the replay must price it too
+        "nrm2": _time_op(lambda v: v + tiny * _dot(v, v), x, reps=reps),
         "axpy": _time_op(lambda y, a, p: y + a * p, x, alpha, x,
                          reps=reps),
+        # copy (p = r at setup): one read + one write; a scale by ~1
+        # keeps the chain's data dependence where a literal jnp.copy
+        # would be elided inside the fused chain
+        "copy": _time_op(lambda y, a: y * a, x,
+                         jnp.asarray(1.0000001, dtype), reps=reps),
     }
 
 
@@ -236,6 +272,20 @@ def _profile_dist(solver, b, reps: int) -> dict[str, float]:
         return smap(body, (pspec, pspec))(x, c)
 
     out["dot"] = _time_op(dot_once, bd, x0 + 1.0, reps=reps)
+
+    def nrm2_once(x):
+        def body(a):
+            # single-vector read: the convergence test's (r, r) class
+            return (a[0] + tiny * jnp.dot(a[0], a[0]))[None]
+
+        return smap(body, (pspec,))(x)
+
+    out["nrm2"] = _time_op(nrm2_once, bd, reps=reps)
+    # copy (p = r at setup): one read + one write per part; the
+    # scale-by-~1 keeps the chain's data dependence (like axpy below,
+    # sharding propagates through the plain jit chain)
+    out["copy"] = _time_op(lambda y, a: y * a, bd,
+                           jnp.asarray(1.0000001, prob.vdtype), reps=reps)
 
     def allreduce_once(s):
         def body(s):

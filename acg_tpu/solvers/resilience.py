@@ -89,9 +89,21 @@ class RecoveryDriver:
         self.what = what
         self.restarts = 0
 
-    def record(self, event: str) -> None:
+    def record(self, event: str, kind: str = "recovery") -> None:
         self.stats.recovery_log.append(event)
+        # timestamped twin for the structured stats sink (--stats-json)
+        from acg_tpu.telemetry import record_event
+        record_event(self.stats, kind, event)
         sys.stderr.write(f"acg-tpu: {self.what}: {event}\n")
+
+    def log_trace_window(self, trace) -> None:
+        """Attach the in-loop telemetry's trailing residual window to
+        the event log -- the trajectory that led INTO the breakdown is
+        exactly what the post-hoc stats block cannot show.  No-op when
+        the solve ran without a convergence trace."""
+        if trace is None:
+            return
+        self.record(trace.tail_summary(), kind="trace-window")
 
     def on_breakdown(self, niter: int) -> bool:
         """Account one detected breakdown; returns True when the policy
@@ -102,6 +114,9 @@ class RecoveryDriver:
         together."""
         st = self.stats
         st.nbreakdowns += 1
+        from acg_tpu.telemetry import record_event
+        record_event(st, "breakdown",
+                     f"breakdown detected at iteration {niter}")
         pol = self.policy
         want_restart = pol is not None and self.restarts < pol.max_restarts
         if not self._agree(0 if want_restart else 1):
@@ -117,12 +132,12 @@ class RecoveryDriver:
             time.sleep(pol.backoff * (2 ** (self.restarts - 1)))
         self.record(f"breakdown detected at iteration {niter}; "
                     f"restart {self.restarts}/{pol.max_restarts} from "
-                    f"the recomputed true residual")
+                    f"the recomputed true residual", kind="restart")
         return True
 
     def on_fallback(self, event: str) -> None:
         self.stats.nfallbacks += 1
-        self.record(event)
+        self.record(event, kind="fallback")
 
     def _agree(self, code: int) -> bool:
         """Cross-controller restart-vs-abort agreement; True = every

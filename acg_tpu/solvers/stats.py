@@ -29,6 +29,13 @@ OP_CLASSES = ("gemv", "dot", "nrm2", "axpy", "copy", "allreduce", "halo")
 # report labels match the reference output block
 _OP_LABELS = {"allreduce": "MPI_Allreduce", "halo": "MPI_HaloExchange"}
 
+# canonical pipeline-phase order for the ``timings:`` section (the
+# telemetry tier's always-on phase timer); phases recorded out of order
+# -- solvers record transfer/compile/solve, the CLI records the rest --
+# still report in this order
+PHASE_ORDER = ("ingest", "partition", "transfer", "compile", "solve",
+               "writeback")
+
 
 @dataclasses.dataclass
 class StoppingCriteria:
@@ -98,6 +105,62 @@ class SolverStats:
     nrestarts: int = 0
     nfallbacks: int = 0
     recovery_log: list = dataclasses.field(default_factory=list)
+    # telemetry tier (acg_tpu.telemetry): timestamped resilience/fault
+    # events for the structured sink, pipeline-phase seconds, and the
+    # last solve's convergence trace (a telemetry.ConvergenceTrace)
+    events: list = dataclasses.field(default_factory=list)
+    timings: dict = dataclasses.field(default_factory=dict)
+    trace: object = None
+
+    def to_dict(self) -> dict:
+        """Machine-readable twin of :meth:`fwrite` -- the ``stats`` key
+        of a ``--stats-json`` document (schema versioned there).  Every
+        value is plain-JSON-able; the convergence trace's records are
+        identical dicts to the ``--convergence-log`` JSONL data lines,
+        so the two sinks round-trip."""
+        c = self.criteria
+        d = {
+            "unknowns": self.unknowns,
+            "nsolves": self.nsolves,
+            "ntotaliterations": self.ntotaliterations,
+            "niterations": self.niterations,
+            "nflops": self.nflops,
+            "tsolve": self.tsolve,
+            "bnrm2": self.bnrm2,
+            "x0nrm2": self.x0nrm2,
+            "r0nrm2": self.r0nrm2,
+            "rnrm2": self.rnrm2,
+            "dxnrm2": self.dxnrm2,
+            "converged": bool(self.converged),
+            "criteria": {
+                "maxits": c.maxits,
+                "residual_atol": c.residual_atol,
+                "residual_rtol": c.residual_rtol,
+                "diff_atol": c.diff_atol,
+                "diff_rtol": c.diff_rtol,
+            },
+            "ops": {op: {"n": s.n, "t": s.t, "bytes": s.bytes}
+                    for op, s in self.ops.items()},
+            "fexcept": fexcept_str(*self.fexcept_arrays),
+            "resilience": {
+                "nbreakdowns": self.nbreakdowns,
+                "nrestarts": self.nrestarts,
+                "nfallbacks": self.nfallbacks,
+                "log": list(self.recovery_log),
+            },
+            "events": list(self.events),
+            "timings": dict(self.timings),
+        }
+        if self.trace is not None:
+            d["trace"] = self.trace.to_dict()
+        # JSON has no Inf/NaN literal; dxnrm2 is inf when no diff
+        # criterion ran
+        import math
+        for k in ("bnrm2", "x0nrm2", "r0nrm2", "rnrm2", "dxnrm2",
+                  "nflops", "tsolve"):
+            if not math.isfinite(d[k]):
+                d[k] = repr(d[k])
+        return d
 
     def fwrite(self, f=None, indent: int = 0) -> str:
         """Solver report, line-compatible with ``acgsolvercuda_fwrite``."""
@@ -144,6 +207,19 @@ class SolverStats:
               f"{self.nrestarts} restarts, {self.nfallbacks} fallbacks")
             for ev in self.recovery_log:
                 p(f"    {ev}")
+        # phase timings appear only when a phase timer ran (the CLI's
+        # always-on tier sets them; library solves leave them empty), so
+        # library reports stay byte-identical to the reference's
+        if self.timings:
+            p("timings:")
+            seen = []
+            for name in PHASE_ORDER:
+                if name in self.timings:
+                    seen.append(name)
+                    p(f"  {name}: {self.timings[name]:,.6f} seconds")
+            for name, secs in self.timings.items():
+                if name not in seen:
+                    p(f"  {name}: {secs:,.6f} seconds")
         text = out.getvalue()
         if f is not None:
             f.write(text)

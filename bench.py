@@ -63,6 +63,30 @@ _FLAGSHIP_REF_BYTES_PER_ITER = (5 * 2048**2 - 4 * 2048) * 12.0 + 80.0 * 2048**2
 TIMED_REPEATS = 5
 
 
+# --stats-json sink: the telemetry tier's structured writer
+# (acg_tpu.telemetry.write_stats_json, JSONL-appended one document per
+# measured case) -- the same schema-versioned twin of the fwrite block
+# the CLI writes, so bench captures and CLI solves feed one consumer
+_STATS_SINK: str | None = None
+
+
+def _sink_stats(row: dict, solver) -> None:
+    """Append the timed solver's full stats document for this row."""
+    if _STATS_SINK is None or solver is None:
+        return
+    try:
+        from acg_tpu import telemetry
+
+        man = telemetry.run_manifest(
+            metric=row.get("metric"), dtype=row.get("dtype"),
+            kernels=row.get("kernels"), format=row.get("format"))
+        telemetry.write_stats_json(_STATS_SINK, solver.stats,
+                                   manifest=man, append=True)
+    except Exception as e:  # noqa: BLE001 -- the sink must never sink a row
+        print(f"# stats-json sink failed: {type(e).__name__}: {e}",
+              file=sys.stderr)
+
+
 def _ref_bytes_per_iter(csr) -> float:
     """The reference's analytic HBM traffic per classic-CG iteration
     (f64 values, int32 indices -- same accounting as its GB/s printout,
@@ -456,10 +480,12 @@ def run_case(csr, name: str, pipelined: bool, dist: bool = False,
     mvb = np.dtype(mat_dtype).itemsize
     vvb = np.dtype(vec_dtype).itemsize
     ws = csr.nnz * (mvb + idx_bytes) + 6.0 * csr.shape[0] * vvb
-    return _roofline_context(
+    row = _roofline_context(
         row, _our_bytes_per_iter(csr.nnz, csr.shape[0], idx_bytes, mvb,
                                  vvb, pipelined),
         info=info, working_set_bytes=ws, maxits=maxits)
+    _sink_stats(row, solver)
+    return row
 
 
 def run_host_baseline(csr, name: str, kind: str) -> dict:
@@ -482,10 +508,12 @@ def run_host_baseline(csr, name: str, kind: str) -> dict:
     standin = _h100_standin(_ref_bytes_per_iter(csr))
     print(f"# {name}: total solver time: {tsolve:.6f} seconds",
           file=sys.stderr)
-    return {"metric": name, "value": round(iters_per_sec, 2),
-            "unit": "iters/s",
-            "vs_baseline": round(iters_per_sec / standin, 4),
-            "dtype": "f64", "host": True}
+    row = {"metric": name, "value": round(iters_per_sec, 2),
+           "unit": "iters/s",
+           "vs_baseline": round(iters_per_sec / standin, 4),
+           "dtype": "f64", "host": True}
+    _sink_stats(row, solver)
+    return row
 
 
 def _enable_compile_cache():
@@ -664,9 +692,11 @@ def run_case_dia(side: int, dim: int, name: str,
     mvb = np.dtype(mat_dtype).itemsize
     vvb = 2 if replace_every else np.dtype(vec_dtype).itemsize
     ws = nnz * float(mvb) + 6.0 * N * vvb
-    return _roofline_context(
+    row = _roofline_context(
         row, _our_bytes_per_iter(nnz, N, 0.0, mvb, vvb, False),
         info=info, working_set_bytes=ws, maxits=maxits)
+    _sink_stats(row, solver)
+    return row
 
 
 def sweep_np(out=sys.stdout) -> int:
@@ -779,7 +809,14 @@ def main(argv=None) -> int:
                          "out subsequent rows; round-3 verdict item 8)")
     ap.add_argument("--sweep-np", action="store_true",
                     help="multi-chip CPU-mesh correctness sweep")
+    ap.add_argument("--stats-json", metavar="FILE", default=None,
+                    help="JSONL-append each timed case's full solver "
+                         "stats document (the CLI's --stats-json "
+                         "schema, acg_tpu.telemetry) next to the "
+                         "summary rows on stdout")
     args = ap.parse_args(argv)
+    global _STATS_SINK
+    _STATS_SINK = args.stats_json
 
     if args.sweep_np:
         return sweep_np()

@@ -1,0 +1,142 @@
+#!/usr/bin/env python3
+"""Render one or more ``--convergence-log`` JSONL files as a residual-
+history comparison.
+
+With matplotlib: a semilog residual plot (one line per file, wrap
+markers where a ring truncated) written to ``-o OUT.png`` or shown.
+Without matplotlib (or under ``--ascii``): a text sparkline per file --
+log-scaled unicode blocks over the surviving window -- so the tool
+works on a bare pod VM.
+
+Usage:
+    python scripts/plot_convergence.py run1.jsonl [run2.jsonl ...] \
+        [-o compare.png] [--ascii]
+"""
+
+from __future__ import annotations
+
+import argparse
+import math
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+BLOCKS = "▁▂▃▄▅▆▇█"
+
+
+def _load(path):
+    from acg_tpu.telemetry import read_convergence_log
+
+    meta, records = read_convergence_log(path)
+    its = [r["it"] for r in records]
+    # poisoned values arrive as repr strings ("nan"/"inf"); float()
+    # parses those directly, so they stay non-finite for the renderers
+    rn = [float(r["rnrm2"]) for r in records]
+    return meta, its, rn
+
+
+def _sparkline(its, rn, width: int = 72) -> str:
+    finite = [v for v in rn if math.isfinite(v) and v > 0]
+    if not finite:
+        return "(no finite residuals)"
+    lo = math.log10(min(finite))
+    hi = math.log10(max(finite))
+    span = max(hi - lo, 1e-12)
+    # downsample to the terminal width by taking each bucket's max
+    # (drift spikes must survive the downsampling -- they are the point)
+    n = len(rn)
+    step = max(n / width, 1.0)
+    out = []
+    i = 0.0
+    while int(i) < n:
+        chunk = rn[int(i): max(int(i + step), int(i) + 1)]
+        worst = max((v for v in chunk if math.isfinite(v) and v > 0),
+                    default=None)
+        if worst is None:
+            out.append("!")  # non-finite bucket: the breakdown marker
+        else:
+            frac = (math.log10(worst) - lo) / span
+            out.append(BLOCKS[min(int(frac * (len(BLOCKS) - 1) + 0.5),
+                                  len(BLOCKS) - 1)])
+        i += step
+    return "".join(out)
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        description="plot --convergence-log JSONL residual histories")
+    ap.add_argument("logs", nargs="+", metavar="FILE",
+                    help="convergence-log JSONL file(s)")
+    ap.add_argument("-o", "--output", metavar="PNG", default=None,
+                    help="write the plot to PNG instead of showing it")
+    ap.add_argument("--ascii", action="store_true",
+                    help="force the text sparkline fallback even when "
+                         "matplotlib is installed")
+    args = ap.parse_args(argv)
+
+    loaded = []
+    for path in args.logs:
+        try:
+            loaded.append((path,) + _load(path))
+        except (OSError, ValueError, KeyError) as e:
+            print(f"plot_convergence: {path}: {e}", file=sys.stderr)
+            return 1
+
+    plt = None
+    if not args.ascii:
+        try:
+            import matplotlib
+            matplotlib.use("Agg" if args.output else matplotlib.get_backend())
+            import matplotlib.pyplot as plt_mod
+            plt = plt_mod
+        except Exception:  # noqa: BLE001 -- fall back to text
+            plt = None
+
+    if plt is None:
+        for path, meta, its, rn in loaded:
+            finite = [v for v in rn if math.isfinite(v) and v > 0]
+            label = meta.get("solver", "cg")
+            head = (f"{path} [{label}] iterations "
+                    f"{its[0] if its else 0}..{its[-1] if its else 0}")
+            if meta.get("wrapped"):
+                head += (f" (ring wrapped: iterations before "
+                         f"{meta.get('truncated_before', its[0] if its else 0)}"
+                         f" truncated)")
+            print(head)
+            print("  " + _sparkline(its, rn))
+            if finite:
+                print(f"  rnrm2 max {max(finite):.3e}  final "
+                      f"{rn[-1]:.3e}" if math.isfinite(rn[-1])
+                      else f"  rnrm2 max {max(finite):.3e}  final "
+                           f"{rn[-1]!r} (breakdown)")
+        return 0
+
+    fig, ax = plt.subplots(figsize=(9, 5))
+    for path, meta, its, rn in loaded:
+        label = os.path.basename(path)
+        if meta.get("wrapped"):
+            label += " (truncated)"
+        ax.semilogy(its, [v if math.isfinite(v) and v > 0 else float("nan")
+                          for v in rn], label=label, linewidth=1.2)
+        # mark non-finite records (breakdown evidence) on the x-axis
+        bad = [i for i, v in zip(its, rn) if not math.isfinite(v)]
+        if bad:
+            ax.plot(bad, [ax.get_ylim()[0]] * len(bad), "rx",
+                    markersize=8, label=f"{label}: non-finite")
+    ax.set_xlabel("iteration")
+    ax.set_ylabel("residual 2-norm")
+    ax.grid(True, which="both", alpha=0.3)
+    ax.legend(fontsize=8)
+    fig.tight_layout()
+    if args.output:
+        fig.savefig(args.output, dpi=130)
+        print(f"wrote {args.output}")
+    else:
+        plt.show()
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
