@@ -262,6 +262,21 @@ def make_parser() -> argparse.ArgumentParser:
                         "convergence trace, and on multihost runs the "
                         "cross-rank min/median/max + imbalance "
                         "aggregation")
+    p.add_argument("--explain", action="store_true",
+                   help="performance-observability report instead of a "
+                        "normal solve: lower + compile the classic, "
+                        "pipelined and distributed whole-solve programs "
+                        "for this system, extract the compiler's own "
+                        "cost_analysis/memory_analysis (the costmodel:/"
+                        "memory: stats sections and their --stats-json "
+                        "twin), build the static communication ledger "
+                        "(per-neighbour halo bytes, psum counts, ICI-hop "
+                        "estimates), and print a per-tier roofline "
+                        "verdict -- predicted vs. measured iteration "
+                        "time against the probed bandwidth and a bound "
+                        "classification (compute/HBM/comm/dispatch).  "
+                        "Degrades gracefully where the analysis is "
+                        "unsupported on the running jax version/backend")
     p.add_argument("--profile-ops", nargs="?", const=10, type=int,
                    default=None, metavar="REPS",
                    help="fill the stats block's per-op seconds/GB/s by "
@@ -327,8 +342,16 @@ def _buildinfo(out) -> int:
          f"{CONVERGENCE_SCHEMA}), --progress (in-loop heartbeat), "
          f"--stats-json ({STATS_SCHEMA}, phase timings + cross-rank "
          f"aggregation)"),
-        ("profiling", "--profile-ops (per-op replay), --trace "
+        ("profiling", "--profile-ops (per-op replay, chain_overhead "
+         "correction term), --trace "
          "(jax.profiler Perfetto, acg:* phase annotations)"),
+        ("perf observability", f"--explain (compiled cost_analysis/"
+         f"memory_analysis introspection, comm ledger, roofline "
+         f"verdict); 'costmodel'/'memory' keys in the {STATS_SCHEMA} "
+         f"stats twin"),
+        ("bench gating", "bench.py --baseline FILE --fail-on-regress "
+         "PCT; scripts/bench_diff.py (diffs --stats-json or bench-row "
+         "captures case-by-case, nonzero exit on regression)"),
     ]
     for k, v in rows:
         out.write(f"{k}: {v}\n")
@@ -501,7 +524,8 @@ def _solve_generated_direct(args, dim, n, N, jax, jnp, dtype,
 
     if args.profile_ops is not None:
         from acg_tpu.solvers.profile import profile_ops
-        profile_ops(solver, b, reps=max(args.profile_ops, 1))
+        per_call = profile_ops(solver, b, reps=max(args.profile_ops, 1))
+        _report_chain_overhead(per_call)
     _fold_phases(args, solver)
     solver.stats.fwrite(sys.stderr)
     t_wb = time.perf_counter()
@@ -509,6 +533,22 @@ def _solve_generated_direct(args, dim, n, N, jax, jnp, dtype,
     args._phases.add("writeback", time.perf_counter() - t_wb)
     _emit_telemetry(args, solver, matrix_id=args.A)
     return 0
+
+
+def _report_chain_overhead(per_call: dict) -> None:
+    """The --profile-ops replay's scalar-chain correction term, as a
+    line next to the stats block it qualifies: chaining a scalar-result
+    op (dot/nrm2/halo/allreduce) folds its scalar back into the carried
+    vector to keep the data dependence, ~one axpy-equivalent extra per
+    call -- those entries are upper bounds by about this much
+    (solvers/profile.py docstring; the CLI prints it, library callers
+    just read the "chain_overhead" key)."""
+    co = per_call.get("chain_overhead")
+    if co is not None:
+        sys.stderr.write(
+            f"per-op replay: chain_overhead {co:.3e} s/call -- "
+            f"scalar-result chains (dot/nrm2/allreduce/halo) are upper "
+            f"bounds by ~this\n")
 
 
 def _checkpoint(args, stage: str, code: int = 0) -> int:
@@ -1409,6 +1449,39 @@ def _main(args) -> int:
     # block's timings: section), and the in-loop trace/progress knobs
     from acg_tpu.telemetry import PhaseTimer
     args._phases = PhaseTimer()
+    if args.explain:
+        # refuse incompatible modes BEFORE anything expensive or
+        # blocking runs: multihost init would block waiting for peers,
+        # and an armed fault injector would poison the timed analysis
+        # solves while the lowered programs stay pristine -- the report
+        # would describe a solve that never runs
+        if (args.multihost or args.coordinator is not None
+                or args.distributed_read):
+            raise SystemExit(
+                "acg-tpu: --explain is a single-controller analysis "
+                "pass (drop --multihost/--coordinator/"
+                "--distributed-read)")
+        if args.fault_inject or os.environ.get("ACG_TPU_FAULT_INJECT"):
+            raise SystemExit(
+                "acg-tpu: --explain analyses and times the PRISTINE "
+                "solve programs; drop --fault-inject (fault-test a "
+                "normal solve instead)")
+        # output-bearing solve flags refuse explicitly rather than
+        # silently produce nothing (the telemetry-tier convention):
+        # --explain runs its own short analysis solves, so none of
+        # these sinks would be written
+        ignored = [flag for flag, on in [
+            ("--convergence-log", bool(args.convergence_log)),
+            ("--progress", args.progress > 0),
+            ("-o/--output", args.output is not None),
+            ("--profile-ops", args.profile_ops is not None),
+            ("--output-comm-matrix", args.output_comm_matrix),
+        ] if on]
+        if ignored:
+            raise SystemExit(
+                f"acg-tpu: --explain is an analysis pass and produces "
+                f"none of: {', '.join(ignored)} -- run a normal solve "
+                f"for those (--stats-json works with --explain)")
     if args.telemetry_window <= 0:
         raise SystemExit("acg-tpu: --telemetry-window must be positive")
     if args.progress < 0:
@@ -1526,6 +1599,14 @@ def _main(args) -> int:
                  "bf16": jnp.bfloat16}[args.dtype]
         vec_dtype = dtype
     comm = {"mpi": "xla", "nccl": "xla", "nvshmem": "dma"}.get(args.comm, args.comm)
+
+    if args.explain:
+        # the perfmodel tier's analysis pass: per-tier compiled-program
+        # introspection + roofline verdict in place of a normal solve
+        # (incompatible modes were refused at the top of _main, before
+        # the backend probe and multihost init could block)
+        from acg_tpu.perfmodel import run_explain
+        return run_explain(args, dtype=dtype, vec_dtype=vec_dtype)
 
     def checkpoint(stage: str, code: int = 0) -> int:
         return _checkpoint(args, stage, code)
@@ -1827,7 +1908,8 @@ def _main(args) -> int:
     # None = flag absent, any given value is clamped to >= 1 rep
     if args.profile_ops is not None:
         from acg_tpu.solvers.profile import profile_ops
-        profile_ops(solver, b, reps=max(args.profile_ops, 1))
+        per_call = profile_ops(solver, b, reps=max(args.profile_ops, 1))
+        _report_chain_overhead(per_call)
 
     # every controller solves; only "rank 0" speaks (the reference's
     # fwritempi / mtxfile_fwrite_mpi_double root-rank output convention)

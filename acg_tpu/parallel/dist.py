@@ -1239,6 +1239,14 @@ class DistCGSolver:
 
     # -- public solve ------------------------------------------------------
 
+    def _solve_dtype(self):
+        """The dtype solve inputs scatter to (the JaxCGSolver hook's
+        twin, shared with the perfmodel tier): the problem's vector
+        dtype, except the replacement tier's outer iteration owns b/x0
+        in f32."""
+        return np.dtype(np.float32 if self.replace_every
+                        else self.problem.vdtype)
+
     def device_args(self, b_global: np.ndarray,
                     x0: np.ndarray | None = None):
         """Scatter + place every solve input on the mesh (the upload
@@ -1249,8 +1257,7 @@ class DistCGSolver:
         (scattering them to bf16 would bake a u_bf16 backward error
         into every replaced residual)."""
         prob = self.problem
-        dtype = np.dtype(np.float32 if self.replace_every
-                         else prob.vdtype)
+        dtype = self._solve_dtype()
         put = functools.partial(put_global, sharding=self._sharding)
         b = put(prob.scatter(np.asarray(b_global), dtype=dtype))
         x0 = put(prob.scatter(np.asarray(x0), dtype=dtype)
@@ -1265,6 +1272,96 @@ class DistCGSolver:
         scnt_np, rcnt_np = prob.neighbor_counts()
         return (b, x0, la, ga, sidx, gsrc, gval,
                 put(scnt_np), put(rcnt_np))
+
+    def lower_solve(self, b_global, x0=None, criteria=None):
+        """Lower (but do not run) the EXACT whole-solve SPMD program this
+        configuration dispatches for ``(b, x0, criteria)`` and return
+        the ``jax.stages.Lowered`` handle -- the observability hook the
+        perfmodel tier (:mod:`acg_tpu.perfmodel`) compiles to extract
+        the compiler's cost/memory analysis.  Same program object, same
+        static arguments and same input avals as :meth:`solve`, so the
+        lowered text is byte-identical to a clean solve's (asserted in
+        tests/test_hlo_structure.py); detection mirrors a clean solve
+        (armed iff a recovery policy is set -- never the fault
+        injector)."""
+        crit = criteria or StoppingCriteria()
+        if self.replace_every and crit.needs_diff:
+            raise ValueError("replace_every supports residual criteria "
+                             "only")
+        sdt = acc_dtype(np.dtype(self.problem.vdtype))
+        b, x0, la, ga, sidx, gsrc, gval, scnt, rcnt = \
+            self.device_args(np.asarray(b_global), x0)
+        tols = jnp.asarray([crit.residual_atol, crit.residual_rtol,
+                            crit.diff_atol, crit.diff_rtol], dtype=sdt)
+        program = self._program_for(None)
+        return program.lower(la, ga, sidx, gsrc, gval, scnt, rcnt, b, x0,
+                             tols, jnp.int32(crit.maxits),
+                             unbounded=crit.unbounded,
+                             needs_diff=crit.needs_diff,
+                             detect=self.recovery is not None)
+
+    def comm_profile(self) -> dict:
+        """Static per-iteration communication ledger (the perfmodel
+        tier): per-neighbour halo payload bytes from the halo plans,
+        psum/allreduce scalar counts and bytes, and ring-hop estimates
+        from the 1-D mesh shape.  Pure host arithmetic -- nothing here
+        touches the device or the compiled programs.
+
+        Counts describe the direct classic/pipelined loop: one halo'd
+        SpMV per iteration, classic = 2 psums of 1 scalar each,
+        pipelined = 1 FUSED psum of 2 scalars (the communication-
+        avoiding property tests/test_hlo_structure.py pins in the HLO);
+        compensated dots double each payload (hi+lo pairs).  The
+        replacement tier runs the same pattern per inner iteration plus
+        one f32 exchange per segment."""
+        prob = self.problem
+        P = int(prob.nparts)
+        dbl = int(np.dtype(prob.vdtype).itemsize)
+        sdl = int(np.dtype(acc_dtype(np.dtype(prob.vdtype))).itemsize)
+        scnt, _rcnt = prob.neighbor_counts()
+        neighbors = []
+        total = 0
+        max_hops = 0
+        for p in range(P):
+            for q in range(P):
+                c = int(scnt[p, q])
+                if c == 0 or p == q:
+                    continue
+                # ring distance over the 1-D parts axis: the ICI-hop
+                # estimate for a torus-linked pod slice
+                hops = min(abs(p - q), P - abs(p - q))
+                max_hops = max(max_hops, hops)
+                total += c * dbl
+                neighbors.append({"src": p, "dst": q, "bytes": c * dbl,
+                                  "hops": hops})
+        nred = 1 if self.pipelined else 2
+        scal = ((2 if self.pipelined else 1)
+                * (2 if self.precise_dots else 1))
+        led = {
+            "transport": self.comm,
+            "nparts": P,
+            "mesh_shape": {str(k): int(v)
+                           for k, v in dict(self.mesh.shape).items()},
+            "halo_exchanges_per_iteration": 1,
+            # local-read multi-controller builds hold plans only for
+            # this controller's parts (neighbor_counts leaves the rest
+            # zero): the halo totals then cover the OWNED rows only --
+            # marked so a consumer never mistakes a per-controller
+            # partial for the pod-global volume
+            **({"owned_parts_only": True,
+                "owned_parts": [int(p) for p in prob.owned_parts]}
+               if prob.owned_parts is not None else {}),
+            "halo_bytes_per_iteration": int(total),
+            "allreduce_per_iteration": int(nred),
+            "allreduce_scalars": int(scal),
+            "allreduce_bytes_per_iteration": int(nred * scal * sdl),
+            "max_hops": int(max_hops),
+        }
+        if len(neighbors) > 64:
+            led["neighbors_truncated"] = len(neighbors) - 64
+            neighbors = neighbors[:64]
+        led["neighbors"] = neighbors
+        return led
 
     def solve(self, b_global: np.ndarray, x0: np.ndarray | None = None,
               criteria: StoppingCriteria | None = None,

@@ -35,7 +35,7 @@ import jax.numpy as jnp
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from acg_tpu.ops.precision import df_add, two_prod
-from acg_tpu.ops.spmv import DiaMatrix
+from acg_tpu.ops.spmv import DiaMatrix, acc_dtype
 from acg_tpu.parallel.mesh import PARTS_AXIS, solve_mesh
 from acg_tpu.solvers.jax_cg import JaxCGSolver
 
@@ -285,6 +285,64 @@ class ShardedDiaCGSolver(JaxCGSolver):
         self._A_program = DiaMatrix(data=tuple(padded),
                                     offsets=self.A.offsets,
                                     nrows=N, ncols_padded=N)
+
+    def comm_profile(self) -> dict:
+        """Static per-iteration communication ledger for the sharded
+        roll tiers (the perfmodel tier).  The halo here is DERIVED, not
+        planned: under ``xla-roll`` each nonzero offset's cyclic shift
+        partitions into a boundary ``collective-permute`` of
+        ``min(|offset|, nloc)`` elements per shard (offsets wider than a
+        shard hop multiple neighbours); the ``pallas-roll`` tier's
+        explicit ppermute halo moves its padded ``Lh + Rh`` window
+        edges to adjacent shards.  The CG scalars psum exactly like the
+        explicit distributed path's (classic 2 x 1 scalar, pipelined 1
+        fused x 2; compensated dots double each payload)."""
+        P = int(self.mesh.devices.size)
+        N = int(self.A.nrows)
+        nloc = -(-N // P) if P else N
+        vdt = (jnp.dtype(self.vector_dtype)
+               if self.vector_dtype is not None else
+               jnp.dtype(self.A.dtype))
+        if self.replace_every:
+            # the inner recurrences (and so the per-iteration halo
+            # payload) ride bf16 under the replacement tier
+            vdt = jnp.dtype(jnp.bfloat16)
+        dbl = int(np.dtype(vdt).itemsize)
+        sdl = int(np.dtype(acc_dtype(vdt)).itemsize)
+        pallas = isinstance(self.kernels, PallasRollSpmv)
+        if P <= 1:
+            per_shard, max_hops, nexch = 0, 0, 0
+        elif pallas:
+            per_shard = int(self.kernels.Lh + self.kernels.Rh)
+            max_hops = 1
+            # one explicit ppermute per populated halo side
+            nexch = int(bool(self.kernels.Lh)) + int(bool(self.kernels.Rh))
+        else:
+            offs = [abs(int(o)) for o in self.A.offsets if o]
+            per_shard = sum(min(o, nloc) for o in offs)
+            max_hops = max((-(-o // nloc) for o in offs), default=0)
+            # each nonzero offset's cyclic shift partitions into its OWN
+            # boundary collective-permute (unlike the explicit path's
+            # single packed all_to_all) -- per-exchange latency pricing
+            # must see every one of them
+            nexch = len(offs)
+        nred = 1 if self.pipelined else 2
+        scal = ((2 if self.pipelined else 1)
+                * (2 if self.precise_dots else 1))
+        return {
+            "transport": ("pallas-roll/ppermute" if pallas
+                          else "xla-roll/collective-permute"),
+            "nparts": P,
+            "mesh_shape": {str(k): int(v)
+                           for k, v in dict(self.mesh.shape).items()},
+            "halo_exchanges_per_iteration": nexch,
+            "halo_bytes_per_iteration": int(per_shard * P * dbl),
+            "halo_bytes_per_shard": int(per_shard * dbl),
+            "allreduce_per_iteration": int(nred),
+            "allreduce_scalars": int(scal),
+            "allreduce_bytes_per_iteration": int(nred * scal * sdl),
+            "max_hops": int(max_hops),
+        }
 
     def ones_b(self, dtype=None) -> jax.Array:
         """A sharded all-ones right-hand side (the CLI default b)."""

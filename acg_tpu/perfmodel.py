@@ -1,0 +1,763 @@
+"""Compiled-program performance observability: XLA cost/memory
+introspection, the static communication ledger, the roofline ``--explain``
+tier, and the bench regression gate.
+
+The reference's credibility rests on accounting for every byte and flop:
+its stats block reports per-op GB/s against a hardware roofline
+(``cgcuda.c:1942-1957``), and the SC'25 paper's core claims are
+communication-volume arguments (halo bytes vs. allreduce latency).  Our
+always-on counters (:func:`acg_tpu.solvers.stats.cg_flops_per_iteration`,
+``bench._our_bytes_per_iter``) are ANALYTIC -- hand-derived models that
+XLA can silently invalidate through fusion, recomputation, or layout
+padding.  This module closes that gap with ground truth from the compiler
+itself:
+
+* :func:`analyze_solver` lowers + compiles the EXACT whole-solve program a
+  solver dispatches (the ``lower_solve`` hook on :class:`~acg_tpu.solvers.
+  jax_cg.JaxCGSolver`, :class:`~acg_tpu.parallel.dist.DistCGSolver` and
+  the sharded tiers) and extracts ``compiled.cost_analysis()`` (flops,
+  bytes accessed) and ``compiled.memory_analysis()`` (argument / output /
+  temp / generated-code HBM bytes).
+* :func:`per_iteration_cost` separates the loop body's cost from the
+  setup's: HloCostAnalysis counts a while/fori body ONCE, so
+  per-iteration = cost(whole program) - cost(setup probe), the probe
+  lowered from the solver's own SpMV/dot selection.
+* :func:`comm_ledger` asks the solver for its static communication
+  ledger (per-neighbour halo payload bytes, psum counts and bytes,
+  ring-hop estimates from the mesh shape) -- the ``comm_profile`` hooks
+  on the distributed tiers.
+* :func:`run_explain` (CLI ``--explain``) fuses all of it into a per-tier
+  roofline verdict: predicted iteration time from the modelled HBM,
+  comm and dispatch components against the probed bandwidth, measured
+  time, attained fraction of the HBM roofline, and the top residual
+  (HBM- / comm- / dispatch-bound; the unexplained remainder is
+  attributed to compute -- no flops/peak time model is claimed).
+* :func:`load_cases` / :func:`compare_cases` / :func:`check_regression`
+  diff two ``--stats-json`` captures (or bench row files) case-by-case
+  -- ``scripts/bench_diff.py`` and ``bench.py --baseline FILE
+  --fail-on-regress PCT`` -- turning the ``BENCH_*.json`` trajectory
+  into an enforced gate instead of an eyeballed one.
+
+Everything degrades gracefully: where ``cost_analysis`` /
+``memory_analysis`` are unsupported on the running jax version/backend
+the report says so and the analytic counters stand alone.  Nothing here
+mutates solver state or the compiled programs -- disarmed perfmodel
+leaves every solve program byte-identical (asserted at the StableHLO
+level in ``tests/test_hlo_structure.py``), and the ``costmodel:`` /
+``memory:`` stats sections append strictly after the reference-format
+block, like ``timings:``.
+
+jax imports stay inside functions: the bench-diff path (and
+``scripts/bench_diff.py --help``) must answer without initialising a
+backend.
+"""
+
+from __future__ import annotations
+
+import json
+import sys
+import time
+
+import numpy as np
+
+# Order-of-magnitude per-link inter-chip (ICI) bandwidth for v5e-class
+# parts, used only to price the comm ledger's bytes in the --explain
+# verdict on TPU backends (off-TPU the "interconnect" is host memory and
+# the HBM probe is reused).  A stand-in until a measured ppermute probe
+# exists -- the verdict prints the number it used, so a reader can
+# re-price.
+ICI_GBS = 45.0
+
+UNAVAILABLE = ("analysis unavailable on this jax version/backend")
+
+
+# -- compiled-program introspection --------------------------------------
+
+def cost_analysis(compiled) -> dict | None:
+    """Normalise ``compiled.cost_analysis()`` across jax versions (a
+    dict, or one dict per device in older releases) to
+    ``{"flops", "bytes_accessed", "output_bytes", "transcendentals"}``.
+    None when the backend/version exposes nothing usable.  NOTE: for
+    multi-device programs the values are PER DEVICE (XLA analyses the
+    per-device module)."""
+    try:
+        ca = compiled.cost_analysis()
+    except Exception:  # noqa: BLE001 -- unsupported backends raise freely
+        return None
+    if isinstance(ca, (list, tuple)):
+        ca = ca[0] if ca else None
+    if not isinstance(ca, dict) or not ca:
+        return None
+    out: dict = {}
+    for key, name in (("flops", "flops"),
+                      ("bytes accessed", "bytes_accessed"),
+                      ("bytes accessedout{}", "output_bytes"),
+                      ("transcendentals", "transcendentals")):
+        v = ca.get(key)
+        if v is not None:
+            v = float(v)
+            if v == v:  # drop NaN placeholders
+                out[name] = v
+    return out or None
+
+
+def memory_analysis(compiled) -> dict | None:
+    """Normalise ``compiled.memory_analysis()`` (CompiledMemoryStats) to
+    plain ints: the program's HBM footprint split into argument / output
+    / temp / generated-code bytes, plus the aliased-buffer discount and
+    the total."""
+    try:
+        ma = compiled.memory_analysis()
+    except Exception:  # noqa: BLE001
+        return None
+    if ma is None:
+        return None
+    out: dict = {}
+    for attr, name in (("argument_size_in_bytes", "argument_bytes"),
+                       ("output_size_in_bytes", "output_bytes"),
+                       ("temp_size_in_bytes", "temp_bytes"),
+                       ("alias_size_in_bytes", "alias_bytes"),
+                       ("generated_code_size_in_bytes",
+                        "generated_code_bytes")):
+        v = getattr(ma, attr, None)
+        if v is not None:
+            out[name] = int(v)
+    if not out:
+        return None
+    out["total_hbm_bytes"] = (out.get("argument_bytes", 0)
+                              + out.get("output_bytes", 0)
+                              + out.get("temp_bytes", 0)
+                              + out.get("generated_code_bytes", 0)
+                              - out.get("alias_bytes", 0))
+    return out
+
+
+def analyze_solver(solver, b, x0=None, criteria=None) -> dict:
+    """Lower + compile the solver's exact solve program for ``(b, x0,
+    criteria)`` and extract the compiler's own cost/memory analysis.
+
+    Returns ``{"available": True, "cost": {...}, "memory": {...}}`` or
+    ``{"available": False, "why": "..."}`` -- observability must degrade,
+    never raise into a solve path.  Never mutates solver state (the
+    ``lower_solve`` hooks re-dispatch the same static configuration a
+    real solve uses)."""
+    try:
+        compiled = solver.lower_solve(b, x0=x0, criteria=criteria).compile()
+    except Exception as e:  # noqa: BLE001
+        return {"available": False,
+                "why": f"lower/compile failed: {type(e).__name__}: {e}"}
+    c = cost_analysis(compiled)
+    m = memory_analysis(compiled)
+    if c is None and m is None:
+        return {"available": False, "why": UNAVAILABLE}
+    doc: dict = {"available": True}
+    if c is not None:
+        doc["cost"] = c
+    if m is not None:
+        doc["memory"] = m
+    return doc
+
+
+def _setup_probe_costs(solver, b, x0) -> dict | None:
+    """Cost of the solve program's SETUP phase, compiled standalone from
+    the solver's own SpMV/dot selection -- the subtrahend of the
+    per-iteration derivation.  Mirrors ``_cg_program`` /
+    ``_cg_pipelined_program`` setup (norms, initial residual, and for
+    the pipelined variant ``w = A r`` plus the epilogue's fresh ``(r,
+    r)``); the leftover ``maximum``/``sqrt`` scalars are noise at vector
+    sizes.  Only the direct classic/pipelined single-chip tiers have a
+    probe: the replacement/fused tiers restructure the loop, and the
+    shard_map program's setup has no standalone form."""
+    import jax
+    import jax.numpy as jnp
+
+    from acg_tpu.solvers.jax_cg import _scalar_setup, _spmv_fn
+
+    if getattr(solver, "replace_every", 0):
+        return None
+    if getattr(solver, "problem", None) is not None:
+        return None
+    kern = solver.kernels
+    if isinstance(kern, str) and kern.startswith("fused"):
+        return None
+    spmv_ = _spmv_fn(kern)
+    dot, _sdt = _scalar_setup(b.dtype, solver.precise_dots)
+    pipelined = solver.pipelined
+
+    @jax.jit
+    def probe(A, b, x0):
+        bn = jnp.sqrt(dot(b, b))
+        xn = jnp.sqrt(dot(x0, x0))
+        r = b - spmv_(A, x0)
+        g = dot(r, r)
+        out = (bn, xn, jnp.sqrt(g), r)
+        if pipelined:
+            w = spmv_(A, r)
+            out = out + (w, dot(r, r))
+        return out
+
+    try:
+        compiled = probe.lower(solver._A_program, b, x0).compile()
+    except Exception:  # noqa: BLE001
+        return None
+    return cost_analysis(compiled)
+
+
+def per_iteration_cost(solver, b, x0=None, criteria=None,
+                       whole: dict | None = None) -> dict | None:
+    """Compiler-derived per-iteration flops/bytes for the direct
+    single-chip tiers: HloCostAnalysis counts a while/fori body ONCE, so
+    per-iteration = cost(whole program) - cost(setup probe).  None
+    where either half is unavailable.
+
+    Counting conventions differ from the analytic counters BY DESIGN --
+    know them before comparing: XLA bills 2 flops per multiply-add over
+    PADDED DIA/ELL plane elements where the analytic model bills 3 per
+    stored nonzero (the reference's convention, symmetric entries twice,
+    ``cgcuda.c:812``), and ``bytes_accessed`` is fusion-aware where the
+    analytic model is a fixed pass count.  The cross-check test
+    (tests/test_perfmodel.py) pins a small-factor agreement band --
+    tight enough to catch silent drift (wrong pass counts, dropped
+    terms, double billing), loose enough not to chase convention gaps.
+    """
+    import jax.numpy as jnp
+
+    if getattr(solver, "problem", None) is not None:
+        # the shard_map program's setup has no standalone probe form
+        return None
+    dtype = solver._solve_dtype()
+    b = jnp.asarray(b, dtype=dtype)
+    x0 = jnp.zeros_like(b) if x0 is None else jnp.asarray(x0, dtype=dtype)
+    if whole is None:
+        whole = analyze_solver(solver, b, x0=x0, criteria=criteria)
+    if not whole.get("available") or "cost" not in whole:
+        return None
+    setup = _setup_probe_costs(solver, b, x0)
+    if setup is None:
+        return None
+    out: dict = {}
+    for k in ("flops", "bytes_accessed", "transcendentals"):
+        w, s = whole["cost"].get(k), setup.get(k)
+        if w is not None and s is not None:
+            out[k] = max(w - s, 0.0)
+    return out or None
+
+
+# -- communication ledger -------------------------------------------------
+
+def comm_ledger(solver) -> dict | None:
+    """The solver's static per-iteration communication ledger (the
+    ``comm_profile`` hook on the distributed tiers: per-neighbour halo
+    bytes from the halo plans, psum counts/bytes, ICI-hop estimates from
+    the mesh shape).  None for single-device solvers; pure host
+    arithmetic -- building it cannot perturb the compiled programs."""
+    prof = getattr(solver, "comm_profile", None)
+    if prof is None:
+        return None
+    try:
+        return prof()
+    except Exception as e:  # noqa: BLE001 -- a ledger bug must not sink a solve
+        return {"error": f"{type(e).__name__}: {e}"}
+
+
+def attach(stats, analysis: dict | None, ledger: dict | None = None,
+           per_iteration: dict | None = None) -> None:
+    """Record an analysis onto ``stats`` -- fills the ``costmodel:`` /
+    ``memory:`` sections of the stats block and its ``--stats-json``
+    twin.  Append-only by construction: the reference-format block and
+    every existing section are untouched (asserted in
+    tests/test_hlo_structure.py)."""
+    cm: dict = {}
+    if analysis is not None:
+        if analysis.get("available"):
+            cm.update(analysis.get("cost", {}))
+        else:
+            cm["unavailable"] = analysis.get("why", UNAVAILABLE)
+    if per_iteration:
+        cm["per_iteration"] = dict(per_iteration)
+    if ledger is not None:
+        cm["comm"] = ledger
+    if cm:
+        stats.costmodel.update(cm)
+    if analysis is not None and analysis.get("available"):
+        mem = analysis.get("memory")
+        if mem:
+            stats.memory.update(mem)
+
+
+# -- analytic traffic model (shared with bench.py) ------------------------
+
+def analytic_bytes_per_iteration(nnz: int, n: int, idx_bytes: float,
+                                 mat_itemsize: int, vec_itemsize: int,
+                                 pipelined: bool) -> float:
+    """OUR analytic HBM traffic per CG iteration: matrix reads in the
+    matrix storage dtype (+ per-nonzero index bytes) plus the vector
+    passes of the loop (15 classic / 21 pipelined -- the pass count
+    implied by the measured 335 MB/iter f32 flagship, BASELINE.md) in
+    the vector storage dtype.  ``bench._our_bytes_per_iter`` delegates
+    here so the harness and the explain tier cannot drift apart."""
+    passes = 21 if pipelined else 15
+    return nnz * (mat_itemsize + idx_bytes) + passes * n * vec_itemsize
+
+
+def triad_probe_gbs(nelems: int = 1 << 26, reps: int = 3,
+                    attempts: int = 4, lo: float = 20.0,
+                    hi: float = 4000.0) -> float:
+    """Two-point chained saxpy-triad HBM bandwidth estimate -- the
+    estimator ``bench.bandwidth_probe_gbs`` has always used, hoisted
+    here so the --explain tier and the bench harness share ONE
+    implementation.  ``a = c + s*a``: 2 reads + 1 write per step; the
+    16-vs-4-step chained difference cancels per-dispatch latency.
+    ``lo``/``hi`` bound plausibility (the bench defaults suit
+    accelerator HBM; --explain lowers ``lo`` for small host-CPU
+    probes).  Raises RuntimeError when contention keeps the estimate
+    implausible for ``attempts`` tries."""
+    import functools
+
+    import jax
+    import jax.numpy as jnp
+
+    from acg_tpu._platform import device_sync
+
+    n = int(nelems)
+    c = jnp.full((n,), 0.5, jnp.float32)
+    a = jnp.ones((n,), jnp.float32)
+
+    @functools.partial(jax.jit, static_argnames="k")
+    def chain(a, c, k):
+        return jax.lax.fori_loop(
+            0, k, lambda _, v: c + jnp.float32(1.0000001) * v, a)
+
+    def best(k):
+        device_sync(chain(a, c, k))
+        ts = []
+        for _ in range(reps):
+            t0 = time.perf_counter()
+            device_sync(chain(a, c, k))
+            ts.append(time.perf_counter() - t0)
+        return min(ts)
+
+    for _ in range(attempts):
+        dt = best(16) - best(4)
+        if dt > 0:
+            bw = 3.0 * n * 4.0 * 12 / dt / 1e9
+            if lo <= bw <= hi:
+                return bw
+        # contention burst corrupted the estimate; retry
+    raise RuntimeError("bandwidth probe unstable (two-point estimate "
+                       f"implausible after {attempts} attempts)")
+
+
+def _dispatch_seconds(reps: int = 5, dtype=None) -> float:
+    """Per-program dispatch latency (a synced noop): the fixed cost a
+    whole-solve program pays ONCE, amortised over its iterations in the
+    roofline verdict -- on tunneled chips this reaches ~100 ms and
+    legitimately dominates short solves (dispatch-bound).  ``dtype``
+    follows the solve's VECTOR dtype, the same rule the --profile-ops
+    dispatch probe applies (solvers/profile.py): an f32 noop under an
+    x64/bf16 config would measure a different-dtype program than the
+    solve dispatches."""
+    import jax
+    import jax.numpy as jnp
+
+    from acg_tpu._platform import device_sync
+
+    dt = jnp.dtype(dtype) if dtype is not None else jnp.float32
+    noop = jax.jit(lambda v: v + jnp.asarray(1, v.dtype))
+    x = jnp.zeros((8,), dt)
+    device_sync(noop(x))
+    ts = []
+    for _ in range(reps):
+        t0 = time.perf_counter()
+        device_sync(noop(x))
+        ts.append(time.perf_counter() - t0)
+    return min(ts)
+
+
+def classify_bound(measured_s: float, hbm_s: float, comm_s: float,
+                   dispatch_s: float) -> tuple[str, dict]:
+    """``(verdict, components)``: attribute a measured iteration time to
+    its largest modelled component; whatever the byte/comm/dispatch
+    model cannot explain is attributed to compute (or an unmodelled
+    term -- the verdict is a pointer, not a proof)."""
+    comp = {"HBM-bound": max(hbm_s, 0.0),
+            "comm-bound": max(comm_s, 0.0),
+            "dispatch-bound": max(dispatch_s, 0.0)}
+    comp["compute-bound"] = max(measured_s - sum(comp.values()), 0.0)
+    verdict = max(comp, key=lambda k: comp[k])
+    return verdict, comp
+
+
+# -- the CLI --explain tier ----------------------------------------------
+
+def _explain_matrix(args):
+    """Host CSR for the explain pass: gen: specs synthesized in-process,
+    files read via mtxfile.  Explain is an analysis pass over all three
+    solver tiers, so it needs the host matrix -- refuse sizes that only
+    the direct on-device assembly path could hold."""
+    from acg_tpu.errors import AcgError
+    from acg_tpu.matrix import SymCsrMatrix
+
+    if args.A.startswith("gen:"):
+        from acg_tpu.cli import _gen_direct_min, _parse_gen_spec
+        from acg_tpu.io.generators import (irregular_spd_coo, poisson2d_coo,
+                                           poisson3d_coo)
+
+        kind, dim, n, N, avg = _parse_gen_spec(args.A)
+        if N > _gen_direct_min():
+            raise SystemExit(
+                f"acg-tpu: --explain analyses host-assembled tiers "
+                f"(N={N:,} rows needs the direct on-device path); use a "
+                f"smaller gen: spec")
+        if kind == "poisson":
+            gen = poisson2d_coo if dim == 2 else poisson3d_coo
+            r, c, v, N = gen(n)
+        else:
+            r, c, v, N = irregular_spd_coo(n, avg_degree=avg,
+                                           seed=args.seed)
+        A = SymCsrMatrix.from_coo(N, r, c, v)
+    else:
+        from acg_tpu.io.mtxfile import read_mtx
+
+        try:
+            A = SymCsrMatrix.from_mtx(read_mtx(args.A, binary=args.binary))
+        except AcgError as e:
+            raise SystemExit(f"acg-tpu: {args.A}: {e}")
+    return A.to_csr(epsilon=args.epsilon)
+
+
+def _fmt_bytes(n: float) -> str:
+    return f"{n:,.0f} B" if n < 1 << 20 else f"{n / 2**20:,.1f} MiB"
+
+
+def _explain_tier(name, solver, b, csr, K, bw_gbs, dispatch_s, on_tpu,
+                  err) -> dict | None:
+    """Analyze + time one tier and print its explain block.  Returns the
+    verdict row (for the optional --stats-json sink), or None when the
+    tier failed entirely."""
+    from acg_tpu.ops.spmv import matrix_index_bytes, matrix_dtype
+    from acg_tpu.solvers.stats import (StoppingCriteria,
+                                       cg_flops_per_iteration)
+
+    an = analyze_solver(solver, b)
+    per = per_iteration_cost(solver, b, whole=an)
+    led = comm_ledger(solver)
+
+    # timed short solve: warmup absorbs the compile, K iterations
+    # unbounded (the benchmark protocol's fixed-trip shape)
+    solver.stats.tsolve = 0.0
+    solver.solve(b, criteria=StoppingCriteria(maxits=K), warmup=1,
+                 host_result=False, raise_on_divergence=False)
+    t_iter = solver.stats.tsolve / K
+
+    attach(solver.stats, an, ledger=led, per_iteration=per)
+
+    # analytic fallbacks when the compiler analysis is unavailable
+    prob = getattr(solver, "problem", None)
+    if prob is not None:
+        nnz, n = int(prob.nnz_total), int(prob.n)
+        mat_b = int(np.dtype(prob.dtype).itemsize)
+        vec_b = int(np.dtype(prob.vdtype).itemsize)
+        idx_b = 0.0 if prob.local.format == "dia" else 4.0
+    else:
+        A = solver.A
+        nnz, n = int(csr.nnz), int(csr.shape[0])
+        mat_b = int(np.dtype(matrix_dtype(A)).itemsize)
+        vec_b = int(np.dtype(solver._solve_dtype()).itemsize)
+        idx_b = matrix_index_bytes(A)
+    flops_it_analytic = cg_flops_per_iteration(nnz, n, solver.pipelined)
+    bytes_it_analytic = analytic_bytes_per_iteration(
+        nnz, n, idx_b, mat_b, vec_b, solver.pipelined)
+    bytes_it = per.get("bytes_accessed", bytes_it_analytic) if per \
+        else bytes_it_analytic
+
+    comm_bytes = 0
+    if led and "error" not in led:
+        comm_bytes = (led.get("halo_bytes_per_iteration", 0)
+                      + led.get("allreduce_bytes_per_iteration", 0))
+    ici = ICI_GBS if on_tpu else bw_gbs
+    t_hbm = bytes_it / (bw_gbs * 1e9) if bw_gbs else 0.0
+    t_comm = comm_bytes / (ici * 1e9) if (comm_bytes and ici) else 0.0
+    t_disp = dispatch_s / max(K, 1)
+    verdict, comp = classify_bound(t_iter, t_hbm, t_comm, t_disp)
+    predicted = t_hbm + t_comm + t_disp
+    attained = (t_hbm / t_iter) if t_iter > 0 else 0.0
+
+    err.write(f"== explain: {name} ==\n")
+    solver.stats.fwrite(err, indent=2)
+    if an.get("available") and "cost" in an:
+        c = an["cost"]
+        err.write(f"  compiler: flops {c.get('flops', 0):,.4g}, bytes "
+                  f"accessed {c.get('bytes_accessed', 0):,.4g} per program"
+                  f" (loop body counted once by HloCostAnalysis"
+                  f"{'; per device' if prob is not None else ''})\n")
+    else:
+        err.write(f"  compiler: cost {an.get('why', UNAVAILABLE)}\n")
+    if per:
+        err.write(f"  per-iteration (compiler-derived): flops "
+                  f"{per.get('flops', 0):,.4g}, bytes "
+                  f"{per.get('bytes_accessed', 0):,.4g}; analytic: flops "
+                  f"{flops_it_analytic:,.4g}, bytes "
+                  f"{bytes_it_analytic:,.4g}\n")
+    else:
+        err.write(f"  per-iteration (analytic): flops "
+                  f"{flops_it_analytic:,.4g}, bytes "
+                  f"{bytes_it_analytic:,.4g}\n")
+    mem = an.get("memory") if an.get("available") else None
+    if mem:
+        err.write(f"  memory (HBM footprint): arguments "
+                  f"{_fmt_bytes(mem.get('argument_bytes', 0))} + output "
+                  f"{_fmt_bytes(mem.get('output_bytes', 0))} + temp "
+                  f"{_fmt_bytes(mem.get('temp_bytes', 0))} = "
+                  f"{_fmt_bytes(mem.get('total_hbm_bytes', 0))}\n")
+    if led and "error" not in led:
+        err.write(f"  comm ledger: halo "
+                  f"{led.get('halo_bytes_per_iteration', 0):,} B/iter, "
+                  f"allreduce {led.get('allreduce_per_iteration', 0)} x "
+                  f"{led.get('allreduce_scalars', 0)} scalars "
+                  f"({led.get('allreduce_bytes_per_iteration', 0)} B/iter),"
+                  f" max {led.get('max_hops', 0)} hop(s) "
+                  f"[{led.get('transport', '?')}]\n")
+    bw_txt = f"{bw_gbs:,.1f} GB/s" if bw_gbs else "unavailable"
+    err.write(f"  roofline: probe {bw_txt}"
+              + (f", ici {ici:,.0f} GB/s (stand-in)" if comm_bytes and
+                 on_tpu else "")
+              + f"; predicted {predicted:.3e} s/iter (hbm {t_hbm:.3e} + "
+              f"comm {t_comm:.3e} + dispatch {t_disp:.3e})\n")
+    err.write(f"  measured {t_iter:.3e} s/iter over {K} iterations; "
+              f"attained {attained:.2f}x of HBM roofline; "
+              f"verdict: {verdict}\n\n")
+
+    return {"tier": name, "measured_s_per_iter": t_iter,
+            "predicted_s_per_iter": predicted,
+            "attained_roofline_frac": attained, "bound": verdict,
+            "components_s": comp}
+
+
+def run_explain(args, dtype, vec_dtype) -> int:
+    """The CLI ``--explain`` driver: build the system once, then for the
+    classic, pipelined and distributed tiers lower + compile the exact
+    solve programs, extract compiler cost/memory, build the comm ledger,
+    time a short solve, and print the roofline verdict per tier.
+    Single-controller analysis pass; exits 0 when at least one tier
+    reported."""
+    import jax
+
+    err = sys.stderr
+    csr = _explain_matrix(args)
+    n = csr.shape[0]
+    b = np.ones(n)
+    K = max(8, min(args.max_iterations, 60))
+    on_tpu = jax.default_backend() == "tpu"
+    bw = None
+    try:
+        # full-size probe on real HBM; a small (16 MiB/vector) variant
+        # elsewhere -- host CPUs move the small triad fast enough, and
+        # --explain must stay cheap in CPU test runs
+        bw = (triad_probe_gbs() if on_tpu
+              else triad_probe_gbs(1 << 22, lo=0.5))
+    except Exception as e:  # noqa: BLE001
+        err.write(f"acg-tpu: bandwidth probe failed ({e}); roofline "
+                  f"fractions unavailable\n")
+    disp = _dispatch_seconds(dtype=vec_dtype)
+
+    import jax.numpy as jnp
+
+    from acg_tpu.ops.spmv import device_matrix_from_csr, prefers_dia
+    from acg_tpu.solvers.jax_cg import JaxCGSolver
+
+    rows = []
+    # ONE device assembly serves both single-chip tiers (A is immutable;
+    # rebuilding it per tier would re-upload every plane)
+    A = device_matrix_from_csr(csr, dtype=dtype, format=args.spmv_format)
+    for name, pipelined in (("cg", False), ("cg-pipelined", True)):
+        try:
+            # the session's recovery policy rides along (--recover):
+            # lower_solve arms detect exactly like solve(), so the
+            # analyzed/timed programs are the configured ones
+            solver = JaxCGSolver(A, pipelined=pipelined,
+                                 precise_dots=args.precise_dots,
+                                 kernels=args.kernels,
+                                 vector_dtype=vec_dtype,
+                                 recovery=getattr(args, "_recovery",
+                                                  None))
+            row = _explain_tier(
+                f"{name} ({solver.kernels} kernels, {args.dtype})",
+                solver, jnp.asarray(b, solver._solve_dtype()), csr, K, bw,
+                disp, on_tpu, err)
+            if row:
+                rows.append((row, solver))
+        except Exception as e:  # noqa: BLE001 -- one tier must not sink the rest
+            err.write(f"acg-tpu: explain tier {name} failed: "
+                      f"{type(e).__name__}: {e}\n")
+
+    # one distributed tier: the halo'd multi-part program over however
+    # many devices this host exposes (capped -- the ledger and verdict,
+    # not scaling, are the point here)
+    nparts = args.nparts or min(len(jax.devices()), 4)
+    try:
+        from acg_tpu.parallel.dist import DistCGSolver, DistributedProblem
+        from acg_tpu.partition import partition_rows
+
+        method = "band" if prefers_dia(csr) else "graph"
+        part = partition_rows(csr, nparts, seed=args.seed, method=method)
+        prob = DistributedProblem.build(csr, part, nparts, dtype=dtype,
+                                        vector_dtype=vec_dtype)
+        comm = {"mpi": "xla", "nccl": "xla",
+                "nvshmem": "dma"}.get(args.comm, args.comm)
+        solver = DistCGSolver(prob, pipelined=False,
+                              comm=comm if comm != "none" else "xla",
+                              precise_dots=args.precise_dots,
+                              kernels=args.kernels,
+                              recovery=getattr(args, "_recovery", None))
+        row = _explain_tier(f"dist-cg (nparts={nparts}, {solver.kernels} "
+                            f"kernels, {args.dtype})", solver, b, csr, K,
+                            bw, disp, on_tpu, err)
+        if row:
+            rows.append((row, solver))
+    except Exception as e:  # noqa: BLE001
+        err.write(f"acg-tpu: explain tier dist-cg failed: "
+                  f"{type(e).__name__}: {e}\n")
+
+    if args.stats_json:
+        from acg_tpu import telemetry
+
+        try:
+            for row, solver in rows:
+                man = telemetry.run_manifest(
+                    metric=f"explain:{row['tier']}", matrix=str(args.A),
+                    dtype=args.dtype, explain=row)
+                telemetry.write_stats_json(args.stats_json, solver.stats,
+                                           manifest=man, append=True)
+        except OSError as e:
+            err.write(f"acg-tpu: {args.stats_json}: {e}\n")
+    return 0 if rows else 1
+
+
+# -- bench regression gate ------------------------------------------------
+
+def _doc_case(doc: dict):
+    """``(key, value)`` for one --stats-json document: the case key is
+    the manifest metric (bench rows) or solver:matrix (CLI solves), the
+    value iterations/second from the stats twin."""
+    man = doc.get("manifest") or {}
+    st = doc.get("stats") or {}
+    metric = man.get("metric")
+    if metric is None:
+        metric = f"{man.get('solver', 'solve')}:{man.get('matrix', '?')}"
+    try:
+        tsolve = float(st.get("tsolve", 0.0))
+        niter = float(st.get("niterations", 0))
+    except (TypeError, ValueError):
+        return None
+    if tsolve <= 0 or niter <= 0:
+        return None
+    return str(metric), niter / tsolve
+
+
+def _row_case(row: dict):
+    """``(key, value)`` for one bench summary row (the JSON lines bench
+    prints / BENCH_*.json records)."""
+    metric, value = row.get("metric"), row.get("value")
+    if metric is None or not isinstance(value, (int, float)):
+        return None
+    return str(metric), float(value)
+
+
+def rows_to_cases(rows) -> dict:
+    """Best value per metric over a list of bench row dicts."""
+    cases: dict = {}
+    for row in rows:
+        c = _row_case(row)
+        if c is not None:
+            cases[c[0]] = max(cases.get(c[0], float("-inf")), c[1])
+    return cases
+
+
+def load_cases(path) -> dict:
+    """Parse a capture file into ``{metric: best_value}``.  Accepts
+    either format on either side of a diff: ``--stats-json`` documents
+    (one indented document, or JSONL-appended as bench writes them) or
+    bench summary-row JSONL (BENCH_*.json); non-JSON lines (the ``#``
+    commentary bench interleaves) are skipped."""
+    with open(path) as f:
+        text = f.read()
+    objs = []
+    try:
+        whole = json.loads(text)
+        objs = whole if isinstance(whole, list) else [whole]
+    except ValueError:
+        for line in text.splitlines():
+            line = line.strip()
+            if not line or line.startswith("#"):
+                continue
+            try:
+                objs.append(json.loads(line))
+            except ValueError:
+                continue
+    cases: dict = {}
+    for obj in objs:
+        if not isinstance(obj, dict):
+            continue
+        if isinstance(obj.get("parsed"), dict):
+            # the growth driver's BENCH_r0N.json wrapper: the row it
+            # parsed from the run's stdout rides under "parsed"
+            obj = obj["parsed"]
+        c = _doc_case(obj) if "stats" in obj else _row_case(obj)
+        if c is not None:
+            cases[c[0]] = max(cases.get(c[0], float("-inf")), c[1])
+    return cases
+
+
+def compare_cases(old: dict, new: dict, pct: float
+                  ) -> tuple[list[str], int, int]:
+    """``(report_lines, nregressed, ncompared)``: case-by-case diff of
+    two capture dicts.  A case regresses when its new value falls more
+    than ``pct`` percent below the baseline; cases present on only one
+    side are reported but never gate (a renamed row must not silently
+    pass OR fail -- the no-common-cases outcome is its own exit code)."""
+    lines: list[str] = []
+    nreg = ncmp = 0
+    for key in sorted(set(old) | set(new)):
+        if key not in old:
+            lines.append(f"bench-diff: {key}: (new case) {new[key]:,.2f}")
+            continue
+        if key not in new:
+            lines.append(f"bench-diff: {key}: baseline-only "
+                         f"({old[key]:,.2f}); not gated")
+            continue
+        ncmp += 1
+        o, v = old[key], new[key]
+        delta = (v - o) / o * 100.0 if o else 0.0
+        if o > 0 and v < o * (1.0 - pct / 100.0):
+            nreg += 1
+            lines.append(f"bench-diff: {key}: {o:,.2f} -> {v:,.2f} "
+                         f"({delta:+.1f}% REGRESSION, threshold "
+                         f"-{pct:g}%)")
+        else:
+            lines.append(f"bench-diff: {key}: {o:,.2f} -> {v:,.2f} "
+                         f"({delta:+.1f}%)")
+    return lines, nreg, ncmp
+
+
+def check_regression(rows, baseline_path, pct: float) -> int:
+    """The ``bench.py --baseline FILE --fail-on-regress PCT`` gate:
+    compare this run's emitted rows against the baseline capture.
+    Exit-code contract (shared with scripts/bench_diff.py): 0 = no
+    regression, 1 = regression past the threshold, 2 = nothing
+    comparable (unreadable baseline / no common cases) -- 2 is a
+    failure too, so a renamed metric cannot silently green the gate."""
+    try:
+        old = load_cases(baseline_path)
+    except OSError as e:
+        print(f"bench-diff: {baseline_path}: {e}", file=sys.stderr)
+        return 2
+    new = rows_to_cases(rows)
+    lines, nreg, ncmp = compare_cases(old, new, pct)
+    for ln in lines:
+        print(ln, file=sys.stderr)
+    if ncmp == 0:
+        print("bench-diff: no comparable cases between this run and "
+              f"{baseline_path}", file=sys.stderr)
+        return 2
+    return 1 if nreg else 0

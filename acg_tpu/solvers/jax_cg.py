@@ -849,77 +849,30 @@ class JaxCGSolver:
             self._spmv_flops_cache = spmv_flops(self.A)
         return self._spmv_flops_cache
 
-    def solve(self, b, x0=None, criteria: StoppingCriteria | None = None,
-              raise_on_divergence: bool = True, warmup: int = 0,
-              host_result: bool = True) -> np.ndarray:
-        """Solve Ax=b.  ``host_result=False`` returns the device array
-        instead of copying x to the host -- at pod-filling sizes the
-        copy dwarfs the solve (537 MB for 512^3), and a caller that only
-        needs the timing/stats (benchmarks) or feeds x to another device
-        computation should not pay it.  The FP-exception report then
-        comes from a device-side finiteness check instead of the host
-        scan."""
-        crit = criteria or StoppingCriteria()
-        st = self.stats
-        st.criteria = crit
-        from acg_tpu import faults
-        fault = faults.device_fault()
-        if fault is not None and fault.site == "halo":
-            # no halo exists on the single-device solver: an armed
-            # injector that can never fire must refuse, not report a
-            # clean "fault-tested" solve (the replace_every rationale)
-            raise AcgError(
-                ErrorCode.INVALID_VALUE,
-                "halo fault injection needs a distributed problem with "
-                "ghost exchange (DistCGSolver, nparts > 1); the "
-                "single-device solver has no halo to poison")
-        if fault is not None and fault.part > 0:
-            # _fault_nparts distinguishes the true single-device solver
-            # from multi-part subclasses that reuse this solve (the
-            # sharded roll tier): NEITHER can honour part targeting --
-            # these programs apply faults to the global vector -- but
-            # the diagnosis must name the right reason
-            if getattr(self, "_fault_nparts", 1) == 1:
-                raise AcgError(
-                    ErrorCode.INVALID_VALUE,
-                    f"fault spec targets part {fault.part}, but the "
-                    f"single-device solver has only part 0 -- the fault "
-                    f"could never fire")
-            raise AcgError(
-                ErrorCode.INVALID_VALUE,
-                f"the sharded single-program tier applies faults to the "
-                f"global vector and cannot target part {fault.part}; "
-                f"drop part= or use the partitioned DistCGSolver path "
-                f"for part-targeted injection")
-        # detection arms with the recovery policy OR an active injector
-        # (an injected fault must surface, never launder into x); the
-        # detect=False programs stay byte-identical to the seed's
-        detect = self.recovery is not None or fault is not None
+    def _solve_dtype(self):
+        """The vector dtype a solve converts b/x0 to: the matrix dtype
+        unless ``vector_dtype`` overrides it; the replacement tier's
+        outer iteration owns b/x0 in f32 (rounding b to bf16 would bake
+        a u_bf16-sized backward error into every replaced residual)."""
         dtype = matrix_dtype(self.A)
         if self.vector_dtype is not None:
             dtype = jnp.dtype(self.vector_dtype)
         if self.replace_every:
-            # the outer iteration owns b/x0 in f32 -- rounding b to bf16
-            # here would bake a u_bf16-sized backward error into every
-            # residual the replacement recomputes
             dtype = jnp.dtype(jnp.float32)
-        from acg_tpu import telemetry
-        if fault is not None:
-            # timestamped twin of the injector's stderr line for the
-            # structured sink (--stats-json)
-            telemetry.record_event(st, "fault-armed",
-                                   f"{fault.site}:{fault.mode}"
-                                   f"@{fault.iteration}")
-        t_xfer = time.perf_counter()
-        with telemetry.annotate("transfer"):
-            b = jnp.asarray(b, dtype=dtype)
-            x0 = (jnp.zeros_like(b) if x0 is None
-                  else jnp.asarray(x0, dtype=dtype))
-        telemetry.add_timing(st, "transfer",
-                             time.perf_counter() - t_xfer)
+        return dtype
+
+    def _select_program(self, b, x0, crit: StoppingCriteria,
+                        detect: bool = False, fault=None):
+        """``(program, args, kwargs, traced)``: this configuration's
+        whole-solve program dispatch -- ONE function shared by
+        :meth:`solve` and :meth:`lower_solve`, so the observability tier
+        (:mod:`acg_tpu.perfmodel`) interrogates EXACTLY the program a
+        solve runs, never a reconstruction that could drift.  ``b``/``x0``
+        must already be device arrays in :meth:`_solve_dtype`.  Raises
+        the same configuration refusals a solve would."""
         # tolerances ride in the scalar dtype (f32 for bf16 storage) so a
         # 1e-9 rtol is not pre-rounded to 8 mantissa bits
-        sdt = acc_dtype(dtype)
+        sdt = acc_dtype(b.dtype)
         telem = self.trace or self.progress
         if self.replace_every:
             if crit.needs_diff:
@@ -997,6 +950,99 @@ class JaxCGSolver:
         tr = self.trace and not (self.replace_every
                                  or (isinstance(self.kernels, str)
                                      and self.kernels.startswith("fused")))
+        return program, args, kwargs, tr
+
+    def lower_solve(self, b, x0=None, criteria=None):
+        """Lower (but do not run) the EXACT whole-solve XLA program this
+        configuration dispatches for ``(b, x0, criteria)`` and return
+        the ``jax.stages.Lowered`` handle -- the observability hook the
+        perfmodel tier (:mod:`acg_tpu.perfmodel`) compiles to extract
+        the compiler's own cost/memory analysis.
+
+        Never mutates solver state, and shares :meth:`_select_program`
+        with :meth:`solve`, so the lowered program is byte-identical to
+        the one a solve compiles (asserted in tests/test_hlo_structure.
+        py).  Breakdown detection mirrors a clean solve: armed iff a
+        recovery policy is set.  The fault injector is deliberately NOT
+        consulted -- analysis describes the pristine program."""
+        crit = criteria or StoppingCriteria()
+        dtype = self._solve_dtype()
+        b = jnp.asarray(b, dtype=dtype)
+        x0 = (jnp.zeros_like(b) if x0 is None
+              else jnp.asarray(x0, dtype=dtype))
+        program, args, kwargs, _ = self._select_program(
+            b, x0, crit, detect=self.recovery is not None, fault=None)
+        return program.lower(*args, **kwargs)
+
+    def solve(self, b, x0=None, criteria: StoppingCriteria | None = None,
+              raise_on_divergence: bool = True, warmup: int = 0,
+              host_result: bool = True) -> np.ndarray:
+        """Solve Ax=b.  ``host_result=False`` returns the device array
+        instead of copying x to the host -- at pod-filling sizes the
+        copy dwarfs the solve (537 MB for 512^3), and a caller that only
+        needs the timing/stats (benchmarks) or feeds x to another device
+        computation should not pay it.  The FP-exception report then
+        comes from a device-side finiteness check instead of the host
+        scan."""
+        crit = criteria or StoppingCriteria()
+        st = self.stats
+        st.criteria = crit
+        from acg_tpu import faults
+        fault = faults.device_fault()
+        if fault is not None and fault.site == "halo":
+            # no halo exists on the single-device solver: an armed
+            # injector that can never fire must refuse, not report a
+            # clean "fault-tested" solve (the replace_every rationale)
+            raise AcgError(
+                ErrorCode.INVALID_VALUE,
+                "halo fault injection needs a distributed problem with "
+                "ghost exchange (DistCGSolver, nparts > 1); the "
+                "single-device solver has no halo to poison")
+        if fault is not None and fault.part > 0:
+            # _fault_nparts distinguishes the true single-device solver
+            # from multi-part subclasses that reuse this solve (the
+            # sharded roll tier): NEITHER can honour part targeting --
+            # these programs apply faults to the global vector -- but
+            # the diagnosis must name the right reason
+            if getattr(self, "_fault_nparts", 1) == 1:
+                raise AcgError(
+                    ErrorCode.INVALID_VALUE,
+                    f"fault spec targets part {fault.part}, but the "
+                    f"single-device solver has only part 0 -- the fault "
+                    f"could never fire")
+            raise AcgError(
+                ErrorCode.INVALID_VALUE,
+                f"the sharded single-program tier applies faults to the "
+                f"global vector and cannot target part {fault.part}; "
+                f"drop part= or use the partitioned DistCGSolver path "
+                f"for part-targeted injection")
+        # detection arms with the recovery policy OR an active injector
+        # (an injected fault must surface, never launder into x); the
+        # detect=False programs stay byte-identical to the seed's
+        detect = self.recovery is not None or fault is not None
+        # dtype policy (vector_dtype override, f32 replacement outer)
+        # lives in _solve_dtype, shared with the lower_solve hook
+        dtype = self._solve_dtype()
+        from acg_tpu import telemetry
+        if fault is not None:
+            # timestamped twin of the injector's stderr line for the
+            # structured sink (--stats-json)
+            telemetry.record_event(st, "fault-armed",
+                                   f"{fault.site}:{fault.mode}"
+                                   f"@{fault.iteration}")
+        t_xfer = time.perf_counter()
+        with telemetry.annotate("transfer"):
+            b = jnp.asarray(b, dtype=dtype)
+            x0 = (jnp.zeros_like(b) if x0 is None
+                  else jnp.asarray(x0, dtype=dtype))
+        telemetry.add_timing(st, "transfer",
+                             time.perf_counter() - t_xfer)
+        # scalar dtype for recovery's re-derived tolerances below; the
+        # program dispatch itself -- tolerances, static kwargs, the
+        # configuration refusals -- is shared with lower_solve
+        sdt = acc_dtype(dtype)
+        program, args, kwargs, tr = self._select_program(
+            b, x0, crit, detect=detect, fault=fault)
 
         def run(*a, **kw):
             """One program invocation, normalised to (CGResult, ring)."""

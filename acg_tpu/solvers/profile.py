@@ -107,6 +107,14 @@ def profile_ops(solver, b, reps: int = 10) -> dict[str, float]:
     for op, t in per_call.items():
         s = solver.stats.ops[op]
         s.t = t * s.n
+    # the scalar-chain replay caveat as a NUMBER, not prose (the module
+    # docstring's last bullet): chaining a scalar-result op (dot, nrm2,
+    # halo, allreduce) folds its scalar back into the carried vector to
+    # keep the data dependence, ~one axpy-equivalent extra per
+    # repetition -- so those entries are upper bounds by about this
+    # much per call.  Reported as an explicit key so consumers can
+    # discount it mechanically instead of reading a docstring.
+    per_call["chain_overhead"] = per_call.get("axpy", 0.0)
     # per-program dispatch latency, reported for context (the in-loop
     # ops pay it once per solve, not once per op).  The noop rides the
     # SOLVER'S value dtype, not the default: under x64 the default

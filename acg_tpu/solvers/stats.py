@@ -111,6 +111,14 @@ class SolverStats:
     events: list = dataclasses.field(default_factory=list)
     timings: dict = dataclasses.field(default_factory=dict)
     trace: object = None
+    # perfmodel tier (acg_tpu.perfmodel): the compiler's OWN cost
+    # analysis of the compiled solve program (flops / bytes accessed,
+    # per-iteration derivation, the static communication ledger) and its
+    # memory analysis (argument/output/temp/generated-code HBM bytes).
+    # Sections render only when an analysis pass (--explain) recorded
+    # them -- the reference-format block stays byte-identical otherwise
+    costmodel: dict = dataclasses.field(default_factory=dict)
+    memory: dict = dataclasses.field(default_factory=dict)
 
     def to_dict(self) -> dict:
         """Machine-readable twin of :meth:`fwrite` -- the ``stats`` key
@@ -150,6 +158,8 @@ class SolverStats:
             },
             "events": list(self.events),
             "timings": dict(self.timings),
+            "costmodel": dict(self.costmodel),
+            "memory": dict(self.memory),
         }
         if self.trace is not None:
             d["trace"] = self.trace.to_dict()
@@ -220,6 +230,15 @@ class SolverStats:
             for name, secs in self.timings.items():
                 if name not in seen:
                     p(f"  {name}: {secs:,.6f} seconds")
+        # perfmodel sections (compiler-reported cost/memory + the comm
+        # ledger) append strictly LAST, like timings: a disarmed run --
+        # and every report the reference's scripts grep -- is unchanged
+        if self.costmodel:
+            p("costmodel:")
+            _write_section(p, self.costmodel, 1)
+        if self.memory:
+            p("memory:")
+            _write_section(p, self.memory, 1)
         text = out.getvalue()
         if f is not None:
             f.write(text)
@@ -227,6 +246,24 @@ class SolverStats:
 
     def print(self, indent: int = 0):
         self.fwrite(sys.stderr, indent)
+
+
+def _write_section(p, d: dict, depth: int) -> None:
+    """Generic nested renderer for the perfmodel sections: scalars one
+    per line, sub-dicts indented, lists summarised by length (their full
+    form lives in the --stats-json twin -- a 64-neighbour halo table
+    does not belong in the text block)."""
+    ind = "  " * depth
+    for k, v in d.items():
+        if isinstance(v, dict):
+            p(f"{ind}{k}:")
+            _write_section(p, v, depth + 1)
+        elif isinstance(v, (list, tuple)):
+            p(f"{ind}{k}: [{len(v)} entries -- see --stats-json]")
+        elif isinstance(v, float):
+            p(f"{ind}{k}: {v:,.6g}")
+        else:
+            p(f"{ind}{k}: {v}")
 
 
 def cg_flops_per_iteration(nnz_full: int, n: int, pipelined: bool = False) -> float:

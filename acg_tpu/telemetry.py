@@ -55,7 +55,10 @@ import numpy as np
 
 from acg_tpu.solvers.stats import PHASE_ORDER
 
-STATS_SCHEMA = "acg-tpu-stats/1"
+# /2: the stats twin grew the perfmodel tier's "costmodel" (compiler
+# cost analysis + per-iteration derivation + comm ledger) and "memory"
+# (compiled HBM footprint) keys -- additive, so /1 consumers keep working
+STATS_SCHEMA = "acg-tpu-stats/2"
 CONVERGENCE_SCHEMA = "acg-tpu-convergence/1"
 # default ring capacity (--telemetry-window): 512 iterations x 4 scalars
 # is 8 KiB of f32 carry -- negligible against any solve's vectors, and

@@ -102,9 +102,13 @@ def _our_bytes_per_iter(nnz: int, n: int, idx_bytes: float,
     ops.spmv.matrix_index_bytes) plus the vector passes of the loop
     (15 classic / 21 pipelined, the pass count implied by the measured
     335 MB/iter f32 flagship -- BASELINE.md) in the vector storage
-    dtype (they differ under --dtype mixed)."""
-    passes = 21 if pipelined else 15
-    return nnz * (mat_itemsize + idx_bytes) + passes * n * vec_itemsize
+    dtype (they differ under --dtype mixed).  Delegates to the perfmodel
+    tier's shared model, which the --explain roofline and the
+    cost_analysis cross-check test also consume -- one model, no drift."""
+    from acg_tpu.perfmodel import analytic_bytes_per_iteration
+
+    return analytic_bytes_per_iteration(nnz, n, idx_bytes, mat_itemsize,
+                                        vec_itemsize, pipelined)
 
 
 # storage tiers: (matrix dtype, vector dtype) by bench dtype name;
@@ -144,48 +148,14 @@ def bandwidth_probe_gbs(refresh: bool = False) -> float:
     global _probe_cache
     if _probe_cache is not None and not refresh:
         return _probe_cache
-    import functools
+    # the chained two-point estimator (device_sync'd, dispatch latency
+    # cancelled, 20-4000 GB/s plausibility bounds) lives in the
+    # perfmodel tier now, shared with the --explain roofline verdict;
+    # raises RuntimeError("bandwidth probe unstable ...") as before
+    from acg_tpu.perfmodel import triad_probe_gbs
 
-    import jax
-    import jax.numpy as jnp
-
-    n = 1 << 26  # 256 MB per f32 vector
-    c = jnp.full((n,), 0.5, jnp.float32)
-    a = jnp.ones((n,), jnp.float32)
-
-    from acg_tpu._platform import device_sync
-
-    @functools.partial(jax.jit, static_argnames="k")
-    def chain(a, c, k):
-        # a = c + s*a: 2 reads + 1 write per step, data-dependent chain
-        return jax.lax.fori_loop(
-            0, k, lambda _, v: c + jnp.float32(1.0000001) * v, a)
-
-    def best(k, reps=3):
-        # device_sync (not bare block_until_ready -- _platform): the
-        # fetch round-trip it may add is constant per call, which the
-        # two-point difference below cancels
-        device_sync(chain(a, c, k))
-        ts = []
-        for _ in range(reps):
-            t0 = time.perf_counter()
-            device_sync(chain(a, c, k))
-            ts.append(time.perf_counter() - t0)
-        return min(ts)
-
-    for _ in range(4):
-        dt = best(16) - best(4)
-        if dt > 0:
-            bw = 3.0 * n * 4.0 * 12 / dt / 1e9
-            # plausibility bounds: nothing in this hardware class moves
-            # under 20 or over 4000 GB/s -- out-of-range means a
-            # contention burst landed inside the two-point difference
-            if 20.0 <= bw <= 4000.0:
-                _probe_cache = bw
-                return bw
-        # contention burst corrupted the estimate; retry
-    raise RuntimeError("bandwidth probe unstable (two-point estimate "
-                       "implausible after 4 attempts)")
+    _probe_cache = triad_probe_gbs(1 << 26)  # 256 MB per f32 vector
+    return _probe_cache
 
 
 def _h100_standin(ref_bytes_per_iter: float) -> float:
@@ -797,10 +767,35 @@ def sweep_np(out=sys.stdout) -> int:
     return 0 if (flat and flat2 and flat3) else 1
 
 
+def _finish(args, rows, rc: int) -> int:
+    """Apply the --baseline regression gate to this run's emitted rows
+    (the perfmodel tier's case-by-case diff -- same engine as
+    scripts/bench_diff.py): exit nonzero when any common case fell more
+    than --fail-on-regress percent below the baseline capture, or when
+    nothing was comparable at all (a renamed metric must not silently
+    green the gate)."""
+    if not args.baseline:
+        return rc
+    from acg_tpu.perfmodel import check_regression
+
+    gate = check_regression(rows, args.baseline, args.fail_on_regress)
+    return rc or gate
+
+
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser()
     ap.add_argument("--full", action="store_true",
                     help="run the whole BASELINE ladder (one JSON line/row)")
+    ap.add_argument("--baseline", metavar="FILE", default=None,
+                    help="compare this run's rows against a prior "
+                         "capture (a --stats-json JSONL or a bench "
+                         "row file like BENCH_*.json) and exit nonzero "
+                         "on regression -- the enforced form of the "
+                         "BENCH trajectory")
+    ap.add_argument("--fail-on-regress", type=float, default=10.0,
+                    metavar="PCT",
+                    help="with --baseline: regression threshold in "
+                         "percent (default: 10)")
     ap.add_argument("--row", metavar="SUBSTR", default=None,
                     help="with --full: run only ladder rows whose metric "
                          "name contains SUBSTR (per-row driver "
@@ -885,7 +880,11 @@ def main(argv=None) -> int:
                 best["partial_capture"] = True
                 print(json.dumps(best))
                 sys.stdout.flush()
-            sys.exit(0 if rows else 124)
+                # the baseline gate runs on the partial row too: a
+                # truncated capture is exactly when a silent regression
+                # would otherwise green the gate
+                sys.exit(_finish(args, [best], 0))
+            sys.exit(124)
 
         for sig in (signal.SIGTERM, signal.SIGINT):
             signal.signal(sig, _emit_partial)
@@ -942,7 +941,7 @@ def main(argv=None) -> int:
                         row["rel_residual_1000it"]
         best["quiet_window"] = bool(quiet)
         print(json.dumps(best))
-        return 0
+        return _finish(args, [best], 0)
 
     cases = [
             ("cg_iters_per_sec_poisson2d_n2048_f32",
@@ -976,6 +975,12 @@ def main(argv=None) -> int:
         ]
 
     built: dict[tuple, object] = {}
+    emitted: list[dict] = []  # every row this run printed (baseline gate)
+
+    def emit(row: dict) -> None:
+        emitted.append(row)
+        print(json.dumps(row))
+
     if args.row:
         # exact name match wins (several row names are substrings of
         # others, e.g. ..._bf16 / ..._bf16rr); substring is the
@@ -994,9 +999,9 @@ def main(argv=None) -> int:
                 print(f"# setup: {dim}D n={side} N={csr.shape[0]} "
                       f"nnz={csr.nnz} in {time.perf_counter() - t0:.1f}s on "
                       f"{jax.devices()[0].platform}", file=sys.stderr)
-            print(json.dumps(run_case(
+            emit(run_case(
                 built[key], name, pipelined, dist, kernels, dtn,
-                spmv_format="coo" if "_coo_" in name else "auto")))
+                spmv_format="coo" if "_coo_" in name else "auto"))
         except Exception as e:  # noqa: BLE001 -- report and continue
             print(f"# {name} skipped: {type(e).__name__}: "
                   f"{str(e).splitlines()[0][:200]}", file=sys.stderr)
@@ -1014,7 +1019,7 @@ def main(argv=None) -> int:
         try:
             if (128, 3) not in built:
                 built[(128, 3)] = _build(128, 3)
-            print(json.dumps(run_host_baseline(built[(128, 3)], name, kind)))
+            emit(run_host_baseline(built[(128, 3)], name, kind))
         except Exception as e:  # noqa: BLE001 -- report and continue
             print(f"# {name} skipped: {type(e).__name__}: "
                   f"{str(e).splitlines()[0][:200]}", file=sys.stderr)
@@ -1031,12 +1036,12 @@ def main(argv=None) -> int:
         if args.row and args.row not in name:
             continue
         try:
-            print(json.dumps(run_case_dia(side, 3, name, dtn)))
+            emit(run_case_dia(side, 3, name, dtn))
         except Exception as e:  # noqa: BLE001 -- report and continue
             print(f"# {side}^3 {dtn} row skipped: {type(e).__name__}: "
                   f"{str(e).splitlines()[0][:200]}", file=sys.stderr)
         sys.stdout.flush()
-    return 0
+    return _finish(args, emitted, 0)
 
 
 if __name__ == "__main__":

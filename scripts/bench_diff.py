@@ -1,0 +1,76 @@
+#!/usr/bin/env python3
+"""Diff two performance captures case-by-case and gate on regressions.
+
+The enforced form of the ``BENCH_*.json`` trajectory: instead of
+eyeballing rows across rounds, point this at any two captures and get a
+per-case verdict plus a nonzero exit on regression.  Accepts EITHER
+format on either side:
+
+* ``--stats-json`` documents -- one indented document (CLI solves) or
+  JSONL-appended (``bench.py --stats-json``, ``--explain``); the case
+  value is iterations/second derived from the stats twin
+  (``niterations / tsolve``), keyed by the manifest's metric (bench) or
+  ``solver:matrix`` (CLI);
+* bench summary-row JSONL (``BENCH_*.json``); the case value is the
+  row's ``value``, keyed by ``metric``.  ``#`` commentary lines are
+  skipped.
+
+Exit codes (shared with ``bench.py --baseline --fail-on-regress``):
+0 = no regression, 1 = at least one case regressed past the threshold,
+2 = nothing comparable (unreadable input / no common cases) -- 2 fails
+too, so a renamed metric cannot silently green a CI gate.
+
+Examples:
+  bench_diff.py BENCH_r04.json BENCH_r05.json
+  bench_diff.py old_stats.jsonl new_stats.jsonl --fail-on-regress 5
+"""
+
+import argparse
+import os
+import sys
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="bench_diff.py",
+        description="Diff two bench / --stats-json captures case-by-case "
+                    "and exit nonzero on regression (the enforced BENCH "
+                    "trajectory gate).",
+        epilog="Exit codes: 0 = ok, 1 = regression past the threshold, "
+               "2 = nothing comparable.")
+    ap.add_argument("baseline",
+                    help="prior capture (--stats-json JSONL/document, or "
+                         "bench row JSONL like BENCH_*.json)")
+    ap.add_argument("candidate", help="new capture, same accepted formats")
+    ap.add_argument("--fail-on-regress", type=float, default=10.0,
+                    metavar="PCT",
+                    help="regression threshold in percent (default: 10)")
+    args = ap.parse_args(argv)
+
+    # import AFTER parsing so --help answers without touching the
+    # package (and never initialises a jax backend -- perfmodel keeps
+    # jax imports inside the functions that need a device)
+    sys.path.insert(0, os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))))
+    from acg_tpu.perfmodel import compare_cases, load_cases
+
+    try:
+        old = load_cases(args.baseline)
+        new = load_cases(args.candidate)
+    except OSError as e:
+        print(f"bench-diff: {e}", file=sys.stderr)
+        return 2
+    lines, nreg, ncmp = compare_cases(old, new, args.fail_on_regress)
+    for ln in lines:
+        print(ln)
+    if ncmp == 0:
+        print("bench-diff: no comparable cases between "
+              f"{args.baseline} and {args.candidate}", file=sys.stderr)
+        return 2
+    print(f"bench-diff: {ncmp} case(s) compared, {nreg} regression(s) "
+          f"past -{args.fail_on_regress:g}%")
+    return 1 if nreg else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

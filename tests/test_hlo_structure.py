@@ -82,3 +82,76 @@ def test_precise_dots_keep_fusion(prob):
     ar, ata, _ = _counts(txt)
     assert ar == 5, f"precise-dots pipelined program has {ar} all_reduces"
     assert ata == 3
+
+
+# -- perfmodel tier: disarmed observability changes NOTHING ---------------
+
+def test_lower_solve_is_the_dispatched_program(prob):
+    """The perfmodel hook (DistCGSolver.lower_solve) must hand out the
+    program solve() dispatches -- byte-identical StableHLO to lowering
+    the cached program by hand with solve()'s own argument
+    construction.  A hook that rebuilt or re-parameterised the program
+    could silently analyse something the solve never runs."""
+    s = DistCGSolver(prob)
+    b, x0, la, ga, sidx, gsrc, gval, scnt, rcnt = s.device_args(
+        np.ones(prob.n))
+    tols = jnp.zeros(4)
+    args = (la, ga, sidx, gsrc, gval, scnt, rcnt, b, x0, tols,
+            jnp.int32(100))
+    direct = s._program.lower(*args, unbounded=True, needs_diff=False,
+                              detect=False).as_text()
+    hook = s.lower_solve(np.ones(prob.n)).as_text()
+    assert hook == direct
+
+
+def test_perfmodel_analysis_leaves_programs_byte_identical(prob):
+    """Disarmed perfmodel (like disarmed telemetry): running a full
+    analysis pass -- lower, compile, cost/memory extraction, comm
+    ledger -- must leave the solver's lowered solve program
+    byte-identical, single-chip and distributed."""
+    from acg_tpu import perfmodel
+    from acg_tpu.io.generators import poisson2d_coo as _p2
+    from acg_tpu.ops.spmv import device_matrix_from_csr
+    from acg_tpu.solvers.jax_cg import JaxCGSolver
+
+    r, c, v, N = _p2(12)
+    csr = SymCsrMatrix.from_coo(N, r, c, v).to_csr()
+    b1 = np.ones(N)
+    s1 = JaxCGSolver(device_matrix_from_csr(csr, dtype=jnp.float64),
+                     kernels="xla")
+    before = s1.lower_solve(b1).as_text()
+    perfmodel.analyze_solver(s1, b1)
+    perfmodel.per_iteration_cost(s1, b1)
+    assert s1.lower_solve(b1).as_text() == before
+
+    s2 = DistCGSolver(prob)
+    b2 = np.ones(prob.n)
+    before2 = s2.lower_solve(b2).as_text()
+    perfmodel.analyze_solver(s2, b2)
+    perfmodel.comm_ledger(s2)
+    assert s2.lower_solve(b2).as_text() == before2
+
+
+def test_explain_sections_append_only():
+    """--explain never mutates the reference-format stats block: the
+    costmodel:/memory: sections (like timings:) append strictly AFTER
+    it, so the report with them set starts byte-for-byte with the
+    report without them."""
+    from acg_tpu.solvers.stats import SolverStats
+
+    st = SolverStats(unknowns=7)
+    st.timings["solve"] = 0.25  # an existing optional section, for order
+    base = st.fwrite()
+    st.costmodel.update({"flops": 123.0,
+                         "comm": {"halo_bytes_per_iteration": 64,
+                                  "neighbors": [{"src": 0, "dst": 1}]}})
+    st.memory.update({"argument_bytes": 10, "total_hbm_bytes": 10})
+    txt = st.fwrite()
+    assert txt.startswith(base)
+    tail = txt[len(base):]
+    assert tail.index("costmodel:") < tail.index("memory:")
+    # lists render summarised in text (full form lives in the JSON twin)
+    assert "[1 entries -- see --stats-json]" in tail
+    # and the JSON twin round-trips the full structure
+    d = st.to_dict()
+    assert d["costmodel"]["comm"]["neighbors"] == [{"src": 0, "dst": 1}]

@@ -27,11 +27,14 @@ def test_profile_single_device(csr):
     solver.solve(b, criteria=StoppingCriteria(maxits=20))
     per_call = profile_ops(solver, b, reps=3)
     # nrm2/copy joined the replay when the compiled solvers' counters
-    # for them stopped being permanently zero (PR 2 satellite)
+    # for them stopped being permanently zero (PR 2 satellite);
+    # chain_overhead is the scalar-chain correction term reported as an
+    # explicit key (PR 3 satellite) -- one axpy-equivalent per call
     assert set(per_call) == {"gemv", "dot", "nrm2", "axpy", "copy",
-                             "dispatch"}
+                             "dispatch", "chain_overhead"}
     assert all(t >= 0 for t in per_call.values())
     assert per_call["dispatch"] > 0
+    assert per_call["chain_overhead"] == per_call["axpy"]
     st = solver.stats
     for op in ("gemv", "dot", "nrm2", "axpy", "copy"):
         assert st.ops[op].n > 0
