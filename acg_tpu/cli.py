@@ -262,6 +262,33 @@ def make_parser() -> argparse.ArgumentParser:
                         "convergence trace, and on multihost runs the "
                         "cross-rank min/median/max + imbalance "
                         "aggregation")
+    p.add_argument("--soak", type=int, default=0, metavar="N",
+                   help="service-soak mode: run N repeated solves of "
+                        "the same system (first one carries --warmup), "
+                        "feed every solve into the process-wide "
+                        "metrics registry, report p50/p95/p99 solve "
+                        "latency + iterations from its histograms in a "
+                        "'soak:' stats section, and arm an EWMA "
+                        "latency-drift detector (see --fail-on-drift). "
+                        " Single-controller only")
+    p.add_argument("--fail-on-drift", type=float, default=None,
+                   metavar="PCT",
+                   help="with --soak: exit 7 when EWMA solve latency "
+                        "drifts more than PCT percent above the "
+                        "baseline window's median (default: warn-only "
+                        "at 50%%)")
+    p.add_argument("--metrics-file", metavar="FILE", default=None,
+                   help="write the service-metrics registry "
+                        "(acg_tpu.metrics: solve/iteration counters, "
+                        "latency + phase histograms, halo/psum byte "
+                        "counters, RSS/device-memory gauges) to FILE "
+                        "in Prometheus text format -- atomic rename, "
+                        "flushed on exit and on SIGTERM (the "
+                        "node-exporter textfile-collector contract)")
+    p.add_argument("--metrics-port", type=int, default=0, metavar="PORT",
+                   help="serve GET /metrics (Prometheus text format) "
+                        "on PORT from a daemon thread for the "
+                        "process's lifetime (default: off)")
     p.add_argument("--explain", action="store_true",
                    help="performance-observability report instead of a "
                         "normal solve: lower + compile the classic, "
@@ -352,6 +379,12 @@ def _buildinfo(out) -> int:
         ("bench gating", "bench.py --baseline FILE --fail-on-regress "
          "PCT; scripts/bench_diff.py (diffs --stats-json or bench-row "
          "captures case-by-case, nonzero exit on regression)"),
+        ("service metrics", f"--metrics-file (Prometheus textfile, "
+         f"atomic rename, flushed on exit/SIGTERM), --metrics-port "
+         f"(stdlib /metrics endpoint), --soak N + --fail-on-drift PCT "
+         f"(EWMA latency-drift gate, exit 7; bench.py --soak too); "
+         f"registry snapshot ('metrics') and soak report ('soak') "
+         f"ride the {STATS_SCHEMA} stats twin"),
     ]
     for k, v in rows:
         out.write(f"{k}: {v}\n")
@@ -506,8 +539,9 @@ def _solve_generated_direct(args, dim, n, N, jax, jnp, dtype,
     if args.trace:
         jax.profiler.start_trace(args.trace)
     try:
-        x = solver.solve(b, criteria=criteria, warmup=args.warmup,
-                         host_result=bool(not args.quiet or args.output))
+        x = _run_solve(args, solver, b, criteria=criteria,
+                       warmup=args.warmup,
+                       host_result=bool(not args.quiet or args.output))
     except (NotConvergedError, BreakdownError) as e:
         sys.stderr.write(f"acg-tpu: {e}\n")
         _fold_phases(args, solver)
@@ -549,6 +583,31 @@ def _report_chain_overhead(per_call: dict) -> None:
             f"per-op replay: chain_overhead {co:.3e} s/call -- "
             f"scalar-result chains (dot/nrm2/allreduce/halo) are upper "
             f"bounds by ~this\n")
+
+
+def _run_solve(args, solver, b, *, x0=None, criteria=None, warmup=None,
+               **solve_kwargs):
+    """One CLI solve -- or, under ``--soak N``, the soak driver's N
+    repeated solves (:mod:`acg_tpu.soak`).  ``warmup`` rides only the
+    first soak solve (it absorbs the compile); every other kwarg rides
+    them all.  The soak report lands on ``solver.stats.soak`` (the
+    ``soak:`` stats section and its ``--stats-json`` twin) and on
+    ``args._soak_report`` for the ``--fail-on-drift`` exit gate."""
+    if not getattr(args, "soak", 0):
+        if warmup is not None:
+            solve_kwargs["warmup"] = warmup
+        return solver.solve(b, x0=x0, criteria=criteria, **solve_kwargs)
+    from acg_tpu.soak import run_soak
+
+    x, report = run_soak(
+        solver, b, nsolves=args.soak, x0=x0, criteria=criteria,
+        fail_on_drift=args.fail_on_drift,
+        first_solve_kwargs=({"warmup": warmup} if warmup is not None
+                            else None),
+        solve_kwargs=solve_kwargs,
+        progress_every=(max(1, args.soak // 10) if args.verbose else 0))
+    args._soak_report = report
+    return x
 
 
 def _checkpoint(args, stage: str, code: int = 0) -> int:
@@ -1332,8 +1391,8 @@ def _solve_generated_sharded(args, dim, n, N, jax, jnp, dtype,
                 inner_maxits=args.refine_inner_maxits, warmup=args.warmup)
             x = xh
         else:
-            x = solver.solve(b, criteria=criteria, warmup=args.warmup,
-                             host_result=False)
+            x = _run_solve(args, solver, b, criteria=criteria,
+                           warmup=args.warmup, host_result=False)
             xl = None
     except (NotConvergedError, BreakdownError) as e:
         # the stats block carries the resilience event log -- most
@@ -1422,11 +1481,31 @@ def main(argv=None) -> int:
     from acg_tpu import faults
     prev_fault_env = os.environ.get(faults.ENV_VAR)
     try:
-        return _main(args)
+        rc = _main(args)
+        if rc == 0 and getattr(args, "_soak_report", None) is not None:
+            # the --fail-on-drift gate: a clean solve run whose latency
+            # drifted is a service-level failure (exit 7)
+            from acg_tpu.soak import gate_exit_code
+            rc = gate_exit_code(args._soak_report, args.fail_on_drift)
+        return rc
     except OSError as e:
         sys.stderr.write(f"acg-tpu: {e}\n")
         return 1
     finally:
+        if args.metrics_file and getattr(args, "_metrics_armed", False):
+            # the atexit/SIGTERM handlers cover process death; this
+            # covers in-process callers (tests, library use) AND makes
+            # sure error paths leave a final scrape behind.  Gated on
+            # _main having armed the layer: a run that died in flag
+            # validation ran nothing, and an all-zeros scrape must not
+            # clobber the last healthy run's textfile
+            from acg_tpu import metrics
+            try:
+                metrics.write_textfile(args.metrics_file)
+            except OSError as e:
+                sys.stderr.write(
+                    f"acg-tpu: --metrics-file {args.metrics_file}: "
+                    f"{e}\n")
         if args.fault_inject:
             # _main exports the spec (env var = how children inherit it)
             # and installs it process-wide; both are scoped to THIS
@@ -1486,6 +1565,56 @@ def _main(args) -> int:
         raise SystemExit("acg-tpu: --telemetry-window must be positive")
     if args.progress < 0:
         raise SystemExit("acg-tpu: --progress must be >= 0")
+    # service-metrics tier: validate + arm BEFORE anything records.
+    # --soak implies arming (the soak driver reports from the registry
+    # histograms); --metrics-file/--metrics-port arm it for single
+    # solves too
+    if args.soak < 0:
+        raise SystemExit("acg-tpu: --soak must be >= 0")
+    if args.fail_on_drift is not None and not args.soak:
+        raise SystemExit("acg-tpu: --fail-on-drift needs --soak N "
+                         "(drift is a property of repeated solves)")
+    if args.fail_on_drift is not None and args.fail_on_drift <= 0:
+        # a zero/negative threshold trips on ordinary jitter -- a
+        # "gate" that fails healthy runs
+        raise SystemExit("acg-tpu: --fail-on-drift must be positive "
+                         "percent")
+    if args.fail_on_drift is not None:
+        from acg_tpu.soak import gate_is_vacuous
+        if gate_is_vacuous(args.soak):
+            # the baseline window would consume the whole run: a gate
+            # that inspects nothing must refuse, not green CI silently
+            raise SystemExit(
+                f"acg-tpu: --fail-on-drift is vacuous at --soak "
+                f"{args.soak}: the baseline window consumes the whole "
+                f"run; use --soak 4 or more")
+    if args.metrics_port < 0 or args.metrics_port > 65535:
+        raise SystemExit("acg-tpu: --metrics-port must be 0-65535")
+    if args.soak:
+        unsupported = [flag for flag, on in [
+            ("--refine (the outer iteration re-enters solve itself)",
+             args.refine),
+            ("--explain (an analysis pass, not a serving loop)",
+             args.explain),
+            ("--profile-ops", args.profile_ops is not None),
+            ("--multihost/--coordinator (soak is per-process; run one "
+             "driver per controller)",
+             args.multihost or args.coordinator is not None),
+            ("--distributed-read", args.distributed_read),
+        ] if on]
+        if unsupported:
+            raise SystemExit(f"acg-tpu: --soak does not support: "
+                             f"{', '.join(unsupported)}")
+    if args.metrics_file or args.metrics_port or args.soak:
+        from acg_tpu import metrics
+        metrics.arm()
+        args._metrics_armed = True
+        if args.metrics_file:
+            metrics.install_flush_handlers(args.metrics_file)
+        if args.metrics_port:
+            srv = metrics.serve(args.metrics_port)
+            _log(args, f"metrics: serving /metrics on port "
+                       f"{srv.server_address[1]}")
     # the ring buffer arms only when the JSONL sink will read it
     # (--stats-json alone stays compatible with every solver tier,
     # including replace_every/fused which refuse in-loop telemetry)
@@ -1516,9 +1645,17 @@ def _main(args) -> int:
     if args.fault_inject:
         from acg_tpu import faults
         try:
-            faults.install(faults.parse_fault_spec(args.fault_inject))
+            spec = faults.parse_fault_spec(args.fault_inject)
+            faults.install(spec)
         except ValueError as e:
             raise SystemExit(f"acg-tpu: {e}")
+        if spec.site == "solve" and not args.soak:
+            # the slowdown site fires from the soak driver's per-solve
+            # hook: armed without --soak it could never fire (the
+            # replace_every refusal rationale)
+            raise SystemExit(
+                "acg-tpu: solve:slow fires from the soak driver's "
+                "per-solve hook; add --soak N")
         os.environ[faults.ENV_VAR] = args.fault_inject
         if (faults.device_fault() is not None
                 and args.solver in ("host-native", "petsc")):
@@ -1785,7 +1922,7 @@ def _main(args) -> int:
             except RuntimeError as e:
                 sys.stderr.write(f"acg-tpu: {e}\n")
                 return 1
-            x = solver.solve(b, x0=x0, criteria=criteria)
+            x = _run_solve(args, solver, b, x0=x0, criteria=criteria)
         elif args.solver == "host":
             if nparts > 1 and comm != "none":
                 # the acgsolver_solvempi analog (cg.c:408): same
@@ -1817,13 +1954,13 @@ def _main(args) -> int:
                 solver = HostCGSolver(csr, recovery=args._recovery,
                                       trace=args._trace,
                                       progress=args.progress)
-            x = solver.solve(b, x0=x0, criteria=criteria)
+            x = _run_solve(args, solver, b, x0=x0, criteria=criteria)
         elif args.solver == "petsc":
             # external cross-implementation oracle (the KSPCG role,
             # cgpetsc.c:181) backed by scipy.sparse.linalg.cg
             from acg_tpu.solvers.petsc_cg import PetscBaselineSolver
             solver = PetscBaselineSolver(csr, pipelined=pipelined)
-            x = solver.solve(b, x0=x0, criteria=criteria)
+            x = _run_solve(args, solver, b, x0=x0, criteria=criteria)
         elif comm == "none" or nparts == 1:
             dev = device_matrix_from_csr(csr, dtype=dtype,
                                          format=args.spmv_format)
@@ -1842,7 +1979,8 @@ def _main(args) -> int:
             if args.refine:
                 solver = RefinedSolver(solver, csr,
                                        inner_rtol=args.refine_rtol)
-            x = solver.solve(b, x0=x0, criteria=criteria, warmup=args.warmup)
+            x = _run_solve(args, solver, b, x0=x0, criteria=criteria,
+                           warmup=args.warmup)
         else:
             from acg_tpu.parallel.mesh import solve_mesh
             mesh = solve_mesh(nparts)
@@ -1876,8 +2014,8 @@ def _main(args) -> int:
             if args.refine:
                 solver = RefinedSolver(solver, csr,
                                        inner_rtol=args.refine_rtol)
-            x = solver.solve(b, x0=x0, criteria=criteria,
-                             warmup=args.warmup)
+            x = _run_solve(args, solver, b, x0=x0, criteria=criteria,
+                           warmup=args.warmup)
     except (NotConvergedError, BreakdownError) as e:
         sys.stderr.write(f"acg-tpu: {e}\n")
         _fold_phases(args, solver)

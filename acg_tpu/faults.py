@@ -19,6 +19,8 @@ firing condition:
                             error-agreement checkpoint
   * ``peer:stall:proc=1:secs=30``  controller 1 stalls instead
   * ``backend:hang:secs=120``      backend init (probe children) hangs
+  * ``solve:slow@10:secs=0.05``    every solve from soak index 10 on is
+                                   dilated 50 ms (drift-detector test)
 
 Keys: ``part`` (mesh part a vector fault targets; -1 = every part),
 ``proc`` (controller index for peer faults), ``secs`` (hang/stall
@@ -42,13 +44,18 @@ import time
 import numpy as np
 
 DEVICE_SITES = ("spmv", "dot", "halo")
-_SITES = DEVICE_SITES + ("peer", "backend")
+_SITES = DEVICE_SITES + ("peer", "backend", "solve")
 _MODES = {
     "spmv": ("nan", "inf"),
     "halo": ("nan", "inf"),
     "dot": ("nan", "zero", "neg"),
     "peer": ("dead", "stall"),
     "backend": ("hang",),
+    # host-side latency dilation for the soak driver's drift detector
+    # (``solve:slow@K:secs=S``: every solve from index K onward sleeps
+    # S seconds inside the timed window) -- contention/throttling made
+    # deterministic; the compiled programs are untouched
+    "solve": ("slow",),
 }
 ENV_VAR = "ACG_TPU_FAULT_INJECT"
 
@@ -179,6 +186,12 @@ def parse_fault_spec(text: str) -> FaultSpec:
     if site in DEVICE_SITES and "iteration" not in kwargs:
         raise ValueError(f"fault spec {text!r}: site {site!r} needs a "
                          f"firing iteration (e.g. {site}:{mode}@5)")
+    if site == "solve" and "secs" not in kwargs:
+        # the default 300 s stall is a hang-detection figure; a latency
+        # dilation without an explicit magnitude is a footgun
+        raise ValueError(f"fault spec {text!r}: solve:slow needs an "
+                         f"explicit dilation (e.g. solve:slow@10:"
+                         f"secs=0.05)")
     return FaultSpec(site=site, mode=mode, **kwargs)
 
 
@@ -274,6 +287,22 @@ def maybe_fail_peer(stage: str = "") -> None:
                      f"{stage or '?'}\n")
     sys.stderr.flush()
     time.sleep(spec.secs)
+
+
+def maybe_slow_solve(solve_index: int) -> float:
+    """Soak-driver hook (``solve:slow@K:secs=S``): sleep ``S`` seconds
+    inside the timed window of every solve from index ``K`` onward
+    (``@ITER`` here is a SOLVE index, not an iteration -- the drift
+    detector needs a clean baseline window first).  Returns the seconds
+    slept so callers can log the dilation."""
+    spec = active_fault()
+    if spec is None or spec.site != "solve":
+        return 0.0
+    start = max(spec.iteration, 0)
+    if int(solve_index) < start:
+        return 0.0
+    time.sleep(spec.secs)
+    return spec.secs
 
 
 def maybe_hang_backend() -> None:

@@ -1,0 +1,668 @@
+"""Process-lifetime service metrics: registry, Prometheus exposition,
+and the instrumentation hooks the solver layers feed.
+
+Everything observability-shaped so far describes ONE solve and exits:
+the stats block (PR 0), convergence telemetry (PR 2), and the compiled
+cost/memory introspection (PR 3) are all per-solve documents.  A solver
+FLEET needs process-lifetime evidence instead -- cumulative counters,
+latency histograms, drift across thousands of solves -- the same way
+the aCG paper treats per-iteration cost as the quantity that must stay
+flat at scale, and the reduction-pipelining line of work
+(arXiv:1905.06850) treats latency JITTER, not mean cost, as the scaling
+killer.  Jitter and drift are invisible to any single-solve document by
+construction; they live here.
+
+Three metric kinds, Prometheus-shaped (text exposition format 0.0.4):
+
+* :class:`Counter` -- monotone totals (solves, iterations, breakdowns,
+  restarts, halo bytes);
+* :class:`Gauge` -- point-in-time values (process RSS, device memory,
+  the soak driver's drift ratio);
+* :class:`Histogram` -- fixed exponential buckets with cumulative
+  counts (solve latency, iterations-to-converge, phase seconds);
+  :meth:`Histogram.quantile` interpolates p50/p95/p99 the same way
+  ``histogram_quantile`` does, so the soak report and a Grafana panel
+  over the scraped data agree.
+
+One process-wide :data:`REGISTRY`, thread-safe (one lock; the HTTP
+exposition thread and the solving thread share it).  The layer is
+DISARMED by default and every hook is a cheap early-return -- and since
+all recording is host-side bookkeeping, the compiled solver programs
+are byte-identical armed or disarmed (pinned in
+tests/test_hlo_structure.py, the telemetry/faults convention).
+
+Sinks:
+* :func:`write_textfile` -- atomic-rename Prometheus textfile (the
+  node-exporter textfile-collector contract); the CLI flushes it on
+  exit and on SIGTERM (:func:`install_flush_handlers`);
+* :func:`serve` -- a stdlib ``/metrics`` HTTP endpoint on a daemon
+  thread (``--metrics-port``);
+* :func:`snapshot_dict` -- the JSON twin embedded in ``--stats-json``
+  documents (schema ``acg-tpu-stats/3``, additive).
+"""
+
+from __future__ import annotations
+
+import atexit
+import math
+import os
+import signal
+import sys
+import threading
+
+__all__ = [
+    "Counter", "Gauge", "Histogram", "Registry", "REGISTRY",
+    "arm", "disarm", "armed", "exponential_buckets",
+    "write_textfile", "install_flush_handlers", "serve",
+    "snapshot_dict", "expose",
+]
+
+
+def exponential_buckets(start: float, factor: float,
+                        count: int) -> tuple[float, ...]:
+    """``count`` bucket upper bounds ``start * factor**i`` -- the fixed
+    exponential ladder every histogram here uses (a latency that can
+    span 1e5x needs log-spaced resolution, not linear)."""
+    if start <= 0 or factor <= 1 or count < 1:
+        raise ValueError("exponential_buckets needs start > 0, "
+                         "factor > 1, count >= 1")
+    return tuple(start * factor ** i for i in range(count))
+
+
+# solve latency: 100 us .. ~1.7 h in x2 steps -- wide enough for a tiny
+# CPU debug solve and a pod-filling 512^3 one in the same ladder
+SOLVE_SECONDS_BUCKETS = exponential_buckets(1e-4, 2.0, 26)
+# iterations-to-converge: 1 .. ~8.4M
+ITERATION_BUCKETS = exponential_buckets(1.0, 2.0, 24)
+# pipeline phases: 10 us .. ~10 min
+PHASE_SECONDS_BUCKETS = exponential_buckets(1e-5, 2.0, 26)
+
+_NAME_OK = frozenset(
+    "abcdefghijklmnopqrstuvwxyzABCDEFGHIJKLMNOPQRSTUVWXYZ0123456789_:")
+
+
+def _fmt(v: float) -> str:
+    """Prometheus sample-value formatting: integers without a trailing
+    ``.0``, ``+Inf``/``-Inf``/``NaN`` spelled the exposition-format way."""
+    v = float(v)
+    if math.isinf(v):
+        return "+Inf" if v > 0 else "-Inf"
+    if math.isnan(v):
+        return "NaN"
+    if v == int(v) and abs(v) < 1e15:
+        return str(int(v))
+    return f"{v:.12g}"
+
+
+def _label_str(names, values) -> str:
+    if not names:
+        return ""
+    esc = [str(v).replace("\\", r"\\").replace('"', r'\"')
+           .replace("\n", r"\n") for v in values]
+    return "{" + ",".join(f'{n}="{e}"' for n, e in zip(names, esc)) + "}"
+
+
+class _Child:
+    """One labelled time series of a metric family."""
+
+    __slots__ = ("_family", "_values", "_sum", "_count", "labelvalues")
+
+    def __init__(self, family, labelvalues):
+        self._family = family
+        self.labelvalues = labelvalues
+        nb = len(family.buckets) if family.kind == "histogram" else 0
+        self._values = [0.0] * nb if nb else 0.0
+        self._sum = 0.0
+        self._count = 0
+
+    # counter/gauge -----------------------------------------------------
+    def inc(self, amount: float = 1.0) -> None:
+        if self._family.kind == "histogram":
+            raise ValueError(f"{self._family.name}: histograms "
+                             f"observe(), they do not inc()")
+        if self._family.kind == "counter" and amount < 0:
+            raise ValueError(f"{self._family.name}: counters are "
+                             f"monotone (inc by {amount})")
+        with self._family._lock:
+            self._values += float(amount)
+
+    def dec(self, amount: float = 1.0) -> None:
+        if self._family.kind != "gauge":
+            raise ValueError(f"{self._family.name}: only gauges dec")
+        with self._family._lock:
+            self._values -= float(amount)
+
+    def set(self, value: float) -> None:
+        if self._family.kind != "gauge":
+            raise ValueError(f"{self._family.name}: only gauges set")
+        with self._family._lock:
+            self._values = float(value)
+
+    @property
+    def value(self) -> float:
+        return self._values if not isinstance(self._values, list) \
+            else float(self._count)
+
+    # histogram ---------------------------------------------------------
+    def observe(self, value: float) -> None:
+        if self._family.kind != "histogram":
+            raise ValueError(f"{self._family.name}: only histograms "
+                             f"observe")
+        value = float(value)
+        with self._family._lock:
+            for i, ub in enumerate(self._family.buckets):
+                if value <= ub:
+                    self._values[i] += 1
+                    break
+            self._sum += value
+            self._count += 1
+
+    def cumulative_buckets(self) -> list[tuple[float, int]]:
+        """``(upper_bound, cumulative_count)`` pairs ending with
+        ``(+Inf, count)`` -- the exposition's ``_bucket`` series."""
+        with self._family._lock:
+            out, acc = [], 0
+            for ub, c in zip(self._family.buckets, self._values):
+                acc += int(c)
+                out.append((ub, acc))
+            out.append((math.inf, self._count))
+            return out
+
+    def quantile(self, q: float) -> float:
+        """Histogram-interpolated quantile (the ``histogram_quantile``
+        estimator: linear within the landing bucket, lower edge 0 for
+        the first).  Returns NaN on an empty histogram."""
+        if not 0.0 <= q <= 1.0:
+            raise ValueError(f"quantile {q} outside [0, 1]")
+        cum = self.cumulative_buckets()
+        total = cum[-1][1]
+        if total == 0:
+            return math.nan
+        rank = q * total
+        prev_ub, prev_c = 0.0, 0
+        for ub, c in cum:
+            if c >= rank:
+                if math.isinf(ub):
+                    # landed past the ladder: the last finite edge is
+                    # the honest answer (no width to interpolate in)
+                    return prev_ub if prev_ub else math.nan
+                if c == prev_c:
+                    return ub
+                return prev_ub + (ub - prev_ub) * (rank - prev_c) / (
+                    c - prev_c)
+            prev_ub, prev_c = ub, c
+        return prev_ub
+
+
+class _Family:
+    """One named metric family; unlabelled families proxy straight to
+    their single child, so ``REGISTRY.counter("x", "...").inc()`` works
+    without a ``.labels()`` hop."""
+
+    def __init__(self, name: str, help: str, kind: str, registry,
+                 labelnames=(), buckets=()):
+        bad = set(name) - _NAME_OK
+        if bad or not name or name[0].isdigit():
+            raise ValueError(f"invalid metric name {name!r}")
+        self.name = name
+        self.help = help
+        self.kind = kind
+        self.labelnames = tuple(labelnames)
+        self.buckets = tuple(sorted(float(b) for b in buckets))
+        self._lock = registry._lock
+        self._children: dict[tuple, _Child] = {}
+        if not self.labelnames:
+            self._children[()] = _Child(self, ())
+
+    def labels(self, *values, **kwargs) -> _Child:
+        if kwargs:
+            if values:
+                raise ValueError("pass label values positionally OR by "
+                                 "name, not both")
+            try:
+                values = tuple(kwargs[n] for n in self.labelnames)
+            except KeyError as e:
+                raise ValueError(f"{self.name}: missing label {e}")
+            if len(kwargs) != len(self.labelnames):
+                extra = set(kwargs) - set(self.labelnames)
+                raise ValueError(f"{self.name}: unknown labels {extra}")
+        values = tuple(str(v) for v in values)
+        if len(values) != len(self.labelnames):
+            raise ValueError(f"{self.name}: expected labels "
+                             f"{self.labelnames}, got {values}")
+        with self._lock:
+            child = self._children.get(values)
+            if child is None:
+                # label dedup: one child per distinct value tuple, ever
+                child = self._children[values] = _Child(self, values)
+            return child
+
+    def _only(self) -> _Child:
+        if self.labelnames:
+            raise ValueError(f"{self.name} is labelled "
+                             f"{self.labelnames}; use .labels()")
+        return self._children[()]
+
+    # unlabelled proxies
+    def inc(self, amount: float = 1.0) -> None:
+        self._only().inc(amount)
+
+    def dec(self, amount: float = 1.0) -> None:
+        self._only().dec(amount)
+
+    def set(self, value: float) -> None:
+        self._only().set(value)
+
+    def observe(self, value: float) -> None:
+        self._only().observe(value)
+
+    @property
+    def value(self) -> float:
+        return self._only().value
+
+    def quantile(self, q: float) -> float:
+        """Quantile over ALL children merged (the soak driver's view:
+        one latency distribution regardless of solver labels)."""
+        with self._lock:
+            kids = list(self._children.values())
+        if len(kids) == 1:
+            return kids[0].quantile(q)
+        merged = _Child(self, ())
+        for k in kids:
+            with self._lock:
+                merged._values = [a + b for a, b in
+                                  zip(merged._values, k._values)]
+                merged._sum += k._sum
+                merged._count += k._count
+        return merged.quantile(q)
+
+    @property
+    def count(self) -> int:
+        with self._lock:
+            return sum(k._count for k in self._children.values())
+
+
+# aliases so isinstance-ish naming reads naturally in callers/tests
+Counter = Gauge = Histogram = _Family
+
+
+class Registry:
+    """Thread-safe metric registry with Prometheus text exposition."""
+
+    def __init__(self):
+        self._lock = threading.RLock()
+        self._families: dict[str, _Family] = {}
+        self._collect_callbacks: list = []
+
+    def _register(self, name, help, kind, labelnames, buckets=()):
+        with self._lock:
+            fam = self._families.get(name)
+            if fam is not None:
+                if (fam.kind != kind
+                        or fam.labelnames != tuple(labelnames)
+                        or (kind == "histogram" and fam.buckets !=
+                            tuple(sorted(float(b) for b in buckets)))):
+                    raise ValueError(
+                        f"metric {name!r} re-registered as {kind}"
+                        f"{tuple(labelnames)} (was {fam.kind}"
+                        f"{fam.labelnames}; histograms must also keep "
+                        f"their bucket ladder)")
+                return fam
+            fam = _Family(name, help, kind, self, labelnames, buckets)
+            self._families[name] = fam
+            return fam
+
+    def counter(self, name, help="", labelnames=()) -> _Family:
+        return self._register(name, help, "counter", labelnames)
+
+    def gauge(self, name, help="", labelnames=()) -> _Family:
+        return self._register(name, help, "gauge", labelnames)
+
+    def histogram(self, name, help="", labelnames=(),
+                  buckets=SOLVE_SECONDS_BUCKETS) -> _Family:
+        if not buckets:
+            raise ValueError(f"{name}: histogram needs buckets")
+        return self._register(name, help, "histogram", labelnames,
+                              buckets)
+
+    def on_collect(self, fn) -> None:
+        """Register a pre-exposition callback (resource gauges refresh
+        at scrape/flush time, the Prometheus collector convention)."""
+        with self._lock:
+            if fn not in self._collect_callbacks:
+                self._collect_callbacks.append(fn)
+
+    def expose(self) -> str:
+        """The Prometheus text exposition (format 0.0.4): families in
+        name order, children in label order -- deterministic, so a
+        golden test can pin it."""
+        for fn in list(self._collect_callbacks):
+            try:
+                fn()
+            except Exception:  # noqa: BLE001 -- a failed resource
+                pass           # refresh must never sink a scrape
+        out = []
+        with self._lock:
+            for name in sorted(self._families):
+                fam = self._families[name]
+                out.append(f"# HELP {name} {fam.help}")
+                out.append(f"# TYPE {name} {fam.kind}")
+                for lv in sorted(fam._children):
+                    child = fam._children[lv]
+                    if fam.kind == "histogram":
+                        for ub, c in child.cumulative_buckets():
+                            ls = _label_str(fam.labelnames + ("le",),
+                                            lv + (_fmt(ub),))
+                            out.append(f"{name}_bucket{ls} {c}")
+                        ls = _label_str(fam.labelnames, lv)
+                        out.append(f"{name}_sum{ls} "
+                                   f"{_fmt(child._sum)}")
+                        out.append(f"{name}_count{ls} {child._count}")
+                    else:
+                        ls = _label_str(fam.labelnames, lv)
+                        out.append(f"{name}{ls} "
+                                   f"{_fmt(child._values)}")
+        return "\n".join(out) + "\n"
+
+    def snapshot(self) -> dict:
+        """JSON-able registry snapshot (the ``metrics`` key of an
+        ``acg-tpu-stats/3`` document)."""
+        for fn in list(self._collect_callbacks):
+            try:
+                fn()
+            except Exception:  # noqa: BLE001
+                pass
+        doc: dict = {}
+        with self._lock:
+            for name in sorted(self._families):
+                fam = self._families[name]
+                entry: dict = {"type": fam.kind, "help": fam.help,
+                               "samples": []}
+                for lv in sorted(fam._children):
+                    child = fam._children[lv]
+                    labels = dict(zip(fam.labelnames, lv))
+                    if fam.kind == "histogram":
+                        entry["samples"].append({
+                            "labels": labels,
+                            "buckets": [[(None if math.isinf(ub)
+                                          else ub), c]
+                                        for ub, c in
+                                        child.cumulative_buckets()],
+                            "sum": child._sum,
+                            "count": child._count,
+                        })
+                    else:
+                        entry["samples"].append(
+                            {"labels": labels, "value": child._values})
+                doc[name] = entry
+        return doc
+
+    def reset(self) -> None:
+        """Drop every family (tests only -- a service registry is
+        append-only for life)."""
+        with self._lock:
+            self._families.clear()
+            self._collect_callbacks.clear()
+
+
+REGISTRY = Registry()
+
+# -- the instrument set the solver layers feed ---------------------------
+
+SOLVES = REGISTRY.counter(
+    "acg_solves_total", "Completed solve() calls by solver and outcome.",
+    labelnames=("solver", "converged"))
+ITERATIONS = REGISTRY.counter(
+    "acg_iterations_total", "CG iterations executed across all solves.")
+SOLVE_SECONDS = REGISTRY.histogram(
+    "acg_solve_seconds", "Wall-clock seconds per solve.",
+    buckets=SOLVE_SECONDS_BUCKETS)
+SOLVE_ITERATIONS = REGISTRY.histogram(
+    "acg_solve_iterations", "Iterations-to-converge per solve.",
+    buckets=ITERATION_BUCKETS)
+PHASE_SECONDS = REGISTRY.histogram(
+    "acg_phase_seconds", "Pipeline-phase seconds "
+    "(ingest/partition/transfer/compile/solve/writeback).",
+    labelnames=("phase",), buckets=PHASE_SECONDS_BUCKETS)
+COMPILES = REGISTRY.counter(
+    "acg_compiles_total", "Compile phases observed (warmup-absorbed "
+    "program compiles in the CLI and bench paths).")
+BREAKDOWNS = REGISTRY.counter(
+    "acg_breakdowns_total", "Breakdowns detected by the solve loops.")
+RESTARTS = REGISTRY.counter(
+    "acg_restarts_total", "Recovery restarts granted by the policy.")
+FALLBACKS = REGISTRY.counter(
+    "acg_fallbacks_total", "Transport/solver fallbacks taken.")
+EVENTS = REGISTRY.counter(
+    "acg_events_total", "Structured telemetry events by kind.",
+    labelnames=("kind",))
+HALO_BYTES = REGISTRY.counter(
+    "acg_halo_bytes_total", "Halo-exchange payload bytes moved "
+    "(static comm-ledger estimate x iterations).")
+ALLREDUCE_BYTES = REGISTRY.counter(
+    "acg_allreduce_bytes_total", "Allreduce/psum payload bytes moved "
+    "(static comm-ledger estimate x iterations).")
+RSS_BYTES = REGISTRY.gauge(
+    "acg_process_resident_bytes", "Resident set size of this process.")
+DEVICE_MEMORY = REGISTRY.gauge(
+    "acg_device_memory_bytes", "Per-device memory where the backend "
+    "reports it (jax memory_stats).", labelnames=("device", "kind"))
+DRIFT_RATIO = REGISTRY.gauge(
+    "acg_soak_latency_drift_ratio", "Soak driver: EWMA solve latency "
+    "over the baseline window's (1.0 = no drift).")
+
+_armed = False
+
+
+def arm() -> None:
+    """Arm the process-wide hooks.  All recording is host-side
+    bookkeeping, so arming cannot perturb the compiled programs; the
+    hooks stay cheap early-returns until this is called."""
+    global _armed
+    _armed = True
+    REGISTRY.on_collect(update_resource_gauges)
+
+
+def disarm() -> None:
+    global _armed
+    _armed = False
+
+
+def armed() -> bool:
+    return _armed
+
+
+def record_solve(seconds: float, iterations: int, converged: bool,
+                 solver: str = "cg") -> None:
+    """One completed solve (called from the solvers' solve() tails)."""
+    if not _armed:
+        return
+    SOLVES.labels(solver=solver,
+                  converged="true" if converged else "false").inc()
+    ITERATIONS.inc(max(int(iterations), 0))
+    SOLVE_SECONDS.observe(max(float(seconds), 0.0))
+    SOLVE_ITERATIONS.observe(max(int(iterations), 0))
+
+
+def record_phase(name: str, seconds: float) -> None:
+    """One pipeline-phase timing (fed from telemetry's phase timer and
+    the solvers' add_timing); a compile phase also counts a compile."""
+    if not _armed:
+        return
+    PHASE_SECONDS.labels(phase=str(name)).observe(max(float(seconds),
+                                                      0.0))
+    if name == "compile":
+        COMPILES.inc()
+
+
+def record_event_kind(kind: str) -> None:
+    if not _armed:
+        return
+    EVENTS.labels(kind=str(kind)).inc()
+
+
+def record_breakdown() -> None:
+    if _armed:
+        BREAKDOWNS.inc()
+
+
+def record_restart() -> None:
+    if _armed:
+        RESTARTS.inc()
+
+
+def record_fallback() -> None:
+    if _armed:
+        FALLBACKS.inc()
+
+
+def record_comm(ledger: dict, iterations: int) -> None:
+    """Fold one solve's communication volume out of the perfmodel
+    tier's static ledger: per-iteration halo/psum bytes x the solve's
+    iteration count."""
+    if not _armed or not ledger:
+        return
+    its = max(int(iterations), 0)
+    HALO_BYTES.inc(int(ledger.get("halo_bytes_per_iteration", 0)) * its)
+    ALLREDUCE_BYTES.inc(
+        int(ledger.get("allreduce_bytes_per_iteration", 0)) * its)
+
+
+def observe_solver_comm(solver, iterations: int) -> None:
+    """``record_comm`` from a solver's own ``comm_profile()`` hook
+    (PR 3); solvers without one are a no-op."""
+    if not _armed:
+        return
+    prof = getattr(solver, "comm_profile", None)
+    if prof is None:
+        return
+    try:
+        record_comm(prof(), iterations)
+    except Exception:  # noqa: BLE001 -- metrics must never sink a solve
+        pass
+
+
+def update_resource_gauges() -> None:
+    """Refresh RSS and (where the backend reports memory_stats) the
+    per-device memory gauges; registered as a collect callback so every
+    scrape/flush sees fresh values."""
+    try:
+        with open("/proc/self/statm") as f:
+            RSS_BYTES.set(int(f.read().split()[1])
+                          * os.sysconf("SC_PAGE_SIZE"))
+    except (OSError, ValueError, IndexError):
+        pass
+    try:
+        import jax
+
+        for d in jax.local_devices():
+            stats = getattr(d, "memory_stats", lambda: None)()
+            if not stats:
+                continue  # CPU backend reports none
+            for key in ("bytes_in_use", "peak_bytes_in_use",
+                        "bytes_limit"):
+                if key in stats:
+                    DEVICE_MEMORY.labels(device=str(d.id),
+                                         kind=key).set(stats[key])
+    except Exception:  # noqa: BLE001 -- no backend is a fine state for
+        pass           # a metrics scrape
+
+
+# -- sinks ----------------------------------------------------------------
+
+def expose() -> str:
+    return REGISTRY.expose()
+
+
+def snapshot_dict() -> dict:
+    return REGISTRY.snapshot()
+
+
+def write_textfile(path, registry: Registry | None = None) -> None:
+    """Atomic textfile flush (write sibling temp + rename): a scraper
+    of ``--metrics-file`` output never reads a torn write -- the
+    node-exporter textfile-collector contract."""
+    reg = registry or REGISTRY
+    path = os.fspath(path)
+    tmp = f"{path}.tmp.{os.getpid()}"
+    with open(tmp, "w") as f:
+        f.write(reg.expose())
+        f.flush()
+        os.fsync(f.fileno())
+    os.replace(tmp, path)
+
+
+_flush_path: str | None = None
+_flush_installed = False
+
+
+def _flush_now() -> None:
+    if _flush_path is None:
+        return
+    try:
+        write_textfile(_flush_path)
+    except OSError as e:
+        sys.stderr.write(f"acg-tpu: --metrics-file {_flush_path}: "
+                         f"{e}\n")
+
+
+def install_flush_handlers(path) -> None:
+    """Arrange for ``--metrics-file`` to be written on normal exit AND
+    on SIGTERM (a soak run killed by an orchestrator must still leave
+    its final scrape behind).  The SIGTERM handler chains to whatever
+    was installed before it, preserving the prior exit semantics."""
+    global _flush_path, _flush_installed
+    _flush_path = os.fspath(path)
+    if _flush_installed:
+        return
+    _flush_installed = True
+    atexit.register(_flush_now)
+    try:
+        prev = signal.getsignal(signal.SIGTERM)
+
+        def _on_term(signum, frame):
+            _flush_now()
+            if prev == signal.SIG_IGN:
+                return  # the run was ignoring SIGTERM; keep it alive
+            if callable(prev) and prev != signal.SIG_DFL:
+                prev(signum, frame)
+            else:
+                signal.signal(signum, signal.SIG_DFL)
+                os.kill(os.getpid(), signum)
+
+        signal.signal(signal.SIGTERM, _on_term)
+    except ValueError:
+        # not the main thread: atexit still covers the normal path
+        pass
+
+
+def serve(port: int, registry: Registry | None = None):
+    """Serve ``GET /metrics`` on a daemon thread (``--metrics-port``):
+    stdlib only, bound on all interfaces like every Prometheus
+    exporter.  Returns the live server (``.server_address[1]`` is the
+    real port -- pass 0 to let the OS pick, the test hook)."""
+    from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+    reg = registry or REGISTRY
+
+    class _Handler(BaseHTTPRequestHandler):
+        def do_GET(self):  # noqa: N802 -- stdlib handler contract
+            if self.path.split("?")[0] not in ("/metrics", "/"):
+                self.send_error(404)
+                return
+            body = reg.expose().encode()
+            self.send_response(200)
+            self.send_header("Content-Type",
+                             "text/plain; version=0.0.4; charset=utf-8")
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+
+        def log_message(self, *a):  # scrapes must not spam stderr
+            pass
+
+    server = ThreadingHTTPServer(("", int(port)), _Handler)
+    t = threading.Thread(target=server.serve_forever,
+                         name="acg-metrics", daemon=True)
+    t.start()
+    return server

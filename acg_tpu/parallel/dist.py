@@ -1562,6 +1562,14 @@ class DistCGSolver:
         st.rnrm2 = float(rnrm2)
         st.dxnrm2 = float(dxnrm2)
         st.converged = bool(done) or crit.unbounded
+        # service-metrics tier (no-op disarmed): one completed solve,
+        # plus this solve's halo/psum traffic folded out of the static
+        # comm ledger (comm_profile, the perfmodel tier's hook)
+        from acg_tpu import metrics
+        metrics.record_solve(t_solve, niter, st.converged,
+                             solver="dist-cg-pipelined" if self.pipelined
+                             else "dist-cg")
+        metrics.observe_solver_comm(self, niter)
         n = prob.n
         st.nflops += (cg_flops_per_iteration(prob.nnz_total, n, self.pipelined)
                       * niter + 3.0 * prob.nnz_total + 2.0 * n)

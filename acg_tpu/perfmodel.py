@@ -639,12 +639,27 @@ def run_explain(args, dtype, vec_dtype) -> int:
 def _doc_case(doc: dict):
     """``(key, value)`` for one --stats-json document: the case key is
     the manifest metric (bench rows) or solver:matrix (CLI solves), the
-    value iterations/second from the stats twin."""
+    value iterations/second from the stats twin.
+
+    A ``/3`` SOAK capture (``stats.soak`` present) is valued at its
+    median instead -- p50 iterations over p50 latency -- so two soak
+    runs of the same case diff on the steady-state figure, not on a
+    cumulative ``tsolve`` whose meaning shifts with the solve count."""
     man = doc.get("manifest") or {}
     st = doc.get("stats") or {}
     metric = man.get("metric")
     if metric is None:
         metric = f"{man.get('solver', 'solve')}:{man.get('matrix', '?')}"
+    soak = st.get("soak") or {}
+    if soak:
+        try:
+            lat = float((soak.get("latency") or {}).get("p50") or 0.0)
+            its = float((soak.get("iterations") or {}).get("p50") or 0.0)
+        except (TypeError, ValueError):
+            return None
+        if lat <= 0 or its <= 0:
+            return None
+        return str(metric), its / lat
     try:
         tsolve = float(st.get("tsolve", 0.0))
         niter = float(st.get("niterations", 0))

@@ -239,6 +239,9 @@ class HostCGSolver:
         from acg_tpu.telemetry import add_timing
         add_timing(st, "solve", t_solve)
         st.converged = converged or crit.unbounded
+        from acg_tpu import metrics
+        metrics.record_solve(t_solve, st.niterations, st.converged,
+                             solver="host-cg")
         st.fexcept_arrays = [x, r]
         finish_trace()
         if not st.converged and raise_on_divergence:

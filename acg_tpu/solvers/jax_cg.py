@@ -1150,6 +1150,14 @@ class JaxCGSolver:
         st.rnrm2 = float(res.rnrm2)
         st.dxnrm2 = float(res.dxnrm2)
         st.converged = bool(res.converged) or crit.unbounded
+        # service-metrics tier: one completed solve (no-op disarmed;
+        # the sharded subclass reuses this solve, so its comm ledger
+        # rides through the same hook)
+        from acg_tpu import metrics
+        metrics.record_solve(t_solve, niter, st.converged,
+                             solver="cg-pipelined" if self.pipelined
+                             else "cg")
+        metrics.observe_solver_comm(self, niter)
         n = self.A.nrows
         per_it = cg_flops_per_iteration(self._spmv_flops / 3.0, n,
                                         self.pipelined)

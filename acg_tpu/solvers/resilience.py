@@ -114,6 +114,8 @@ class RecoveryDriver:
         together."""
         st = self.stats
         st.nbreakdowns += 1
+        from acg_tpu import metrics
+        metrics.record_breakdown()
         from acg_tpu.telemetry import record_event
         record_event(st, "breakdown",
                      f"breakdown detected at iteration {niter}")
@@ -128,6 +130,7 @@ class RecoveryDriver:
             return False
         self.restarts += 1
         st.nrestarts += 1
+        metrics.record_restart()
         if pol.backoff > 0:
             time.sleep(pol.backoff * (2 ** (self.restarts - 1)))
         self.record(f"breakdown detected at iteration {niter}; "
@@ -137,6 +140,8 @@ class RecoveryDriver:
 
     def on_fallback(self, event: str) -> None:
         self.stats.nfallbacks += 1
+        from acg_tpu import metrics
+        metrics.record_fallback()
         self.record(event, kind="fallback")
 
     def _agree(self, code: int) -> bool:

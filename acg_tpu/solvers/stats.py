@@ -119,6 +119,10 @@ class SolverStats:
     # them -- the reference-format block stays byte-identical otherwise
     costmodel: dict = dataclasses.field(default_factory=dict)
     memory: dict = dataclasses.field(default_factory=dict)
+    # service-metrics tier (acg_tpu.soak): the soak driver's report --
+    # latency/iteration percentiles + drift verdict.  Rendered (and
+    # exported, stats schema /3) only when a soak run recorded it
+    soak: dict = dataclasses.field(default_factory=dict)
 
     def to_dict(self) -> dict:
         """Machine-readable twin of :meth:`fwrite` -- the ``stats`` key
@@ -160,6 +164,7 @@ class SolverStats:
             "timings": dict(self.timings),
             "costmodel": dict(self.costmodel),
             "memory": dict(self.memory),
+            "soak": dict(self.soak),
         }
         if self.trace is not None:
             d["trace"] = self.trace.to_dict()
@@ -239,6 +244,9 @@ class SolverStats:
         if self.memory:
             p("memory:")
             _write_section(p, self.memory, 1)
+        if self.soak:
+            p("soak:")
+            _write_section(p, self.soak, 1)
         text = out.getvalue()
         if f is not None:
             f.write(text)

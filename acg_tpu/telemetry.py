@@ -58,7 +58,12 @@ from acg_tpu.solvers.stats import PHASE_ORDER
 # /2: the stats twin grew the perfmodel tier's "costmodel" (compiler
 # cost analysis + per-iteration derivation + comm ledger) and "memory"
 # (compiled HBM footprint) keys -- additive, so /1 consumers keep working
-STATS_SCHEMA = "acg-tpu-stats/2"
+# /3: the service-metrics tier adds a top-level "metrics" key (the
+# process-wide registry snapshot, acg_tpu.metrics, present when the
+# metrics layer is armed) and a "soak" key inside the stats twin (the
+# soak driver's latency/iteration percentiles + drift verdict) --
+# additive again, so /1 and /2 consumers keep working
+STATS_SCHEMA = "acg-tpu-stats/3"
 CONVERGENCE_SCHEMA = "acg-tpu-convergence/1"
 # default ring capacity (--telemetry-window): 512 iterations x 4 scalars
 # is 8 KiB of f32 carry -- negligible against any solve's vectors, and
@@ -249,19 +254,32 @@ class EagerTraceRecorder:
 def read_convergence_log(path) -> tuple[dict, list[dict]]:
     """Parse a ``--convergence-log`` JSONL file back into
     ``(meta, records)`` -- the inverse of :meth:`write_jsonl`, shared by
-    the tests and ``scripts/plot_convergence.py``."""
+    the tests and ``scripts/plot_convergence.py``.
+
+    A TRUNCATED TRAILING line (a SIGTERM/OOM-kill landing mid-write --
+    exactly the runs whose telemetry matters most) yields the parseable
+    prefix with ``meta["truncated"] = True`` instead of raising; a
+    malformed line with valid JSON after it is still an error (that is
+    corruption, not truncation)."""
     meta: dict = {}
     records: list[dict] = []
     with open(path) as f:
-        for line in f:
-            line = line.strip()
-            if not line:
-                continue
+        lines = f.read().split("\n")
+    for i, line in enumerate(lines):
+        line = line.strip()
+        if not line:
+            continue
+        try:
             obj = json.loads(line)
-            if obj.get("meta"):
-                meta = obj
-            else:
-                records.append(obj)
+        except ValueError:
+            if any(later.strip() for later in lines[i + 1:]):
+                raise
+            meta["truncated"] = True
+            break
+        if obj.get("meta"):
+            meta = obj
+        else:
+            records.append(obj)
     return meta, records
 
 
@@ -288,6 +306,9 @@ class PhaseTimer:
 
     def add(self, name: str, seconds: float) -> None:
         self.phases[name] = self.phases.get(name, 0.0) + float(seconds)
+        # service-metrics tier: phase-time histogram (no-op disarmed)
+        from acg_tpu import metrics
+        metrics.record_phase(name, seconds)
 
     @contextlib.contextmanager
     def phase(self, name: str):
@@ -334,14 +355,19 @@ def annotate(name: str):
 def add_timing(stats, name: str, seconds: float) -> None:
     """Accumulate one phase's seconds onto ``stats.timings``."""
     stats.timings[name] = stats.timings.get(name, 0.0) + float(seconds)
+    from acg_tpu import metrics
+    metrics.record_phase(name, seconds)
 
 
 def record_event(stats, kind: str, detail: str) -> None:
     """Append one timestamped event (resilience, fault injection) for
     the structured sink; the human-readable ``recovery_log`` is separate
-    and unchanged."""
+    and unchanged.  Every event also bumps the service-metrics
+    by-kind counter (``acg_events_total``; no-op disarmed)."""
     stats.events.append({"t": time.time(), "kind": kind,
                          "detail": str(detail)})
+    from acg_tpu import metrics
+    metrics.record_event_kind(kind)
 
 
 # -- structured stats sink ----------------------------------------------
@@ -382,12 +408,16 @@ def stats_document(stats, manifest: dict | None = None,
                    ranks: dict | None = None) -> dict:
     """The full ``--stats-json`` document: schema + manifest + the
     machine-readable twin of ``fwrite`` (+ cross-rank aggregation when
-    gathered)."""
+    gathered; + the service-metrics registry snapshot when that layer
+    is armed -- the /3 additive key)."""
     doc = {"schema": STATS_SCHEMA,
            "manifest": manifest or run_manifest(),
            "stats": stats.to_dict()}
     if ranks is not None:
         doc["ranks"] = ranks
+    from acg_tpu import metrics
+    if metrics.armed():
+        doc["metrics"] = metrics.snapshot_dict()
     return doc
 
 

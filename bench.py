@@ -767,6 +767,68 @@ def sweep_np(out=sys.stdout) -> int:
     return 0 if (flat and flat2 and flat3) else 1
 
 
+def run_soak_mode(args) -> int:
+    """``bench.py --soak N``: the service-soak harness over one Poisson
+    matrix (``--soak-side``/``--soak-dim``) -- N repeated fixed-work
+    solves through :func:`acg_tpu.soak.run_soak`, one JSON summary row
+    (p50/p95/p99 latency, drift verdict) on stdout, the full
+    ``acg-tpu-stats/3`` document on ``--stats-json``, a Prometheus
+    textfile on ``--metrics-file``, and the ``--fail-on-drift`` exit
+    gate (exit 7) shared with the CLI."""
+    import numpy as np
+
+    from acg_tpu import metrics, soak
+    from acg_tpu.ops.spmv import device_matrix_from_csr
+    from acg_tpu.solvers.jax_cg import JaxCGSolver
+    from acg_tpu.solvers.stats import StoppingCriteria
+
+    metrics.arm()
+    if args.metrics_file:
+        metrics.install_flush_handlers(args.metrics_file)
+    name = (f"soak_poisson{args.soak_dim}d_n{args.soak_side}"
+            f"_{args.soak_dtype}_x{args.soak}")
+    csr = _build(args.soak_side, args.soak_dim)
+    mat_dtype, vec_dtype = _dtypes_of(args.soak_dtype)
+    A = device_matrix_from_csr(csr, dtype=mat_dtype)
+    solver = JaxCGSolver(A, kernels="auto", vector_dtype=vec_dtype)
+    b = np.ones(csr.shape[0], dtype=np.float32)
+    # fixed-iteration protocol (the bench convention): every solve does
+    # identical work, so the latency distribution measures the SYSTEM,
+    # not the convergence path
+    crit = StoppingCriteria(maxits=args.soak_its)
+    t0 = time.perf_counter()
+    x, report = soak.run_soak(
+        solver, b, nsolves=args.soak, criteria=crit,
+        fail_on_drift=args.fail_on_drift,
+        first_solve_kwargs={"warmup": 1},
+        solve_kwargs={"raise_on_divergence": False,
+                      "host_result": False},
+        progress_every=max(1, args.soak // 10), what="bench-soak")
+    print(f"# {name}: {args.soak} solves in "
+          f"{time.perf_counter() - t0:.1f}s", file=sys.stderr)
+    lat, its = report["latency"], report["iterations"]
+    row = {
+        "metric": name,
+        # iters/s at the medians: the longitudinally comparable figure
+        "value": (round(its["p50"] / lat["p50"], 2)
+                  if lat.get("p50") and its.get("p50") else 0.0),
+        "unit": "iters/s",
+        "dtype": args.soak_dtype,
+        "kernels": getattr(solver, "kernels", "auto"),
+        "latency_p50_s": lat["p50"], "latency_p95_s": lat["p95"],
+        "latency_p99_s": lat["p99"],
+        "drift_ratio": report["drift"]["ratio"],
+        "drift_tripped": report["drift"]["tripped"],
+        "nsolves": args.soak,
+    }
+    print(json.dumps(row))
+    _sink_stats(row, solver)
+    if args.metrics_file:
+        metrics.write_textfile(args.metrics_file)
+    rc = _finish(args, [row], 0)
+    return rc or soak.gate_exit_code(report, args.fail_on_drift)
+
+
 def _finish(args, rows, rc: int) -> int:
     """Apply the --baseline regression gate to this run's emitted rows
     (the perfmodel tier's case-by-case diff -- same engine as
@@ -809,9 +871,46 @@ def main(argv=None) -> int:
                          "stats document (the CLI's --stats-json "
                          "schema, acg_tpu.telemetry) next to the "
                          "summary rows on stdout")
+    ap.add_argument("--soak", type=int, default=0, metavar="N",
+                    help="service-soak mode: N repeated fixed-work "
+                         "solves of one Poisson system through "
+                         "acg_tpu.soak (p50/p95/p99 latency row, EWMA "
+                         "drift detector, --fail-on-drift exit 7)")
+    ap.add_argument("--soak-side", type=int, default=256, metavar="N",
+                    help="with --soak: Poisson grid side (default: 256)")
+    ap.add_argument("--soak-dim", type=int, default=2, choices=(2, 3),
+                    help="with --soak: Poisson dimension (default: 2)")
+    ap.add_argument("--soak-its", type=int, default=200, metavar="K",
+                    help="with --soak: fixed iterations per solve "
+                         "(default: 200)")
+    ap.add_argument("--soak-dtype", default="f32",
+                    choices=("f32", "mixed", "bf16"),
+                    help="with --soak: storage tier (default: f32)")
+    ap.add_argument("--fail-on-drift", type=float, default=None,
+                    metavar="PCT",
+                    help="with --soak: exit 7 when EWMA solve latency "
+                         "drifts more than PCT percent over the "
+                         "baseline window's median")
+    ap.add_argument("--metrics-file", metavar="FILE", default=None,
+                    help="with --soak: flush the service-metrics "
+                         "registry to FILE in Prometheus text format "
+                         "(atomic rename; also written on SIGTERM)")
     args = ap.parse_args(argv)
     global _STATS_SINK
     _STATS_SINK = args.stats_json
+    if not args.soak and (args.metrics_file
+                          or args.fail_on_drift is not None):
+        # only the soak harness reads these; silently ignoring them
+        # would let an operator believe a gate/capture ran
+        ap.error("--metrics-file/--fail-on-drift need --soak N")
+    if args.fail_on_drift is not None:
+        from acg_tpu.soak import gate_is_vacuous
+        if args.fail_on_drift <= 0:
+            ap.error("--fail-on-drift must be positive percent")
+        if gate_is_vacuous(args.soak):
+            ap.error(f"--fail-on-drift is vacuous at --soak "
+                     f"{args.soak} (the baseline window consumes the "
+                     f"whole run); use --soak 4 or more")
 
     if args.sweep_np:
         return sweep_np()
@@ -841,6 +940,9 @@ def main(argv=None) -> int:
     import jax
 
     _enable_compile_cache()
+
+    if args.soak:
+        return run_soak_mode(args)
 
     if not args.full:
         # flagship: wait for a quiet window (probe-gated, round-3

@@ -1,0 +1,169 @@
+#!/usr/bin/env python3
+"""Validate a ``--metrics-file`` Prometheus textfile.
+
+The CI soak-smoke's assertion (and a handy operator check): every line
+must be exposition-format 0.0.4 -- ``# HELP``/``# TYPE`` comments or
+``name{labels} value`` samples -- histograms must have monotone
+cumulative buckets whose ``+Inf`` count equals ``_count``, and any
+series named on the command line must be present.
+
+Usage:
+    python scripts/check_metrics_textfile.py FILE [--require NAME ...]
+
+Exit 0 = valid, 1 = malformed (each problem named on stderr).
+"""
+
+from __future__ import annotations
+
+import argparse
+import math
+import re
+import sys
+
+_SAMPLE = re.compile(
+    r'^(?P<name>[a-zA-Z_:][a-zA-Z0-9_:]*)'
+    r'(?P<labels>\{[^{}]*\})?\s+(?P<value>\S+)(\s+\d+)?$')
+_LABEL = re.compile(r'^[a-zA-Z_][a-zA-Z0-9_]*="(?:[^"\\]|\\.)*"$')
+
+
+def _parse_value(text: str) -> float:
+    if text == "+Inf":
+        return math.inf
+    if text == "-Inf":
+        return -math.inf
+    if text == "NaN":
+        return math.nan
+    return float(text)
+
+
+def check(path: str, require=()) -> list[str]:
+    problems: list[str] = []
+    typed: dict[str, str] = {}
+    buckets: dict[str, dict[tuple, list]] = {}
+    counts: dict[str, dict[tuple, float]] = {}
+    seen: set[str] = set()
+    with open(path) as f:
+        for lineno, line in enumerate(f, 1):
+            line = line.rstrip("\n")
+            if not line.strip():
+                continue
+            if line.startswith("#"):
+                parts = line.split(None, 3)
+                if len(parts) < 3 or parts[1] not in ("HELP", "TYPE"):
+                    problems.append(f"{path}:{lineno}: malformed "
+                                    f"comment: {line!r}")
+                elif parts[1] == "TYPE":
+                    if parts[3] not in ("counter", "gauge", "histogram",
+                                        "summary", "untyped"):
+                        problems.append(f"{path}:{lineno}: unknown "
+                                        f"type {parts[3]!r}")
+                    typed[parts[2]] = parts[3]
+                continue
+            m = _SAMPLE.match(line)
+            if not m:
+                problems.append(f"{path}:{lineno}: malformed sample: "
+                                f"{line!r}")
+                continue
+            name = m.group("name")
+            labels = m.group("labels")
+            lab_pairs = []
+            if labels:
+                for pair in _split_labels(labels[1:-1]):
+                    if not _LABEL.match(pair):
+                        problems.append(f"{path}:{lineno}: malformed "
+                                        f"label {pair!r}")
+                    lab_pairs.append(pair)
+            try:
+                value = _parse_value(m.group("value"))
+            except ValueError:
+                problems.append(f"{path}:{lineno}: non-numeric value "
+                                f"{m.group('value')!r}")
+                continue
+            seen.add(name)
+            base = re.sub(r"_(bucket|sum|count)$", "", name)
+            seen.add(base)
+            if typed.get(base) == "histogram":
+                key = tuple(p for p in lab_pairs
+                            if not p.startswith("le="))
+                if name.endswith("_bucket"):
+                    le = [p for p in lab_pairs if p.startswith("le=")]
+                    if not le:
+                        problems.append(f"{path}:{lineno}: histogram "
+                                        f"bucket without le label")
+                        continue
+                    ub = _parse_value(le[0][4:-1])
+                    buckets.setdefault(base, {}).setdefault(
+                        key, []).append((ub, value, lineno))
+                elif name.endswith("_count"):
+                    counts.setdefault(base, {})[key] = value
+    for base, by_series in buckets.items():
+        for key, rows in by_series.items():
+            rows.sort()
+            cum = [v for _, v, _ in rows]
+            if any(b > a for a, b in zip(cum[1:], cum)):
+                problems.append(f"{path}: {base}{list(key)}: bucket "
+                                f"counts not monotone: {cum}")
+            if not rows or not math.isinf(rows[-1][0]):
+                problems.append(f"{path}: {base}{list(key)}: missing "
+                                f"+Inf bucket")
+            elif counts.get(base, {}).get(key) is not None \
+                    and rows[-1][1] != counts[base][key]:
+                problems.append(
+                    f"{path}: {base}{list(key)}: +Inf bucket "
+                    f"{rows[-1][1]} != _count {counts[base][key]}")
+    for name in require:
+        if name not in seen:
+            problems.append(f"{path}: required series {name!r} absent")
+    return problems
+
+
+def _split_labels(body: str) -> list[str]:
+    """Split ``a="x",b="y,z"`` on commas outside quotes."""
+    out, cur, in_q, esc = [], [], False, False
+    for ch in body:
+        if esc:
+            cur.append(ch)
+            esc = False
+            continue
+        if ch == "\\":
+            cur.append(ch)
+            esc = True
+            continue
+        if ch == '"':
+            in_q = not in_q
+            cur.append(ch)
+            continue
+        if ch == "," and not in_q:
+            out.append("".join(cur))
+            cur = []
+            continue
+        cur.append(ch)
+    if cur:
+        out.append("".join(cur))
+    return out
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        description="validate a Prometheus metrics textfile "
+                    "(--metrics-file output)")
+    ap.add_argument("file", help="textfile to validate")
+    ap.add_argument("--require", action="append", default=[],
+                    metavar="NAME",
+                    help="fail unless this metric family is present "
+                         "(repeatable)")
+    args = ap.parse_args(argv)
+    try:
+        problems = check(args.file, args.require)
+    except OSError as e:
+        print(f"check-metrics: {e}", file=sys.stderr)
+        return 1
+    for p in problems:
+        print(f"check-metrics: {p}", file=sys.stderr)
+    if not problems:
+        print(f"check-metrics: {args.file}: OK")
+    return 1 if problems else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

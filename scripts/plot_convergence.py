@@ -1,23 +1,35 @@
 #!/usr/bin/env python3
-"""Render one or more ``--convergence-log`` JSONL files as a residual-
-history comparison.
+"""Render convergence logs and service-metrics captures side by side.
 
-With matplotlib: a semilog residual plot (one line per file, wrap
-markers where a ring truncated) written to ``-o OUT.png`` or shown.
-Without matplotlib (or under ``--ascii``): a text sparkline per file --
-log-scaled unicode blocks over the surviving window -- so the tool
-works on a bare pod VM.
+Accepts any mix of:
+
+* ``--convergence-log`` JSONL files (residual history per iteration);
+* ``--stats-json`` documents, schema ``acg-tpu-stats/3`` (a soak run's
+  latency/iteration percentiles, and the embedded registry snapshot's
+  latency histogram when the metrics layer was armed);
+* ``--metrics-file`` Prometheus textfiles (the ``acg_solve_seconds``
+  histogram and its percentiles re-derived from the bucket counts).
+
+With matplotlib: a semilog residual plot (one line per log, wrap
+markers where a ring truncated) and, when any latency input is given,
+a latency-histogram bar panel beside it; written to ``-o OUT.png`` or
+shown.  Without matplotlib (or under ``--ascii``): unicode sparklines
+-- log-scaled blocks for residuals, linear blocks over the occupied
+latency buckets -- plus a p50/p95/p99 summary line, so the tool works
+on a bare pod VM.
 
 Usage:
-    python scripts/plot_convergence.py run1.jsonl [run2.jsonl ...] \
+    python scripts/plot_convergence.py run1.jsonl [soak.prom s.json ...] \
         [-o compare.png] [--ascii]
 """
 
 from __future__ import annotations
 
 import argparse
+import json
 import math
 import os
+import re
 import sys
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(
@@ -26,7 +38,7 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(
 BLOCKS = "▁▂▃▄▅▆▇█"
 
 
-def _load(path):
+def _load_conv(path):
     from acg_tpu.telemetry import read_convergence_log
 
     meta, records = read_convergence_log(path)
@@ -35,6 +47,158 @@ def _load(path):
     # parses those directly, so they stay non-finite for the renderers
     rn = [float(r["rnrm2"]) for r in records]
     return meta, its, rn
+
+
+# -- latency inputs ------------------------------------------------------
+
+def _hist_quantile(cum, q: float):
+    """``histogram_quantile`` over ``[(upper_bound, cumulative), ...]``
+    ending with the +Inf bucket -- the same estimator acg_tpu.metrics
+    uses, re-implemented here so the script stays runnable against a
+    bare textfile with no package import needed at render time."""
+    total = cum[-1][1]
+    if total <= 0:
+        return None
+    rank = q * total
+    prev_ub, prev_c = 0.0, 0
+    for ub, c in cum:
+        if c >= rank:
+            if math.isinf(ub):
+                return prev_ub or None
+            if c == prev_c:
+                return ub
+            return prev_ub + (ub - prev_ub) * (rank - prev_c) / (c - prev_c)
+        prev_ub, prev_c = ub, c
+    return prev_ub
+
+
+_BUCKET_RE = re.compile(
+    r'^acg_solve_seconds_bucket\{[^}]*le="([^"]+)"[^}]*\}\s+(\S+)$')
+
+
+def _load_metrics_textfile(path):
+    """The ``acg_solve_seconds`` histogram out of a Prometheus
+    textfile: ``(cumulative_buckets, count)``."""
+    buckets: dict[float, int] = {}
+    count = 0
+    with open(path) as f:
+        for line in f:
+            line = line.strip()
+            m = _BUCKET_RE.match(line)
+            if m:
+                ub = math.inf if m.group(1) == "+Inf" else float(m.group(1))
+                buckets[ub] = buckets.get(ub, 0) + int(float(m.group(2)))
+            elif line.startswith("acg_solve_seconds_count"):
+                count += int(float(line.rsplit(None, 1)[1]))
+    if not buckets:
+        raise ValueError("no acg_solve_seconds histogram in textfile "
+                         "(not a --metrics-file capture?)")
+    cum = sorted(buckets.items())
+    if not math.isinf(cum[-1][0]):
+        cum.append((math.inf, count or cum[-1][1]))
+    return cum, count or cum[-1][1]
+
+
+def _load_stats_json(path):
+    """Latency evidence out of an ``acg-tpu-stats`` document (single
+    document or the first JSONL line): the soak report's percentiles,
+    plus the registry snapshot's latency buckets when present."""
+    with open(path) as f:
+        text = f.read()
+    try:
+        doc = json.loads(text)
+    except ValueError:
+        doc = None
+        for line in text.splitlines():
+            line = line.strip()
+            if not line or line.startswith("#"):
+                continue
+            try:
+                doc = json.loads(line)
+                break
+            except ValueError:
+                continue
+    if not isinstance(doc, dict) or "stats" not in doc:
+        raise ValueError("not an acg-tpu-stats document")
+    soak = (doc.get("stats") or {}).get("soak") or {}
+    cum = None
+    samples = ((doc.get("metrics") or {}).get("acg_solve_seconds")
+               or {}).get("samples") or []
+    if samples:
+        cum = [((math.inf if ub is None else float(ub)), int(c))
+               for ub, c in samples[0].get("buckets", [])]
+    return soak, cum
+
+
+def _latency_summary(label, soak, cum):
+    """One record the renderers share: percentiles (soak report first,
+    histogram-derived otherwise) + the occupied bucket histogram."""
+    pcts = {}
+    lat = soak.get("latency") or {}
+    for k in ("p50", "p95", "p99"):
+        if lat.get(k) is not None:
+            pcts[k] = float(lat[k])
+    if not pcts and cum is not None:
+        for q, k in ((0.5, "p50"), (0.95, "p95"), (0.99, "p99")):
+            v = _hist_quantile(cum, q)
+            if v is not None:
+                pcts[k] = v
+    return {"label": label, "pcts": pcts, "cum": cum,
+            "nsolves": soak.get("nsolves"),
+            "drift": soak.get("drift") or {}}
+
+
+def _fmt_s(v: float) -> str:
+    return f"{v * 1e3:.3g} ms" if v < 1.0 else f"{v:.3g} s"
+
+
+def _occupied(cum):
+    """Non-cumulative counts over the occupied finite-bucket window:
+    ``(edges, counts)``."""
+    counts, edges, prev = [], [], 0
+    for ub, c in cum:
+        counts.append(c - prev)
+        edges.append(ub)
+        prev = c
+    nz = [i for i, c in enumerate(counts) if c > 0]
+    if not nz:
+        return [], []
+    lo, hi = nz[0], nz[-1]
+    return edges[lo:hi + 1], counts[lo:hi + 1]
+
+
+def _latency_text(rec) -> list[str]:
+    head = rec["label"]
+    if rec["nsolves"]:
+        head += f" [{rec['nsolves']} solves]"
+    p = rec["pcts"]
+    if p:
+        head += ("  latency "
+                 + "  ".join(f"{k} {_fmt_s(v)}"
+                             for k, v in sorted(p.items())))
+    drift = rec["drift"]
+    if drift.get("ratio") is not None:
+        head += f"  drift x{drift['ratio']:.2f}"
+        if drift.get("tripped"):
+            head += " (TRIPPED)"
+    lines = [head]
+    if rec["cum"]:
+        edges, counts = _occupied(rec["cum"])
+        if counts and all(math.isinf(e) for e in edges):
+            lines.append(f"  ({counts[-1]} observation(s) past the "
+                         f"bucket ladder)")
+        elif counts:
+            peak = max(counts)
+            bar = "".join(
+                BLOCKS[min(int(c / peak * (len(BLOCKS) - 1) + 0.5),
+                           len(BLOCKS) - 1)] if c else "▁"
+                for c in counts)
+            lo = edges[0] if not math.isinf(edges[0]) else 0.0
+            hi = next((e for e in reversed(edges)
+                       if not math.isinf(e)), lo)
+            lines.append(f"  {bar}  buckets {_fmt_s(lo)} .. "
+                         f"{_fmt_s(hi)}")
+    return lines
 
 
 def _sparkline(its, rn, width: int = 72) -> str:
@@ -64,25 +228,52 @@ def _sparkline(its, rn, width: int = 72) -> str:
     return "".join(out)
 
 
+def _classify(path):
+    """``("conv", ...) | ("latency", ...)`` by content, not extension:
+    a convergence log's first parseable line is the meta record, a
+    stats document has a ``stats`` key, anything with an
+    ``acg_solve_seconds`` series is a metrics textfile."""
+    try:
+        soak, cum = _load_stats_json(path)
+        if soak or cum:
+            return ("latency",
+                    _latency_summary(os.path.basename(path), soak, cum))
+        raise ValueError("stats document without latency evidence "
+                         "(no soak section or metrics snapshot)")
+    except ValueError:
+        pass
+    try:
+        cum, _n = _load_metrics_textfile(path)
+        return ("latency",
+                _latency_summary(os.path.basename(path), {}, cum))
+    except (ValueError, UnicodeDecodeError):
+        pass
+    meta, its, rn = _load_conv(path)
+    return ("conv", (path, meta, its, rn))
+
+
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(
-        description="plot --convergence-log JSONL residual histories")
+        description="plot --convergence-log residual histories and "
+                    "--metrics-file/--stats-json latency captures")
     ap.add_argument("logs", nargs="+", metavar="FILE",
-                    help="convergence-log JSONL file(s)")
+                    help="convergence-log JSONL, acg-tpu-stats JSON, "
+                         "or Prometheus metrics textfile(s)")
     ap.add_argument("-o", "--output", metavar="PNG", default=None,
                     help="write the plot to PNG instead of showing it")
     ap.add_argument("--ascii", action="store_true",
-                    help="force the text sparkline fallback even when "
-                         "matplotlib is installed")
+                    help="force the text fallback even when matplotlib "
+                         "is installed")
     args = ap.parse_args(argv)
 
-    loaded = []
+    conv, latency = [], []
     for path in args.logs:
         try:
-            loaded.append((path,) + _load(path))
+            kind, rec = _classify(path)
         except (OSError, ValueError, KeyError) as e:
             print(f"plot_convergence: {path}: {e}", file=sys.stderr)
             return 1
+        (conv if kind == "conv" else latency).append(rec)
 
     plt = None
     if not args.ascii:
@@ -95,7 +286,7 @@ def main(argv=None) -> int:
             plt = None
 
     if plt is None:
-        for path, meta, its, rn in loaded:
+        for path, meta, its, rn in conv:
             finite = [v for v in rn if math.isfinite(v) and v > 0]
             label = meta.get("solver", "cg")
             head = (f"{path} [{label}] iterations "
@@ -104,6 +295,8 @@ def main(argv=None) -> int:
                 head += (f" (ring wrapped: iterations before "
                          f"{meta.get('truncated_before', its[0] if its else 0)}"
                          f" truncated)")
+            if meta.get("truncated"):
+                head += " (trailing line truncated mid-write)"
             print(head)
             print("  " + _sparkline(its, rn))
             if finite:
@@ -111,10 +304,16 @@ def main(argv=None) -> int:
                       f"{rn[-1]:.3e}" if math.isfinite(rn[-1])
                       else f"  rnrm2 max {max(finite):.3e}  final "
                            f"{rn[-1]!r} (breakdown)")
+        for rec in latency:
+            for line in _latency_text(rec):
+                print(line)
         return 0
 
-    fig, ax = plt.subplots(figsize=(9, 5))
-    for path, meta, its, rn in loaded:
+    ncols = (1 if not latency else 2) if conv else 1
+    fig, axes = plt.subplots(1, ncols, figsize=(9 if ncols == 1 else 13, 5))
+    axes = [axes] if ncols == 1 else list(axes)
+    ax = axes[0] if conv else None
+    for path, meta, its, rn in conv:
         label = os.path.basename(path)
         if meta.get("wrapped"):
             label += " (truncated)"
@@ -125,10 +324,46 @@ def main(argv=None) -> int:
         if bad:
             ax.plot(bad, [ax.get_ylim()[0]] * len(bad), "rx",
                     markersize=8, label=f"{label}: non-finite")
-    ax.set_xlabel("iteration")
-    ax.set_ylabel("residual 2-norm")
-    ax.grid(True, which="both", alpha=0.3)
-    ax.legend(fontsize=8)
+    if conv:
+        ax.set_xlabel("iteration")
+        ax.set_ylabel("residual 2-norm")
+        ax.grid(True, which="both", alpha=0.3)
+        ax.legend(fontsize=8)
+    if latency:
+        lax = axes[-1]
+        plotted = False
+        for rec in latency:
+            if not rec["cum"]:
+                continue
+            edges, counts = _occupied(rec["cum"])
+            finite = [e for e in edges if not math.isinf(e)]
+            if not counts or not finite:
+                continue  # only the +Inf bucket occupied: no finite
+                # position to anchor a log-axis step at
+            # step plot at the TRUE bucket edges on a log axis --
+            # multiple inputs with disjoint latency ranges keep their
+            # own positions (a shared integer axis would mislabel all
+            # but the last); the +Inf bucket renders one synthetic
+            # decade past the last finite edge so overflow stays
+            # visible
+            xs = [(e if not math.isinf(e) else finite[-1] * 10)
+                  for e in edges]
+            lax.step(xs, counts, where="pre", marker="o",
+                     markersize=3, alpha=0.8, label=rec["label"])
+            plotted = True
+        if plotted:
+            lax.set_xscale("log")
+        summary = "; ".join(
+            f"{rec['label']}: "
+            + " ".join(f"{k}={_fmt_s(v)}"
+                       for k, v in sorted(rec["pcts"].items()))
+            for rec in latency if rec["pcts"])
+        lax.set_xlabel("solve latency bucket")
+        lax.set_ylabel("solves")
+        if summary:
+            lax.set_title(summary, fontsize=8)
+        if plotted:
+            lax.legend(fontsize=8)
     fig.tight_layout()
     if args.output:
         fig.savefig(args.output, dpi=130)

@@ -3,6 +3,12 @@
 # the same thing (ROADMAP.md "Tier-1 verify").  Prints DOTS_PASSED (the
 # per-test pass count the growth driver tracks) and exits with pytest's
 # status.  Run from anywhere; executes at the repo root.
+#
+# T1_SOAK=1 additionally runs the service-soak smoke after the tests: a
+# tiny 3-solve --soak run whose --metrics-file must validate as
+# Prometheus exposition format and whose --stats-json must carry the
+# acg-tpu-stats/3 soak section (the CI soak-smoke step runs the same
+# thing).
 set -o pipefail
 cd "$(dirname "$0")/.."
 rm -f /tmp/_t1.log
@@ -11,4 +17,25 @@ timeout -k 10 870 env JAX_PLATFORMS=cpu python -m pytest tests/ -q \
     -p no:xdist -p no:randomly 2>&1 | tee /tmp/_t1.log
 rc=${PIPESTATUS[0]}
 echo DOTS_PASSED=$(grep -aE '^[.FEsx]+( *\[ *[0-9]+%\])?$' /tmp/_t1.log | tr -cd . | wc -c)
+if [ "${T1_SOAK:-0}" = "1" ]; then
+    echo "T1_SOAK: 3-solve soak smoke"
+    rm -f /tmp/_t1_soak.prom /tmp/_t1_soak.json
+    timeout -k 10 300 env JAX_PLATFORMS=cpu python -m acg_tpu.cli \
+        gen:poisson2d:16 --comm none --max-iterations 100 \
+        --residual-rtol 1e-8 --warmup 0 --quiet --soak 3 \
+        --metrics-file /tmp/_t1_soak.prom \
+        --stats-json /tmp/_t1_soak.json || rc=$((rc ? rc : 1))
+    python scripts/check_metrics_textfile.py /tmp/_t1_soak.prom \
+        --require acg_solves_total --require acg_solve_seconds \
+        --require acg_solve_iterations || rc=$((rc ? rc : 1))
+    python - <<'PY' || rc=$((rc ? rc : 1))
+import json
+doc = json.load(open("/tmp/_t1_soak.json"))
+assert doc["schema"] == "acg-tpu-stats/3", doc["schema"]
+soak = doc["stats"]["soak"]
+assert soak["nsolves"] == 3 and soak["latency"]["p50"] is not None, soak
+assert "metrics" in doc, "registry snapshot missing from /3 document"
+print("T1_SOAK: OK")
+PY
+fi
 exit $rc

@@ -132,6 +132,61 @@ def test_perfmodel_analysis_leaves_programs_byte_identical(prob):
     assert s2.lower_solve(b2).as_text() == before2
 
 
+def test_metrics_layer_leaves_programs_byte_identical(prob):
+    """The service-metrics tier is host-side bookkeeping only: arming
+    the registry, recording solves/phases/events, and a full soak pass
+    must leave the lowered solve programs byte-identical, single-chip
+    and distributed (the telemetry/faults/perfmodel disarmament
+    contract, extended to PR 4's layer)."""
+    from acg_tpu import metrics, soak
+    from acg_tpu.io.generators import poisson2d_coo as _p2
+    from acg_tpu.ops.spmv import device_matrix_from_csr
+    from acg_tpu.solvers.jax_cg import JaxCGSolver
+    from acg_tpu.solvers.stats import StoppingCriteria
+
+    r, c, v, N = _p2(12)
+    csr = SymCsrMatrix.from_coo(N, r, c, v).to_csr()
+    b1 = np.ones(N)
+    s1 = JaxCGSolver(device_matrix_from_csr(csr, dtype=jnp.float64),
+                     kernels="xla")
+    s2 = DistCGSolver(prob)
+    b2 = np.ones(prob.n)
+    before1 = s1.lower_solve(b1).as_text()
+    before2 = s2.lower_solve(b2).as_text()
+    was = metrics.armed()
+    try:
+        metrics.arm()
+        soak.run_soak(s1, b1, nsolves=3,
+                      criteria=StoppingCriteria(maxits=20),
+                      solve_kwargs={"raise_on_divergence": False})
+        s2.solve(b2, criteria=StoppingCriteria(maxits=10),
+                 raise_on_divergence=False)
+        assert s1.lower_solve(b1).as_text() == before1
+        assert s2.lower_solve(b2).as_text() == before2
+    finally:
+        if not was:
+            metrics.disarm()
+
+
+def test_soak_section_appends_only():
+    """Like costmodel:/memory:, the soak: section appends strictly
+    after the reference-format block -- a report without it is a
+    byte-prefix of one with it."""
+    from acg_tpu.solvers.stats import SolverStats
+
+    st = SolverStats(unknowns=7)
+    st.timings["solve"] = 0.25
+    st.costmodel.update({"flops": 1.0})
+    base = st.fwrite()
+    st.soak.update({"nsolves": 3,
+                    "latency": {"p50": 0.001, "p95": 0.002},
+                    "drift": {"tripped": False}})
+    txt = st.fwrite()
+    assert txt.startswith(base)
+    assert "soak:" in txt[len(base):]
+    assert st.to_dict()["soak"]["latency"]["p50"] == 0.001
+
+
 def test_explain_sections_append_only():
     """--explain never mutates the reference-format stats block: the
     costmodel:/memory: sections (like timings:) append strictly AFTER

@@ -156,6 +156,7 @@ def _free_port():
         return s.getsockname()[1]
 
 
+@pytest.mark.two_process_collectives
 def test_cli_two_process_distributed_read(binfile):
     """The full 2-process flow: both controllers range-read only their
     rows (--distributed-read), solve, and process 0 reports the
@@ -332,6 +333,7 @@ def test_partitioned_local_read_solves_to_original(part_binfile, irregular):
     assert rel < 1e-8
 
 
+@pytest.mark.two_process_collectives
 def test_cli_two_process_partitioned_distributed_read(part_binfile):
     """2-process METIS-partitioned ingest: each controller range-reads
     only its permuted rows (O(local nnz)), bounds sidecar auto-detected."""
@@ -381,6 +383,7 @@ def test_read_vector_rows_gather(tmp_path):
         read_vector_rows(p, np.asarray([n]), expect_nrows=n)
 
 
+@pytest.mark.two_process_collectives
 def test_cli_two_process_permuted_b_x0_files(part_binfile, irregular,
                                              tmp_path_factory):
     """b/x0 FILES with a METIS-permuted matrix under --distributed-read
@@ -472,6 +475,7 @@ def test_write_vector_window_roundtrip(tmp_path):
     assert p.read_bytes() == ref.read_bytes()
 
 
+@pytest.mark.two_process_collectives
 def test_cli_two_process_distributed_write(binfile, tmp_path_factory):
     """2-process --distributed-read --output: both controllers range-
     write their owned windows; the assembled file is byte-identical to
@@ -629,6 +633,7 @@ def test_distributed_read_refine_f64_class(binfile, csr, tmp_path):
     assert rel < 1e-9
 
 
+@pytest.mark.two_process_collectives
 def test_cli_two_process_distributed_read_refine(binfile):
     """2-process --distributed-read --refine: the outer matvec combines
     per-controller owned windows across processes."""

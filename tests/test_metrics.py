@@ -1,0 +1,517 @@
+"""Service-metrics tier (acg_tpu.metrics + acg_tpu.soak): registry
+semantics, Prometheus exposition golden, the soak driver, the drift
+detector + injected-slowdown trip path, and the ``acg-tpu-stats/3``
+round-trip through ``scripts/bench_diff.py``.
+
+Covers the PR-4 satellite checklist: counter monotonicity, histogram
+bucket boundaries, label dedup, an exposition-format golden, a
+3-solve soak smoke with the drift detector armed, and the /3 schema
+diffing through the bench gate."""
+
+import json
+import math
+import os
+import subprocess
+import sys
+import urllib.request
+
+import numpy as np
+import pytest
+
+from acg_tpu import metrics, soak
+from acg_tpu.io.generators import poisson2d_coo
+from acg_tpu.matrix import SymCsrMatrix
+from acg_tpu.solvers.stats import StoppingCriteria
+
+SCRIPTS = os.path.join(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))), "scripts")
+
+ENV_KEYS = {"JAX_PLATFORMS": "cpu",
+            "XLA_FLAGS": "--xla_force_host_platform_device_count=8"}
+
+
+def run_cli(argv, **kw):
+    env = dict(os.environ)
+    env.update(ENV_KEYS)
+    kw.setdefault("timeout", 600)
+    return subprocess.run([sys.executable, "-m", "acg_tpu.cli", *argv],
+                          capture_output=True, text=True, env=env, **kw)
+
+
+@pytest.fixture(scope="module")
+def csr():
+    r, c, v, N = poisson2d_coo(12)
+    return SymCsrMatrix.from_coo(N, r, c, v).to_csr()
+
+
+def _jax_solver(csr, **kw):
+    import jax.numpy as jnp
+
+    from acg_tpu.ops.spmv import device_matrix_from_csr
+    from acg_tpu.solvers.jax_cg import JaxCGSolver
+
+    A = device_matrix_from_csr(csr, dtype=jnp.float64)
+    return JaxCGSolver(A, **kw)
+
+
+@pytest.fixture(autouse=True)
+def _disarm_after():
+    """Every test leaves the process-wide layer the way it found it."""
+    was = metrics.armed()
+    yield
+    if not was:
+        metrics.disarm()
+
+
+# -- registry semantics --------------------------------------------------
+
+def test_counter_monotonic():
+    reg = metrics.Registry()
+    c = reg.counter("t_total", "x")
+    c.inc()
+    c.inc(2.5)
+    assert c.value == 3.5
+    with pytest.raises(ValueError):
+        c.inc(-1)
+    with pytest.raises(ValueError):
+        c.dec()  # counters cannot go down, by any route
+
+
+def test_gauge_set_dec():
+    reg = metrics.Registry()
+    g = reg.gauge("t_g", "x")
+    g.set(10)
+    g.dec(3)
+    g.inc(0.5)
+    assert g.value == 7.5
+
+
+def test_histogram_bucket_boundaries():
+    """A value EQUAL to an upper bound lands in that bucket (le =
+    less-or-equal, the Prometheus contract); above the ladder it lands
+    only in +Inf."""
+    reg = metrics.Registry()
+    h = reg.histogram("t_h", "x", buckets=(1.0, 2.0, 4.0))
+    for v in (0.5, 1.0, 2.0, 2.00001, 100.0):
+        h.observe(v)
+    cum = h._children[()].cumulative_buckets()
+    assert cum == [(1.0, 2), (2.0, 3), (4.0, 4), (math.inf, 5)]
+    assert h.count == 5
+
+
+def test_histogram_quantile_interpolation():
+    reg = metrics.Registry()
+    h = reg.histogram("t_q", "x", buckets=(1.0, 2.0, 4.0))
+    assert math.isnan(h.quantile(0.5))  # empty
+    for _ in range(10):
+        h.observe(1.5)  # all land in (1, 2]
+    # rank 5 of 10 inside [1, 2] -> linear midpoint
+    assert h.quantile(0.5) == pytest.approx(1.5)
+    assert h.quantile(1.0) == pytest.approx(2.0)
+    h.observe(1000.0)  # beyond the ladder: +Inf bucket
+    assert h.quantile(0.999) == pytest.approx(4.0)  # last finite edge
+
+
+def test_label_dedup_and_validation():
+    reg = metrics.Registry()
+    c = reg.counter("t_l", "x", labelnames=("a", "b"))
+    c1 = c.labels(a="1", b="2")
+    c2 = c.labels(b="2", a="1")
+    assert c1 is c2  # one child per distinct value tuple, ever
+    c1.inc()
+    assert c.labels("1", "2").value == 1
+    with pytest.raises(ValueError):
+        c.labels(a="1")  # missing label
+    with pytest.raises(ValueError):
+        c.labels(a="1", b="2", z="3")  # unknown label
+    with pytest.raises(ValueError):
+        c.inc()  # labelled family needs .labels()
+
+
+def test_reregistration_returns_same_family_or_raises():
+    reg = metrics.Registry()
+    a = reg.counter("t_r", "x")
+    assert reg.counter("t_r", "x") is a
+    with pytest.raises(ValueError):
+        reg.gauge("t_r", "x")
+    with pytest.raises(ValueError):
+        reg.counter("t_r", "x", labelnames=("l",))
+    with pytest.raises(ValueError):
+        reg.counter("bad name", "x")
+
+
+# -- exposition golden ---------------------------------------------------
+
+def test_prometheus_exposition_golden():
+    """The full text format, pinned: HELP/TYPE comments, label
+    escaping, cumulative histogram buckets with +Inf, _sum/_count, and
+    deterministic family/series ordering."""
+    reg = metrics.Registry()
+    h = reg.histogram("t_lat_seconds", "Latency.",
+                      buckets=(0.1, 1.0, 10.0))
+    h.observe(0.05)
+    h.observe(5.0)
+    c = reg.counter("t_requests_total", "Total requests.",
+                    labelnames=("code",))
+    c.labels(code="200").inc(3)
+    c.labels(code='5"00').inc()  # a quote that must escape
+    g = reg.gauge("t_temp_celsius", "Temp.")
+    g.set(21.5)
+    expected = "\n".join([
+        "# HELP t_lat_seconds Latency.",
+        "# TYPE t_lat_seconds histogram",
+        't_lat_seconds_bucket{le="0.1"} 1',
+        't_lat_seconds_bucket{le="1"} 1',
+        't_lat_seconds_bucket{le="10"} 2',
+        't_lat_seconds_bucket{le="+Inf"} 2',
+        "t_lat_seconds_sum 5.05",
+        "t_lat_seconds_count 2",
+        "# HELP t_requests_total Total requests.",
+        "# TYPE t_requests_total counter",
+        't_requests_total{code="200"} 3',
+        't_requests_total{code="5\\"00"} 1',
+        "# HELP t_temp_celsius Temp.",
+        "# TYPE t_temp_celsius gauge",
+        "t_temp_celsius 21.5",
+    ]) + "\n"
+    assert reg.expose() == expected
+
+
+def test_exposition_validates_and_snapshot_roundtrips(tmp_path):
+    """The process-wide registry's exposition passes the CI validator,
+    and the JSON snapshot agrees with the text counters."""
+    metrics.arm()
+    metrics.record_solve(0.01, 25, True, solver="unit-test")
+    path = tmp_path / "m.prom"
+    metrics.write_textfile(path)
+    r = subprocess.run(
+        [sys.executable, os.path.join(SCRIPTS,
+                                      "check_metrics_textfile.py"),
+         str(path), "--require", "acg_solves_total",
+         "--require", "acg_solve_seconds"],
+        capture_output=True, text=True, timeout=120)
+    assert r.returncode == 0, r.stderr
+    snap = metrics.snapshot_dict()
+    total = sum(s["value"]
+                for s in snap["acg_solves_total"]["samples"]
+                if s["labels"].get("solver") == "unit-test")
+    assert total >= 1
+    assert snap["acg_solve_seconds"]["type"] == "histogram"
+
+
+def test_textfile_flush_is_atomic_rename(tmp_path):
+    """write_textfile leaves no temp droppings and replaces in place."""
+    path = tmp_path / "out.prom"
+    metrics.write_textfile(path)
+    first = path.read_text()
+    metrics.write_textfile(path)
+    assert path.read_text().startswith("# HELP")
+    assert first.startswith("# HELP")
+    assert [p for p in os.listdir(tmp_path)
+            if p.startswith("out.prom.tmp")] == []
+
+
+def test_http_endpoint_serves_metrics():
+    metrics.arm()
+    server = metrics.serve(0)
+    try:
+        port = server.server_address[1]
+        body = urllib.request.urlopen(
+            f"http://127.0.0.1:{port}/metrics", timeout=30).read()
+        assert b"acg_solves_total" in body
+        with pytest.raises(Exception):
+            urllib.request.urlopen(
+                f"http://127.0.0.1:{port}/nope", timeout=30)
+    finally:
+        server.shutdown()
+        server.server_close()
+
+
+def test_disarmed_hooks_are_noops():
+    metrics.disarm()
+    before = metrics.SOLVE_SECONDS.count
+    metrics.record_solve(1.0, 10, True)
+    metrics.record_phase("solve", 1.0)
+    metrics.record_event_kind("breakdown")
+    assert metrics.SOLVE_SECONDS.count == before
+
+
+# -- drift detector ------------------------------------------------------
+
+def test_drift_detector_trips_deterministically():
+    det = soak.DriftDetector(nsolves=10, threshold_pct=20.0)
+    for i in range(det.nbaseline):
+        assert det.update(i, 0.010) is False
+    assert det.baseline == pytest.approx(0.010)
+    tripped = []
+    for i in range(det.nbaseline, 10):
+        if det.update(i, 0.030):  # 3x the baseline
+            tripped.append(i)
+    assert len(tripped) == 1  # structured event fires ONCE
+    assert det.to_dict()["tripped"] is True
+    assert det.ratio > 1.2
+
+
+def test_drift_detector_stable_latency_never_trips():
+    det = soak.DriftDetector(nsolves=20, threshold_pct=20.0)
+    assert not any(det.update(i, 0.010 + (i % 3) * 1e-4)
+                   for i in range(20))
+    assert det.to_dict()["tripped"] is False
+
+
+# -- soak driver ---------------------------------------------------------
+
+def test_soak_smoke_three_solves(csr):
+    """The satellite's 3-solve smoke: report shape, registry feed, and
+    the stats section landing on the solver."""
+    s = _jax_solver(csr)
+    b = np.ones(csr.shape[0])
+    before = metrics.SOLVE_SECONDS.count
+    x, report = soak.run_soak(
+        s, b, nsolves=3,
+        criteria=StoppingCriteria(maxits=60, residual_rtol=1e-8))
+    assert np.linalg.norm(b - csr @ np.asarray(x, np.float64)) \
+        <= 1e-6 * np.linalg.norm(b)
+    assert report["nsolves"] == 3
+    assert report["latency"]["p50"] > 0
+    assert report["iterations"]["p50"] > 0
+    assert report["drift"]["tripped"] is False
+    assert report["drift"]["baseline_solves"] == 3
+    assert s.stats.soak is report and s.stats.nsolves == 3
+    # the solvers fed the process-wide histograms too (metrics armed
+    # by the driver)
+    assert metrics.SOLVE_SECONDS.count >= before + 3
+
+
+def test_soak_slow_fault_trips_detector(csr):
+    """solve:slow@K dilates solves from index K inside the timed
+    window; the EWMA detector must trip and record ONE drift event."""
+    from acg_tpu import faults
+
+    s = _jax_solver(csr)
+    b = np.ones(csr.shape[0])
+    drift_ctr = metrics.EVENTS.labels(kind="drift")
+    before = drift_ctr.value
+    with faults.injected("solve:slow@4:secs=0.05"):
+        x, report = soak.run_soak(
+            s, b, nsolves=10, fail_on_drift=20.0,
+            criteria=StoppingCriteria(maxits=30,
+                                      residual_rtol=1e-8),
+            solve_kwargs={"raise_on_divergence": False})
+    assert report["drift"]["tripped"] is True
+    # the by-kind counter and stats.events must AGREE: one trip, one
+    # increment (record_event routes to the counter; no double count)
+    assert drift_ctr.value == before + 1
+    assert report["drift"]["tripped_at_solve"] >= 4
+    drift_events = [e for e in s.stats.events if e["kind"] == "drift"]
+    assert len(drift_events) == 1
+    assert soak.gate_exit_code(report, 20.0) == soak.DRIFT_EXIT_CODE
+    assert soak.gate_exit_code(report, None) == 0  # gate needs the flag
+
+
+def test_solve_slow_spec_parsing():
+    from acg_tpu import faults
+
+    spec = faults.parse_fault_spec("solve:slow@10:secs=0.25")
+    assert (spec.site, spec.mode, spec.iteration, spec.secs) == \
+        ("solve", "slow", 10, 0.25)
+    assert not spec.device_site
+    with pytest.raises(ValueError):
+        faults.parse_fault_spec("solve:slow@10")  # secs is mandatory
+    with pytest.raises(ValueError):
+        faults.parse_fault_spec("solve:nan@10:secs=1")
+
+
+# -- CLI end-to-end ------------------------------------------------------
+
+def test_cli_soak_acceptance(tmp_path):
+    """The acceptance criterion: one --soak run produces a textfile the
+    format validator accepts, p50/p95/p99 latency + iteration
+    histograms in the /3 stats document, and the soak: stats section."""
+    prom = tmp_path / "m.prom"
+    stats = tmp_path / "s.json"
+    r = run_cli(["gen:poisson2d:12", "--comm", "none",
+                 "--max-iterations", "200", "--residual-rtol", "1e-8",
+                 "--warmup", "1", "--quiet", "--soak", "6",
+                 "--metrics-file", str(prom),
+                 "--stats-json", str(stats)])
+    assert r.returncode == 0, r.stderr
+    assert "soak:" in r.stderr  # the stats section rendered
+    v = subprocess.run(
+        [sys.executable, os.path.join(SCRIPTS,
+                                      "check_metrics_textfile.py"),
+         str(prom), "--require", "acg_solves_total",
+         "--require", "acg_solve_seconds",
+         "--require", "acg_solve_iterations",
+         "--require", "acg_process_resident_bytes"],
+        capture_output=True, text=True, timeout=120)
+    assert v.returncode == 0, v.stderr + v.stdout
+    doc = json.loads(stats.read_text())
+    assert doc["schema"] == "acg-tpu-stats/3"
+    sk = doc["stats"]["soak"]
+    assert sk["nsolves"] == 6
+    for k in ("p50", "p95", "p99"):
+        assert sk["latency"][k] > 0
+        assert sk["iterations"][k] > 0
+    assert doc["metrics"]["acg_solve_seconds"]["samples"][0]["count"] \
+        >= 6
+    # RSS gauge carries a real value
+    rss = doc["metrics"]["acg_process_resident_bytes"]["samples"][0]
+    assert rss["value"] > 1e6
+
+
+def test_cli_soak_drift_gate_exit_code(tmp_path):
+    """The injected slowdown trips --fail-on-drift: exit 7, a drift
+    event in the stats document."""
+    stats = tmp_path / "s.json"
+    r = run_cli(["gen:poisson2d:12", "--comm", "none",
+                 "--max-iterations", "100", "--residual-rtol", "1e-8",
+                 "--warmup", "0", "--quiet", "--soak", "12",
+                 "--fail-on-drift", "20",
+                 "--fault-inject", "solve:slow@6:secs=0.05",
+                 "--stats-json", str(stats)])
+    assert r.returncode == soak.DRIFT_EXIT_CODE, r.stderr
+    assert "latency drift" in r.stderr
+    doc = json.loads(stats.read_text())
+    assert any(e["kind"] == "drift" for e in doc["stats"]["events"])
+    assert doc["stats"]["soak"]["drift"]["tripped"] is True
+
+
+def test_cli_soak_flag_validation():
+    r = run_cli(["gen:poisson2d:12", "--comm", "none", "--quiet",
+                 "--fail-on-drift", "10"])
+    assert r.returncode != 0 and "--fail-on-drift needs --soak" in r.stderr
+    r = run_cli(["gen:poisson2d:12", "--comm", "none", "--quiet",
+                 "--soak", "2", "--refine"])
+    assert r.returncode != 0 and "--soak does not support" in r.stderr
+    r = run_cli(["gen:poisson2d:12", "--comm", "none", "--quiet",
+                 "--soak", "3", "--fail-on-drift", "-5"])
+    assert r.returncode != 0 and "must be positive" in r.stderr
+    # a gate whose baseline window consumes the whole run could never
+    # trip -- it must refuse, not green CI silently
+    r = run_cli(["gen:poisson2d:12", "--comm", "none", "--quiet",
+                 "--soak", "3", "--fail-on-drift", "20"])
+    assert r.returncode != 0 and "vacuous" in r.stderr
+
+
+def test_failed_validation_never_clobbers_textfile(tmp_path):
+    """A run that dies in flag validation ran nothing: it must not
+    replace the last healthy run's textfile with an all-zeros scrape."""
+    prom = tmp_path / "m.prom"
+    prom.write_text("# last healthy capture\n")
+    r = run_cli(["gen:poisson2d:12", "--comm", "none", "--quiet",
+                 "--soak", "3", "--fail-on-drift", "20",
+                 "--metrics-file", str(prom)])
+    assert r.returncode != 0
+    assert prom.read_text() == "# last healthy capture\n"
+
+
+def test_gate_is_vacuous_boundary():
+    assert soak.gate_is_vacuous(3)
+    assert not soak.gate_is_vacuous(4)  # one evaluated solve
+    assert not soak.gate_is_vacuous(50)
+    with pytest.raises(ValueError):
+        # library route refuses the same way the CLI does
+        soak.run_soak(object(), None, nsolves=3, fail_on_drift=10.0)
+    r = run_cli(["gen:poisson2d:12", "--comm", "none", "--quiet",
+                 "--fault-inject", "solve:slow@2:secs=0.01"])
+    assert r.returncode != 0 and "--soak N" in r.stderr
+
+
+def test_cli_soak_dist_solver(tmp_path):
+    """Soak over the distributed solver on the 8-device mesh: the comm
+    ledger feeds the halo/psum byte counters."""
+    prom = tmp_path / "m.prom"
+    r = run_cli(["gen:poisson2d:16", "--nparts", "4",
+                 "--max-iterations", "200", "--residual-rtol", "1e-8",
+                 "--warmup", "0", "--quiet", "--soak", "3",
+                 "--metrics-file", str(prom)])
+    assert r.returncode == 0, r.stderr
+    text = prom.read_text()
+    halo = [ln for ln in text.splitlines()
+            if ln.startswith("acg_halo_bytes_total")][0]
+    psum = [ln for ln in text.splitlines()
+            if ln.startswith("acg_allreduce_bytes_total")][0]
+    assert float(halo.split()[-1]) > 0
+    assert float(psum.split()[-1]) > 0
+
+
+# -- /3 round-trip through bench_diff ------------------------------------
+
+def _soak_doc(metric: str, p50_lat: float, p50_its: float) -> dict:
+    return {"schema": "acg-tpu-stats/3",
+            "manifest": {"schema": "acg-tpu-stats/3", "metric": metric},
+            "stats": {"niterations": 0, "tsolve": 0.0,
+                      "soak": {"nsolves": 5,
+                               "latency": {"p50": p50_lat},
+                               "iterations": {"p50": p50_its}}}}
+
+
+def test_bench_diff_soak_captures(tmp_path):
+    """Two /3 soak documents diff case-by-case on the p50 figure: a
+    slower candidate regresses, an equal one passes."""
+    base = tmp_path / "base.jsonl"
+    good = tmp_path / "good.jsonl"
+    bad = tmp_path / "bad.jsonl"
+    base.write_text(json.dumps(_soak_doc("soak_case", 0.010, 100)) + "\n")
+    good.write_text(json.dumps(_soak_doc("soak_case", 0.010, 100)) + "\n")
+    bad.write_text(json.dumps(_soak_doc("soak_case", 0.020, 100)) + "\n")
+    script = os.path.join(SCRIPTS, "bench_diff.py")
+
+    def diff(a, b):
+        return subprocess.run(
+            [sys.executable, script, str(a), str(b),
+             "--fail-on-regress", "10"],
+            capture_output=True, text=True, timeout=120)
+
+    r = diff(base, good)
+    assert r.returncode == 0, r.stdout + r.stderr
+    assert "1 case(s) compared" in r.stdout
+    r = diff(base, bad)
+    assert r.returncode == 1
+    assert "REGRESSION" in r.stdout
+
+
+def test_cli_soak_stats_json_diffs_itself(tmp_path):
+    """A REAL soak capture diffs cleanly against itself through the
+    bench gate (the /3 reader path end-to-end)."""
+    stats = tmp_path / "s.json"
+    r = run_cli(["gen:poisson2d:12", "--comm", "none",
+                 "--max-iterations", "100", "--residual-rtol", "1e-8",
+                 "--warmup", "0", "--quiet", "--soak", "3",
+                 "--stats-json", str(stats)])
+    assert r.returncode == 0, r.stderr
+    d = subprocess.run(
+        [sys.executable, os.path.join(SCRIPTS, "bench_diff.py"),
+         str(stats), str(stats)],
+        capture_output=True, text=True, timeout=120)
+    assert d.returncode == 0, d.stdout + d.stderr
+    assert "1 case(s) compared" in d.stdout
+
+
+# -- tooling: plot_convergence latency inputs ----------------------------
+
+def test_plot_convergence_accepts_metrics_and_stats(tmp_path):
+    metrics.arm()
+    for v in (0.001, 0.002, 0.002, 0.004):
+        metrics.SOLVE_SECONDS.observe(v)
+    prom = tmp_path / "m.prom"
+    metrics.write_textfile(prom)
+    doc = _soak_doc("x", 0.002, 50)
+    sj = tmp_path / "s.json"
+    sj.write_text(json.dumps(doc))
+    script = os.path.join(SCRIPTS, "plot_convergence.py")
+    r = subprocess.run(
+        [sys.executable, script, str(prom), str(sj), "--ascii"],
+        capture_output=True, text=True, timeout=120)
+    assert r.returncode == 0, r.stderr
+    assert "p50" in r.stdout and "latency" in r.stdout
+
+
+def test_buildinfo_advertises_service_metrics():
+    r = run_cli(["--buildinfo"])
+    assert r.returncode == 0, r.stderr
+    assert "--metrics-file" in r.stdout
+    assert "--soak" in r.stdout
+    assert "--fail-on-drift" in r.stdout
+    assert "acg-tpu-stats/3" in r.stdout

@@ -163,6 +163,7 @@ print(rss_kb() - before)
 
 
 @pytest.mark.parametrize("nparts", [4, 2])
+@pytest.mark.two_process_collectives
 def test_cli_two_process_solve(matrix_file, nparts):
     """Both controllers solve; only process 0 prints stats + solution;
     the manufactured-solution error matches a single-process solve."""

@@ -131,6 +131,7 @@ def _free_port() -> int:
         return s.getsockname()[1]
 
 
+@pytest.mark.two_process_collectives
 def test_cli_two_process_gen_direct():
     """gen:poisson3d under --multihost --nparts 4: the north-star
     configuration shape, on the 2-process CPU pod.  Both controllers

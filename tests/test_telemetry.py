@@ -381,6 +381,52 @@ def test_cli_buildinfo_advertises_telemetry():
     assert telemetry.STATS_SCHEMA in r.stdout
 
 
+def test_read_convergence_log_truncated_tail(tmp_path):
+    """A SIGTERM landing mid-write leaves a half JSON line at the end;
+    the reader must return the parseable prefix with a truncated
+    marker instead of raising (PR-4 satellite)."""
+    t = telemetry.ConvergenceTrace(
+        capacity=8, niterations=8,
+        records=np.column_stack([np.logspace(0, -7, 8),
+                                 np.ones(8), np.ones(8), np.ones(8)]),
+        iterations=np.arange(8), wrapped=False)
+    path = tmp_path / "c.jsonl"
+    t.write_jsonl(path)
+    whole_meta, whole_records = telemetry.read_convergence_log(path)
+    text = path.read_text()
+    # chop mid-way through the LAST record line
+    path.write_text(text[:text.rstrip().rfind('"')])
+    meta, records = telemetry.read_convergence_log(path)
+    assert meta["truncated"] is True
+    assert records == whole_records[:-1]
+    assert meta["schema"] == whole_meta["schema"]
+
+
+def test_read_convergence_log_mid_corruption_still_raises(tmp_path):
+    """A malformed line FOLLOWED by valid JSON is corruption, not a
+    truncated tail -- that must still raise."""
+    path = tmp_path / "c.jsonl"
+    path.write_text('{"meta": true, "schema": "x"}\n'
+                    '{"it": 0, "rnrm2": 1.0\n'
+                    '{"it": 1, "rnrm2": 0.5}\n')
+    with pytest.raises(ValueError):
+        telemetry.read_convergence_log(path)
+
+
+def test_load_cases_tolerates_truncated_tail(tmp_path):
+    """bench_diff's reader keeps the parseable prefix of a capture
+    whose final JSONL line was cut mid-write."""
+    from acg_tpu.perfmodel import load_cases
+
+    path = tmp_path / "cap.jsonl"
+    good = json.dumps({"metric": "case_a", "value": 10.0})
+    path.write_text(good + "\n"
+                    + json.dumps({"metric": "case_b",
+                                  "value": 20.0})[:17] + "\n")
+    cases = load_cases(path)
+    assert cases == {"case_a": 10.0}
+
+
 def test_plot_convergence_sparkline(tmp_path):
     """The tooling satellite: text fallback renders any log."""
     import os
