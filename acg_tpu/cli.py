@@ -150,6 +150,27 @@ def make_parser() -> argparse.ArgumentParser:
                         "the sound-bf16 contract: f32-class residuals at "
                         "~2%% overhead (K=50 measured at flagship "
                         "conditioning; 0 = off)")
+    p.add_argument("--precond", default="none", metavar="KIND",
+                   help="preconditioner (acg_tpu.precond): none | "
+                        "jacobi (inverse-diagonal scaling, zero extra "
+                        "communication) | bjacobi[:BS] (dense Cholesky "
+                        "of the BSxBS local diagonal blocks, batched "
+                        "triangular solves, no halo traffic; default "
+                        "BS 32) | cheby:K (degree-K Chebyshev "
+                        "polynomial -- K extra SpMVs per iteration "
+                        "riding the tier's own SpMV + halo machinery, "
+                        "lambda_max from a power iteration at setup).  "
+                        "Turns the classic/pipelined solvers into PCG / "
+                        "pipelined-PCG on every device tier; 'none' "
+                        "compiles byte-identical unpreconditioned "
+                        "programs (default)")
+    p.add_argument("--aniso", type=float, default=None, metavar="EPS",
+                   help="with gen:poisson2d:N: generate the ANISOTROPIC "
+                        "(stretched-grid) Poisson family instead -- "
+                        "y-spacings graded by stretch factor EPS in "
+                        "(0, 1]; the diagonal then varies by ~1/EPS, "
+                        "the ill-conditioned SPD family where "
+                        "--precond measurably cuts iterations")
     p.add_argument("--precise-dots", action="store_true",
                    help="compensated (double-float) dot products for the "
                         "CG scalars; lets f32 storage converge past the "
@@ -379,6 +400,12 @@ def _buildinfo(out) -> int:
         ("bench gating", "bench.py --baseline FILE --fail-on-regress "
          "PCT; scripts/bench_diff.py (diffs --stats-json or bench-row "
          "captures case-by-case, nonzero exit on regression)"),
+        ("preconditioning", f"--precond none|jacobi|bjacobi[:BS]|"
+         f"cheby:K (PCG / pipelined-PCG on every device tier + the "
+         f"host oracle; 'none' lowers byte-identical programs), "
+         f"--aniso EPS (stretched-grid ill-conditioned SPD generator "
+         f"for gen:poisson2d), precond: fault site, 'precond' stats "
+         f"section in the {STATS_SCHEMA} twin"),
         ("service metrics", f"--metrics-file (Prometheus textfile, "
          f"atomic rename, flushed on exit/SIGTERM), --metrics-port "
          f"(stdlib /metrics endpoint), --soak N + --fail-on-drift PCT "
@@ -527,7 +554,8 @@ def _solve_generated_direct(args, dim, n, N, jax, jnp, dtype,
                              kernels=args.kernels, vector_dtype=vec_dtype,
                              replace_every=args.replace_every,
                              recovery=getattr(args, "_recovery", None),
-                             trace=args._trace, progress=args.progress)
+                             trace=args._trace, progress=args.progress,
+                             precond=getattr(args, "_precond", None))
     except ValueError as e:
         raise SystemExit(f"acg-tpu: {e}")
     b = jnp.ones(N, dtype=vec_dtype)
@@ -715,6 +743,14 @@ def _emit_telemetry(args, solver, *, matrix_id, nparts=1,
     extra = {"matrix": str(matrix_id), "solver": args.solver,
              "comm": comm, "nparts": int(nparts), "dtype": args.dtype,
              "argv": list(sys.argv[1:])}
+    pc = getattr(args, "_precond", None)
+    if pc is not None:
+        # the precond selection joins the CASE KEY downstream
+        # (perfmodel._doc_case): preconditioned and plain captures must
+        # never silently diff against each other
+        extra["precond"] = str(pc)
+    if args.aniso is not None:
+        extra["aniso"] = float(args.aniso)
     kern = getattr(inner, "kernels", None)
     extra["kernels"] = kern if isinstance(kern, str) else args.kernels
     mesh = getattr(inner, "mesh", None)
@@ -918,7 +954,8 @@ def _solve_distributed_read(args, jax, jnp, dtype, vec_dtype) -> int:
                               kernels=args.kernels,
                               replace_every=args.replace_every,
                               recovery=getattr(args, "_recovery", None),
-                              trace=args._trace, progress=args.progress)
+                              trace=args._trace, progress=args.progress,
+                              precond=getattr(args, "_precond", None))
     except ValueError as e:
         sys.stderr.write(f"acg-tpu: {e}\n")
         _checkpoint(args, "solve", 1)
@@ -1338,7 +1375,8 @@ def _solve_generated_sharded(args, dim, n, N, jax, jnp, dtype,
             precise_dots=args.precise_dots, epsilon=args.epsilon,
             replace_every=args.replace_every, kernels=sharded_kernels,
             recovery=getattr(args, "_recovery", None),
-            trace=args._trace, progress=args.progress)
+            trace=args._trace, progress=args.progress,
+            precond=getattr(args, "_precond", None))
     except ValueError as e:
         raise SystemExit(f"acg-tpu: {e}")
     _log(args, f"assemble sharded DIA planes on device ({nparts} parts):",
@@ -1565,6 +1603,38 @@ def _main(args) -> int:
         raise SystemExit("acg-tpu: --telemetry-window must be positive")
     if args.progress < 0:
         raise SystemExit("acg-tpu: --progress must be >= 0")
+    # preconditioning tier (acg_tpu.precond): validate the spec BEFORE
+    # anything expensive, and refuse configurations where the armed
+    # preconditioner could never run (the fault-injector discipline)
+    from acg_tpu.precond import parse_precond
+    try:
+        args._precond = parse_precond(args.precond)
+    except ValueError as e:
+        raise SystemExit(f"acg-tpu: {e}")
+    if args._precond is not None:
+        unsupported = [flag for flag, on in [
+            (f"--solver {args.solver} (the external oracles have no "
+             f"preconditioner hooks)",
+             args.solver in ("host-native", "petsc")),
+            ("--replace-every (the replacement segments restructure "
+             "the recurrences M^-1 threads through)",
+             args.replace_every > 0),
+            ("--kernels fused (the two-phase kernels fold the whole "
+             "iteration; no preconditioner hook)",
+             args.kernels == "fused"),
+        ] if on]
+        if unsupported:
+            raise SystemExit(
+                f"acg-tpu: --precond {args.precond} does not support: "
+                f"{', '.join(unsupported)}")
+    if args.aniso is not None:
+        if not 0.0 < args.aniso <= 1.0:
+            raise SystemExit("acg-tpu: --aniso EPS must be in (0, 1]")
+        if not (args.A.startswith("gen:poisson2d:")):
+            raise SystemExit(
+                "acg-tpu: --aniso generates the stretched-grid 2D "
+                "Poisson family and needs a gen:poisson2d:N matrix "
+                "spec")
     # service-metrics tier: validate + arm BEFORE anything records.
     # --soak implies arming (the soak driver reports from the registry
     # histograms); --metrics-file/--metrics-port arm it for single
@@ -1770,14 +1840,21 @@ def _main(args) -> int:
         if args.A.startswith("gen:"):
             spec = _parse_gen_spec(args.A)
             kind, dim, n, N = spec[:4]
-            if kind == "poisson" and N > _gen_direct_min():
+            if (kind == "poisson" and N > _gen_direct_min()
+                    and args.aniso is None):
                 # too large for host CSR assembly: direct on-device DIA
+                # (the aniso family keeps the host route: its graded
+                # weights are not the pure-stencil device assembly)
                 return _solve_generated_direct(args, dim, n, N, jax, jnp, dtype,
                                                vec_dtype)
             _log(args, f"synthesizing {args.A} (N={N})")
-            from acg_tpu.io.generators import (irregular_spd_coo, poisson2d_coo,
+            from acg_tpu.io.generators import (aniso_poisson2d_coo,
+                                               irregular_spd_coo,
+                                               poisson2d_coo,
                                                poisson3d_coo)
-            if kind == "poisson":
+            if kind == "poisson" and args.aniso is not None:
+                r, c, v, N = aniso_poisson2d_coo(n, args.aniso)
+            elif kind == "poisson":
                 r, c, v, N = (poisson2d_coo if dim == 2 else poisson3d_coo)(n)
             else:
                 r, c, v, N = irregular_spd_coo(n, avg_degree=spec[4],
@@ -1939,6 +2016,14 @@ def _main(args) -> int:
                         "fault injection has no injection sites in the "
                         "multi-part host solver; use the serial host "
                         "solver (--nparts 1) or the device solvers")
+                if args._precond is not None:
+                    # silently running UNpreconditioned CG would not be
+                    # the solve the user asked for (the fault-injector
+                    # could-never-fire discipline): refuse
+                    raise AcgError(
+                        ErrorCode.INVALID_VALUE,
+                        "--precond has no hooks in the multi-part host "
+                        "solver; use --nparts 1 or the device solvers")
                 if args._recovery is not None:
                     sys.stderr.write(
                         "acg-tpu: warning: --recover has no effect on "
@@ -1953,7 +2038,8 @@ def _main(args) -> int:
             else:
                 solver = HostCGSolver(csr, recovery=args._recovery,
                                       trace=args._trace,
-                                      progress=args.progress)
+                                      progress=args.progress,
+                                      precond=args._precond)
             x = _run_solve(args, solver, b, x0=x0, criteria=criteria)
         elif args.solver == "petsc":
             # external cross-implementation oracle (the KSPCG role,
@@ -1973,7 +2059,8 @@ def _main(args) -> int:
                                      recovery=args._recovery,
                                      host_matrix=csr,
                                      trace=args._trace,
-                                     progress=args.progress)
+                                     progress=args.progress,
+                                     precond=args._precond)
             except ValueError as e:
                 raise SystemExit(f"acg-tpu: {e}")
             if args.refine:
@@ -2008,7 +2095,8 @@ def _main(args) -> int:
                                       replace_every=args.replace_every,
                                       recovery=args._recovery,
                                       trace=args._trace,
-                                      progress=args.progress)
+                                      progress=args.progress,
+                                      precond=args._precond)
             except ValueError as e:
                 raise SystemExit(f"acg-tpu: {e}")
             if args.refine:

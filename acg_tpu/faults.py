@@ -14,6 +14,9 @@ firing condition:
   * ``spmv:inf@7:part=2``   Inf into part 2's local SpMV result
   * ``halo:nan@3``          NaN into the received halo payload
   * ``dot:neg@5``           (p, Ap) driven non-positive at iteration 5
+  * ``precond:nan@4``       NaN into z = M^-1 r at iteration 4 (the
+                            non-SPD-preconditioner breakdown path;
+                            needs an armed --precond)
   * ``dot:nan@5``           NaN into the dot scalar
   * ``peer:dead:proc=1``    controller 1 dies before its next
                             error-agreement checkpoint
@@ -43,11 +46,15 @@ import time
 
 import numpy as np
 
-DEVICE_SITES = ("spmv", "dot", "halo")
+DEVICE_SITES = ("spmv", "dot", "halo", "precond")
 _SITES = DEVICE_SITES + ("peer", "backend", "solve")
 _MODES = {
     "spmv": ("nan", "inf"),
     "halo": ("nan", "inf"),
+    # the preconditioner apply's output z = M^-1 r (PCG tier,
+    # acg_tpu.precond): a poisoned z drives the (r, z) scalar non-finite
+    # or negative -- the non-SPD-M breakdown path, made deterministic
+    "precond": ("nan", "inf"),
     "dot": ("nan", "zero", "neg"),
     "peer": ("dead", "stall"),
     "backend": ("hang",),
@@ -118,6 +125,12 @@ class FaultSpec:
             return ghost
         return self._poison(ghost, k, part_index)
 
+    def apply_precond(self, z, k, part_index=None):
+        """Poison one element of the preconditioner apply's output."""
+        if self.site != "precond" or k is None:
+            return z
+        return self._poison(z, k, part_index)
+
     def apply_dot(self, s, k):
         """Corrupt a CG scalar: NaN, zero, or driven non-positive."""
         if self.site != "dot" or k is None:
@@ -141,6 +154,14 @@ class FaultSpec:
         y[self.seed % max(y.size, 1)] = (np.nan if self.mode == "nan"
                                          else np.inf)
         return y
+
+    def apply_precond_np(self, z: np.ndarray, k: int) -> np.ndarray:
+        if self.site != "precond" or k != self.iteration:
+            return z
+        z = np.array(z, copy=True)
+        z[self.seed % max(z.size, 1)] = (np.nan if self.mode == "nan"
+                                         else np.inf)
+        return z
 
     def apply_dot_np(self, s: float, k: int) -> float:
         if self.site != "dot" or k != self.iteration:

@@ -450,6 +450,10 @@ DEVICE_MEMORY = REGISTRY.gauge(
 DRIFT_RATIO = REGISTRY.gauge(
     "acg_soak_latency_drift_ratio", "Soak driver: EWMA solve latency "
     "over the baseline window's (1.0 = no drift).")
+PRECOND_APPLIES = REGISTRY.counter(
+    "acg_precond_applies_total", "Preconditioner applies (analytic: "
+    "one per iteration + setup; cheby bills its per-apply SpMVs).",
+    labelnames=("kind",))
 
 _armed = False
 
@@ -514,6 +518,13 @@ def record_restart() -> None:
 def record_fallback() -> None:
     if _armed:
         FALLBACKS.inc()
+
+
+def record_precond(kind: str, applies: int) -> None:
+    """One solve's preconditioner applies (the PCG tier's solve()
+    tails, acg_tpu.precond)."""
+    if _armed:
+        PRECOND_APPLIES.labels(kind=str(kind)).inc(max(int(applies), 0))
 
 
 def record_comm(ledger: dict, iterations: int) -> None:

@@ -450,6 +450,42 @@ def _spmv(A: DeviceMatrix, x: jax.Array) -> jax.Array:
     raise TypeError(f"unsupported device matrix {type(A)}")
 
 
+def matrix_diagonal(A: DeviceMatrix) -> jax.Array:
+    """``diag(A)`` as an (nrows,) device array, jit-safe -- the
+    preconditioning tier's setup primitive (acg_tpu.precond): extracted
+    once per solver, zero host transfers.  Rows without a stored
+    diagonal entry (structural padding of the stacked layouts) come
+    back exactly 0, which the Jacobi state builder turns into a 0
+    inverse (padded residual entries are exactly 0 by construction)."""
+    adt = acc_dtype(matrix_dtype(A))
+    if isinstance(A, DiaMatrix):
+        if 0 in A.offsets:
+            return A.data[A.offsets.index(0)][: A.nrows].astype(adt)
+        return jnp.zeros((A.nrows,), dtype=adt)
+    if isinstance(A, EllMatrix):
+        rows = jnp.arange(A.nrows)[:, None]
+        return jnp.sum(jnp.where(A.cols == rows, A.data, 0),
+                       axis=1).astype(adt)
+    if isinstance(A, CooMatrix):
+        on = A.rows == A.cols
+        return jax.ops.segment_sum(
+            jnp.where(on, A.vals, 0).astype(adt), A.rows,
+            num_segments=A.nrows, indices_are_sorted=True)
+    if isinstance(A, BinnedEllMatrix):
+        d = jnp.zeros((A.nrows,), dtype=adt)
+        for rows, data, cols in zip(A.bin_rows, A.bin_data, A.bin_cols):
+            contrib = jnp.sum(jnp.where(cols == rows[:, None], data, 0),
+                              axis=1).astype(adt)
+            d = d.at[rows].add(contrib, unique_indices=True)
+        if A.tail_rows.size:
+            on = A.tail_rows == A.tail_cols
+            d = d + jax.ops.segment_sum(
+                jnp.where(on, A.tail_vals, 0).astype(adt), A.tail_rows,
+                num_segments=A.nrows, indices_are_sorted=True)
+        return d
+    raise TypeError(f"unsupported device matrix {type(A)}")
+
+
 @jax.jit
 def _count_nonzero_on_device(arrays):
     """Total nonzeros across a pytree of arrays, as ONE compiled device

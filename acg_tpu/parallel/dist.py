@@ -747,7 +747,8 @@ class DistCGSolver:
                  mesh: Mesh | None = None, comm: str = "xla",
                  precise_dots: bool = False, kernels: str = "auto",
                  replace_every: int = 0, replace_restart: bool = True,
-                 recovery=None, trace: int = 0, progress: int = 0):
+                 recovery=None, trace: int = 0, progress: int = 0,
+                 precond=None):
         """``recovery`` (acg_tpu.solvers.resilience.RecoveryPolicy) arms
         in-loop breakdown detection plus the host-side restart ladder:
         bounded restarts from the recomputed true residual, the
@@ -760,7 +761,17 @@ class DistCGSolver:
         loop.  Every recorded scalar is already psum'd, so the buffer
         is replicated across shards and leaves the mesh as ONE
         rank-independent fetch per solve; the heartbeat fires on part 0
-        only."""
+        only.
+
+        ``precond`` (acg_tpu.precond: spec / spec string / None) arms
+        PCG / pipelined-PCG over the mesh: Jacobi and block-Jacobi
+        state comes from each part's LOCAL block (stacked host-side,
+        sharded like the matrix -- zero extra communication per apply),
+        Chebyshev's lambda_max from a power iteration compiled over the
+        same halo'd SpMV the solve uses.  The classic loop keeps 2
+        allreduces per iteration (the second fuses (r, z) with (r, r))
+        and the pipelined loop keeps its SINGLE fused allreduce (3
+        scalars)."""
         if comm not in ("xla", "dma"):
             raise ValueError(f"unknown halo transport {comm!r}")
         if comm == "dma" and jax.process_count() > 1:
@@ -817,6 +828,16 @@ class DistCGSolver:
                 raise ValueError("replace_every computes scalars in "
                                  "plain f32; precise_dots needs the "
                                  "direct programs")
+        from acg_tpu.precond import parse_precond
+        self.precond_spec = parse_precond(precond)
+        if self.precond_spec is not None and self.replace_every:
+            raise ValueError(
+                "precond does not compose with replace_every: the "
+                "replacement segments restructure the recurrences the "
+                "preconditioner threads through")
+        # preconditioner state: host-stacked (jacobi/bjacobi) or device
+        # scalars (cheby), built lazily at first solve/lower
+        self._mstate = None
         self.recovery = recovery
         self.trace = int(trace)
         self.progress = int(progress)
@@ -863,8 +884,11 @@ class DistCGSolver:
         precise = self.precise_dots
         trace = self.trace
         progress = self.progress
+        precond_spec = self.precond_spec
         if trace or progress:
             from acg_tpu import telemetry
+        if precond_spec is not None:
+            from acg_tpu.precond import make_apply
 
         dist_spmv = make_dist_spmv(prob, comm, interpret,
                                    kernels=self.kernels, fault=fault)
@@ -883,11 +907,14 @@ class DistCGSolver:
             return v if single_shard else lax.psum(v, axis)
 
         def shard_body(la, ga, sidx, gsrc, gval, scnt, rcnt, b, x0,
-                       tols, maxits, unbounded, needs_diff, detect=False):
+                       tols, maxits, mstate=None, unbounded=False,
+                       needs_diff=False, detect=False):
             # shard_map keeps the sharded parts axis as a leading size-1 dim
             la, ga = (jax.tree.map(lambda a: a[0], t) for t in (la, ga))
             sidx, gsrc, gval, scnt, rcnt, b, x0 = (
                 a[0] for a in (sidx, gsrc, gval, scnt, rcnt, b, x0))
+            if precond_spec is not None:
+                mstate = jax.tree.map(lambda a: a[0], mstate)
             maxits = maxits.astype(jnp.int32)
             dtype = b.dtype
             # bf16 storage keeps every scalar in f32 (jax_cg._scalar_setup
@@ -939,11 +966,44 @@ class DistCGSolver:
                                            ldot(a2, c2)]))
                     return pair[0], pair[1]
 
+            if precise:
+                def pdot3_fused(a1, c1, a2, c2, a3, c3):
+                    # the pipelined-PCG reduction: three compensated
+                    # dots in ONE psum of 6 scalars -- the single-
+                    # allreduce property survives preconditioning
+                    h1, l1 = dot_compensated(a1.astype(sdt), c1.astype(sdt))
+                    h2, l2 = dot_compensated(a2.astype(sdt), c2.astype(sdt))
+                    h3, l3 = dot_compensated(a3.astype(sdt), c3.astype(sdt))
+                    six = psum(jnp.stack([h1, l1, h2, l2, h3, l3]))
+                    return (six[0] + six[1], six[2] + six[3],
+                            six[4] + six[5])
+            else:
+                def pdot3_fused(a1, c1, a2, c2, a3, c3):
+                    tri = psum(jnp.stack([ldot(a1, c1), ldot(a2, c2),
+                                          ldot(a3, c3)]))
+                    return tri[0], tri[1], tri[2]
+
             bnrm2 = jnp.sqrt(pdot(b, b))
             x0nrm2 = jnp.sqrt(pdot(x0, x0))
             r = b - spmv(x0)
-            gamma = pdot(r, r)
-            r0nrm2 = jnp.sqrt(gamma)
+            if precond_spec is not None:
+                # papply reuses the tier's halo'd SpMV closure: the
+                # cheby apply's communication is exactly K extra SpMVs
+                _papply = make_apply(precond_spec, lambda _A, x: spmv(x))
+
+                def papply(vec, k=None):
+                    z = _papply(mstate, None, vec)
+                    if fault is not None and k is not None:
+                        z = fault.apply_precond(z, k, pidx)
+                    return z
+
+                u0 = store(papply(r))
+                gamma0, rr0 = pdot2_fused(r, u0, r, r)
+                gamma = rr0
+                r0nrm2 = jnp.sqrt(rr0)
+            else:
+                gamma = pdot(r, r)
+                r0nrm2 = jnp.sqrt(gamma)
             res_tol = jnp.maximum(res_atol, res_rtol * r0nrm2)
             diff_tol = jnp.maximum(diff_atol, diff_rtol * x0nrm2)
             inf = jnp.asarray(jnp.inf, sdt)
@@ -1045,6 +1105,11 @@ class DistCGSolver:
                 leader = lax.axis_index(axis) == jnp.int32(0)
 
             if not pipelined:
+                # carry layout mirrors jax_cg._cg_program: rr (the true
+                # residual the convergence test reads) joins only under
+                # precond, dx only under a diff criterion
+                dx_i = 5 if precond_spec is not None else 4
+
                 # dxsqr joins the carry only under a diff criterion (extra
                 # loop-carried scalars measurably slow the TPU loop)
                 def body(k, state):
@@ -1068,22 +1133,36 @@ class DistCGSolver:
                         alpha = gamma / pdott
                         x = store(x + alpha * p)
                         r = store(r - alpha * t)
-                    gamma_next = pdot(r, r)
-                    beta = gamma_next / gamma
-                    p_next = store(r + beta * p)
-                    out = (x, r, p_next, gamma_next)
+                    if precond_spec is not None:
+                        z = papply(r, k)
+                        # ONE fused psum for both scalars: the classic
+                        # PCG loop keeps 2 allreduces per iteration
+                        gamma_next, rr_next = pdot2_fused(r, z, r, r)
+                        beta = gamma_next / gamma
+                        p_next = store(z + beta * p)
+                        out = (x, r, p_next, gamma_next, rr_next)
+                    else:
+                        gamma_next = pdot(r, r)
+                        beta = gamma_next / gamma
+                        p_next = store(r + beta * p)
+                        out = (x, r, p_next, gamma_next)
                     if needs_diff:
                         dx = alpha * alpha * psum(ldot(p, p))
                         if detect:
                             # freeze dx on breakdown (jax_cg rationale):
                             # alpha = 0 must not fake the diff criterion
-                            dx = jnp.where(bad, state[4], dx)
+                            dx = jnp.where(bad, state[dx_i], dx)
                         out = out + (dx,)
                     if detect:
-                        out = out + (bad | (~jnp.isfinite(gamma_next)),)
+                        deferred = bad | (~jnp.isfinite(gamma_next))
+                        if precond_spec is not None:
+                            # negative (r, z): the non-SPD-M signal
+                            deferred = deferred | (gamma_next < 0)
+                        out = out + (deferred,)
                     if trace:
                         # psum'd scalars: the ring is replicated, one
-                        # rank-independent fetch per solve
+                        # rank-independent fetch per solve (gamma IS the
+                        # preconditioned residual norm^2 under precond)
                         out = out + (telemetry.ring_record(
                             buf, k, gamma_next, alpha, beta, pdott),)
                     if progress:
@@ -1091,7 +1170,87 @@ class DistCGSolver:
                                             leader=leader, what="dist-cg")
                     return out
 
-                init_state = (x0, r, r, gamma) + ((inf,) if needs_diff else ())
+                if precond_spec is not None:
+                    init_state = (x0, r, u0, gamma0, rr0)
+                else:
+                    init_state = (x0, r, r, gamma)
+                init_state = init_state + ((inf,) if needs_diff else ())
+                if detect:
+                    init_state = init_state + (jnp.asarray(False),)
+                if trace:
+                    init_state = init_state + (telemetry.ring_init(trace,
+                                                                   sdt),)
+                bad_i = -2 if trace else -1
+                conv_i = 4 if precond_spec is not None else 3
+                k, state, done = run_iter(
+                    body, init_state, lambda s: s[conv_i],
+                    (lambda s: s[dx_i]) if needs_diff else (lambda s: inf),
+                    bad_of=(lambda s: s[bad_i]) if detect else None)
+                x, r_fin, gamma_fin = state[0], state[1], state[conv_i]
+                dxsqr = state[dx_i] if needs_diff else inf
+                breakdown = state[bad_i] if detect else jnp.asarray(False)
+                tbuf = state[-1] if trace else None
+                rnrm2 = jnp.sqrt(gamma_fin)
+            elif precond_spec is not None:
+                # preconditioned Ghysels-Vanroose (jax_cg pbody, psum'd):
+                # ONE fused 3-scalar allreduce per iteration, the
+                # preconditioner apply + its SpMV overlapping it
+                w = spmv(u0)
+                zeros = jnp.zeros_like(b)
+
+                def pbody(k, state):
+                    if trace:
+                        buf, state = state[-1], state[:-1]
+                    x, r, u, w, p, s, q, z, gamma_prev, alpha_prev = \
+                        state[:10]
+                    gamma, delta, rr = pdot3_fused(r, u, w, u, r, r)
+                    if fault is not None:
+                        delta = fault.apply_dot(delta, k)
+                    m = papply(w, k)
+                    nvec = spmv(m, k)
+                    beta = gamma / gamma_prev
+                    denom = delta - beta * (gamma / alpha_prev)
+                    if detect:
+                        bad, alpha = _breakdown_guard(gamma, denom)
+                        bad = bad | (gamma < 0)
+                        alpha = jnp.where(bad, jnp.zeros_like(alpha),
+                                          alpha)
+                    else:
+                        alpha = gamma / denom
+                    z = store(nvec + beta * z)
+                    q = store(m + beta * q)
+                    s = store(w + beta * s)
+                    p = store(u + beta * p)
+                    if detect:
+                        x = store(jnp.where(bad, x, x + alpha * p))
+                        r = store(jnp.where(bad, r, r - alpha * s))
+                        u = store(jnp.where(bad, u, u - alpha * q))
+                        w = store(jnp.where(bad, w, w - alpha * z))
+                    else:
+                        x = store(x + alpha * p)
+                        r = store(r - alpha * s)
+                        u = store(u - alpha * q)
+                        w = store(w - alpha * z)
+                    out = (x, r, u, w, p, s, q, z, gamma, alpha, rr)
+                    if needs_diff:
+                        dx = alpha * alpha * psum(ldot(p, p))
+                        if detect:
+                            dx = jnp.where(bad, state[11], dx)
+                        out = out + (dx,)
+                    if detect:
+                        out = out + (bad,)
+                    if trace:
+                        out = out + (telemetry.ring_record(
+                            buf, k, gamma, alpha, beta, denom),)
+                    if progress:
+                        telemetry.heartbeat(k, gamma, progress,
+                                            leader=leader,
+                                            what="dist-cg")
+                    return out
+
+                init_state = (x0, r, u0, w, zeros, zeros, zeros, zeros,
+                              inf, inf, rr0) + (
+                    (inf,) if needs_diff else ())
                 if detect:
                     init_state = init_state + (jnp.asarray(False),)
                 if trace:
@@ -1099,14 +1258,17 @@ class DistCGSolver:
                                                                    sdt),)
                 bad_i = -2 if trace else -1
                 k, state, done = run_iter(
-                    body, init_state, lambda s: s[3],
-                    (lambda s: s[4]) if needs_diff else (lambda s: inf),
+                    pbody, init_state, lambda s: s[10],
+                    (lambda s: s[11]) if needs_diff else (lambda s: inf),
+                    init_gamma=rr0,
                     bad_of=(lambda s: s[bad_i]) if detect else None)
-                x, r_fin, gamma_fin = state[0], state[1], state[3]
-                dxsqr = state[4] if needs_diff else inf
+                x, r_fin = state[0], state[1]
+                dxsqr = state[11] if needs_diff else inf
                 breakdown = state[bad_i] if detect else jnp.asarray(False)
                 tbuf = state[-1] if trace else None
-                rnrm2 = jnp.sqrt(gamma_fin)
+                rnrm2 = jnp.sqrt(pdot(r_fin, r_fin))
+                # stale-test consistency: see jax_cg._cg_pipelined_program
+                done = jnp.logical_or(done, rnrm2 <= res_tol)
             else:
                 w = spmv(r)
                 zeros = jnp.zeros_like(b)
@@ -1194,6 +1356,7 @@ class DistCGSolver:
                    done, breakdown)
             return out + ((tbuf,) if trace else ())
 
+        with_precond = precond_spec is not None
         if single_shard and not prob.halo.has_ghosts:
             # one shard, no halo: shard_body runs as a PLAIN jit program
             # (the stacked (1, ...) leading axes are stripped inside it
@@ -1205,9 +1368,9 @@ class DistCGSolver:
                                                 "detect"))
             def program(la, ga, sidx, gsrc, gval, scnt, rcnt, b, x0,
                         tols, maxits, unbounded, needs_diff,
-                        detect=False):
+                        detect=False, mstate=None):
                 return shard_body(la, ga, sidx, gsrc, gval, scnt, rcnt,
-                                  b, x0, tols, maxits,
+                                  b, x0, tols, maxits, mstate=mstate,
                                   unbounded=unbounded,
                                   needs_diff=needs_diff, detect=detect)
 
@@ -1215,11 +1378,15 @@ class DistCGSolver:
 
         pspec = P(PARTS_AXIS)
         rspec = P()
-        # pspec acts as a pytree prefix for the la/ga tuples
+        # pspec acts as a pytree prefix for the la/ga tuples (and the
+        # mstate pytree when a preconditioner is armed: every state
+        # leaf carries a leading parts axis, scalars tiled)
         in_specs = (pspec, pspec,                              # blocks
                     pspec, pspec, pspec, pspec, pspec,         # halo, counts
                     pspec, pspec,                              # b, x0
                     rspec, rspec)                              # tols, maxits
+        if with_precond:
+            in_specs = in_specs + (pspec,)                     # mstate
         # the telemetry ring is built from psum'd scalars -> replicated
         out_specs = (pspec,) + (rspec,) * (9 if trace else 8)
 
@@ -1227,15 +1394,110 @@ class DistCGSolver:
                            static_argnames=("unbounded", "needs_diff",
                                             "detect"))
         def program(la, ga, sidx, gsrc, gval, scnt, rcnt, b, x0,
-                    tols, maxits, unbounded, needs_diff, detect=False):
+                    tols, maxits, unbounded, needs_diff, detect=False,
+                    mstate=None):
+            extra = (mstate,) if with_precond else ()
+
+            def smb(la, ga, sidx, gsrc, gval, scnt, rcnt, b, x0, tols,
+                    maxits, mstate=None):
+                return shard_body(la, ga, sidx, gsrc, gval, scnt, rcnt,
+                                  b, x0, tols, maxits, mstate=mstate,
+                                  unbounded=unbounded,
+                                  needs_diff=needs_diff, detect=detect)
+
             return _shard_map(
-                functools.partial(shard_body,
-                                  unbounded=unbounded, needs_diff=needs_diff,
-                                  detect=detect),
+                smb,
                 mesh=self.mesh, in_specs=in_specs, out_specs=out_specs,
-            )(la, ga, sidx, gsrc, gval, scnt, rcnt, b, x0, tols, maxits)
+            )(la, ga, sidx, gsrc, gval, scnt, rcnt, b, x0, tols, maxits,
+              *extra)
 
         return program
+
+    # -- preconditioner state ---------------------------------------------
+
+    def _power_lmax(self, dev_args, iters=None) -> float:
+        """Power-iteration lambda_max over the SAME halo'd distributed
+        SpMV the solve programs run, compiled once at setup (the
+        Chebyshev tier's spectral estimate).  Norms psum across the
+        mesh, so every shard (and controller) derives the identical
+        scalar."""
+        from acg_tpu.precond import POWER_ITERS
+        iters = POWER_ITERS if iters is None else int(iters)
+        b, x0, la, ga, sidx, gsrc, gval, scnt, rcnt = dev_args
+        prob = self.problem
+        axis = PARTS_AXIS
+        dist_spmv = make_dist_spmv(prob, self.comm, self._interpret,
+                                   kernels=self.kernels)
+        single_shard = self.mesh.devices.size == 1
+        sdt = acc_dtype(np.dtype(prob.vdtype))
+
+        def shard(la, ga, sidx, gsrc, gval, scnt, rcnt, v):
+            la, ga = (jax.tree.map(lambda a: a[0], t) for t in (la, ga))
+            sidx, gsrc, gval, scnt, rcnt, v = (
+                a[0] for a in (sidx, gsrc, gval, scnt, rcnt, v))
+
+            def psum(s):
+                return s if single_shard else lax.psum(s, axis)
+
+            def spmv(x):
+                return dist_spmv(x, la, ga, sidx, gsrc, gval, scnt, rcnt)
+
+            def ldot(a, c):
+                return jnp.dot(a, c, preferred_element_type=sdt)
+
+            def it(_, v):
+                w = spmv(v)
+                return (w.astype(sdt)
+                        / jnp.sqrt(psum(ldot(w, w)))).astype(v.dtype)
+
+            v = jax.lax.fori_loop(0, iters, it, v)
+            w = spmv(v)
+            return psum(ldot(v, w)) / psum(ldot(v, v))
+
+        rng = np.random.default_rng(0)
+        v0 = put_global(prob.scatter(rng.standard_normal(prob.n)),
+                        sharding=self._sharding)
+        if single_shard and not prob.halo.has_ghosts:
+            out = jax.jit(shard)(la, ga, sidx, gsrc, gval, scnt, rcnt, v0)
+        else:
+            pspec = P(PARTS_AXIS)
+            out = jax.jit(_shard_map(
+                shard, mesh=self.mesh,
+                in_specs=(pspec,) * 8, out_specs=P(),
+            ))(la, ga, sidx, gsrc, gval, scnt, rcnt, v0)
+        return float(out)
+
+    def _ensure_precond_state(self, dev_args=None):
+        """Build (once) the stacked preconditioner state and place it on
+        the mesh: jacobi/bjacobi from each part's LOCAL host blocks (no
+        communication -- diagonal entries are owned x owned by
+        construction), cheby from the power iteration above.  Every
+        leaf carries a leading parts axis (scalars tiled), so ONE
+        pytree-prefix spec shards the whole state."""
+        if self.precond_spec is None or self._mstate is not None:
+            return self._mstate
+        from acg_tpu import precond as precond_mod
+        prob = self.problem
+        sdt = np.dtype(acc_dtype(np.dtype(prob.vdtype)))
+        spec = self.precond_spec
+        if spec.kind == "jacobi":
+            host = precond_mod.stacked_jacobi_state(prob, sdt)
+        elif spec.kind == "bjacobi":
+            host = precond_mod.stacked_bjacobi_state(prob, spec.block, sdt)
+        else:
+            if dev_args is None:
+                dev_args = getattr(self, "_last_dev_args", None)
+            if dev_args is None:
+                raise RuntimeError("cheby state needs the placed device "
+                                   "arguments (solve/lower build them)")
+            lmax = self._power_lmax(dev_args) * precond_mod.CHEBY_SAFETY
+            lmin = lmax / precond_mod.CHEBY_RATIO
+            self._precond_lams = (lmin, lmax)
+            host = (np.full((prob.nparts,), lmin, sdt),
+                    np.full((prob.nparts,), lmax, sdt))
+        put = functools.partial(put_global, sharding=self._sharding)
+        self._mstate = jax.tree.map(put, host)
+        return self._mstate
 
     # -- public solve ------------------------------------------------------
 
@@ -1289,16 +1551,19 @@ class DistCGSolver:
             raise ValueError("replace_every supports residual criteria "
                              "only")
         sdt = acc_dtype(np.dtype(self.problem.vdtype))
-        b, x0, la, ga, sidx, gsrc, gval, scnt, rcnt = \
-            self.device_args(np.asarray(b_global), x0)
+        dev = self.device_args(np.asarray(b_global), x0)
+        b, x0, la, ga, sidx, gsrc, gval, scnt, rcnt = dev
         tols = jnp.asarray([crit.residual_atol, crit.residual_rtol,
                             crit.diff_atol, crit.diff_rtol], dtype=sdt)
         program = self._program_for(None)
+        kwargs = dict(unbounded=crit.unbounded,
+                      needs_diff=crit.needs_diff,
+                      detect=self.recovery is not None)
+        if self.precond_spec is not None:
+            self._last_dev_args = dev
+            kwargs["mstate"] = self._ensure_precond_state(dev)
         return program.lower(la, ga, sidx, gsrc, gval, scnt, rcnt, b, x0,
-                             tols, jnp.int32(crit.maxits),
-                             unbounded=crit.unbounded,
-                             needs_diff=crit.needs_diff,
-                             detect=self.recovery is not None)
+                             tols, jnp.int32(crit.maxits), **kwargs)
 
     def comm_profile(self) -> dict:
         """Static per-iteration communication ledger (the perfmodel
@@ -1357,6 +1622,26 @@ class DistCGSolver:
             "allreduce_bytes_per_iteration": int(nred * scal * sdl),
             "max_hops": int(max_hops),
         }
+        if self.precond_spec is not None:
+            # reclassify for PCG: cheby multiplies the halo pattern by
+            # its degree (K extra SpMV-shaped exchanges per iteration);
+            # jacobi/bjacobi move nothing.  The scalar fused into the
+            # existing reductions ((r,z) / the 3-scalar pipelined psum)
+            # widens payloads without adding collectives
+            from acg_tpu.precond import comm_contribution
+            pc = comm_contribution(self.precond_spec)
+            extra = int(pc.get("halo_spmv_equivalents_per_apply", 0))
+            led["halo_exchanges_per_iteration"] = 1 + extra
+            led["halo_bytes_per_iteration"] = int(total) * (1 + extra)
+            # widest reduction payload: pipelined PCG fuses 3 scalars,
+            # classic PCG's second psum fuses 2 (doubled compensated)
+            led["allreduce_scalars"] = ((3 if self.pipelined else 2)
+                                        * (2 if self.precise_dots else 1))
+            # TOTAL scalars per iteration, not nred x widest: both PCG
+            # loops move 3 (classic: 1 + the 2-scalar fusion)
+            led["allreduce_bytes_per_iteration"] = (
+                3 * (2 if self.precise_dots else 1) * sdl)
+            led["precond"] = pc
         if len(neighbors) > 64:
             led["neighbors_truncated"] = len(neighbors) - 64
             neighbors = neighbors[:64]
@@ -1409,6 +1694,15 @@ class DistCGSolver:
                 "fault injection does not reach the replacement-segment "
                 "program (replace_every); inject into the direct "
                 "classic/pipelined programs instead")
+        if (fault is not None and fault.site == "precond"
+                and self.precond_spec is None):
+            # no preconditioner armed: the apply the fault poisons
+            # never runs (the replace_every rationale)
+            raise AcgError(
+                ErrorCode.INVALID_VALUE,
+                "precond fault injection needs an armed preconditioner "
+                "(--precond jacobi|bjacobi|cheby:K); this solve runs "
+                "unpreconditioned CG")
         detect = self.recovery is not None or fault is not None
         from acg_tpu import telemetry
         if fault is not None:
@@ -1431,6 +1725,11 @@ class DistCGSolver:
                             crit.diff_atol, crit.diff_rtol], dtype=sdt)
         kwargs = dict(unbounded=crit.unbounded, needs_diff=crit.needs_diff,
                       detect=detect)
+        if self.precond_spec is not None:
+            self._last_dev_args = (b, x0, la, ga, sidx, gsrc, gval,
+                                   scnt, rcnt)
+            kwargs["mstate"] = self._ensure_precond_state(
+                self._last_dev_args)
         args = (la, ga, sidx, gsrc, gval, scnt, rcnt, b, x0, tols,
                 jnp.int32(crit.maxits))
         # device_sync, not bare block_until_ready: see _platform (the
@@ -1527,6 +1826,12 @@ class DistCGSolver:
                     if fault is not None:
                         fault = fault.shift(k_done)
                         program = self._program_for(fault)
+                    if self.precond_spec is not None:
+                        # preserve finite preconditioner state across
+                        # the restart, rebuild it when poisoned
+                        from acg_tpu.precond import refresh_state
+                        if refresh_state(self, driver):
+                            kwargs["mstate"] = self._mstate
                     args = restart_args(x_next)
                     out = program(*args, **kwargs)
                     device_sync(out[0])
@@ -1601,6 +1906,36 @@ class DistCGSolver:
                              if s.halo is not None)
         halo_bytes = halo_total * dbl
         st.ops["halo"].add(niter + 1, 0.0, halo_bytes * (niter + 1))
+        if self.precond_spec is not None:
+            # the precond_apply census (jax_cg._account_precond's dist
+            # twin): one apply per iteration + setup, cheby billing its
+            # per-apply SpMVs -- and their halo exchanges, which are the
+            # only preconditioner communication on this tier
+            from acg_tpu import metrics as _metrics
+            from acg_tpu import precond as precond_mod
+            spec = self.precond_spec
+            nappl = niter + 1
+            per_fl = precond_mod.flops_per_apply(spec, n,
+                                                 3.0 * prob.nnz_total)
+            st.nflops += per_fl * nappl
+            sb = precond_mod.state_bytes(self._mstate)
+            per_b = precond_mod.bytes_per_apply(
+                spec, n, dbl,
+                prob.nnz_total * (mat_dbl + idx_b) + 2 * n * dbl, sb)
+            nops = nappl * (spec.degree if spec.kind == "cheby" else 1)
+            st.ops["precond"].add(nops, 0.0, int(per_b * nappl))
+            st.ops["dot"].add(nappl, 0.0, 2 * n * dbl * nappl)
+            if spec.kind == "cheby":
+                st.ops["halo"].add(spec.degree * nappl, 0.0,
+                                   halo_bytes * spec.degree * nappl)
+            st.precond.update({"kind": str(spec), "applies": nappl,
+                               "flops_per_apply": per_fl,
+                               "state_bytes": sb})
+            lams = getattr(self, "_precond_lams", None)
+            if lams is not None:
+                st.precond["lambda_min"] = lams[0]
+                st.precond["lambda_max"] = lams[1]
+            _metrics.record_precond(spec.kind, nops)
 
         if host_result:
             x = prob.gather(get_global(x_st))

@@ -226,7 +226,8 @@ class ShardedDiaCGSolver(JaxCGSolver):
                  pipelined: bool = False, precise_dots: bool = False,
                  vector_dtype=None, stencil: tuple[int, int] | None = None,
                  replace_every: int = 0, replace_restart: bool = True,
-                 recovery=None, trace: int = 0, progress: int = 0):
+                 recovery=None, trace: int = 0, progress: int = 0,
+                 precond=None):
         if A.ncols_padded != A.nrows:
             raise ValueError("sharded DIA solve needs a square matrix")
         # replace_every (the sound bf16 tier, _cg_replaced_program)
@@ -238,11 +239,17 @@ class ShardedDiaCGSolver(JaxCGSolver):
         # trace/progress (the telemetry tier) ride the same programs:
         # the CG scalars are global reductions, so the recorded ring is
         # replicated by GSPMD exactly like the result scalars
+        # precond (acg_tpu.precond) rides the inherited programs
+        # unchanged: the jacobi diagonal is the sharded offset-0 plane,
+        # bjacobi's block extraction shards by block row, and the cheby
+        # apply's rolls partition into the same boundary collective-
+        # permutes as every other SpMV of the loop
         super().__init__(A, pipelined=pipelined, precise_dots=precise_dots,
                          kernels="xla-roll", vector_dtype=vector_dtype,
                          replace_every=replace_every,
                          replace_restart=replace_restart,
-                         recovery=recovery, trace=trace, progress=progress)
+                         recovery=recovery, trace=trace, progress=progress,
+                         precond=precond)
         self.mesh = mesh if mesh is not None else solve_mesh()
         # fault-injection diagnosis hook (JaxCGSolver.solve): this tier
         # is multi-part but still cannot honour part= targeting
@@ -329,6 +336,24 @@ class ShardedDiaCGSolver(JaxCGSolver):
         nred = 1 if self.pipelined else 2
         scal = ((2 if self.pipelined else 1)
                 * (2 if self.precise_dots else 1))
+        precond_led = {}
+        ar_bytes = None
+        if self.precond_spec is not None:
+            # PCG reclassification (the explicit dist tier's rule):
+            # cheby multiplies the derived-halo pattern by its degree,
+            # the PCG scalar widens the fused reductions
+            from acg_tpu.precond import comm_contribution
+            pc = comm_contribution(self.precond_spec)
+            extra = int(pc.get("halo_spmv_equivalents_per_apply", 0))
+            nexch = nexch * (1 + extra)
+            per_shard = per_shard * (1 + extra)
+            # widest payload in the scalars field; BYTES bill the true
+            # per-iteration total (both PCG loops move 3 scalars --
+            # classic: 1 + the 2-scalar fusion)
+            scal = ((3 if self.pipelined else 2)
+                    * (2 if self.precise_dots else 1))
+            ar_bytes = 3 * (2 if self.precise_dots else 1) * sdl
+            precond_led = {"precond": pc}
         return {
             "transport": ("pallas-roll/ppermute" if pallas
                           else "xla-roll/collective-permute"),
@@ -340,8 +365,10 @@ class ShardedDiaCGSolver(JaxCGSolver):
             "halo_bytes_per_shard": int(per_shard * dbl),
             "allreduce_per_iteration": int(nred),
             "allreduce_scalars": int(scal),
-            "allreduce_bytes_per_iteration": int(nred * scal * sdl),
+            "allreduce_bytes_per_iteration": int(
+                nred * scal * sdl if ar_bytes is None else ar_bytes),
             "max_hops": int(max_hops),
+            **precond_led,
         }
 
     def ones_b(self, dtype=None) -> jax.Array:
@@ -596,7 +623,7 @@ def build_sharded_poisson_solver(n: int, dim: int, nparts: int | None = None,
                                  replace_restart: bool = True,
                                  kernels: str = "xla-roll",
                                  recovery=None, trace: int = 0,
-                                 progress: int = 0):
+                                 progress: int = 0, precond=None):
     """Assemble a sharded Poisson problem and its solver in one call
     (the gen-direct CLI path under ``--nparts``/``--multihost``).
 
@@ -629,7 +656,7 @@ def build_sharded_poisson_solver(n: int, dim: int, nparts: int | None = None,
                                 replace_every=replace_every,
                                 replace_restart=replace_restart,
                                 recovery=recovery, trace=trace,
-                                progress=progress)
+                                progress=progress, precond=precond)
     if kernels == "pallas-roll":
         solver.use_pallas_roll(n, dim)
     return solver

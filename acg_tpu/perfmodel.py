@@ -409,7 +409,10 @@ def _explain_matrix(args):
                 f"acg-tpu: --explain analyses host-assembled tiers "
                 f"(N={N:,} rows needs the direct on-device path); use a "
                 f"smaller gen: spec")
-        if kind == "poisson":
+        if kind == "poisson" and getattr(args, "aniso", None) is not None:
+            from acg_tpu.io.generators import aniso_poisson2d_coo
+            r, c, v, N = aniso_poisson2d_coo(n, args.aniso)
+        elif kind == "poisson":
             gen = poisson2d_coo if dim == 2 else poisson3d_coo
             r, c, v, N = gen(n)
         else:
@@ -468,6 +471,18 @@ def _explain_tier(name, solver, b, csr, K, bw_gbs, dispatch_s, on_tpu,
     flops_it_analytic = cg_flops_per_iteration(nnz, n, solver.pipelined)
     bytes_it_analytic = analytic_bytes_per_iteration(
         nnz, n, idx_b, mat_b, vec_b, solver.pipelined)
+    spec = getattr(solver, "precond_spec", None)
+    if spec is not None:
+        # reclassify the roofline for PCG: one M^-1 apply per iteration
+        # joins both analytic models (the compiler-derived numbers see
+        # it automatically -- the apply is IN the program)
+        from acg_tpu.precond import (bytes_per_apply, flops_per_apply,
+                                     state_bytes)
+        mst = getattr(solver, "_mstate", None)
+        sb = state_bytes(mst) if mst is not None else 0
+        flops_it_analytic += flops_per_apply(spec, n, 3.0 * nnz)
+        bytes_it_analytic += bytes_per_apply(
+            spec, n, vec_b, nnz * (mat_b + idx_b) + 2 * n * vec_b, sb)
     bytes_it = per.get("bytes_accessed", bytes_it_analytic) if per \
         else bytes_it_analytic
 
@@ -580,9 +595,12 @@ def run_explain(args, dtype, vec_dtype) -> int:
                                  kernels=args.kernels,
                                  vector_dtype=vec_dtype,
                                  recovery=getattr(args, "_recovery",
-                                                  None))
+                                                  None),
+                                 precond=getattr(args, "_precond", None))
+            pc = getattr(args, "_precond", None)
             row = _explain_tier(
-                f"{name} ({solver.kernels} kernels, {args.dtype})",
+                f"{name} ({solver.kernels} kernels, {args.dtype}"
+                + (f", precond {pc}" if pc is not None else "") + ")",
                 solver, jnp.asarray(b, solver._solve_dtype()), csr, K, bw,
                 disp, on_tpu, err)
             if row:
@@ -609,9 +627,13 @@ def run_explain(args, dtype, vec_dtype) -> int:
                               comm=comm if comm != "none" else "xla",
                               precise_dots=args.precise_dots,
                               kernels=args.kernels,
-                              recovery=getattr(args, "_recovery", None))
+                              recovery=getattr(args, "_recovery", None),
+                              precond=getattr(args, "_precond", None))
+        pc = getattr(args, "_precond", None)
         row = _explain_tier(f"dist-cg (nparts={nparts}, {solver.kernels} "
-                            f"kernels, {args.dtype})", solver, b, csr, K,
+                            f"kernels, {args.dtype}"
+                            + (f", precond {pc}" if pc is not None
+                               else "") + ")", solver, b, csr, K,
                             bw, disp, on_tpu, err)
         if row:
             rows.append((row, solver))
@@ -650,6 +672,7 @@ def _doc_case(doc: dict):
     metric = man.get("metric")
     if metric is None:
         metric = f"{man.get('solver', 'solve')}:{man.get('matrix', '?')}"
+    metric = _precond_keyed(metric, man.get("precond"))
     soak = st.get("soak") or {}
     if soak:
         try:
@@ -670,13 +693,23 @@ def _doc_case(doc: dict):
     return str(metric), niter / tsolve
 
 
+def _precond_keyed(metric, precond) -> str:
+    """Fold the precond selection into the case key: a preconditioned
+    capture must NEVER silently diff against a plain one -- their
+    iterations/second measure different algorithms."""
+    metric = str(metric)
+    if precond and str(precond) != "none":
+        return f"{metric}|precond={precond}"
+    return metric
+
+
 def _row_case(row: dict):
     """``(key, value)`` for one bench summary row (the JSON lines bench
     prints / BENCH_*.json records)."""
     metric, value = row.get("metric"), row.get("value")
     if metric is None or not isinstance(value, (int, float)):
         return None
-    return str(metric), float(value)
+    return _precond_keyed(metric, row.get("precond")), float(value)
 
 
 def rows_to_cases(rows) -> dict:

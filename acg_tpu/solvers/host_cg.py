@@ -50,11 +50,18 @@ class HostCGSolver:
     while the fault injector (acg_tpu.faults) is active."""
 
     def __init__(self, A: SymCsrMatrix | sp.spmatrix, epsilon: float = 0.0,
-                 recovery=None, trace: int = 0, progress: int = 0):
+                 recovery=None, trace: int = 0, progress: int = 0,
+                 precond=None):
         self.A = as_csr(A, epsilon)
         self.n = self.A.shape[0]
         self.nnz_full = self.A.nnz
         self.recovery = recovery
+        # preconditioning tier (acg_tpu.precond): the eager PCG twin of
+        # the compiled solvers' -- same three kinds, f64 numpy/scipy
+        # arithmetic (this solver doubles as the PCG oracle in tests)
+        from acg_tpu.precond import parse_precond
+        self.precond_spec = parse_precond(precond)
+        self._mhost = None
         # telemetry tier (acg_tpu.telemetry): the eager twin of the
         # compiled solvers' device ring -- same (rnrm2, alpha, beta,
         # pAp) tuple, same capacity/wrap semantics, recorded per
@@ -86,6 +93,30 @@ class HostCGSolver:
                 ErrorCode.INVALID_VALUE,
                 "the serial host solver has no halo and only part 0: "
                 "this fault spec could never fire")
+        if (fault is not None and fault.site == "precond"
+                and self.precond_spec is None):
+            from acg_tpu.errors import AcgError, ErrorCode
+            raise AcgError(
+                ErrorCode.INVALID_VALUE,
+                "precond fault injection needs an armed preconditioner "
+                "(--precond jacobi|bjacobi|cheby:K); this solve runs "
+                "unpreconditioned CG")
+        M = None
+        if self.precond_spec is not None:
+            if self._mhost is None:
+                from acg_tpu.precond import HostPrecond
+                self._mhost = HostPrecond(self.precond_spec, A)
+            M = self._mhost
+            from acg_tpu.precond import (bytes_per_apply, flops_per_apply,
+                                         state_bytes)
+            self._mflops = flops_per_apply(self.precond_spec, self.n,
+                                           3.0 * self.nnz_full)
+            # kind-aware per-apply traffic (cheby streams the CSR
+            # degree-many times), matching the compiled tiers' census
+            self._mbytes = bytes_per_apply(
+                self.precond_spec, self.n, 8,
+                self.nnz_full * (8 + 4) + 2 * self.n * 8,
+                state_bytes(M.state))
         pol = self.recovery
         detect = pol is not None or fault is not None
         driver = None
@@ -110,13 +141,41 @@ class HostCGSolver:
         r = b - A @ x
         self._op("gemv", time.perf_counter() - t0,
                  self.nnz_full * (dbl + 4) + 2 * n * dbl, 3.0 * self.nnz_full)
-        p = r.copy()
+
+        napply = [0]
+
+        def papply(r, k=None):
+            """One timed preconditioner apply (eager: seconds are real,
+            unlike the compiled tiers' replayed estimates).  The op row
+            counts per the compiled tiers' convention: cheby bills its
+            degree-many SpMVs per apply, so host and device censuses
+            agree."""
+            t0 = time.perf_counter()
+            z = M.apply(r)
+            if fault is not None and k is not None:
+                z = fault.apply_precond_np(z, k)
+            napply[0] += 1
+            per = (self.precond_spec.degree
+                   if self.precond_spec.kind == "cheby" else 1)
+            self.stats.ops["precond"].add(per, time.perf_counter() - t0,
+                                          int(self._mbytes))
+            self.stats.nflops += self._mflops
+            return z
+
+        if M is not None:
+            z = papply(r)
+            p = z.copy()
+            gamma = float(r @ z)
+            rr = float(r @ r)
+            self._op("dot", 0.0, 2 * n * dbl, 2.0 * n)
+        else:
+            p = r.copy()
+            gamma = rr = float(r @ r)
         self._op("copy", 0.0, 2 * n * dbl, 0.0)
 
         t0 = time.perf_counter()
-        gamma = float(r @ r)
         self._op("nrm2", time.perf_counter() - t0, n * dbl, 2.0 * n)
-        st.r0nrm2 = st.rnrm2 = float(np.sqrt(gamma))
+        st.r0nrm2 = st.rnrm2 = float(np.sqrt(rr))
         st.dxnrm2 = np.inf
 
         res_tol = max(crit.residual_atol,
@@ -132,7 +191,7 @@ class HostCGSolver:
             recompute the true residual from the last finite iterate and
             rebuild the Krylov space; raise once the policy's restarts
             are exhausted."""
-            nonlocal x, r, p, gamma
+            nonlocal x, r, p, gamma, rr, M
             driver.log_trace_window(finish_trace())
             if not driver.on_breakdown(k):
                 st.tsolve += time.perf_counter() - tstart
@@ -145,9 +204,29 @@ class HostCGSolver:
                 driver.record("iterate non-finite; restarting from the "
                               "initial guess")
             r = b - A @ x
-            p = r.copy()
-            gamma = float(r @ r)
-            st.rnrm2 = float(np.sqrt(gamma))
+            if M is not None:
+                # preserve-or-rebuild (the compiled tiers' contract):
+                # immutable finite state survives; a poisoned one is
+                # refactored from the matrix
+                if not all(np.isfinite(np.asarray(leaf)).all()
+                           for leaf in M.state):
+                    from acg_tpu.precond import HostPrecond
+                    self._mhost = M = HostPrecond(self.precond_spec, A)
+                    driver.record(f"preconditioner "
+                                  f"({self.precond_spec}) state "
+                                  f"non-finite; rebuilt from the matrix")
+                else:
+                    driver.record(f"preconditioner "
+                                  f"({self.precond_spec}) state "
+                                  f"preserved across restart")
+                z = M.apply(r)
+                p = z.copy()
+                gamma = float(r @ z)
+                rr = float(r @ r)
+            else:
+                p = r.copy()
+                gamma = rr = float(r @ r)
+            st.rnrm2 = float(np.sqrt(rr))
 
         while not converged and k < crit.maxits:
             t0 = time.perf_counter()
@@ -170,8 +249,11 @@ class HostCGSolver:
                 if recorder is not None:
                     # the poisoned scalar stays visible in the window
                     # the recovery log quotes; no update ran -> no
-                    # alpha/beta for this iteration
-                    recorder.record(st.rnrm2, np.nan, np.nan, pdott)
+                    # alpha/beta for this iteration (preconditioned
+                    # norm under precond, the compiled rings' slot)
+                    gq = gamma if M is not None else st.rnrm2 ** 2
+                    recorder.record(np.sqrt(gq) if gq >= 0 else gq,
+                                    np.nan, np.nan, pdott)
                 _breakdown("non-finite or non-positive p^T A p")
                 converged = self._test(crit, st, res_tol)
                 continue
@@ -197,18 +279,36 @@ class HostCGSolver:
             self._op("axpy", time.perf_counter() - t0, 3 * n * dbl, 2.0 * n)
             self._op("axpy", 0.0, 3 * n * dbl, 2.0 * n)
 
-            t0 = time.perf_counter()
-            gamma_next = float(r @ r)
-            self._op("nrm2", time.perf_counter() - t0, n * dbl, 2.0 * n)
-            if detect and not np.isfinite(gamma_next):
+            if M is not None:
+                z = papply(r, k)
+                t0 = time.perf_counter()
+                gamma_next = float(r @ z)
+                rr = float(r @ r)
+                self._op("dot", time.perf_counter() - t0, 2 * n * dbl,
+                         2.0 * n)
+                self._op("nrm2", 0.0, n * dbl, 2.0 * n)
+            else:
+                t0 = time.perf_counter()
+                gamma_next = rr = float(r @ r)
+                self._op("nrm2", time.perf_counter() - t0, n * dbl,
+                         2.0 * n)
+            if detect and (not np.isfinite(gamma_next)
+                           or not np.isfinite(rr)
+                           # a negative (r, z): the non-SPD-M signal
+                           or (M is not None and gamma_next < 0)):
                 k += 1
                 st.niterations = k
                 st.ntotaliterations += 1
                 if recorder is not None:
-                    recorder.record(np.sqrt(gamma_next)
-                                    if gamma_next >= 0 else gamma_next,
+                    # the compiled rings record the PRECONDITIONED
+                    # residual norm under precond (the raw poisoned
+                    # gamma stays visible); mirror them exactly
+                    gq = gamma_next if M is not None else rr
+                    recorder.record(np.sqrt(gq) if gq >= 0 else gq,
                                     alpha, np.nan, pdott)
-                _breakdown("non-finite residual")
+                _breakdown("non-finite residual"
+                           if not np.isfinite(rr)
+                           else "non-SPD preconditioner signal")
                 converged = self._test(crit, st, res_tol)
                 continue
             beta = gamma_next / gamma
@@ -218,15 +318,20 @@ class HostCGSolver:
                 st.dxnrm2 = abs(alpha) * float(np.linalg.norm(p))
 
             t0 = time.perf_counter()
-            p = r + beta * p
+            p = (z if M is not None else r) + beta * p
             self._op("axpy", time.perf_counter() - t0, 3 * n * dbl, 2.0 * n)
 
             k += 1
             st.niterations = k
             st.ntotaliterations += 1
-            st.rnrm2 = float(np.sqrt(gamma))
+            st.rnrm2 = float(np.sqrt(rr))
             if recorder is not None:
-                recorder.record(st.rnrm2, alpha, beta, pdott)
+                # the eager-twin contract: under precond the compiled
+                # rings record the PRECONDITIONED norm sqrt((r, z)) in
+                # the rnrm2 slot -- record the same quantity here
+                gq = gamma if M is not None else rr
+                recorder.record(float(np.sqrt(gq)) if gq >= 0 else gq,
+                                alpha, beta, pdott)
             if self.progress and k % self.progress == 0:
                 import sys
                 sys.stderr.write(f"acg-tpu: host-cg: iteration {k}: "
@@ -242,6 +347,17 @@ class HostCGSolver:
         from acg_tpu import metrics
         metrics.record_solve(t_solve, st.niterations, st.converged,
                              solver="host-cg")
+        if M is not None:
+            per = (self.precond_spec.degree
+                   if self.precond_spec.kind == "cheby" else 1)
+            st.precond.update({"kind": str(self.precond_spec),
+                               "applies": napply[0],
+                               "flops_per_apply": self._mflops})
+            if self.precond_spec.kind == "cheby":
+                st.precond["lambda_min"] = float(M.state[0])
+                st.precond["lambda_max"] = float(M.state[1])
+            metrics.record_precond(self.precond_spec.kind,
+                                   napply[0] * per)
         st.fexcept_arrays = [x, r]
         finish_trace()
         if not st.converged and raise_on_divergence:

@@ -221,12 +221,12 @@ def _iterate(iter_body, init_state, gamma_of, maxits, res_tol,
 @functools.partial(jax.jit,
                    static_argnames=("unbounded", "needs_diff", "precise",
                                     "kernels", "detect", "fault", "trace",
-                                    "progress"))
+                                    "progress", "precond"))
 def _cg_program(A: DeviceMatrix, b, x0, res_atol, res_rtol, diff_atol,
                 diff_rtol, maxits, unbounded: bool, needs_diff: bool,
                 precise: bool = False, kernels: str = "xla",
                 detect: bool = False, fault=None, trace: int = 0,
-                progress: int = 0):
+                progress: int = 0, precond=None, mstate=None):
     """Whole classic-CG solve as one XLA program.
 
     ``precise`` switches the CG scalars' dot products to the compensated
@@ -249,7 +249,17 @@ def _cg_program(A: DeviceMatrix, b, x0, res_atol, res_rtol, diff_atol,
     ONCE with the result, no per-iteration host traffic -- and makes
     the program return ``(CGResult, buffer)``.  ``progress`` emits a
     host heartbeat every that-many iterations (jax.debug.callback).
-    Both are static: 0 compiles the byte-identical pristine program."""
+    Both are static: 0 compiles the byte-identical pristine program.
+
+    ``precond`` (a static :class:`acg_tpu.precond.PrecondSpec`) turns
+    the loop into PRECONDITIONED CG: ``mstate`` (the preconditioner
+    state pytree, an ordinary argument) feeds ``z = M^-1 r`` after each
+    residual update, the CG scalar becomes ``gamma = (r, z)``, and the
+    carry grows one extra true-residual scalar ``rr = (r, r)`` so the
+    convergence test (and the reported rnrm2) keep the UNpreconditioned
+    meaning while the telemetry ring records the preconditioned norm.
+    ``None`` compiles the byte-identical unpreconditioned program
+    (pinned in tests/test_hlo_structure.py)."""
     dtype = b.dtype
     dot, sdt = _scalar_setup(dtype, precise)
     store = (lambda v: v.astype(dtype)) if sdt != dtype else (lambda v: v)
@@ -257,15 +267,29 @@ def _cg_program(A: DeviceMatrix, b, x0, res_atol, res_rtol, diff_atol,
     bnrm2 = jnp.sqrt(dot(b, b))
     x0nrm2 = jnp.sqrt(dot(x0, x0))
     r = b - spmv_(A, x0)
-    p = r
-    gamma = dot(r, r)
-    r0nrm2 = jnp.sqrt(gamma)
+    if precond is not None:
+        from acg_tpu.precond import make_apply
+        papply = make_apply(precond, spmv_)
+        z0 = papply(mstate, A, r)
+        p = store(z0)
+        gamma = dot(r, z0)
+        rr = dot(r, r)
+        r0nrm2 = jnp.sqrt(rr)
+    else:
+        p = r
+        gamma = dot(r, r)
+        r0nrm2 = jnp.sqrt(gamma)
     res_tol = jnp.maximum(res_atol, res_rtol * r0nrm2)
     diff_tol = jnp.maximum(diff_atol, diff_rtol * x0nrm2)
     inf = jnp.asarray(jnp.inf, sdt)
 
     if trace or progress:
         from acg_tpu import telemetry
+
+    # carry layout: (x, r, p, gamma [, rr] [, dx] [, bad] [, ring]) --
+    # rr (the true residual the convergence test reads) joins only
+    # under precond, dx only under a diff criterion
+    dx_i = 5 if precond is not None else 4
 
     # dxsqr joins the carry only when a diff criterion is active: every
     # extra loop-carried scalar measurably slows the TPU loop (~0.1 ms/it)
@@ -294,25 +318,41 @@ def _cg_program(A: DeviceMatrix, b, x0, res_atol, res_rtol, diff_atol,
             alpha = gamma / pdott
             x = store(x + alpha * p)
             r = store(r - alpha * t)
-        gamma_next = dot(r, r)
-        beta = gamma_next / gamma
-        p_next = store(r + beta * p)
-        out = (x, r, p_next, gamma_next)
+        if precond is not None:
+            z = papply(mstate, A, r)
+            if fault is not None:
+                z = fault.apply_precond(z, k)
+            gamma_next = dot(r, z)
+            rr_next = dot(r, r)
+            beta = gamma_next / gamma
+            p_next = store(z + beta * p)
+            out = (x, r, p_next, gamma_next, rr_next)
+        else:
+            gamma_next = dot(r, r)
+            beta = gamma_next / gamma
+            p_next = store(r + beta * p)
+            out = (x, r, p_next, gamma_next)
         if needs_diff:
             dx = alpha * alpha * dot(p, p)
             if detect:
                 # freeze dx too: a zeroed alpha would make the frozen
                 # iteration "satisfy" the diff criterion and launder the
                 # breakdown into a converged exit
-                dx = jnp.where(bad, state[4], dx)
+                dx = jnp.where(bad, state[dx_i], dx)
             out = out + (dx,)
         if detect:
             # a poison that slipped past pdott (e.g. a NaN row of t with
-            # a finite dot) lands in r: flag it one iteration deferred
-            out = out + (bad | (~jnp.isfinite(gamma_next)),)
+            # a finite dot) lands in r: flag it one iteration deferred.
+            # Under precond, a NEGATIVE (r, z) is the non-SPD-M signal
+            # (the precond: fault site's deterministic twin)
+            deferred = bad | (~jnp.isfinite(gamma_next))
+            if precond is not None:
+                deferred = deferred | (gamma_next < 0)
+            out = out + (deferred,)
         if trace:
             # record the RAW scalars (a poisoned pdott/gamma_next stays
-            # visible in the window the recovery log quotes)
+            # visible in the window the recovery log quotes); under
+            # precond gamma IS the preconditioned residual norm^2
             out = out + (telemetry.ring_record(buf, k, gamma_next, alpha,
                                                beta, pdott),)
         if progress:
@@ -320,26 +360,34 @@ def _cg_program(A: DeviceMatrix, b, x0, res_atol, res_rtol, diff_atol,
         return out
 
     # the ring buffer rides LAST in the carry so every existing index
-    # (dx at [4], the deferred-bad freeze reads) is untouched; only the
+    # (dx, the deferred-bad freeze reads) is untouched; only the
     # tail accessors below shift by one
-    init_state = (x0, r, p, gamma) + ((inf,) if needs_diff else ())
+    init_state = (x0, r, p, gamma)
+    if precond is not None:
+        init_state = init_state + (rr,)
+    init_state = init_state + ((inf,) if needs_diff else ())
     if detect:
         init_state = init_state + (jnp.asarray(False),)
     if trace:
         init_state = init_state + (telemetry.ring_init(trace, sdt),)
     bad_i = -2 if trace else -1
+    # the convergence test reads the TRUE residual either way: gamma
+    # itself unpreconditioned, the carried rr under precond
+    conv_i = 4 if precond is not None else 3
     k, state, done = _iterate(
-        body, init_state, lambda s: s[3], maxits,
-        res_tol, diff_tol, (lambda s: s[4]) if needs_diff else (lambda s: inf),
+        body, init_state, lambda s: s[conv_i], maxits,
+        res_tol, diff_tol,
+        (lambda s: s[dx_i]) if needs_diff else (lambda s: inf),
         unbounded, bad_of=(lambda s: s[bad_i]) if detect else None)
     x, r, p, gamma = state[:4]
-    dxsqr = state[4] if needs_diff else inf
+    rnrm2sqr = state[4] if precond is not None else gamma
+    dxsqr = state[dx_i] if needs_diff else inf
     breakdown = state[bad_i] if detect else jnp.asarray(False)
     # a breakdown flagged on the same iteration the tolerance was met is
     # convergence, not breakdown: at the f32 floor the (p, Ap) scalar
     # legitimately rounds to <= 0 once progress is exhausted
     breakdown = breakdown & ~done
-    res = CGResult(x=x, niterations=k, rnrm2=jnp.sqrt(gamma),
+    res = CGResult(x=x, niterations=k, rnrm2=jnp.sqrt(rnrm2sqr),
                    r0nrm2=r0nrm2, bnrm2=bnrm2, x0nrm2=x0nrm2,
                    dxnrm2=jnp.sqrt(dxsqr), converged=done,
                    breakdown=breakdown)
@@ -571,12 +619,13 @@ def _cg_fused_program(A: DeviceMatrix, b, x0, res_atol, res_rtol,
 @functools.partial(jax.jit,
                    static_argnames=("unbounded", "needs_diff", "precise",
                                     "kernels", "detect", "fault", "trace",
-                                    "progress"))
+                                    "progress", "precond"))
 def _cg_pipelined_program(A: DeviceMatrix, b, x0, res_atol, res_rtol,
                           diff_atol, diff_rtol, maxits, unbounded: bool,
                           needs_diff: bool, precise: bool = False,
                           kernels: str = "xla", detect: bool = False,
-                          fault=None, trace: int = 0, progress: int = 0):
+                          fault=None, trace: int = 0, progress: int = 0,
+                          precond=None, mstate=None):
     """Whole pipelined-CG (Ghysels-Vanroose) solve as one XLA program.
 
     ``detect``/``fault``/``trace``/``progress`` as in
@@ -588,7 +637,18 @@ def _cg_pipelined_program(A: DeviceMatrix, b, x0, res_atol, res_rtol,
     records the CARRIED gamma = ||r||^2 from before the update (the
     same one-iteration-stale quantity the convergence test uses) and
     the alpha denominator in the pAp slot -- exactly the recurrence
-    scalars whose drift the deep-pipelining literature plots."""
+    scalars whose drift the deep-pipelining literature plots.
+
+    ``precond``/``mstate`` arm the PRECONDITIONED pipelined variant
+    (Ghysels-Vanroose's M^-1 formulation, the method arXiv:1801.04728 /
+    1905.06850 actually pipeline): the carry grows ``u = M^-1 r`` and
+    ``q = M^-1 s`` plus the extra ``w/m/n`` recurrences -- one
+    preconditioner apply (``m = M^-1 w``) and one SpMV (``n = A m``)
+    per iteration, both overlapping the fused reduction exactly like
+    the unpreconditioned q = A w.  The fused reduction carries THREE
+    scalars (gamma = (r, u), delta = (w, u), rr = (r, r)) so the mesh
+    tiers keep the single-allreduce property.  ``None`` compiles the
+    byte-identical unpreconditioned program."""
     dtype = b.dtype
     dot, sdt = _scalar_setup(dtype, precise)
     store = (lambda v: v.astype(dtype)) if sdt != dtype else (lambda v: v)
@@ -596,14 +656,87 @@ def _cg_pipelined_program(A: DeviceMatrix, b, x0, res_atol, res_rtol,
     bnrm2 = jnp.sqrt(dot(b, b))
     x0nrm2 = jnp.sqrt(dot(x0, x0))
     r = b - spmv_(A, x0)
-    w = spmv_(A, r)
-    r0nrm2 = jnp.sqrt(dot(r, r))
+    if precond is not None:
+        from acg_tpu.precond import make_apply
+        papply = make_apply(precond, spmv_)
+        u0 = store(papply(mstate, A, r))
+        w = spmv_(A, u0)
+        rr0 = dot(r, r)
+        r0nrm2 = jnp.sqrt(rr0)
+    else:
+        w = spmv_(A, r)
+        r0nrm2 = jnp.sqrt(dot(r, r))
     res_tol = jnp.maximum(res_atol, res_rtol * r0nrm2)
     diff_tol = jnp.maximum(diff_atol, diff_rtol * x0nrm2)
     inf = jnp.asarray(jnp.inf, sdt)
     zeros = jnp.zeros_like(b)
     if trace or progress:
         from acg_tpu import telemetry
+
+    def pbody(k, state):
+        """Preconditioned GV body: carry (x, r, u, w, p, s, q, z,
+        gamma_prev, alpha_prev, rr) -- s is the A-direction (the
+        unpreconditioned t), z the A M^-1 A-direction, q the M^-1
+        A-direction."""
+        if trace:
+            buf, state = state[-1], state[:-1]
+        x, r, u, w, p, s, q, z, gamma_prev, alpha_prev = state[:10]
+        # the iteration's three reductions, fused (ONE allreduce on a
+        # mesh): gamma/delta drive the recurrences, rr feeds the true-
+        # residual convergence test (stale by one, like gamma)
+        gamma = dot(r, u)
+        delta = dot(w, u)
+        rr = dot(r, r)
+        if fault is not None:
+            delta = fault.apply_dot(delta, k)
+        # m = M^-1 w and n = A m overlap the reduction under XLA's
+        # scheduler -- the preconditioned restatement of q = A w
+        m = papply(mstate, A, w)
+        if fault is not None:
+            m = fault.apply_precond(m, k)
+        nvec = spmv_(A, m)
+        if fault is not None:
+            nvec = fault.apply_spmv(nvec, k)
+        beta = gamma / gamma_prev               # inf -> 0 on first iteration
+        denom = delta - beta * (gamma / alpha_prev)
+        if detect:
+            bad, alpha = _breakdown_guard(gamma, denom)
+            # a negative (r, u) is the non-SPD-M signal (precond: fault
+            # twin); the unpreconditioned guard cannot see it
+            bad = bad | (gamma < 0)
+            alpha = jnp.where(bad, jnp.zeros_like(alpha), alpha)
+        else:
+            alpha = gamma / denom
+        z = store(nvec + beta * z)
+        q = store(m + beta * q)
+        s = store(w + beta * s)
+        p = store(u + beta * p)
+        if detect:
+            x = store(jnp.where(bad, x, x + alpha * p))
+            r = store(jnp.where(bad, r, r - alpha * s))
+            u = store(jnp.where(bad, u, u - alpha * q))
+            w = store(jnp.where(bad, w, w - alpha * z))
+        else:
+            x = store(x + alpha * p)
+            r = store(r - alpha * s)
+            u = store(u - alpha * q)
+            w = store(w - alpha * z)
+        out = (x, r, u, w, p, s, q, z, gamma, alpha, rr)
+        if needs_diff:
+            dx = alpha * alpha * dot(p, p)
+            if detect:
+                dx = jnp.where(bad, state[11], dx)
+            out = out + (dx,)
+        if detect:
+            out = out + (bad,)
+        if trace:
+            # gamma = the PRECONDITIONED residual norm^2 (stale by one,
+            # like the convergence test); alpha denominator in pAp slot
+            out = out + (telemetry.ring_record(buf, k, gamma, alpha,
+                                               beta, denom),)
+        if progress:
+            telemetry.heartbeat(k, gamma, progress)
+        return out
 
     def body(k, state):
         if trace:
@@ -665,21 +798,36 @@ def _cg_pipelined_program(A: DeviceMatrix, b, x0, res_atol, res_rtol,
 
     # convergence tests the carried gamma = ||r||^2 from *before* the
     # update -- one iteration stale, the reference's deferred test
-    # (cgcuda.c:1798-1810); saves a fresh dot per iteration
-    init_state = (x0, r, w, zeros, zeros, zeros, inf, inf) + (
-        (inf,) if needs_diff else ())
+    # (cgcuda.c:1798-1810); saves a fresh dot per iteration.  The
+    # preconditioned carry tests the carried rr (same staleness), so
+    # tolerances keep the true-residual meaning
+    if precond is not None:
+        init_state = (x0, r, u0, w, zeros, zeros, zeros, zeros, inf, inf,
+                      rr0) + ((inf,) if needs_diff else ())
+        loop_body = pbody
+        conv_of = lambda s: s[10]
+        dx_of = (lambda s: s[11]) if needs_diff else (lambda s: inf)
+        init_gamma = rr0
+    else:
+        init_state = (x0, r, w, zeros, zeros, zeros, inf, inf) + (
+            (inf,) if needs_diff else ())
+        loop_body = body
+        conv_of = lambda s: s[6]
+        dx_of = (lambda s: s[8]) if needs_diff else (lambda s: inf)
+        init_gamma = r0nrm2 * r0nrm2
     if detect:
         init_state = init_state + (jnp.asarray(False),)
     if trace:
         init_state = init_state + (telemetry.ring_init(trace, sdt),)
     bad_i = -2 if trace else -1
     k, state, done = _iterate(
-        body, init_state, lambda s: s[6], maxits,
-        res_tol, diff_tol, (lambda s: s[8]) if needs_diff else (lambda s: inf),
-        unbounded, init_gamma=r0nrm2 * r0nrm2,
+        loop_body, init_state, conv_of, maxits,
+        res_tol, diff_tol, dx_of,
+        unbounded, init_gamma=init_gamma,
         bad_of=(lambda s: s[bad_i]) if detect else None)
     x, r = state[0], state[1]
-    dxsqr = state[8] if needs_diff else inf
+    dxsqr = ((state[11] if precond is not None else state[8])
+             if needs_diff else inf)
     breakdown = state[bad_i] if detect else jnp.asarray(False)
     rnrm2 = jnp.sqrt(dot(r, r))
     # the in-loop test is one iteration stale; at the maxits boundary a
@@ -708,7 +856,8 @@ class JaxCGSolver:
                  precise_dots: bool = False, kernels: str = "auto",
                  vector_dtype=None, replace_every: int = 0,
                  replace_restart: bool = True, recovery=None,
-                 host_matrix=None, trace: int = 0, progress: int = 0):
+                 host_matrix=None, trace: int = 0, progress: int = 0,
+                 precond=None):
         """``recovery`` (a :class:`acg_tpu.solvers.resilience.
         RecoveryPolicy`) arms breakdown detection in the compiled loop
         plus the host-side restart policy; ``host_matrix`` (scipy CSR)
@@ -735,7 +884,14 @@ class JaxCGSolver:
         Unlike the all-bf16 tier it has no kappa limit: bf16 vector
         storage caps convergence at kappa ~ 1/u_bf16 ~ 500 (measured:
         diverges on 2D Poisson n >= 512), whereas this tier's iterates
-        never touch bf16."""
+        never touch bf16.
+
+        ``precond`` (an :class:`acg_tpu.precond.PrecondSpec`, a spec
+        string like ``"jacobi"``/``"bjacobi:32"``/``"cheby:4"``, or
+        None) arms preconditioned CG / pipelined CG: the state is built
+        once (lazily, on device) and rides the solve programs as an
+        argument; ``None`` leaves every lowered program byte-identical
+        to an unpreconditioned build."""
         self.A = A
         self.vector_dtype = vector_dtype
         self.pipelined = pipelined
@@ -820,6 +976,23 @@ class JaxCGSolver:
                                  "kernels='xla'/'pallas' (the fused "
                                  "two-phase iteration has no replacement "
                                  "hook)")
+        from acg_tpu.precond import parse_precond
+        self.precond_spec = parse_precond(precond)
+        if self.precond_spec is not None:
+            if self.replace_every:
+                raise ValueError(
+                    "precond does not compose with replace_every: the "
+                    "replacement segments restructure the recurrences "
+                    "the preconditioner threads through (use the direct "
+                    "classic/pipelined PCG programs)")
+            if isinstance(kernels, str) and kernels.startswith("fused"):
+                raise ValueError(
+                    "kernels='fused' folds the whole iteration into two "
+                    "streamed kernels and has no preconditioner hook; "
+                    "precond needs kernels='xla'/'pallas'")
+        # the preconditioner state pytree (device arrays); built lazily
+        # at first dispatch so construction stays zero-transfer
+        self._mstate = None
         self.kernels = kernels
         self.recovery = recovery
         self.host_matrix = host_matrix
@@ -860,6 +1033,22 @@ class JaxCGSolver:
         if self.replace_every:
             dtype = jnp.dtype(jnp.float32)
         return dtype
+
+    def _ensure_precond_state(self):
+        """Build (once, lazily) the preconditioner state pytree that
+        rides the solve programs as an argument: diagonal / block
+        factors extracted from the CLEAN matrix view ``self.A``, the
+        Chebyshev lambda_max power iteration run through the SAME SpMV
+        selection the programs dispatch (``self._A_program`` -- the
+        per-shard-padded twin on the pallas-roll tier)."""
+        if self.precond_spec is None or self._mstate is not None:
+            return self._mstate
+        from acg_tpu.precond import setup_single
+        sdt = acc_dtype(self._solve_dtype())
+        self._mstate = setup_single(self.precond_spec, self.A,
+                                    _spmv_fn(self.kernels), sdt,
+                                    A_program=self._A_program)
+        return self._mstate
 
     def _select_program(self, b, x0, crit: StoppingCriteria,
                         detect: bool = False, fault=None):
@@ -947,6 +1136,11 @@ class JaxCGSolver:
                           precise=self.precise_dots, kernels=self.kernels,
                           detect=detect, fault=fault,
                           trace=self.trace, progress=self.progress)
+            if self.precond_spec is not None:
+                # the disarmed call site stays byte-identical: neither
+                # kwarg is passed at all without a spec
+                kwargs["precond"] = self.precond_spec
+                kwargs["mstate"] = self._ensure_precond_state()
         tr = self.trace and not (self.replace_every
                                  or (isinstance(self.kernels, str)
                                      and self.kernels.startswith("fused")))
@@ -998,6 +1192,15 @@ class JaxCGSolver:
                 "halo fault injection needs a distributed problem with "
                 "ghost exchange (DistCGSolver, nparts > 1); the "
                 "single-device solver has no halo to poison")
+        if (fault is not None and fault.site == "precond"
+                and self.precond_spec is None):
+            # no preconditioner is armed: the apply the fault poisons
+            # never runs (the replace_every rationale)
+            raise AcgError(
+                ErrorCode.INVALID_VALUE,
+                "precond fault injection needs an armed preconditioner "
+                "(--precond jacobi|bjacobi|cheby:K); this solve runs "
+                "unpreconditioned CG")
         if fault is not None and fault.part > 0:
             # _fault_nparts distinguishes the true single-device solver
             # from multi-part subclasses that reuse this solve (the
@@ -1115,6 +1318,12 @@ class JaxCGSolver:
                     if fault is not None and "fault" in kwargs:
                         fault = fault.shift(k_done)
                         kwargs["fault"] = fault
+                    if self.precond_spec is not None:
+                        # preserve finite preconditioner state across
+                        # the restart, rebuild it when poisoned
+                        from acg_tpu.precond import refresh_state
+                        if refresh_state(self, driver):
+                            kwargs["mstate"] = self._mstate
                     remaining = max(crit.maxits - niter, 1)
                     args = (args[:2] + (x_next,)
                             + (jnp.asarray(abs_tol, sdt),
@@ -1206,6 +1415,8 @@ class JaxCGSolver:
             st.ops["axpy"].add(3 * niter, 0.0, 3 * n * dbl * 3 * niter)
             if not self.pipelined:
                 st.ops["copy"].add(1, 0.0, 2 * n * dbl)
+            if self.precond_spec is not None:
+                self._account_precond(st, niter, n, dbl, mat_bytes)
         if host_result:
             x = np.asarray(res.x)
             st.fexcept_arrays = [x]
@@ -1222,6 +1433,38 @@ class JaxCGSolver:
             raise NotConvergedError(
                 f"{niter} iterations, residual {st.rnrm2:.3e}")
         return x
+
+    def _account_precond(self, st, niter: int, n: int, dbl: int,
+                         mat_bytes: int) -> None:
+        """Analytic accounting for the preconditioner (the precond_apply
+        satellite): niter + 1 applies per solve (setup z0 + one per
+        iteration); cheby's op count bills its degree-many SpMVs per
+        apply, the PCG scalar (r, z) adds one dot per apply, and the
+        ``precond:`` stats section records the armed configuration."""
+        from acg_tpu import metrics, precond as precond_mod
+
+        spec = self.precond_spec
+        nappl = niter + 1
+        per_apply_flops = precond_mod.flops_per_apply(
+            spec, n, self._spmv_flops)
+        st.nflops += per_apply_flops * nappl
+        sb = precond_mod.state_bytes(self._mstate)
+        per_apply_bytes = precond_mod.bytes_per_apply(
+            spec, n, dbl, mat_bytes + 2 * n * dbl, sb)
+        nops = nappl * (spec.degree if spec.kind == "cheby" else 1)
+        st.ops["precond"].add(nops, 0.0, int(per_apply_bytes * nappl))
+        # the extra PCG scalar (r, z) per apply
+        st.ops["dot"].add(nappl, 0.0, 2 * n * dbl * nappl)
+        st.precond.update({
+            "kind": str(spec),
+            "applies": nappl,
+            "flops_per_apply": per_apply_flops,
+            "state_bytes": sb,
+        })
+        if spec.kind == "cheby":
+            st.precond["lambda_min"] = float(self._mstate[0])
+            st.precond["lambda_max"] = float(self._mstate[1])
+        metrics.record_precond(spec.kind, nops)
 
     def _host_fallback(self, b, crit, raise_on_divergence: bool,
                        host_result: bool):

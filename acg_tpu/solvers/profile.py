@@ -181,7 +181,7 @@ def _profile_single(solver, b, reps: int) -> dict[str, float]:
     # the matrix rides as an ARGUMENT, not a closure: captured device
     # arrays become compile-time constants and are shipped with the
     # program (gigabytes at large N)
-    return {
+    out = {
         "gemv": _time_op(lambda v, M: spmv_f(M, v), x, A, reps=reps),
         "dot": _time_op(lambda v, c: v + tiny * _dot(v, c), x, x,
                         reps=reps),
@@ -197,6 +197,23 @@ def _profile_single(solver, b, reps: int) -> dict[str, float]:
         "copy": _time_op(lambda y, a: y * a, x,
                          jnp.asarray(1.0000001, dtype), reps=reps),
     }
+    spec = getattr(solver, "precond_spec", None)
+    if spec is not None:
+        # replay the M^-1 apply too: the analytic precond counters
+        # must not print 0 seconds next to replayed times (the could-
+        # never-fire discipline).  The replayed seconds are divided by
+        # the per-apply op count (cheby counts its degree-many SpMVs),
+        # so ops["precond"].t = seconds/op x n reconstructs the true
+        # per-apply cost
+        from acg_tpu.precond import make_apply
+
+        mstate = solver._ensure_precond_state()
+        papply = make_apply(spec, spmv_f)
+        per = spec.degree if spec.kind == "cheby" else 1
+        out["precond"] = _time_op(
+            lambda v, M, ms: papply(ms, M, v), x, A, mstate,
+            reps=reps) / per
+    return out
 
 
 def _profile_dist(solver, b, reps: int) -> dict[str, float]:
@@ -309,4 +326,35 @@ def _profile_dist(solver, b, reps: int) -> dict[str, float]:
 
     out["axpy"] = _time_op(lambda y, a, p: y + a * p, bd,
                            jnp.asarray(0.5, prob.vdtype), bd, reps=reps)
+
+    spec = getattr(solver, "precond_spec", None)
+    if spec is not None:
+        # the sharded M^-1 apply (the single-device replay's twin):
+        # jacobi/bjacobi run per shard with no communication, cheby
+        # through the same halo'd SpMV the gemv replay times
+        from acg_tpu.precond import make_apply
+
+        mstate = solver._ensure_precond_state(
+            (bd, x0, la, ga, sidx, gsrc, gval, scnt, rcnt))
+
+        def precond_once(x, la, ga, sidx, gsrc, gval, scnt, rcnt, ms):
+            def body(la, ga, sidx, gsrc, gval, scnt, rcnt, x, ms):
+                la, ga = (jax.tree.map(lambda a: a[0], t)
+                          for t in (la, ga))
+                sidx, gsrc, gval, scnt, rcnt, x = (
+                    a[0] for a in (sidx, gsrc, gval, scnt, rcnt, x))
+                ms = jax.tree.map(lambda a: a[0], ms)
+                papply = make_apply(
+                    spec, lambda _A, v: spmv_shard(v, la, ga, sidx,
+                                                   gsrc, gval, scnt,
+                                                   rcnt))
+                return papply(ms, None, x)[None]
+
+            return smap(body, (pspec,) * 9)(la, ga, sidx, gsrc, gval,
+                                            scnt, rcnt, x, ms)
+
+        per = spec.degree if spec.kind == "cheby" else 1
+        out["precond"] = _time_op(precond_once, bd, la, ga, sidx, gsrc,
+                                  gval, scnt, rcnt, mstate,
+                                  reps=reps) / per
     return out

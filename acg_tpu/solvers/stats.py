@@ -25,9 +25,14 @@ import sys
 
 from acg_tpu.errors import fexcept_str
 
-OP_CLASSES = ("gemv", "dot", "nrm2", "axpy", "copy", "allreduce", "halo")
+OP_CLASSES = ("gemv", "dot", "nrm2", "axpy", "copy", "allreduce", "halo",
+              "precond")
 # report labels match the reference output block
 _OP_LABELS = {"allreduce": "MPI_Allreduce", "halo": "MPI_HaloExchange"}
+# op classes the reference block does not know: their row renders only
+# when something was counted, so unpreconditioned reports stay
+# byte-identical to the reference's (the resilience-lines discipline)
+_OPTIONAL_OPS = ("precond",)
 
 # canonical pipeline-phase order for the ``timings:`` section (the
 # telemetry tier's always-on phase timer); phases recorded out of order
@@ -123,6 +128,10 @@ class SolverStats:
     # latency/iteration percentiles + drift verdict.  Rendered (and
     # exported, stats schema /3) only when a soak run recorded it
     soak: dict = dataclasses.field(default_factory=dict)
+    # preconditioning tier (acg_tpu.precond, stats schema /4): the armed
+    # preconditioner's kind/parameters, analytic applies, and spectral
+    # estimates.  Appends after every existing section, like soak
+    precond: dict = dataclasses.field(default_factory=dict)
 
     def to_dict(self) -> dict:
         """Machine-readable twin of :meth:`fwrite` -- the ``stats`` key
@@ -165,6 +174,7 @@ class SolverStats:
             "costmodel": dict(self.costmodel),
             "memory": dict(self.memory),
             "soak": dict(self.soak),
+            "precond": dict(self.precond),
         }
         if self.trace is not None:
             d["trace"] = self.trace.to_dict()
@@ -197,6 +207,8 @@ class SolverStats:
         p("performance breakdown:")
         for op in OP_CLASSES:
             s = self.ops[op]
+            if op in _OPTIONAL_OPS and s.n == 0:
+                continue
             gbs = 1.0e-9 * s.bytes / s.t if s.t > 0 else 0.0
             label = _OP_LABELS.get(op, op)
             p(f"  {label}: {s.t:,.6f} seconds {s.n:,} times {s.bytes:,} B {gbs:,.3f} GB/s")
@@ -247,6 +259,9 @@ class SolverStats:
         if self.soak:
             p("soak:")
             _write_section(p, self.soak, 1)
+        if self.precond:
+            p("precond:")
+            _write_section(p, self.precond, 1)
         text = out.getvalue()
         if f is not None:
             f.write(text)

@@ -63,7 +63,11 @@ from acg_tpu.solvers.stats import PHASE_ORDER
 # metrics layer is armed) and a "soak" key inside the stats twin (the
 # soak driver's latency/iteration percentiles + drift verdict) --
 # additive again, so /1 and /2 consumers keep working
-STATS_SCHEMA = "acg-tpu-stats/3"
+# /4: the preconditioning tier (acg_tpu.precond) adds a "precond" key
+# inside the stats twin (kind/applies/spectral estimates), a "precond"
+# op-class row under "ops", and a manifest "precond" key that joins the
+# bench-diff case key -- additive, so /1../3 consumers keep working
+STATS_SCHEMA = "acg-tpu-stats/4"
 CONVERGENCE_SCHEMA = "acg-tpu-convergence/1"
 # default ring capacity (--telemetry-window): 512 iterations x 4 scalars
 # is 8 KiB of f32 carry -- negligible against any solve's vectors, and

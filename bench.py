@@ -79,7 +79,10 @@ def _sink_stats(row: dict, solver) -> None:
 
         man = telemetry.run_manifest(
             metric=row.get("metric"), dtype=row.get("dtype"),
-            kernels=row.get("kernels"), format=row.get("format"))
+            kernels=row.get("kernels"), format=row.get("format"),
+            # rides into the bench-diff case key (perfmodel._doc_case):
+            # preconditioned captures never diff against plain ones
+            precond=row.get("precond"))
         telemetry.write_stats_json(_STATS_SINK, solver.stats,
                                    manifest=man, append=True)
     except Exception as e:  # noqa: BLE001 -- the sink must never sink a row
@@ -785,12 +788,15 @@ def run_soak_mode(args) -> int:
     metrics.arm()
     if args.metrics_file:
         metrics.install_flush_handlers(args.metrics_file)
+    from acg_tpu.precond import parse_precond
+    pc = parse_precond(args.precond)
     name = (f"soak_poisson{args.soak_dim}d_n{args.soak_side}"
             f"_{args.soak_dtype}_x{args.soak}")
     csr = _build(args.soak_side, args.soak_dim)
     mat_dtype, vec_dtype = _dtypes_of(args.soak_dtype)
     A = device_matrix_from_csr(csr, dtype=mat_dtype)
-    solver = JaxCGSolver(A, kernels="auto", vector_dtype=vec_dtype)
+    solver = JaxCGSolver(A, kernels="auto", vector_dtype=vec_dtype,
+                         precond=pc)
     b = np.ones(csr.shape[0], dtype=np.float32)
     # fixed-iteration protocol (the bench convention): every solve does
     # identical work, so the latency distribution measures the SYSTEM,
@@ -821,6 +827,9 @@ def run_soak_mode(args) -> int:
         "drift_tripped": report["drift"]["tripped"],
         "nsolves": args.soak,
     }
+    if pc is not None:
+        # folded into the diff case key by perfmodel._row_case
+        row["precond"] = str(pc)
     print(json.dumps(row))
     _sink_stats(row, solver)
     if args.metrics_file:
@@ -886,6 +895,12 @@ def main(argv=None) -> int:
     ap.add_argument("--soak-dtype", default="f32",
                     choices=("f32", "mixed", "bf16"),
                     help="with --soak: storage tier (default: f32)")
+    ap.add_argument("--precond", default="none", metavar="KIND",
+                    help="with --soak: preconditioner selection "
+                         "(none | jacobi | bjacobi[:BS] | cheby:K, "
+                         "acg_tpu.precond); joins the case metric so "
+                         "preconditioned captures never diff against "
+                         "plain ones")
     ap.add_argument("--fail-on-drift", type=float, default=None,
                     metavar="PCT",
                     help="with --soak: exit 7 when EWMA solve latency "
@@ -899,10 +914,12 @@ def main(argv=None) -> int:
     global _STATS_SINK
     _STATS_SINK = args.stats_json
     if not args.soak and (args.metrics_file
-                          or args.fail_on_drift is not None):
+                          or args.fail_on_drift is not None
+                          or args.precond != "none"):
         # only the soak harness reads these; silently ignoring them
         # would let an operator believe a gate/capture ran
-        ap.error("--metrics-file/--fail-on-drift need --soak N")
+        ap.error("--metrics-file/--fail-on-drift/--precond need "
+                 "--soak N")
     if args.fail_on_drift is not None:
         from acg_tpu.soak import gate_is_vacuous
         if args.fail_on_drift <= 0:

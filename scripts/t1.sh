@@ -7,7 +7,7 @@
 # T1_SOAK=1 additionally runs the service-soak smoke after the tests: a
 # tiny 3-solve --soak run whose --metrics-file must validate as
 # Prometheus exposition format and whose --stats-json must carry the
-# acg-tpu-stats/3 soak section (the CI soak-smoke step runs the same
+# acg-tpu-stats/4 soak section (the CI soak-smoke step runs the same
 # thing).
 set -o pipefail
 cd "$(dirname "$0")/.."
@@ -31,11 +31,36 @@ if [ "${T1_SOAK:-0}" = "1" ]; then
     python - <<'PY' || rc=$((rc ? rc : 1))
 import json
 doc = json.load(open("/tmp/_t1_soak.json"))
-assert doc["schema"] == "acg-tpu-stats/3", doc["schema"]
+assert doc["schema"] == "acg-tpu-stats/4", doc["schema"]
 soak = doc["stats"]["soak"]
 assert soak["nsolves"] == 3 and soak["latency"]["p50"] is not None, soak
 assert "metrics" in doc, "registry snapshot missing from /3 document"
 print("T1_SOAK: OK")
 PY
+fi
+if [ "${T1_PRECOND:-0}" = "1" ]; then
+    # preconditioning smoke (the PR-5 acceptance in miniature): jacobi
+    # and cheby:4 PCG on the anisotropic generator must converge and
+    # leave a /4 stats document carrying the precond section
+    echo "T1_PRECOND: jacobi+cheby smoke"
+    for pc in jacobi cheby:4; do
+        rm -f /tmp/_t1_precond.json
+        timeout -k 10 300 env JAX_PLATFORMS=cpu python -m acg_tpu.cli \
+            gen:poisson2d:32 --aniso 0.05 --precond "$pc" --comm none \
+            --max-iterations 500 --residual-rtol 1e-6 --warmup 0 \
+            --quiet --stats-json /tmp/_t1_precond.json \
+            || rc=$((rc ? rc : 1))
+        env PC="$pc" python - <<'PY' || rc=$((rc ? rc : 1))
+import json, os
+doc = json.load(open("/tmp/_t1_precond.json"))
+assert doc["schema"] == "acg-tpu-stats/4", doc["schema"]
+st = doc["stats"]
+assert st["converged"] is True, st["rnrm2"]
+assert st["precond"]["kind"] == os.environ["PC"], st["precond"]
+assert st["ops"]["precond"]["n"] > 0, st["ops"]["precond"]
+print(f"T1_PRECOND: {os.environ['PC']} OK "
+      f"({st['niterations']} iterations)")
+PY
+    done
 fi
 exit $rc

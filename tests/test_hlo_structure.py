@@ -84,6 +84,59 @@ def test_precise_dots_keep_fusion(prob):
     assert ata == 3
 
 
+# -- preconditioning tier: none = byte-identical; PCG keeps the
+# communication-avoiding structure --------------------------------------
+
+def test_precond_none_is_byte_identical(prob):
+    """--precond none must lower BYTE-IDENTICAL programs to a build
+    that never mentions the preconditioner -- single-chip and
+    distributed (the telemetry/faults/perfmodel disarmament contract,
+    extended to the PCG tier)."""
+    from acg_tpu.io.generators import poisson2d_coo as _p2
+    from acg_tpu.matrix import SymCsrMatrix as _S
+    from acg_tpu.ops.spmv import device_matrix_from_csr
+    from acg_tpu.solvers.jax_cg import JaxCGSolver
+
+    r, c, v, N = _p2(12)
+    csr = _S.from_coo(N, r, c, v).to_csr()
+    b1 = np.ones(N)
+    A = device_matrix_from_csr(csr, dtype=jnp.float64)
+    plain = JaxCGSolver(A, kernels="xla").lower_solve(b1).as_text()
+    none = JaxCGSolver(A, kernels="xla",
+                       precond="none").lower_solve(b1).as_text()
+    armed = JaxCGSolver(A, kernels="xla",
+                        precond="jacobi").lower_solve(b1).as_text()
+    assert none == plain
+    assert armed != plain
+
+    b2 = np.ones(prob.n)
+    d_plain = DistCGSolver(prob).lower_solve(b2).as_text()
+    d_none = DistCGSolver(prob, precond="none").lower_solve(b2).as_text()
+    assert d_none == d_plain
+
+
+def test_pcg_collective_counts(prob):
+    """PCG keeps the tiers' communication structure: the classic loop
+    still runs 2 in-loop allreduces (the second FUSES (r, z) with
+    (r, r)), the pipelined loop keeps its SINGLE fused in-loop
+    allreduce (now 3 scalars), and cheby:K adds exactly K halo'd SpMVs
+    per apply site (setup + loop = 2K extra all_to_alls)."""
+    b = np.ones(prob.n)
+
+    def counts(pipelined, pc):
+        s = DistCGSolver(prob, pipelined=pipelined, precond=pc)
+        return _counts(s.lower_solve(b).as_text())[:2]
+
+    # jacobi/bjacobi: zero extra collectives anywhere
+    assert counts(False, "jacobi") == (5, 2)
+    assert counts(True, "jacobi") == (5, 3)
+    assert counts(False, "bjacobi:16") == (5, 2)
+    assert counts(True, "bjacobi:16") == (5, 3)
+    # cheby:2 -> 2 apply sites x 2 SpMVs, allreduce count unchanged
+    assert counts(False, "cheby:2") == (5, 2 + 4)
+    assert counts(True, "cheby:2") == (5, 3 + 4)
+
+
 # -- perfmodel tier: disarmed observability changes NOTHING ---------------
 
 def test_lower_solve_is_the_dispatched_program(prob):

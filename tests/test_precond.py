@@ -1,0 +1,444 @@
+"""Preconditioning subsystem (acg_tpu.precond): apply-level unit tests
+against scipy references, SPD preservation, Chebyshev spectral-estimate
+bounds, single-device <-> 8-part dist parity, the anisotropic-Poisson
+acceptance criterion (>= 2x iteration reduction for jacobi and cheby:4),
+and restart-after-breakdown with preconditioner state rebuild."""
+
+import numpy as np
+import pytest
+import scipy.linalg as sla
+import scipy.sparse as sp
+
+import jax.numpy as jnp
+
+from acg_tpu import faults, precond
+from acg_tpu.io.generators import aniso_poisson2d_coo, poisson2d_coo
+from acg_tpu.matrix import SymCsrMatrix
+from acg_tpu.ops.spmv import device_matrix_from_csr, matrix_diagonal, spmv
+from acg_tpu.parallel.dist import DistCGSolver, DistributedProblem
+from acg_tpu.partition import partition_rows
+from acg_tpu.solvers.host_cg import HostCGSolver
+from acg_tpu.solvers.jax_cg import JaxCGSolver
+from acg_tpu.solvers.resilience import RecoveryPolicy
+from acg_tpu.solvers.stats import StoppingCriteria
+
+
+def _csr(n=12, aniso=None):
+    if aniso is None:
+        r, c, v, N = poisson2d_coo(n)
+    else:
+        r, c, v, N = aniso_poisson2d_coo(n, aniso)
+    return SymCsrMatrix.from_coo(N, r, c, v).to_csr()
+
+
+# -- spec parsing ---------------------------------------------------------
+
+def test_parse_precond():
+    assert precond.parse_precond(None) is None
+    assert precond.parse_precond("none") is None
+    assert precond.parse_precond("jacobi").kind == "jacobi"
+    s = precond.parse_precond("bjacobi:8")
+    assert (s.kind, s.block) == ("bjacobi", 8)
+    assert precond.parse_precond("bjacobi").block == precond.DEFAULT_BLOCK
+    s = precond.parse_precond("cheby:4")
+    assert (s.kind, s.degree) == ("cheby", 4)
+    assert str(s) == "cheby:4"
+    for bad in ("chebyshev", "cheby", "cheby:x", "cheby:0", "jacobi:3",
+                "bjacobi:0", "bjacobi:9999", "nope"):
+        with pytest.raises(ValueError):
+            precond.parse_precond(bad)
+
+
+# -- apply-level unit tests vs the scipy reference ------------------------
+
+def test_matrix_diagonal_all_formats():
+    csr = _csr(7, aniso=0.1)
+    want = csr.diagonal()
+    for fmt in ("dia", "ell", "coo", "bell"):
+        A = device_matrix_from_csr(csr, dtype=jnp.float64, format=fmt)
+        got = np.asarray(matrix_diagonal(A))
+        np.testing.assert_allclose(got, want, rtol=1e-14,
+                                   err_msg=fmt)
+
+
+@pytest.mark.parametrize("kind", ["jacobi", "bjacobi:8", "cheby:3"])
+@pytest.mark.parametrize("fmt", ["dia", "ell"])
+def test_device_apply_matches_host_reference(kind, fmt):
+    """The traced device apply must agree with the eager numpy/scipy
+    twin (HostPrecond) on the same matrix and vector."""
+    csr = _csr(9, aniso=0.2)
+    n = csr.shape[0]
+    spec = precond.parse_precond(kind)
+    A = device_matrix_from_csr(csr, dtype=jnp.float64, format=fmt)
+    mstate = precond.setup_single(spec, A, spmv, jnp.float64)
+    apply_fn = precond.make_apply(spec, spmv)
+    rng = np.random.default_rng(3)
+    r = rng.standard_normal(n)
+    z_dev = np.asarray(apply_fn(mstate, A, jnp.asarray(r)))
+
+    host = precond.HostPrecond(spec, csr)
+    if spec.kind == "cheby":
+        # pin the host twin to the device interval so the polynomials
+        # are identical (their lambda estimates differ by rng stream)
+        host.state = (float(mstate[0]), float(mstate[1]))
+    z_host = host.apply(r)
+    np.testing.assert_allclose(z_dev, z_host, rtol=1e-10, atol=1e-12)
+
+
+def test_bjacobi_apply_vs_scipy_cho_solve():
+    """Block solves agree with an explicit scipy cho_solve over the
+    dense diagonal blocks (including the ragged final block)."""
+    csr = _csr(5, aniso=0.3)      # n = 25, bs = 8 -> ragged last block
+    n = csr.shape[0]
+    bs = 8
+    spec = precond.parse_precond(f"bjacobi:{bs}")
+    A = device_matrix_from_csr(csr, dtype=jnp.float64)
+    mstate = precond.setup_single(spec, A, spmv, jnp.float64)
+    apply_fn = precond.make_apply(spec, spmv)
+    rng = np.random.default_rng(0)
+    r = rng.standard_normal(n)
+    z = np.asarray(apply_fn(mstate, A, jnp.asarray(r)))
+    dense = csr.toarray()
+    want = np.zeros(n)
+    for lo in range(0, n, bs):
+        hi = min(lo + bs, n)
+        blk = dense[lo:hi, lo:hi]
+        want[lo:hi] = sla.cho_solve((sla.cholesky(blk, lower=True), True),
+                                    r[lo:hi])
+    np.testing.assert_allclose(z, want, rtol=1e-10, atol=1e-12)
+
+
+@pytest.mark.parametrize("kind", ["jacobi", "bjacobi:4", "cheby:3"])
+def test_spd_preservation(kind):
+    """M^-1 (the operator the applies implement) must be symmetric
+    positive definite -- PCG's correctness precondition."""
+    csr = _csr(4, aniso=0.2)      # n = 16: dense operator is cheap
+    n = csr.shape[0]
+    spec = precond.parse_precond(kind)
+    A = device_matrix_from_csr(csr, dtype=jnp.float64)
+    mstate = precond.setup_single(spec, A, spmv, jnp.float64)
+    apply_fn = precond.make_apply(spec, spmv)
+    M = np.column_stack([
+        np.asarray(apply_fn(mstate, A, jnp.asarray(e)))
+        for e in np.eye(n)])
+    np.testing.assert_allclose(M, M.T, rtol=1e-10, atol=1e-12)
+    assert np.linalg.eigvalsh(M).min() > 0
+
+
+def test_cheby_lambda_estimate_bounds():
+    """The power-iteration lambda_max lands inside a known band: it can
+    only UNDERestimate the true largest eigenvalue, and 24 iterations
+    from a random start get well past 70% of it; the state builder's
+    interval then pads by CHEBY_SAFETY."""
+    csr = _csr(24)
+    A = device_matrix_from_csr(csr, dtype=jnp.float64)
+    est = float(precond.estimate_lmax(spmv, A, A.nrows, jnp.float64))
+    true = float(sp.linalg.eigsh(csr, k=1, which="LA",
+                                 return_eigenvectors=False)[0])
+    assert 0.7 * true <= est <= true * (1 + 1e-9)
+    lmin, lmax = precond.cheby_state(est, jnp.float64)
+    assert float(lmax) == pytest.approx(est * precond.CHEBY_SAFETY)
+    assert float(lmin) == pytest.approx(float(lmax) / precond.CHEBY_RATIO)
+
+
+# -- the anisotropic generator -------------------------------------------
+
+def test_aniso_generator_spd_and_limits():
+    r, c, v, N = aniso_poisson2d_coo(10, 0.05)
+    A = sp.csr_matrix((v, (r, c)), shape=(N, N))
+    assert abs(A - A.T).max() < 1e-14
+    assert float(sp.linalg.eigsh(A, k=1, which="SA",
+                                 return_eigenvectors=False)[0]) > 0
+    # the diagonal VARIES (the property that makes Jacobi non-trivial
+    # here, unlike the constant-diagonal uniform stencil)
+    d = A.diagonal()
+    assert d.max() / d.min() > 5.0
+    # eps = 1 degenerates to the uniform 5-point Poisson matrix
+    r1, c1, v1, _ = aniso_poisson2d_coo(10, 1.0)
+    r0, c0, v0, _ = poisson2d_coo(10)
+    A1 = sp.csr_matrix((v1, (r1, c1)), shape=(N, N))
+    A0 = sp.csr_matrix((v0, (r0, c0)), shape=(N, N))
+    assert abs(A1 - A0).max() < 1e-12
+    with pytest.raises(ValueError):
+        aniso_poisson2d_coo(10, 0.0)
+
+
+# -- solver integration: parity and acceptance ---------------------------
+
+@pytest.fixture(scope="module")
+def aniso256():
+    return _csr(256, aniso=0.01)
+
+
+@pytest.mark.parametrize("kind", ["jacobi", "cheby:4"])
+def test_acceptance_2x_single_device(aniso256, kind):
+    """The PR's acceptance bullet, single-device half: on the
+    anisotropic generator (eps = 0.01, n = 256^2), jacobi and cheby:4
+    each cut iterations-to-tolerance by >= 2x vs unpreconditioned CG."""
+    csr = aniso256
+    b = np.ones(csr.shape[0])
+    A = device_matrix_from_csr(csr, dtype=jnp.float64)
+    s = JaxCGSolver(A, precond=kind)
+    s.solve(b, criteria=StoppingCriteria(maxits=2500, residual_rtol=1e-6))
+    it_pc = s.stats.niterations
+    assert s.stats.converged
+    # the >= 2x claim without paying for the full unpreconditioned
+    # solve: at TWICE the preconditioned count, plain CG is still short
+    cap = 2 * it_pc + 1
+    s0 = JaxCGSolver(device_matrix_from_csr(csr, dtype=jnp.float64))
+    s0.solve(b, criteria=StoppingCriteria(maxits=cap, residual_rtol=1e-6),
+             raise_on_divergence=False)
+    assert not s0.stats.converged, (it_pc, s0.stats.niterations)
+
+
+def test_acceptance_2x_dist_8(aniso256):
+    """The acceptance bullet's 8-device half (jacobi; cheby covered by
+    the parity test below): >= 2x on the dist tier too."""
+    csr = aniso256
+    b = np.ones(csr.shape[0])
+    part = partition_rows(csr, 8, seed=0, method="band")
+    prob = DistributedProblem.build(csr, part, 8, dtype=jnp.float64)
+    s = DistCGSolver(prob, precond="jacobi")
+    s.solve(b, criteria=StoppingCriteria(maxits=2500, residual_rtol=1e-6))
+    it_pc = s.stats.niterations
+    assert s.stats.converged
+    prob0 = DistributedProblem.build(csr, part, 8, dtype=jnp.float64)
+    s0 = DistCGSolver(prob0)
+    s0.solve(b, criteria=StoppingCriteria(maxits=2 * it_pc + 1,
+                                          residual_rtol=1e-6),
+             raise_on_divergence=False)
+    assert not s0.stats.converged
+
+
+@pytest.mark.parametrize("kind", ["jacobi", "cheby:4"])
+@pytest.mark.parametrize("pipelined", [False, True])
+def test_dist_parity_with_single_device(kind, pipelined):
+    """8-part mesh PCG matches the single-device tier: same iteration
+    count (+- a rounding iteration) and the same solution."""
+    csr = _csr(48, aniso=0.05)
+    b = np.ones(csr.shape[0])
+    crit = StoppingCriteria(maxits=2000, residual_rtol=1e-7)
+    A = device_matrix_from_csr(csr, dtype=jnp.float64)
+    s1 = JaxCGSolver(A, pipelined=pipelined, precond=kind)
+    x1 = s1.solve(b, criteria=crit)
+    part = partition_rows(csr, 8, seed=0, method="band")
+    prob = DistributedProblem.build(csr, part, 8, dtype=jnp.float64)
+    s8 = DistCGSolver(prob, pipelined=pipelined, precond=kind)
+    x8 = s8.solve(b, criteria=crit)
+    assert abs(s1.stats.niterations - s8.stats.niterations) <= 2
+    np.testing.assert_allclose(x8, x1, rtol=1e-5, atol=1e-8)
+
+
+def test_bjacobi_dist_blocks_are_local(monkeypatch):
+    """Dist block-Jacobi factors each part's LOCAL diagonal block:
+    converges to the right answer on the 8-part mesh (block content
+    differs from the single-device factorization by construction)."""
+    csr = _csr(32, aniso=0.05)
+    rng = np.random.default_rng(1)
+    xsol = rng.standard_normal(csr.shape[0])
+    b = csr @ xsol
+    part = partition_rows(csr, 8, seed=0, method="band")
+    prob = DistributedProblem.build(csr, part, 8, dtype=jnp.float64)
+    s = DistCGSolver(prob, precond="bjacobi:16")
+    x = s.solve(b, criteria=StoppingCriteria(maxits=4000,
+                                             residual_rtol=1e-9))
+    np.testing.assert_allclose(x, xsol, rtol=1e-6, atol=1e-7)
+
+
+def test_host_pcg_matches_device_iterations():
+    """The eager host PCG is the device loop's oracle: identical
+    update order -> identical iteration counts on f64."""
+    csr = _csr(24, aniso=0.05)
+    b = np.ones(csr.shape[0])
+    crit = StoppingCriteria(maxits=2000, residual_rtol=1e-8)
+    for kind in ("jacobi", "bjacobi:16", "cheby:3"):
+        hs = HostCGSolver(csr, precond=kind)
+        hs.solve(b, criteria=crit)
+        A = device_matrix_from_csr(csr, dtype=jnp.float64)
+        ds = JaxCGSolver(A, precond=kind)
+        ds.solve(b, criteria=crit)
+        assert abs(hs.stats.niterations - ds.stats.niterations) <= 1, kind
+        assert hs.stats.ops["precond"].n > 0
+        if hs.stats.niterations == ds.stats.niterations:
+            # host and device bill the SAME op census (cheby counts
+            # its degree-many SpMVs per apply on both)
+            assert hs.stats.ops["precond"].n == \
+                ds.stats.ops["precond"].n, kind
+
+
+# -- stats / accounting ---------------------------------------------------
+
+def test_precond_op_counter_and_section():
+    csr = _csr(16, aniso=0.1)
+    b = np.ones(csr.shape[0])
+    A = device_matrix_from_csr(csr, dtype=jnp.float64)
+    s = JaxCGSolver(A, precond="cheby:2")
+    s.solve(b, criteria=StoppingCriteria(maxits=500, residual_rtol=1e-7))
+    st = s.stats
+    nappl = st.niterations + 1
+    # cheby bills degree-many SpMVs per apply (the satellite's contract)
+    assert st.ops["precond"].n == 2 * nappl
+    assert st.ops["precond"].bytes > 0
+    assert st.precond["kind"] == "cheby:2"
+    assert st.precond["applies"] == nappl
+    assert st.precond["lambda_max"] > st.precond["lambda_min"] > 0
+    # the section renders (append-only) and round-trips the JSON twin
+    txt = st.fwrite()
+    assert "precond:" in txt and "  precond:" in txt
+    assert st.to_dict()["precond"]["applies"] == nappl
+    # ... and an UNpreconditioned report still has no precond row at all
+    s0 = JaxCGSolver(device_matrix_from_csr(csr, dtype=jnp.float64))
+    s0.solve(b, criteria=StoppingCriteria(maxits=500,
+                                          residual_rtol=1e-7))
+    assert "precond" not in s0.stats.fwrite()
+
+
+def test_comm_profile_reclassifies_for_precond():
+    csr = _csr(16)
+    part = partition_rows(csr, 4, seed=0, method="band")
+    prob = DistributedProblem.build(csr, part, 4, dtype=jnp.float64)
+    base = DistCGSolver(prob).comm_profile()
+    led = DistCGSolver(prob, precond="cheby:3").comm_profile()
+    assert led["halo_exchanges_per_iteration"] == 4   # 1 + degree
+    assert led["halo_bytes_per_iteration"] == \
+        4 * base["halo_bytes_per_iteration"]
+    assert led["precond"]["kind"] == "cheby:3"
+    ledj = DistCGSolver(prob, precond="jacobi").comm_profile()
+    # jacobi moves NOTHING extra -- the whole point
+    assert ledj["halo_bytes_per_iteration"] == \
+        base["halo_bytes_per_iteration"]
+    assert ledj["allreduce_per_iteration"] == 2
+    # classic PCG moves 3 scalars per iteration total (1 + the fused
+    # 2): bytes bill the TOTAL, not reductions x widest payload
+    assert ledj["allreduce_bytes_per_iteration"] == 3 * 8
+    ledp = DistCGSolver(prob, pipelined=True,
+                        precond="jacobi").comm_profile()
+    assert ledp["allreduce_per_iteration"] == 1
+    assert ledp["allreduce_bytes_per_iteration"] == 3 * 8
+
+
+def test_host_device_precond_trace_parity():
+    """The eager recorder's rnrm2 slot carries the PRECONDITIONED norm
+    under precond, exactly like the compiled rings (the eager-twin
+    contract the telemetry tier documents)."""
+    csr = _csr(16, aniso=0.1)
+    b = np.ones(csr.shape[0])
+    crit = StoppingCriteria(maxits=400, residual_rtol=1e-7)
+    hs = HostCGSolver(csr, precond="jacobi", trace=64)
+    hs.solve(b, criteria=crit)
+    A = device_matrix_from_csr(csr, dtype=jnp.float64)
+    ds = JaxCGSolver(A, precond="jacobi", trace=64)
+    ds.solve(b, criteria=crit)
+    m = min(hs.last_trace.records.shape[0], ds.last_trace.records.shape[0])
+    np.testing.assert_allclose(hs.last_trace.records[:m, 0],
+                               ds.last_trace.records[:m, 0],
+                               rtol=1e-6)
+
+
+# -- faults + resilience --------------------------------------------------
+
+def test_precond_fault_refused_without_precond():
+    csr = _csr(8)
+    A = device_matrix_from_csr(csr, dtype=jnp.float64)
+    s = JaxCGSolver(A)
+    with faults.injected("precond:nan@2"):
+        with pytest.raises(Exception, match="armed preconditioner"):
+            s.solve(np.ones(csr.shape[0]),
+                    criteria=StoppingCriteria(maxits=50,
+                                              residual_rtol=1e-6))
+
+
+@pytest.mark.parametrize("pipelined", [False, True])
+def test_precond_fault_triggers_recovery(pipelined):
+    """A poisoned z = M^-1 r drives (r, z) non-finite: the breakdown
+    path fires, the restart (fault consumed) converges, and the state
+    is PRESERVED (it was never corrupted)."""
+    csr = _csr(16, aniso=0.1)
+    b = np.ones(csr.shape[0])
+    A = device_matrix_from_csr(csr, dtype=jnp.float64)
+    s = JaxCGSolver(A, pipelined=pipelined, precond="jacobi",
+                    recovery=RecoveryPolicy(max_restarts=2))
+    with faults.injected("precond:nan@3"):
+        s.solve(b, criteria=StoppingCriteria(maxits=1000,
+                                             residual_rtol=1e-7))
+    st = s.stats
+    assert st.converged
+    assert st.nbreakdowns >= 1 and st.nrestarts >= 1
+    assert any("preserved across restart" in ev for ev in st.recovery_log)
+
+
+def test_restart_rebuilds_poisoned_state():
+    """The state-rebuild rung: a non-finite preconditioner state (here
+    poisoned by hand) breaks the first attempt down at setup; recovery
+    detects the non-finite state, rebuilds it from the matrix, and the
+    restart converges."""
+    csr = _csr(16, aniso=0.1)
+    b = np.ones(csr.shape[0])
+    A = device_matrix_from_csr(csr, dtype=jnp.float64)
+    s = JaxCGSolver(A, precond="jacobi",
+                    recovery=RecoveryPolicy(max_restarts=2))
+    s._ensure_precond_state()
+    s._mstate = (s._mstate[0].at[0].set(jnp.nan),)
+    s.solve(b, criteria=StoppingCriteria(maxits=1000,
+                                         residual_rtol=1e-7))
+    st = s.stats
+    assert st.converged
+    assert st.nrestarts >= 1
+    assert any("rebuilt from the matrix" in ev for ev in st.recovery_log)
+    assert precond.state_finite(s._mstate)
+
+
+def test_dist_precond_fault_and_recovery():
+    csr = _csr(16, aniso=0.1)
+    b = np.ones(csr.shape[0])
+    part = partition_rows(csr, 4, seed=0, method="band")
+    prob = DistributedProblem.build(csr, part, 4, dtype=jnp.float64)
+    s = DistCGSolver(prob, precond="jacobi",
+                     recovery=RecoveryPolicy(max_restarts=2))
+    with faults.injected("precond:inf@2"):
+        s.solve(b, criteria=StoppingCriteria(maxits=1000,
+                                             residual_rtol=1e-7))
+    assert s.stats.converged
+    assert s.stats.nbreakdowns >= 1 and s.stats.nrestarts >= 1
+
+
+def test_host_pcg_restart_rebuild():
+    csr = _csr(12, aniso=0.1)
+    b = np.ones(csr.shape[0])
+    s = HostCGSolver(csr, precond="jacobi",
+                     recovery=RecoveryPolicy(max_restarts=2))
+    with faults.injected("precond:nan@2"):
+        s.solve(b, criteria=StoppingCriteria(maxits=1000,
+                                             residual_rtol=1e-7))
+    assert s.stats.converged
+    assert s.stats.nrestarts >= 1
+
+
+# -- configuration refusals ----------------------------------------------
+
+def test_precond_config_refusals():
+    csr = _csr(8)
+    A = device_matrix_from_csr(csr, dtype=jnp.bfloat16)
+    with pytest.raises(ValueError, match="replace_every"):
+        JaxCGSolver(A, precond="jacobi", replace_every=10)
+    part = partition_rows(csr, 2, seed=0, method="band")
+    prob = DistributedProblem.build(csr, part, 2, dtype=jnp.bfloat16,
+                                    vector_dtype=jnp.bfloat16)
+    with pytest.raises(ValueError, match="replace_every"):
+        DistCGSolver(prob, precond="jacobi", replace_every=10)
+
+
+# -- bench-diff case keys -------------------------------------------------
+
+def test_precond_joins_the_case_key():
+    from acg_tpu.perfmodel import _doc_case, _row_case
+
+    row = {"metric": "m", "value": 5.0}
+    assert _row_case(row)[0] == "m"
+    assert _row_case({**row, "precond": "cheby:4"})[0] == \
+        "m|precond=cheby:4"
+    doc = {"manifest": {"metric": "m", "precond": "jacobi"},
+           "stats": {"tsolve": 1.0, "niterations": 10}}
+    assert _doc_case(doc)[0] == "m|precond=jacobi"
+    doc["manifest"].pop("precond")
+    assert _doc_case(doc)[0] == "m"
