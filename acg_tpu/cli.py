@@ -171,6 +171,39 @@ def make_parser() -> argparse.ArgumentParser:
                         "(0, 1]; the diagonal then varies by ~1/EPS, "
                         "the ill-conditioned SPD family where "
                         "--precond measurably cuts iterations")
+    p.add_argument("--audit-every", type=int, default=0, metavar="K",
+                   help="numerical-health tier (acg_tpu.health): every "
+                        "K iterations the compiled solve loop "
+                        "recomputes the TRUE residual b - Ax through "
+                        "the tier's own SpMV/halo machinery and "
+                        "carries the relative gap ||r_true - r_rec||/"
+                        "||b|| -- the drift pipelined CG trades for "
+                        "hidden latency.  The gap lands in a 'health:' "
+                        "stats section, the acg_health_* metrics, and "
+                        "(with --convergence-log) a 'gap' column in "
+                        "the trace.  0 (default) compiles the "
+                        "byte-identical unaudited programs")
+    p.add_argument("--gap-threshold", type=float, default=0.0,
+                   metavar="G",
+                   help="with --audit-every: a relative gap above G "
+                        "emits a structured accuracy_degraded event "
+                        "and drives --on-gap (default 0: record-only)")
+    p.add_argument("--on-gap", default="warn",
+                   choices=["warn", "replace", "abort"],
+                   help="what a gap past --gap-threshold does: warn = "
+                        "event only; replace = exit the loop through "
+                        "the breakdown path and let the recovery "
+                        "driver restart from the recomputed true "
+                        "residual (a residual-replacement restart; "
+                        "restarts bounded by --max-restarts); abort = "
+                        "fail the solve (default: warn)")
+    p.add_argument("--stall-window", type=int, default=0, metavar="N",
+                   help="device-side stagnation detector: N "
+                        "consecutive iterations without residual "
+                        "decrease exit through the breakdown path "
+                        "(with --recover: bounded restarts; default: "
+                        "off).  Arms the dot-product sign-anomaly "
+                        "guards too")
     p.add_argument("--precise-dots", action="store_true",
                    help="compensated (double-float) dot products for the "
                         "CG scalars; lets f32 storage converge past the "
@@ -412,6 +445,17 @@ def _buildinfo(out) -> int:
          f"(EWMA latency-drift gate, exit 7; bench.py --soak too); "
          f"registry snapshot ('metrics') and soak report ('soak') "
          f"ride the {STATS_SCHEMA} stats twin"),
+        ("numerical health", f"--audit-every K (in-loop true-residual "
+         f"audit through each tier's own SpMV; relative gap in the "
+         f"'health' stats section + acg_health_* metrics + a 'gap' "
+         f"trace column), --gap-threshold G + --on-gap "
+         f"warn|replace|abort (accuracy_degraded events; replace = "
+         f"residual-replacement restart via the recovery driver), "
+         f"--stall-window N (device-side stagnation/sign detectors); "
+         f"Lanczos kappa estimate + predicted-vs-measured iterations "
+         f"from the recorded (alpha, beta) in 'health' and the "
+         f"--explain convergence verdict; soak tracks gap drift; "
+         f"schema {STATS_SCHEMA}"),
     ]
     for k, v in rows:
         out.write(f"{k}: {v}\n")
@@ -555,7 +599,8 @@ def _solve_generated_direct(args, dim, n, N, jax, jnp, dtype,
                              replace_every=args.replace_every,
                              recovery=getattr(args, "_recovery", None),
                              trace=args._trace, progress=args.progress,
-                             precond=getattr(args, "_precond", None))
+                             precond=getattr(args, "_precond", None),
+                             health=getattr(args, "_health", None))
     except ValueError as e:
         raise SystemExit(f"acg-tpu: {e}")
     b = jnp.ones(N, dtype=vec_dtype)
@@ -621,21 +666,57 @@ def _run_solve(args, solver, b, *, x0=None, criteria=None, warmup=None,
     them all.  The soak report lands on ``solver.stats.soak`` (the
     ``soak:`` stats section and its ``--stats-json`` twin) and on
     ``args._soak_report`` for the ``--fail-on-drift`` exit gate."""
+    # the spectrum attach runs in a finally: a not-converged or
+    # broken-down exit still gets its kappa estimate next to the
+    # health: section -- that is exactly when it matters
     if not getattr(args, "soak", 0):
         if warmup is not None:
             solve_kwargs["warmup"] = warmup
-        return solver.solve(b, x0=x0, criteria=criteria, **solve_kwargs)
+        try:
+            return solver.solve(b, x0=x0, criteria=criteria,
+                                **solve_kwargs)
+        finally:
+            _attach_health_spectrum(args, solver)
     from acg_tpu.soak import run_soak
 
-    x, report = run_soak(
-        solver, b, nsolves=args.soak, x0=x0, criteria=criteria,
-        fail_on_drift=args.fail_on_drift,
-        first_solve_kwargs=({"warmup": warmup} if warmup is not None
-                            else None),
-        solve_kwargs=solve_kwargs,
-        progress_every=(max(1, args.soak // 10) if args.verbose else 0))
+    try:
+        x, report = run_soak(
+            solver, b, nsolves=args.soak, x0=x0, criteria=criteria,
+            fail_on_drift=args.fail_on_drift,
+            first_solve_kwargs=({"warmup": warmup} if warmup is not None
+                                else None),
+            solve_kwargs=solve_kwargs,
+            progress_every=(max(1, args.soak // 10) if args.verbose
+                            else 0))
+    finally:
+        _attach_health_spectrum(args, solver)
     args._soak_report = report
     return x
+
+
+def _attach_health_spectrum(args, solver) -> None:
+    """Post-hoc spectrum estimation (the numerical-health tier): with
+    an armed health spec AND a recorded trace, rebuild the Lanczos
+    tridiagonal from the solve's (alpha, beta) window and attach the
+    kappa / predicted-iterations report to the ``health:`` section.
+    Free: the scalars were already recorded."""
+    hs = getattr(args, "_health", None)
+    if hs is None:
+        return
+    from acg_tpu import health as health_mod
+    inner = _inner_solver(solver)
+    trace = getattr(inner, "last_trace", None)
+    if trace is None:
+        return
+    pc = getattr(args, "_precond", None)
+    try:
+        health_mod.attach_spectrum(
+            inner.stats, trace, args.residual_rtol,
+            precond=str(pc) if pc is not None else None)
+    except Exception as e:  # noqa: BLE001 -- health reporting must
+        # never sink a solve that succeeded
+        sys.stderr.write(f"acg-tpu: spectrum estimation failed "
+                         f"({type(e).__name__}: {e})\n")
 
 
 def _checkpoint(args, stage: str, code: int = 0) -> int:
@@ -955,7 +1036,8 @@ def _solve_distributed_read(args, jax, jnp, dtype, vec_dtype) -> int:
                               replace_every=args.replace_every,
                               recovery=getattr(args, "_recovery", None),
                               trace=args._trace, progress=args.progress,
-                              precond=getattr(args, "_precond", None))
+                              precond=getattr(args, "_precond", None),
+                              health=getattr(args, "_health", None))
     except ValueError as e:
         sys.stderr.write(f"acg-tpu: {e}\n")
         _checkpoint(args, "solve", 1)
@@ -1376,7 +1458,8 @@ def _solve_generated_sharded(args, dim, n, N, jax, jnp, dtype,
             replace_every=args.replace_every, kernels=sharded_kernels,
             recovery=getattr(args, "_recovery", None),
             trace=args._trace, progress=args.progress,
-            precond=getattr(args, "_precond", None))
+            precond=getattr(args, "_precond", None),
+            health=getattr(args, "_health", None))
     except ValueError as e:
         raise SystemExit(f"acg-tpu: {e}")
     _log(args, f"assemble sharded DIA planes on device ({nparts} parts):",
@@ -1593,6 +1676,9 @@ def _main(args) -> int:
             ("-o/--output", args.output is not None),
             ("--profile-ops", args.profile_ops is not None),
             ("--output-comm-matrix", args.output_comm_matrix),
+            ("--audit-every (--explain computes its own convergence "
+             "verdict from the host oracle)", args.audit_every > 0),
+            ("--stall-window", args.stall_window > 0),
         ] if on]
         if ignored:
             raise SystemExit(
@@ -1627,6 +1713,38 @@ def _main(args) -> int:
             raise SystemExit(
                 f"acg-tpu: --precond {args.precond} does not support: "
                 f"{', '.join(unsupported)}")
+    # numerical-health tier (acg_tpu.health): validate the spec BEFORE
+    # anything expensive; refuse configurations where an armed audit
+    # could never run (the fault-injector / precond discipline)
+    from acg_tpu import health as _health_mod
+    if args.gap_threshold and not args.audit_every:
+        raise SystemExit(
+            "acg-tpu: --gap-threshold needs --audit-every K (the "
+            "threshold judges audit gaps; without an audit it could "
+            "never fire)")
+    try:
+        args._health = _health_mod.make_spec(
+            args.audit_every, args.gap_threshold, args.on_gap,
+            args.stall_window)
+    except ValueError as e:
+        raise SystemExit(f"acg-tpu: {e}")
+    if args._health is not None:
+        unsupported = [flag for flag, on in [
+            (f"--solver {args.solver} (the external oracles have no "
+             f"audit hooks)",
+             args.solver in ("host-native", "petsc")),
+            ("--replace-every (the replacement segments already "
+             "recompute b - Ax every K iterations)",
+             args.replace_every > 0),
+            ("--kernels fused (the two-phase kernels fold the whole "
+             "iteration; no audit hook)", args.kernels == "fused"),
+            ("--refine (the refinement outer loop already recomputes "
+             "f64 true residuals every pass)", args.refine),
+        ] if on]
+        if unsupported:
+            raise SystemExit(
+                f"acg-tpu: --audit-every/--stall-window do not "
+                f"support: {', '.join(unsupported)}")
     if args.aniso is not None:
         if not 0.0 < args.aniso <= 1.0:
             raise SystemExit("acg-tpu: --aniso EPS must be in (0, 1]")
@@ -1755,7 +1873,13 @@ def _main(args) -> int:
                 f"init\n")
             return 3
 
-    if args.recover or args.fault_inject:
+    # --on-gap replace rides the same recovery machinery as --recover:
+    # the gap trip exits through the breakdown path and the driver
+    # restarts from the recomputed true residual (the residual-
+    # replacement restart), so a policy must exist
+    gap_replace = (args._health is not None
+                   and args._health.action == "replace")
+    if args.recover or args.fault_inject or gap_replace:
         from acg_tpu.solvers.resilience import RecoveryPolicy
         recovery = RecoveryPolicy(max_restarts=max(args.max_restarts, 0),
                                   backoff=max(args.restart_backoff, 0.0),
@@ -2024,6 +2148,13 @@ def _main(args) -> int:
                         ErrorCode.INVALID_VALUE,
                         "--precond has no hooks in the multi-part host "
                         "solver; use --nparts 1 or the device solvers")
+                if args._health is not None:
+                    # an armed audit that could never run (same rule)
+                    raise AcgError(
+                        ErrorCode.INVALID_VALUE,
+                        "--audit-every/--stall-window have no hooks in "
+                        "the multi-part host solver; use --nparts 1 or "
+                        "the device solvers")
                 if args._recovery is not None:
                     sys.stderr.write(
                         "acg-tpu: warning: --recover has no effect on "
@@ -2039,7 +2170,8 @@ def _main(args) -> int:
                 solver = HostCGSolver(csr, recovery=args._recovery,
                                       trace=args._trace,
                                       progress=args.progress,
-                                      precond=args._precond)
+                                      precond=args._precond,
+                                      health=args._health)
             x = _run_solve(args, solver, b, x0=x0, criteria=criteria)
         elif args.solver == "petsc":
             # external cross-implementation oracle (the KSPCG role,
@@ -2060,7 +2192,8 @@ def _main(args) -> int:
                                      host_matrix=csr,
                                      trace=args._trace,
                                      progress=args.progress,
-                                     precond=args._precond)
+                                     precond=args._precond,
+                                     health=args._health)
             except ValueError as e:
                 raise SystemExit(f"acg-tpu: {e}")
             if args.refine:
@@ -2096,7 +2229,8 @@ def _main(args) -> int:
                                       recovery=args._recovery,
                                       trace=args._trace,
                                       progress=args.progress,
-                                      precond=args._precond)
+                                      precond=args._precond,
+                                      health=args._health)
             except ValueError as e:
                 raise SystemExit(f"acg-tpu: {e}")
             if args.refine:

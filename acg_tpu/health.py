@@ -1,0 +1,482 @@
+"""Numerical health observatory: in-loop true-residual audits, Lanczos
+spectrum estimation, and accuracy gates across the solver tiers.
+
+Pipelined CG trades attainable accuracy for hidden latency: the
+recursively-updated residual drifts away from the true residual
+``b - A x`` as rounding accumulates through the extra recurrences, and
+the drift grows with pipeline depth (Cornelis & Vanroose,
+arXiv:1801.04728; the global-reduction-pipelined variants of
+arXiv:1905.06850 inherit the same trade).  Nothing in the existing
+observability stack (telemetry ring, cost model, service metrics)
+watches *numerical* health -- a solve can report ``converged`` from a
+recurrence residual that no longer resembles ``b - A x``.  This module
+closes that gap with three layers:
+
+1. **In-loop true-residual audit** (``--audit-every K``): every K
+   iterations the compiled loop recomputes ``b - A x`` through the
+   tier's OWN SpMV/halo machinery and carries the relative gap
+   ``||r_true - r_rec|| / ||b||`` in a small audit vector riding the
+   loop carry (and, when telemetry is armed, an extra ``gap`` column in
+   the convergence ring).  A gap past ``--gap-threshold`` emits a
+   structured ``accuracy_degraded`` event; ``--on-gap replace`` exits
+   the loop through the breakdown path so the existing
+   :class:`~acg_tpu.solvers.resilience.RecoveryDriver` restarts from
+   the recomputed true residual -- a residual-replacement restart --
+   and ``--on-gap abort`` raises.  Disarmed (the default) every tier's
+   lowered program is byte-identical (static jit argument, the
+   telemetry/faults/precond discipline; pinned in
+   tests/test_hlo_structure.py).
+
+2. **Post-hoc spectrum estimation**: the telemetry ring already records
+   the per-iteration ``(alpha, beta)`` CG coefficients, which ARE the
+   entries of the Lanczos tridiagonal ``T_k`` of the (preconditioned)
+   operator.  :func:`spectrum_estimate` rebuilds ``T_k``, reports
+   estimated extremal eigenvalues and ``kappa(M^-1 A)``, and
+   :func:`predicted_iterations` turns the classical CG error bound into
+   a predicted-vs-measured iteration verdict (the ``--explain``
+   "convergence" section and the ``health:`` stats section).
+
+3. **Device-side stagnation/divergence detectors**
+   (``--stall-window N``): a windowed residual-non-decrease counter and
+   dot-product sign anomalies (a negative ``(r, r)``/``(r, z)`` is
+   arithmetic poison, not a property of an SPD system) feed the
+   existing breakdown path.
+
+Surfaces: the append-only ``health:`` stats section (stats schema
+bumped additively to ``acg-tpu-stats/5``), ``acg_health_*`` Prometheus
+gauges/counters (:mod:`acg_tpu.metrics`), the ``--explain``
+convergence verdict, and gap drift tracked by ``--soak`` alongside
+latency drift.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import math
+
+import numpy as np
+
+ACTIONS = ("warn", "replace", "abort")
+
+# audit-vector slot layout (the sdt (4,) array riding the loop carry)
+AUD_GAP = 0        # latest audited relative gap ||r_true - r_rec||/||b||
+AUD_GAP_MAX = 1    # running max over the solve's audits
+AUD_COUNT = 2      # audits performed
+AUD_STALL = 3      # consecutive non-decreasing-residual iterations
+AUD_SLOTS = 4
+
+
+@dataclasses.dataclass(frozen=True)
+class HealthSpec:
+    """One parsed numerical-health selection: immutable and hashable so
+    it rides the solve programs' STATIC jit arguments (the FaultSpec /
+    PrecondSpec design) -- a given spec compiles its own cache entry
+    and ``None`` compiles the byte-identical unaudited program.
+
+    ``every``: audit period in iterations (0 = no audit).
+    ``threshold``: relative-gap trip level (0 = record-only).
+    ``action``: what a tripped gap does -- ``warn`` (event only),
+    ``replace`` (breakdown-path exit; the recovery driver restarts from
+    the recomputed true residual = residual replacement), ``abort``
+    (breakdown-path exit with no restart budget).
+    ``stall_window``: consecutive non-decreasing-residual iterations
+    before the stagnation detector trips the breakdown path (0 = off).
+    """
+
+    every: int = 0
+    threshold: float = 0.0
+    action: str = "warn"
+    stall_window: int = 0
+
+    def __post_init__(self):
+        if self.every < 0:
+            raise ValueError("audit period (every) must be >= 0")
+        if self.threshold < 0:
+            raise ValueError("gap threshold must be >= 0")
+        if self.action not in ACTIONS:
+            raise ValueError(f"unknown on-gap action {self.action!r} "
+                             f"(one of {', '.join(ACTIONS)})")
+        if self.stall_window < 0:
+            raise ValueError("stall window must be >= 0")
+        if self.action != "warn" and not (self.every and self.threshold):
+            raise ValueError(
+                f"on-gap action {self.action!r} needs an armed audit "
+                f"(every > 0) AND a positive gap threshold -- a gate "
+                f"that could never trip must refuse, not silently warn")
+
+    @property
+    def armed(self) -> bool:
+        return self.every > 0 or self.stall_window > 0
+
+    @property
+    def arms_detect(self) -> bool:
+        """Whether this spec needs the breakdown-detection machinery in
+        the loop (early exit): tripping gaps and the stagnation/sign
+        detectors do; a record-only audit does not."""
+        return ((self.action != "warn" and self.threshold > 0
+                 and self.every > 0) or self.stall_window > 0)
+
+    def __str__(self) -> str:
+        parts = [f"audit-every={self.every}"]
+        if self.threshold:
+            parts.append(f"gap-threshold={self.threshold:g}")
+        parts.append(f"on-gap={self.action}")
+        if self.stall_window:
+            parts.append(f"stall-window={self.stall_window}")
+        return ",".join(parts)
+
+
+def make_spec(every: int = 0, threshold: float = 0.0,
+              action: str = "warn",
+              stall_window: int = 0) -> HealthSpec | None:
+    """``HealthSpec`` or None when nothing is armed (the CLI entry
+    point; None keeps every call site's kwargs untouched so disarmed
+    programs stay byte-identical)."""
+    spec = HealthSpec(every=int(every), threshold=float(threshold),
+                      action=str(action), stall_window=int(stall_window))
+    return spec if spec.armed else None
+
+
+# -- device-side helpers (inside jit; spec fields are static) ------------
+
+def audit_init(sdt):
+    """The carried audit vector: ``[gap, gap_max, naudits, stall]``,
+    gap NaN until the first audit fires (NaN > threshold is False, so
+    an unaudited solve can never trip)."""
+    import jax.numpy as jnp
+
+    return jnp.asarray([jnp.nan, 0.0, 0.0, 0.0], dtype=sdt)
+
+
+def relative_gap(rt, r, dot, bnrm2, sdt):
+    """THE gap definition, shared by every tier's audit closure:
+    ``||r_true - r_rec|| / ||b||`` from the tier's freshly-computed
+    true residual ``rt`` and its recurrence residual ``r``, with the
+    difference widened to the scalar dtype before the (tier-supplied,
+    possibly psum'd/compensated) dot.  One definition so the tiers'
+    gaps stay comparable -- the single-vs-8-part parity tests depend
+    on it."""
+    import jax.numpy as jnp
+
+    d = (rt - r).astype(sdt)
+    return jnp.sqrt(dot(d, d)) / bnrm2
+
+
+def audit_update(aud, spec: HealthSpec, k, compute_gap):
+    """``(aud', fire)``: run the audit when iteration ``k`` is on the
+    period (``(k + 1) % every == 0``), else pass the vector through.
+    ``compute_gap()`` is the tier's closure producing the relative gap
+    through its own SpMV -- it runs inside the taken ``lax.cond``
+    branch only, so a non-audited iteration costs nothing beyond the
+    predicate (the mesh tiers' collectives are safe inside the cond
+    because ``k`` is identical on every shard)."""
+    if not spec.every:
+        return aud, None
+    import jax
+    import jax.numpy as jnp
+
+    def do(a):
+        gap = jnp.asarray(compute_gap(), a.dtype).reshape(())
+        return jnp.stack([gap, jnp.maximum(a[AUD_GAP_MAX], gap),
+                          a[AUD_COUNT] + 1, a[AUD_STALL]])
+
+    fire = (jnp.asarray(k, jnp.int32) + 1) % jnp.int32(spec.every) == 0
+    return jax.lax.cond(fire, do, lambda a: a, aud), fire
+
+
+def stall_update(aud, spec: HealthSpec, progressing):
+    """Windowed residual-non-decrease counter: reset on progress,
+    increment otherwise (``progressing`` = this iteration's residual
+    scalar decreased)."""
+    if not spec.stall_window:
+        return aud
+    import jax.numpy as jnp
+
+    return aud.at[AUD_STALL].set(
+        jnp.where(progressing, jnp.zeros((), aud.dtype),
+                  aud[AUD_STALL] + 1))
+
+
+def trip(aud, spec: HealthSpec):
+    """The breakdown-path predicate this spec contributes: a tripped
+    gap (action != warn) and/or an exhausted stall window.  False
+    dtype-correctly when neither detector is armed."""
+    import jax.numpy as jnp
+
+    t = jnp.asarray(False)
+    if spec.action != "warn" and spec.threshold > 0 and spec.every:
+        t = t | (aud[AUD_GAP] > jnp.asarray(spec.threshold, aud.dtype))
+    if spec.stall_window:
+        t = t | (aud[AUD_STALL]
+                 >= jnp.asarray(spec.stall_window, aud.dtype))
+    return t
+
+
+def ring_gap(aud, fire, sdt):
+    """The ``gap`` column value for this iteration's telemetry record:
+    the fresh gap when the audit fired, NaN otherwise (NaN marks
+    unaudited iterations in mixed windows)."""
+    import jax.numpy as jnp
+
+    if fire is None:
+        return jnp.asarray(jnp.nan, sdt)
+    return jnp.where(fire, aud[AUD_GAP], jnp.asarray(jnp.nan, sdt))
+
+
+# -- host-side audit summary ---------------------------------------------
+
+def _clean(v: float):
+    v = float(v)
+    return v if math.isfinite(v) else None
+
+
+def summarize_audit(aud, spec: HealthSpec) -> dict:
+    """The ``health:`` stats entries for one solve's fetched audit
+    vector (plus the armed configuration, so a reader can interpret
+    the numbers without the launching shell)."""
+    a = np.asarray(aud, dtype=np.float64).reshape(-1)
+    out = {
+        "audit_every": int(spec.every),
+        "on_gap": spec.action,
+        "gap_threshold": float(spec.threshold),
+        "naudits": int(a[AUD_COUNT]) if math.isfinite(a[AUD_COUNT])
+        else 0,
+        "gap_last": _clean(a[AUD_GAP]),
+        "gap_max": _clean(a[AUD_GAP_MAX]),
+    }
+    if spec.stall_window:
+        out["stall_window"] = int(spec.stall_window)
+        out["stall_count"] = _clean(a[AUD_STALL])
+    return out
+
+
+# the stats.health keys the audit summary owns (cleared when a new
+# solve's first attempt reports, so a reused solver never shows a
+# previous solve's numbers)
+_AUDIT_KEYS = ("audit_every", "on_gap", "gap_threshold", "naudits",
+               "gap_last", "gap_max", "stall_window", "stall_count",
+               "spectrum")
+
+
+def note_audit(stats, aud, spec: HealthSpec, what: str,
+               fresh: bool = True) -> bool:
+    """Record one solve ATTEMPT's audit vector onto ``stats.health``,
+    feed the ``acg_health_*`` metrics, and emit the structured
+    ``accuracy_degraded`` event when this attempt's gap exceeded the
+    threshold.  ``fresh=False`` (the recovery loop's later attempts and
+    the post-restart tail) MERGES with the attempts already recorded:
+    ``naudits`` accumulates, ``gap_max`` keeps the worst gap of the
+    whole solve -- a recovered solve must still show the drift that
+    tripped it -- and ``gap_last`` survives a final attempt too short
+    to audit.  Returns True when this attempt exceeded the threshold
+    (the caller's recovery loop uses this to tell a gap trip from an
+    arithmetic breakdown in its log)."""
+    from acg_tpu import metrics, telemetry
+
+    summary = summarize_audit(aud, spec)
+    attempt_naudits = summary["naudits"]
+    attempt_gap_max = summary.get("gap_max")
+    if fresh:
+        for k in _AUDIT_KEYS:
+            stats.health.pop(k, None)
+    else:
+        prev = stats.health
+        summary["naudits"] += int(prev.get("naudits") or 0)
+        pm = prev.get("gap_max")
+        if pm is not None:
+            summary["gap_max"] = (max(pm, summary["gap_max"])
+                                  if summary["gap_max"] is not None
+                                  else pm)
+        if summary.get("gap_last") is None:
+            summary["gap_last"] = prev.get("gap_last")
+    stats.health.update(summary)
+    # the Prometheus counter gets only THIS attempt's increment (it is
+    # cumulative across the process by construction)
+    metrics.record_health_audit(summary.get("gap_last"),
+                                attempt_naudits)
+    exceeded = (spec.threshold > 0
+                and attempt_gap_max is not None
+                and attempt_gap_max > spec.threshold)
+    if exceeded:
+        telemetry.record_event(
+            stats, "accuracy_degraded",
+            f"{what}: true-residual gap {attempt_gap_max:.3e} "
+            f"exceeds threshold {spec.threshold:g} "
+            f"(audit every {spec.every}, on-gap {spec.action})")
+        metrics.record_gap_trip()
+    return exceeded
+
+
+# -- Lanczos spectrum estimation from the recorded (alpha, beta) ----------
+
+def lanczos_tridiagonal(alphas, betas, pipelined: bool = False,
+                        window_start: int = 0):
+    """``(diag, offdiag)`` of the Lanczos tridiagonal ``T_m`` implied by
+    a run of CG coefficients -- the classical CG <-> Lanczos identity::
+
+        T[k, k]     = 1/alpha_k + beta_{k-1}/alpha_{k-1}   (beta_{-1}=0)
+        T[k, k+1]   = sqrt(beta_k) / alpha_k
+
+    ``pipelined`` marks Ghysels-Vanroose traces, whose recorded beta at
+    iteration k is the CLASSIC ``beta_{k-1}`` (computed at the top of
+    the iteration from the carried gamma) -- the rows are re-aligned
+    here.  ``window_start > 0`` (a wrapped telemetry ring) drops the
+    leading row whose ``beta_{k-1}/alpha_{k-1}`` term predates the
+    window; the inner tridiagonal of a Lanczos run is itself a valid
+    Lanczos matrix of the same operator, so the estimate stays sound,
+    just over a shorter recurrence.  Returns ``(None, None)`` when
+    fewer than 2 usable rows survive."""
+    a = np.asarray(alphas, dtype=np.float64)
+    b = np.asarray(betas, dtype=np.float64)
+    m = min(a.size, b.size)
+    a, b = a[:m], b[:m]
+    if m < 2:
+        return None, None
+    if pipelined:
+        beta_prev = b.copy()                       # row k holds beta_{k-1}
+        beta_cur = np.append(b[1:], np.nan)
+    else:
+        lead = 0.0 if window_start == 0 else np.nan
+        beta_prev = np.concatenate([[lead], b[:-1]])
+        beta_cur = b
+    alpha_prev = np.concatenate([[np.nan], a[:-1]])
+    with np.errstate(divide="ignore", invalid="ignore"):
+        d = 1.0 / a + np.where(beta_prev == 0.0, 0.0,
+                               beta_prev / alpha_prev)
+        e = np.sqrt(np.maximum(beta_cur, 0.0)) / a
+    start = 0 if np.isfinite(d[0]) else 1
+    d, e, a = d[start:], e[start:], a[start:]
+    # longest healthy prefix: a poisoned tail (breakdown window, NaN
+    # alpha, negative pivot) must not corrupt the whole estimate
+    ok = np.isfinite(d) & (a > 0)
+    n = int(np.argmin(ok)) if not ok.all() else d.size
+    if n < 2:
+        return None, None
+    d = d[:n]
+    e = e[:n - 1]
+    if not np.isfinite(e).all():
+        # an off-diagonal became non-finite before the diagonal did:
+        # keep the prefix before it
+        n = int(np.argmin(np.isfinite(e))) + 1
+        if n < 2:
+            return None, None
+        d, e = d[:n], e[:n - 1]
+    return d, e
+
+
+def _tridiag_eigvalsh(d, e):
+    try:
+        from scipy.linalg import eigh_tridiagonal
+
+        return eigh_tridiagonal(d, e, eigvals_only=True)
+    except Exception:  # noqa: BLE001 -- scipy variant/LAPACK issues
+        T = np.diag(d) + np.diag(e, 1) + np.diag(e, -1)
+        return np.linalg.eigvalsh(T)
+
+
+def spectrum_estimate(trace, precond: str | None = None) -> dict | None:
+    """Estimated extremal eigenvalues and condition number of the
+    (preconditioned) operator from one solve's telemetry window.
+
+    The Ritz values of ``T_m`` converge to ``M^-1 A``'s extremal
+    eigenvalues from inside, so ``kappa`` here is a LOWER bound that
+    tightens with the iteration count -- good enough to grade a
+    preconditioner and to drive the CG iteration bound, and free: the
+    scalars were already recorded.  None when the window carries too
+    few usable coefficients."""
+    if trace is None or trace.records is None:
+        return None
+    rec = np.asarray(trace.records, dtype=np.float64)
+    if rec.ndim != 2 or rec.shape[0] < 2 or rec.shape[1] < 3:
+        return None
+    pipelined = "pipelined" in str(getattr(trace, "solver", ""))
+    d, e = lanczos_tridiagonal(rec[:, 1], rec[:, 2],
+                               pipelined=pipelined,
+                               window_start=trace.first_iteration)
+    if d is None:
+        return None
+    ev = _tridiag_eigvalsh(d, e)
+    lmin = float(ev.min())
+    lmax = float(ev.max())
+    if not (math.isfinite(lmin) and math.isfinite(lmax)) or lmax <= 0:
+        return None
+    est: dict = {
+        "m": int(d.size),
+        "operator": ("M^-1 A" if precond and precond != "none" else "A"),
+        "lambda_min": lmin,
+        "lambda_max": lmax,
+        "window_only": bool(getattr(trace, "wrapped", False)),
+    }
+    if lmin > 0:
+        kappa = lmax / lmin
+        est["kappa"] = kappa
+        # asymptotic CG convergence factor (sqrt(k)-1)/(sqrt(k)+1)
+        sk = math.sqrt(kappa)
+        est["convergence_factor"] = (sk - 1.0) / (sk + 1.0)
+    else:
+        # a non-positive Ritz value: either the run broke down or the
+        # window is too short to separate the low end -- report, don't
+        # divide
+        est["kappa"] = None
+    return est
+
+
+def predicted_iterations(kappa: float, rtol: float) -> int | None:
+    """Iterations the classical CG bound predicts to reduce the A-norm
+    error by ``rtol``: ``2 ((sqrt(k)-1)/(sqrt(k)+1))^j <= rtol``.  An
+    upper bound on a worst-case spectrum -- clustered eigenvalues
+    converge faster, so measured <= predicted is the healthy verdict.
+    None when the inputs cannot drive the bound."""
+    if not kappa or kappa <= 0 or not rtol or not 0 < rtol < 1:
+        return None
+    sk = math.sqrt(kappa)
+    rate = (sk - 1.0) / (sk + 1.0)
+    if rate <= 0:
+        return 1
+    return max(1, int(math.ceil(math.log(2.0 / rtol)
+                                / -math.log(rate))))
+
+
+def convergence_report(trace, niterations: int, rtol: float,
+                       precond: str | None = None,
+                       kappa_ref: float | None = None) -> dict | None:
+    """The ``spectrum`` entry of the ``health:`` section (and the
+    ``--explain`` convergence verdict): spectrum estimate + the
+    predicted-vs-measured iteration comparison, plus the
+    preconditioner-effectiveness score when an unpreconditioned
+    ``kappa_ref`` is available to compare against."""
+    est = spectrum_estimate(trace, precond=precond)
+    if est is None:
+        return None
+    kappa = est.get("kappa")
+    pred = predicted_iterations(kappa, rtol) if kappa else None
+    est["measured_iterations"] = int(niterations)
+    if pred is not None:
+        est["predicted_iterations"] = pred
+        est["rtol"] = float(rtol)
+        est["bound_ratio"] = (float(niterations) / pred) if pred else None
+    if kappa_ref is not None and kappa:
+        # kappa(A) / kappa(M^-1 A): > 1 means the preconditioner
+        # genuinely compressed the spectrum (the sqrt of this ratio is
+        # the asymptotic iteration-count reduction)
+        est["kappa_unpreconditioned"] = float(kappa_ref)
+        est["precond_effectiveness"] = float(kappa_ref) / kappa
+    return est
+
+
+def attach_spectrum(stats, trace, rtol: float,
+                    precond: str | None = None,
+                    kappa_ref: float | None = None) -> dict | None:
+    """Compute and record the post-hoc spectrum report onto
+    ``stats.health`` (no-op without a usable trace) and feed the
+    ``acg_health_kappa_estimate`` gauge."""
+    rep = convergence_report(trace, stats.niterations, rtol,
+                             precond=precond, kappa_ref=kappa_ref)
+    if rep is None:
+        return None
+    stats.health["spectrum"] = rep
+    from acg_tpu import metrics
+
+    if rep.get("kappa"):
+        metrics.record_health_kappa(rep["kappa"])
+    return rep

@@ -454,6 +454,19 @@ PRECOND_APPLIES = REGISTRY.counter(
     "acg_precond_applies_total", "Preconditioner applies (analytic: "
     "one per iteration + setup; cheby bills its per-apply SpMVs).",
     labelnames=("kind",))
+HEALTH_GAP = REGISTRY.gauge(
+    "acg_health_residual_gap", "Latest in-loop true-residual audit "
+    "gap ||r_true - r_rec||/||b|| (acg_tpu.health, --audit-every).")
+HEALTH_KAPPA = REGISTRY.gauge(
+    "acg_health_kappa_estimate", "Condition-number estimate of the "
+    "(preconditioned) operator from the Lanczos tridiagonal of the "
+    "last traced solve.")
+HEALTH_AUDITS = REGISTRY.counter(
+    "acg_health_audits_total", "In-loop true-residual audits "
+    "performed across all solves.")
+HEALTH_GAP_TRIPS = REGISTRY.counter(
+    "acg_health_gap_trips_total", "Audit gaps past --gap-threshold "
+    "(each one emitted an accuracy_degraded event).")
 
 _armed = False
 
@@ -525,6 +538,27 @@ def record_precond(kind: str, applies: int) -> None:
     tails, acg_tpu.precond)."""
     if _armed:
         PRECOND_APPLIES.labels(kind=str(kind)).inc(max(int(applies), 0))
+
+
+def record_health_audit(gap, naudits: int) -> None:
+    """One solve's audit summary (the numerical-health tier's solve()
+    tails): the latest finite gap lands on the gauge, the audit count
+    on the counter."""
+    if not _armed:
+        return
+    if gap is not None and math.isfinite(float(gap)):
+        HEALTH_GAP.set(float(gap))
+    HEALTH_AUDITS.inc(max(int(naudits), 0))
+
+
+def record_health_kappa(kappa: float) -> None:
+    if _armed and kappa and math.isfinite(float(kappa)):
+        HEALTH_KAPPA.set(float(kappa))
+
+
+def record_gap_trip() -> None:
+    if _armed:
+        HEALTH_GAP_TRIPS.inc()
 
 
 def record_comm(ledger: dict, iterations: int) -> None:

@@ -748,7 +748,7 @@ class DistCGSolver:
                  precise_dots: bool = False, kernels: str = "auto",
                  replace_every: int = 0, replace_restart: bool = True,
                  recovery=None, trace: int = 0, progress: int = 0,
-                 precond=None):
+                 precond=None, health=None):
         """``recovery`` (acg_tpu.solvers.resilience.RecoveryPolicy) arms
         in-loop breakdown detection plus the host-side restart ladder:
         bounded restarts from the recomputed true residual, the
@@ -771,7 +771,17 @@ class DistCGSolver:
         same halo'd SpMV the solve uses.  The classic loop keeps 2
         allreduces per iteration (the second fuses (r, z) with (r, r))
         and the pipelined loop keeps its SINGLE fused allreduce (3
-        scalars)."""
+        scalars).
+
+        ``health`` (acg_tpu.health.HealthSpec or None) arms the
+        numerical-health tier over the mesh: the in-loop audit
+        recomputes ``b - A x`` through the SAME halo'd distributed
+        SpMV the solve runs (inside a ``lax.cond`` whose predicate --
+        the iteration index -- is identical on every shard, so the
+        conditional collectives stay mesh-uniform), the gap psums, and
+        the carried audit vector is replicated like the telemetry
+        ring.  ``None`` compiles the byte-identical unaudited
+        program."""
         if comm not in ("xla", "dma"):
             raise ValueError(f"unknown halo transport {comm!r}")
         if comm == "dma" and jax.process_count() > 1:
@@ -838,6 +848,21 @@ class DistCGSolver:
         # preconditioner state: host-stacked (jacobi/bjacobi) or device
         # scalars (cheby), built lazily at first solve/lower
         self._mstate = None
+        # numerical-health tier (acg_tpu.health): static spec baked
+        # into the compiled SPMD program; refusals mirror JaxCGSolver's
+        if health is not None:
+            from acg_tpu.health import HealthSpec
+            if not isinstance(health, HealthSpec):
+                raise ValueError("health must be an "
+                                 "acg_tpu.health.HealthSpec or None")
+            if not health.armed:
+                health = None
+        if health is not None and self.replace_every:
+            raise ValueError(
+                "the true-residual audit (health) does not compose "
+                "with replace_every: the replacement segments already "
+                "recompute b - A x every K iterations")
+        self.health_spec = health
         self.recovery = recovery
         self.trace = int(trace)
         self.progress = int(progress)
@@ -885,10 +910,13 @@ class DistCGSolver:
         trace = self.trace
         progress = self.progress
         precond_spec = self.precond_spec
+        health = self.health_spec
         if trace or progress:
             from acg_tpu import telemetry
         if precond_spec is not None:
             from acg_tpu.precond import make_apply
+        if health is not None:
+            from acg_tpu import health as _health
 
         dist_spmv = make_dist_spmv(prob, comm, interpret,
                                    kernels=self.kernels, fault=fault)
@@ -1115,6 +1143,8 @@ class DistCGSolver:
                 def body(k, state):
                     if trace:
                         buf, state = state[-1], state[:-1]
+                    if health is not None:
+                        aud, state = state[-1], state[:-1]
                     x, r, p, gamma = state[:4]
                     t = spmv(p, k)
                     pdott = pdot(p, t)
@@ -1153,18 +1183,47 @@ class DistCGSolver:
                             # alpha = 0 must not fake the diff criterion
                             dx = jnp.where(bad, state[dx_i], dx)
                         out = out + (dx,)
+                    fire = None
+                    if health is not None:
+                        # in-loop audit through the SAME halo'd SpMV:
+                        # the cond predicate (k) is mesh-uniform, so
+                        # the conditional collectives fire on every
+                        # shard together; the psum'd gap replicates
+                        def compute_gap():
+                            return _health.relative_gap(b - spmv(x), r,
+                                                                                pdot, bnrm2, sdt)
+
+                        aud, fire = _health.audit_update(
+                            aud, health, k, compute_gap)
+                        prog_now = (out[4] if precond_spec is not None
+                                    else gamma_next)
+                        prog_prev = (state[4] if precond_spec is not None
+                                     else gamma)
+                        aud = _health.stall_update(aud, health,
+                                                   prog_now < prog_prev)
                     if detect:
                         deferred = bad | (~jnp.isfinite(gamma_next))
                         if precond_spec is not None:
                             # negative (r, z): the non-SPD-M signal
                             deferred = deferred | (gamma_next < 0)
+                        if health is not None:
+                            if precond_spec is None:
+                                # sign anomaly (jax_cg rationale)
+                                deferred = deferred | (gamma_next < 0)
+                            deferred = deferred | _health.trip(aud,
+                                                               health)
                         out = out + (deferred,)
+                    if health is not None:
+                        out = out + (aud,)
                     if trace:
                         # psum'd scalars: the ring is replicated, one
                         # rank-independent fetch per solve (gamma IS the
                         # preconditioned residual norm^2 under precond)
+                        audit_col = (_health.ring_gap(aud, fire, sdt)
+                                     if health is not None else None)
                         out = out + (telemetry.ring_record(
-                            buf, k, gamma_next, alpha, beta, pdott),)
+                            buf, k, gamma_next, alpha, beta, pdott,
+                            audit=audit_col),)
                     if progress:
                         telemetry.heartbeat(k, gamma_next, progress,
                                             leader=leader, what="dist-cg")
@@ -1177,10 +1236,13 @@ class DistCGSolver:
                 init_state = init_state + ((inf,) if needs_diff else ())
                 if detect:
                     init_state = init_state + (jnp.asarray(False),)
+                if health is not None:
+                    init_state = init_state + (_health.audit_init(sdt),)
                 if trace:
-                    init_state = init_state + (telemetry.ring_init(trace,
-                                                                   sdt),)
-                bad_i = -2 if trace else -1
+                    init_state = init_state + (telemetry.ring_init(
+                        trace, sdt, audit=health is not None),)
+                bad_i = -1 - (1 if trace else 0) - (
+                    1 if health is not None else 0)
                 conv_i = 4 if precond_spec is not None else 3
                 k, state, done = run_iter(
                     body, init_state, lambda s: s[conv_i],
@@ -1190,6 +1252,8 @@ class DistCGSolver:
                 dxsqr = state[dx_i] if needs_diff else inf
                 breakdown = state[bad_i] if detect else jnp.asarray(False)
                 tbuf = state[-1] if trace else None
+                aud_out = (state[-2] if trace else state[-1]) \
+                    if health is not None else None
                 rnrm2 = jnp.sqrt(gamma_fin)
             elif precond_spec is not None:
                 # preconditioned Ghysels-Vanroose (jax_cg pbody, psum'd):
@@ -1201,8 +1265,11 @@ class DistCGSolver:
                 def pbody(k, state):
                     if trace:
                         buf, state = state[-1], state[:-1]
+                    if health is not None:
+                        aud, state = state[-1], state[:-1]
                     x, r, u, w, p, s, q, z, gamma_prev, alpha_prev = \
                         state[:10]
+                    rr_prev = state[10]
                     gamma, delta, rr = pdot3_fused(r, u, w, u, r, r)
                     if fault is not None:
                         delta = fault.apply_dot(delta, k)
@@ -1237,11 +1304,29 @@ class DistCGSolver:
                         if detect:
                             dx = jnp.where(bad, state[11], dx)
                         out = out + (dx,)
+                    fire = None
+                    if health is not None:
+                        def compute_gap():
+                            return _health.relative_gap(b - spmv(x), r,
+                                                                                pdot, bnrm2, sdt)
+
+                        aud, fire = _health.audit_update(
+                            aud, health, k, compute_gap)
+                        aud = _health.stall_update(aud, health,
+                                                   rr < rr_prev)
                     if detect:
-                        out = out + (bad,)
+                        flag = bad
+                        if health is not None:
+                            flag = flag | _health.trip(aud, health)
+                        out = out + (flag,)
+                    if health is not None:
+                        out = out + (aud,)
                     if trace:
+                        audit_col = (_health.ring_gap(aud, fire, sdt)
+                                     if health is not None else None)
                         out = out + (telemetry.ring_record(
-                            buf, k, gamma, alpha, beta, denom),)
+                            buf, k, gamma, alpha, beta, denom,
+                            audit=audit_col),)
                     if progress:
                         telemetry.heartbeat(k, gamma, progress,
                                             leader=leader,
@@ -1253,10 +1338,13 @@ class DistCGSolver:
                     (inf,) if needs_diff else ())
                 if detect:
                     init_state = init_state + (jnp.asarray(False),)
+                if health is not None:
+                    init_state = init_state + (_health.audit_init(sdt),)
                 if trace:
-                    init_state = init_state + (telemetry.ring_init(trace,
-                                                                   sdt),)
-                bad_i = -2 if trace else -1
+                    init_state = init_state + (telemetry.ring_init(
+                        trace, sdt, audit=health is not None),)
+                bad_i = -1 - (1 if trace else 0) - (
+                    1 if health is not None else 0)
                 k, state, done = run_iter(
                     pbody, init_state, lambda s: s[10],
                     (lambda s: s[11]) if needs_diff else (lambda s: inf),
@@ -1266,6 +1354,8 @@ class DistCGSolver:
                 dxsqr = state[11] if needs_diff else inf
                 breakdown = state[bad_i] if detect else jnp.asarray(False)
                 tbuf = state[-1] if trace else None
+                aud_out = (state[-2] if trace else state[-1]) \
+                    if health is not None else None
                 rnrm2 = jnp.sqrt(pdot(r_fin, r_fin))
                 # stale-test consistency: see jax_cg._cg_pipelined_program
                 done = jnp.logical_or(done, rnrm2 <= res_tol)
@@ -1276,6 +1366,8 @@ class DistCGSolver:
                 def body(k, state):
                     if trace:
                         buf, state = state[-1], state[:-1]
+                    if health is not None:
+                        aud, state = state[-1], state[:-1]
                     x, r, w, p, t, z, gamma_prev, alpha_prev = state[:8]
                     # the pipelined variant's single fused allreduce:
                     # both scalars in one psum (cgcuda.c:1730-1737)
@@ -1292,6 +1384,11 @@ class DistCGSolver:
                         # pipelined poison surfaces in the next
                         # iteration's (w, r) reduction instead)
                         bad, alpha = _breakdown_guard(gamma, denom)
+                        if health is not None:
+                            # sign anomaly (jax_cg rationale)
+                            bad = bad | (gamma < 0)
+                            alpha = jnp.where(bad, jnp.zeros_like(alpha),
+                                              alpha)
                     else:
                         alpha = gamma / denom
                     z = store(q + beta * z)
@@ -1311,14 +1408,32 @@ class DistCGSolver:
                         if detect:
                             dx = jnp.where(bad, state[8], dx)
                         out = out + (dx,)
+                    fire = None
+                    if health is not None:
+                        def compute_gap():
+                            return _health.relative_gap(b - spmv(x), r,
+                                                                                pdot, bnrm2, sdt)
+
+                        aud, fire = _health.audit_update(
+                            aud, health, k, compute_gap)
+                        aud = _health.stall_update(aud, health,
+                                                   gamma < gamma_prev)
                     if detect:
-                        out = out + (bad,)
+                        flag = bad
+                        if health is not None:
+                            flag = flag | _health.trip(aud, health)
+                        out = out + (flag,)
+                    if health is not None:
+                        out = out + (aud,)
                     if trace:
                         # carried gamma (stale by one, like the
                         # convergence test); alpha denominator in the
                         # pAp slot (jax_cg._cg_pipelined_program)
+                        audit_col = (_health.ring_gap(aud, fire, sdt)
+                                     if health is not None else None)
                         out = out + (telemetry.ring_record(
-                            buf, k, gamma, alpha, beta, denom),)
+                            buf, k, gamma, alpha, beta, denom,
+                            audit=audit_col),)
                     if progress:
                         telemetry.heartbeat(k, gamma, progress,
                                             leader=leader, what="dist-cg")
@@ -1330,10 +1445,13 @@ class DistCGSolver:
                     (inf,) if needs_diff else ())
                 if detect:
                     init_state = init_state + (jnp.asarray(False),)
+                if health is not None:
+                    init_state = init_state + (_health.audit_init(sdt),)
                 if trace:
-                    init_state = init_state + (telemetry.ring_init(trace,
-                                                                   sdt),)
-                bad_i = -2 if trace else -1
+                    init_state = init_state + (telemetry.ring_init(
+                        trace, sdt, audit=health is not None),)
+                bad_i = -1 - (1 if trace else 0) - (
+                    1 if health is not None else 0)
                 k, state, done = run_iter(
                     body, init_state, lambda s: s[6],
                     (lambda s: s[8]) if needs_diff else (lambda s: inf),
@@ -1343,6 +1461,8 @@ class DistCGSolver:
                 dxsqr = state[8] if needs_diff else inf
                 breakdown = state[bad_i] if detect else jnp.asarray(False)
                 tbuf = state[-1] if trace else None
+                aud_out = (state[-2] if trace else state[-1]) \
+                    if health is not None else None
                 rnrm2 = jnp.sqrt(pdot(r_fin, r_fin))
                 # stale-test consistency: see jax_cg._cg_pipelined_program
                 done = jnp.logical_or(done, rnrm2 <= res_tol)
@@ -1354,7 +1474,10 @@ class DistCGSolver:
             dxnrm2 = jnp.sqrt(dxsqr)
             out = (x[None], k, rnrm2, r0nrm2, bnrm2, x0nrm2, dxnrm2,
                    done, breakdown)
-            return out + ((tbuf,) if trace else ())
+            out = out + ((tbuf,) if trace else ())
+            # the audit vector rides LAST (after the ring) so the
+            # existing out[9] = ring fetch in solve() is untouched
+            return out + ((aud_out,) if health is not None else ())
 
         with_precond = precond_spec is not None
         if single_shard and not prob.halo.has_ghosts:
@@ -1387,8 +1510,11 @@ class DistCGSolver:
                     rspec, rspec)                              # tols, maxits
         if with_precond:
             in_specs = in_specs + (pspec,)                     # mstate
-        # the telemetry ring is built from psum'd scalars -> replicated
-        out_specs = (pspec,) + (rspec,) * (9 if trace else 8)
+        # the telemetry ring (psum'd scalars) and the audit vector
+        # (psum'd gap) are replicated
+        out_specs = (pspec,) + (rspec,) * (
+            8 + (1 if trace else 0)
+            + (1 if self.health_spec is not None else 0))
 
         @functools.partial(jax.jit,
                            static_argnames=("unbounded", "needs_diff",
@@ -1558,12 +1684,20 @@ class DistCGSolver:
         program = self._program_for(None)
         kwargs = dict(unbounded=crit.unbounded,
                       needs_diff=crit.needs_diff,
-                      detect=self.recovery is not None)
+                      detect=self._detect(None))
         if self.precond_spec is not None:
             self._last_dev_args = dev
             kwargs["mstate"] = self._ensure_precond_state(dev)
         return program.lower(la, ga, sidx, gsrc, gval, scnt, rcnt, b, x0,
                              tols, jnp.int32(crit.maxits), **kwargs)
+
+    def _detect(self, fault) -> bool:
+        """Breakdown-flag arming shared by solve() and lower_solve (the
+        jax_cg._detect twin): recovery, an active injector, or a health
+        spec whose detectors trip the breakdown path."""
+        return (self.recovery is not None or fault is not None
+                or (self.health_spec is not None
+                    and self.health_spec.arms_detect))
 
     def comm_profile(self) -> dict:
         """Static per-iteration communication ledger (the perfmodel
@@ -1703,7 +1837,7 @@ class DistCGSolver:
                 "precond fault injection needs an armed preconditioner "
                 "(--precond jacobi|bjacobi|cheby:K); this solve runs "
                 "unpreconditioned CG")
-        detect = self.recovery is not None or fault is not None
+        detect = self._detect(fault)
         from acg_tpu import telemetry
         if fault is not None:
             telemetry.record_event(st, "fault-armed",
@@ -1757,12 +1891,25 @@ class DistCGSolver:
                 solver="dist-cg-pipelined" if self.pipelined
                 else "dist-cg")
 
+        hl = self.health_spec is not None
+
+        def attempt_aud(out):
+            """The replicated audit vector (rides LAST, after the
+            ring); one tiny rank-independent fetch per attempt."""
+            return np.asarray(out[-1]) if hl else None
+
         t0 = time.perf_counter()
         with telemetry.annotate("solve"):
             out = program(*args, **kwargs)
             device_sync(out[0])
         niter = int(out[1])
         first_norms = None
+        # first note_audit resets the summary, later attempts merge
+        # (the jax_cg rationale: a recovered solve must still show the
+        # worst gap of the whole solve); gap_tripped marks the latest
+        # attempt's exit as an accuracy gate for the raise below
+        aud_fresh = True
+        gap_tripped = False
         if detect and bool(out[8]):
             # the recovery ladder (solvers.resilience): bounded restarts
             # from the recomputed true residual; a recurring breakdown
@@ -1792,10 +1939,32 @@ class DistCGSolver:
 
             while bool(out[8]):
                 k_done = int(out[1])
+                if hl:
+                    # audit evidence before the restart decision: the
+                    # accuracy_degraded event marks a gap trip apart
+                    # from an arithmetic breakdown (jax_cg rationale)
+                    from acg_tpu import health as health_mod
+                    gap_tripped = health_mod.note_audit(
+                        st, attempt_aud(out), self.health_spec,
+                        "dist-cg", fresh=aud_fresh)
+                    aud_fresh = False
                 if self.trace:
                     # the trajectory that led INTO the breakdown
                     st.trace = self.last_trace = attempt_trace(out)
                     driver.log_trace_window(st.trace)
+                if gap_tripped and self.health_spec.action == "abort":
+                    # host-tier parity (the jax_cg rationale): abort is
+                    # a hard stop, the restart budget and the transport
+                    # fallback belong to replace.  The predicate comes
+                    # from the psum'd (replicated) audit vector, so
+                    # every controller raises in unison
+                    st.tsolve += time.perf_counter() - t0
+                    st.converged = False
+                    raise BreakdownError(
+                        f"dist-cg: true-residual gap "
+                        f"{st.health.get('gap_max', 0.0):.3e} exceeds "
+                        f"threshold {self.health_spec.threshold:g} at "
+                        f"iteration {niter} (--on-gap abort)")
                 if (self.comm == "dma" and driver.restarts >= 1
                         and pol is not None and pol.fallback_comm):
                     # a restart did not cure it: suspect the one-sided
@@ -1850,6 +2019,17 @@ class DistCGSolver:
                                                host_result)
                 st.tsolve += time.perf_counter() - t0
                 st.converged = False
+                if gap_tripped:
+                    # the jax_cg parity: a gap-gated exit names the
+                    # accuracy gate, not the arithmetic diagnosis
+                    raise BreakdownError(
+                        f"dist-cg: true-residual gap "
+                        f"{st.health.get('gap_max', 0.0):.3e} exceeds "
+                        f"threshold {self.health_spec.threshold:g} at "
+                        f"iteration {niter} (--on-gap "
+                        f"{self.health_spec.action}); "
+                        f"{st.nrestarts} restart(s) exhausted and no "
+                        f"fallback available")
                 raise driver.give_up(niter, float(out[2]))
         t_solve = time.perf_counter() - t0
         st.tsolve += t_solve
@@ -1867,6 +2047,11 @@ class DistCGSolver:
         st.rnrm2 = float(rnrm2)
         st.dxnrm2 = float(dxnrm2)
         st.converged = bool(done) or crit.unbounded
+        if hl:
+            from acg_tpu import health as health_mod
+            health_mod.note_audit(st, attempt_aud(out),
+                                  self.health_spec, "dist-cg",
+                                  fresh=aud_fresh)
         # service-metrics tier (no-op disarmed): one completed solve,
         # plus this solve's halo/psum traffic folded out of the static
         # comm ledger (comm_profile, the perfmodel tier's hook)

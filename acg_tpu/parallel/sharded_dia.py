@@ -227,7 +227,7 @@ class ShardedDiaCGSolver(JaxCGSolver):
                  vector_dtype=None, stencil: tuple[int, int] | None = None,
                  replace_every: int = 0, replace_restart: bool = True,
                  recovery=None, trace: int = 0, progress: int = 0,
-                 precond=None):
+                 precond=None, health=None):
         if A.ncols_padded != A.nrows:
             raise ValueError("sharded DIA solve needs a square matrix")
         # replace_every (the sound bf16 tier, _cg_replaced_program)
@@ -244,12 +244,17 @@ class ShardedDiaCGSolver(JaxCGSolver):
         # bjacobi's block extraction shards by block row, and the cheby
         # apply's rolls partition into the same boundary collective-
         # permutes as every other SpMV of the loop
+        # health (acg_tpu.health) likewise: the audit's b - A x runs
+        # the same roll SpMV (boundary collective-permutes under the
+        # SPMD partitioner), its norm psums through sharding
+        # propagation like the CG scalars, and the audit vector comes
+        # back replicated exactly like the result scalars
         super().__init__(A, pipelined=pipelined, precise_dots=precise_dots,
                          kernels="xla-roll", vector_dtype=vector_dtype,
                          replace_every=replace_every,
                          replace_restart=replace_restart,
                          recovery=recovery, trace=trace, progress=progress,
-                         precond=precond)
+                         precond=precond, health=health)
         self.mesh = mesh if mesh is not None else solve_mesh()
         # fault-injection diagnosis hook (JaxCGSolver.solve): this tier
         # is multi-part but still cannot honour part= targeting
@@ -623,7 +628,8 @@ def build_sharded_poisson_solver(n: int, dim: int, nparts: int | None = None,
                                  replace_restart: bool = True,
                                  kernels: str = "xla-roll",
                                  recovery=None, trace: int = 0,
-                                 progress: int = 0, precond=None):
+                                 progress: int = 0, precond=None,
+                                 health=None):
     """Assemble a sharded Poisson problem and its solver in one call
     (the gen-direct CLI path under ``--nparts``/``--multihost``).
 
@@ -656,7 +662,8 @@ def build_sharded_poisson_solver(n: int, dim: int, nparts: int | None = None,
                                 replace_every=replace_every,
                                 replace_restart=replace_restart,
                                 recovery=recovery, trace=trace,
-                                progress=progress, precond=precond)
+                                progress=progress, precond=precond,
+                                health=health)
     if kernels == "pallas-roll":
         solver.use_pallas_roll(n, dim)
     return solver

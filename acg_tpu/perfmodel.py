@@ -641,6 +641,14 @@ def run_explain(args, dtype, vec_dtype) -> int:
         err.write(f"acg-tpu: explain tier dist-cg failed: "
                   f"{type(e).__name__}: {e}\n")
 
+    # the numerical-health tier's convergence verdict: kappa from the
+    # Lanczos tridiagonal of a traced host-oracle solve, the CG-bound
+    # predicted iteration count against the measured one, and (when a
+    # preconditioner is armed) the kappa(A)/kappa(M^-1 A) effectiveness
+    # score -- one tier-independent section (kappa is a property of the
+    # operator + preconditioner, not of the execution tier)
+    _explain_convergence(args, csr, rows, err)
+
     if args.stats_json:
         from acg_tpu import telemetry
 
@@ -656,7 +664,127 @@ def run_explain(args, dtype, vec_dtype) -> int:
     return 0 if rows else 1
 
 
+def _explain_convergence(args, csr, rows, err) -> dict | None:
+    """The ``--explain`` "convergence" section (acg_tpu.health): run
+    the eager f64 host oracle traced (cheap at explain sizes), rebuild
+    the Lanczos tridiagonal from its (alpha, beta) window, and print
+    the kappa estimate + predicted-vs-measured verdict.  The report
+    also lands on every tier's ``health:`` stats section so the
+    --stats-json twin carries it."""
+    import numpy as np
+
+    from acg_tpu import health as health_mod
+    from acg_tpu.solvers.host_cg import HostCGSolver
+    from acg_tpu.solvers.stats import StoppingCriteria
+
+    # the oracle is an eager single-threaded f64 loop: bound it by
+    # matrix size the way _explain_tier bounds its timed solves by K --
+    # --explain is documented as a cheap introspection pass, and a
+    # multi-million-nnz oracle solve (x2 under --precond) is not
+    if csr.shape[0] > 200_000 or csr.nnz > 2_000_000:
+        err.write("== explain: convergence ==\n  (skipped: matrix too "
+                  "large for the host-oracle Lanczos estimate; run a "
+                  "normal solve with --audit-every + --convergence-log "
+                  "for the device-side spectrum report)\n\n")
+        return None
+    rtol = (args.residual_rtol
+            if 0 < args.residual_rtol < 1 else 1e-9)
+    crit = StoppingCriteria(maxits=min(max(args.max_iterations, 200),
+                                       2000),
+                            residual_rtol=rtol)
+    b = np.ones(csr.shape[0])
+    pc = getattr(args, "_precond", None)
+    try:
+        kappa_ref = None
+        if pc is not None:
+            # the effectiveness baseline: kappa(A) from an
+            # unpreconditioned oracle run of the same system
+            plain = HostCGSolver(csr, trace=4096)
+            plain.solve(b, criteria=crit, raise_on_divergence=False)
+            ref = health_mod.spectrum_estimate(plain.last_trace)
+            kappa_ref = (ref or {}).get("kappa")
+        hs = HostCGSolver(csr, trace=4096, precond=pc)
+        hs.solve(b, criteria=crit, raise_on_divergence=False)
+        rep = health_mod.convergence_report(
+            hs.last_trace, hs.stats.niterations, rtol,
+            precond=str(pc) if pc is not None else None,
+            kappa_ref=kappa_ref)
+    except Exception as e:  # noqa: BLE001 -- the verdict must not sink
+        err.write(f"acg-tpu: explain convergence verdict failed: "
+                  f"{type(e).__name__}: {e}\n")
+        return None
+    if rep is None:
+        err.write("== explain: convergence ==\n  (window too short "
+                  "for a Lanczos estimate)\n\n")
+        return None
+    err.write("== explain: convergence (host-oracle Lanczos "
+              "estimate) ==\n")
+    err.write(f"  operator {rep['operator']}: lambda "
+              f"{rep['lambda_min']:.4g} .. {rep['lambda_max']:.4g}"
+              + (f", kappa {rep['kappa']:.4g}" if rep.get("kappa")
+                 else ", kappa unavailable (non-positive Ritz value)")
+              + f" (m={rep['m']})\n")
+    if rep.get("precond_effectiveness") is not None:
+        err.write(f"  preconditioner effectiveness: kappa(A) "
+                  f"{rep['kappa_unpreconditioned']:.4g} / "
+                  f"kappa(M^-1 A) {rep['kappa']:.4g} = "
+                  f"{rep['precond_effectiveness']:.2f}x spectrum "
+                  f"compression\n")
+    pred = rep.get("predicted_iterations")
+    if pred is not None:
+        meas = rep["measured_iterations"]
+        verdict = ("within-bound" if meas <= pred
+                   else "OVER-bound (measured exceeds the worst-case "
+                        "CG bound: suspect the estimate window or "
+                        "numerical trouble)")
+        err.write(f"  CG bound at rtol {rep['rtol']:g}: predicted "
+                  f"<= {pred} iterations; measured {meas} "
+                  f"({meas / pred:.2f}x); verdict: {verdict}\n")
+    err.write("\n")
+    for _row, solver in rows:
+        solver.stats.health.setdefault("spectrum", rep)
+    return rep
+
+
 # -- bench regression gate ------------------------------------------------
+
+# the sentinel row bench.py emits when the backend probe fails (tunnel
+# down): value 0 iters/s, not a performance case.  A capture consisting
+# of it alone describes a run that never reached hardware -- comparing
+# against it can only mislead (ROADMAP Recent notes r05)
+UNAVAILABLE_METRIC = "bench_backend_unavailable"
+
+
+def split_unavailable(cases: dict) -> tuple[dict, bool]:
+    """Drop the backend-unavailable sentinel from a case dict; returns
+    ``(real_cases, sentinel_was_present)``.  A capture that is ONLY the
+    sentinel must exit 2 with a re-baseline message, never enter a
+    comparison."""
+    had = any(k == UNAVAILABLE_METRIC or
+              k.startswith(UNAVAILABLE_METRIC + "|") for k in cases)
+    return {k: v for k, v in cases.items()
+            if not (k == UNAVAILABLE_METRIC
+                    or k.startswith(UNAVAILABLE_METRIC + "|"))}, had
+
+
+def refuse_unavailable(old: dict, new: dict, old_name: str,
+                       new_name: str) -> tuple[dict, dict, bool]:
+    """The shared regression-gate guard (check_regression and
+    scripts/bench_diff.py): strip the backend-unavailable sentinel from
+    both captures and, when either side carried ONLY the sentinel,
+    print the re-baseline refusal and flag exit 2.  Returns
+    ``(old_cases, new_cases, refused)``."""
+    old, old_unavail = split_unavailable(old)
+    new, new_unavail = split_unavailable(new)
+    refused = (old_unavail and not old) or (new_unavail and not new)
+    if refused:
+        which = old_name if old_unavail and not old else new_name
+        print(f"bench-diff: {which} records {UNAVAILABLE_METRIC} (the "
+              f"backend/tunnel was down): no comparable cases -- "
+              f"re-baseline before trusting --fail-on-regress",
+              file=sys.stderr)
+    return old, new, refused
+
 
 def _doc_case(doc: dict):
     """``(key, value)`` for one --stats-json document: the case key is
@@ -800,7 +928,11 @@ def check_regression(rows, baseline_path, pct: float) -> int:
     except OSError as e:
         print(f"bench-diff: {baseline_path}: {e}", file=sys.stderr)
         return 2
-    new = rows_to_cases(rows)
+    old, new, refused = refuse_unavailable(old, rows_to_cases(rows),
+                                           str(baseline_path),
+                                           "this run")
+    if refused:
+        return 2
     lines, nreg, ncmp = compare_cases(old, new, pct)
     for ln in lines:
         print(ln, file=sys.stderr)

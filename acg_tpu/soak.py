@@ -166,6 +166,11 @@ def run_soak(solver, b, *, nsolves: int, x0=None, criteria=None,
                               buckets=metrics.ITERATION_BUCKETS)
     t_run0 = time.perf_counter()
     latencies_max = 0.0
+    # numerical-health tier: per-solve audit gaps (solver.stats.health,
+    # present when --audit-every is armed) tracked ALONGSIDE latency --
+    # a serving fleet's accuracy can drift (accumulating operator
+    # updates, thermal-driven recompiles) just like its latency
+    gaps: list[float] = []
     x = None
     for i in range(nsolves):
         kw = dict(kwargs)
@@ -181,6 +186,9 @@ def run_soak(solver, b, *, nsolves: int, x0=None, criteria=None,
         lat_hist.observe(lat)
         it_hist.observe(max(int(st.niterations), 0))
         latencies_max = max(latencies_max, lat)
+        g = (st.health or {}).get("gap_last")
+        if g is not None and math.isfinite(float(g)):
+            gaps.append(float(g))
         if det.update(i, lat):
             msg = (f"latency drift: EWMA {det.ewma:.6f}s is "
                    f"{(det.ratio - 1.0) * 100.0:+.1f}% over the "
@@ -201,6 +209,22 @@ def run_soak(solver, b, *, nsolves: int, x0=None, criteria=None,
         "iterations": _percentiles(it_hist),
         "drift": det.to_dict(),
     }
+    if gaps:
+        # accuracy-drift view of the run: how the audited true-residual
+        # gap moved across repeated solves (the latency drift gate's
+        # numerical twin; warn-only -- the per-solve threshold gate
+        # already owns the hard verdict)
+        report["gap"] = {
+            "first": gaps[0], "last": gaps[-1], "max": max(gaps),
+            "ratio": (gaps[-1] / gaps[0]) if gaps[0] > 0 else None,
+        }
+        if gaps[0] > 0 and gaps[-1] / gaps[0] > 1.0 + threshold / 100.0:
+            msg = (f"residual-gap drift: last audit gap {gaps[-1]:.3e} "
+                   f"is {(gaps[-1] / gaps[0] - 1.0) * 100.0:+.1f}% over "
+                   f"the first solve's {gaps[0]:.3e} "
+                   f"(threshold {threshold:g}%)")
+            telemetry.record_event(st, "gap-drift", msg)
+            sys.stderr.write(f"acg-tpu: {what}: WARNING: {msg}\n")
     st.soak = report
     return x, report
 

@@ -51,11 +51,19 @@ class HostCGSolver:
 
     def __init__(self, A: SymCsrMatrix | sp.spmatrix, epsilon: float = 0.0,
                  recovery=None, trace: int = 0, progress: int = 0,
-                 precond=None):
+                 precond=None, health=None):
         self.A = as_csr(A, epsilon)
         self.n = self.A.shape[0]
         self.nnz_full = self.A.nnz
         self.recovery = recovery
+        # numerical-health tier (acg_tpu.health): the EAGER twin of the
+        # compiled tiers' in-loop audit -- f64 arithmetic, so this
+        # solver doubles as the ground-truth-gap oracle in the tests.
+        # `replace` applies residual replacement literally (r := b - Ax
+        # in place) instead of the compiled tiers' restart hand-off
+        if health is not None and not getattr(health, "armed", False):
+            health = None
+        self.health_spec = health
         # preconditioning tier (acg_tpu.precond): the eager PCG twin of
         # the compiled solvers' -- same three kinds, f64 numpy/scipy
         # arithmetic (this solver doubles as the PCG oracle in tests)
@@ -118,15 +126,26 @@ class HostCGSolver:
                 self.nnz_full * (8 + 4) + 2 * self.n * 8,
                 state_bytes(M.state))
         pol = self.recovery
-        detect = pol is not None or fault is not None
+        # detection mirrors the device tiers' _detect: recovery, an
+        # active injector, or a health spec whose detectors trip (the
+        # replace/abort/stall actions route through the driver so the
+        # restart budget and the resilience counters stay honest)
+        detect = (pol is not None or fault is not None
+                  or (self.health_spec is not None
+                      and self.health_spec.arms_detect))
         driver = None
         if detect:
             from acg_tpu.solvers.resilience import RecoveryDriver
             driver = RecoveryDriver(pol, st, "host-cg")
+        hspec = self.health_spec
+        audited = hspec is not None and hspec.every > 0
+        # audit bookkeeping mirroring the device tiers' carried vector
+        h_gap, h_gap_max, h_naud, h_stall = float("nan"), 0.0, 0, 0
+        rr_prev = float("inf")
         recorder = None
         if self.trace:
             from acg_tpu.telemetry import EagerTraceRecorder
-            recorder = EagerTraceRecorder(self.trace)
+            recorder = EagerTraceRecorder(self.trace, audit=audited)
 
         def finish_trace():
             if recorder is not None:
@@ -197,6 +216,12 @@ class HostCGSolver:
                 st.tsolve += time.perf_counter() - tstart
                 st.converged = False
                 st.fexcept_arrays = [x, r]
+                if hspec is not None:
+                    # the audits that ran must reach the health
+                    # surfaces on exactly the failing solves
+                    from acg_tpu.health import note_audit
+                    note_audit(st, [h_gap, h_gap_max, h_naud, h_stall],
+                               hspec, "host-cg")
                 raise driver.give_up(k, st.rnrm2)
             if not np.isfinite(x).all():
                 x = (np.array(x0, dtype=np.float64, copy=True)
@@ -295,7 +320,11 @@ class HostCGSolver:
             if detect and (not np.isfinite(gamma_next)
                            or not np.isfinite(rr)
                            # a negative (r, z): the non-SPD-M signal
-                           or (M is not None and gamma_next < 0)):
+                           or (M is not None and gamma_next < 0)
+                           # sign anomaly under the health tier: a
+                           # negative computed (r, r) is arithmetic
+                           # poison (device-tier rationale)
+                           or (hspec is not None and gamma_next < 0)):
                 k += 1
                 st.niterations = k
                 st.ntotaliterations += 1
@@ -311,6 +340,82 @@ class HostCGSolver:
                            else "non-SPD preconditioner signal")
                 converged = self._test(crit, st, res_tol)
                 continue
+            gap = float("nan")
+            if audited and (k + 1) % hspec.every == 0:
+                # the eager twin of the device audit: true residual in
+                # f64 through the same CSR, gap relative to ||b||
+                rt = b - A @ x
+                gap = (float(np.linalg.norm(rt - r))
+                       / max(st.bnrm2, 1e-300))
+                h_gap, h_naud = gap, h_naud + 1
+                h_gap_max = max(h_gap_max, gap)
+                if hspec.threshold and gap > hspec.threshold:
+                    if hspec.action == "abort":
+                        st.tsolve += time.perf_counter() - tstart
+                        st.converged = False
+                        st.fexcept_arrays = [x, r]
+                        finish_trace()
+                        from acg_tpu.errors import BreakdownError
+                        from acg_tpu.health import note_audit
+                        note_audit(st, [h_gap, h_gap_max, h_naud,
+                                        h_stall], hspec, "host-cg")
+                        raise BreakdownError(
+                            f"host-cg: true-residual gap {gap:.3e} "
+                            f"exceeds threshold {hspec.threshold:g} at "
+                            f"iteration {k} (--on-gap abort)")
+                    if hspec.action == "replace":
+                        # residual replacement, applied literally (Van
+                        # der Vorst & Ye): the recurrence residual is
+                        # swapped for the true one -- but BOUNDED by
+                        # the same restart budget the compiled tiers
+                        # consume, and counted on the same resilience
+                        # counters (driver.on_breakdown), so the
+                        # cross-tier stats stay comparable and a
+                        # hair-trigger threshold cannot loop forever
+                        if not driver.on_breakdown(k):
+                            st.tsolve += time.perf_counter() - tstart
+                            st.converged = False
+                            st.fexcept_arrays = [x, r]
+                            finish_trace()
+                            from acg_tpu.errors import BreakdownError
+                            from acg_tpu.health import note_audit
+                            note_audit(st, [h_gap, h_gap_max, h_naud,
+                                            h_stall], hspec, "host-cg")
+                            raise BreakdownError(
+                                f"host-cg: true-residual gap {gap:.3e} "
+                                f"exceeds threshold "
+                                f"{hspec.threshold:g} at iteration "
+                                f"{k} (--on-gap replace); "
+                                f"{st.nrestarts} restart(s) exhausted "
+                                f"and no fallback available")
+                        st.recovery_log.append(
+                            f"residual replacement at iteration {k}: "
+                            f"gap {gap:.3e} > {hspec.threshold:g}")
+                        r = rt
+                        if M is not None:
+                            z = papply(r)
+                            gamma_next = float(r @ z)
+                        else:
+                            gamma_next = float(r @ r)
+                        rr = float(r @ r)
+            if hspec is not None and hspec.stall_window:
+                h_stall = 0 if rr < rr_prev else h_stall + 1
+                if h_stall >= hspec.stall_window:
+                    # the stagnation detector feeds the breakdown path
+                    # (an armed stall window always arms the driver --
+                    # see the detect computation above), so restarts,
+                    # counters, and the give-up raise match the
+                    # compiled tiers'
+                    k += 1
+                    st.niterations = k
+                    st.ntotaliterations += 1
+                    st.rnrm2 = float(np.sqrt(rr)) if rr >= 0 else rr
+                    h_stall = 0
+                    _breakdown(f"stagnation: {hspec.stall_window} "
+                               f"non-decreasing iterations")
+                    converged = self._test(crit, st, res_tol)
+                    continue
+            rr_prev = rr
             beta = gamma_next / gamma
             gamma = gamma_next
             if crit.needs_diff:
@@ -328,10 +433,11 @@ class HostCGSolver:
             if recorder is not None:
                 # the eager-twin contract: under precond the compiled
                 # rings record the PRECONDITIONED norm sqrt((r, z)) in
-                # the rnrm2 slot -- record the same quantity here
+                # the rnrm2 slot -- record the same quantity here (and
+                # this iteration's audit gap in the gap column)
                 gq = gamma if M is not None else rr
                 recorder.record(float(np.sqrt(gq)) if gq >= 0 else gq,
-                                alpha, beta, pdott)
+                                alpha, beta, pdott, gap=gap)
             if self.progress and k % self.progress == 0:
                 import sys
                 sys.stderr.write(f"acg-tpu: host-cg: iteration {k}: "
@@ -344,6 +450,10 @@ class HostCGSolver:
         from acg_tpu.telemetry import add_timing
         add_timing(st, "solve", t_solve)
         st.converged = converged or crit.unbounded
+        if hspec is not None:
+            from acg_tpu.health import note_audit
+            note_audit(st, [h_gap, h_gap_max, h_naud, h_stall], hspec,
+                       "host-cg")
         from acg_tpu import metrics
         metrics.record_solve(t_solve, st.niterations, st.converged,
                              solver="host-cg")

@@ -221,12 +221,13 @@ def _iterate(iter_body, init_state, gamma_of, maxits, res_tol,
 @functools.partial(jax.jit,
                    static_argnames=("unbounded", "needs_diff", "precise",
                                     "kernels", "detect", "fault", "trace",
-                                    "progress", "precond"))
+                                    "progress", "precond", "health"))
 def _cg_program(A: DeviceMatrix, b, x0, res_atol, res_rtol, diff_atol,
                 diff_rtol, maxits, unbounded: bool, needs_diff: bool,
                 precise: bool = False, kernels: str = "xla",
                 detect: bool = False, fault=None, trace: int = 0,
-                progress: int = 0, precond=None, mstate=None):
+                progress: int = 0, precond=None, mstate=None,
+                health=None):
     """Whole classic-CG solve as one XLA program.
 
     ``precise`` switches the CG scalars' dot products to the compensated
@@ -259,7 +260,17 @@ def _cg_program(A: DeviceMatrix, b, x0, res_atol, res_rtol, diff_atol,
     convergence test (and the reported rnrm2) keep the UNpreconditioned
     meaning while the telemetry ring records the preconditioned norm.
     ``None`` compiles the byte-identical unpreconditioned program
-    (pinned in tests/test_hlo_structure.py)."""
+    (pinned in tests/test_hlo_structure.py).
+
+    ``health`` (a static :class:`acg_tpu.health.HealthSpec`) arms the
+    numerical-health tier: every ``health.every`` iterations a
+    ``lax.cond``-guarded audit recomputes the TRUE residual
+    ``b - A x`` through this program's own SpMV and carries the
+    relative gap ``||r_true - r_rec||/||b||`` in a 4-scalar audit
+    vector (returned with the result; an extra ``gap`` ring column
+    when telemetry is also armed); the stagnation/sign detectors and a
+    tripped gap feed the breakdown flag (``detect`` must then be
+    armed).  ``None`` compiles the byte-identical unaudited program."""
     dtype = b.dtype
     dot, sdt = _scalar_setup(dtype, precise)
     store = (lambda v: v.astype(dtype)) if sdt != dtype else (lambda v: v)
@@ -285,10 +296,13 @@ def _cg_program(A: DeviceMatrix, b, x0, res_atol, res_rtol, diff_atol,
 
     if trace or progress:
         from acg_tpu import telemetry
+    if health is not None:
+        from acg_tpu import health as _health
 
-    # carry layout: (x, r, p, gamma [, rr] [, dx] [, bad] [, ring]) --
-    # rr (the true residual the convergence test reads) joins only
-    # under precond, dx only under a diff criterion
+    # carry layout: (x, r, p, gamma [, rr] [, dx] [, bad] [, aud]
+    # [, ring]) -- rr (the true residual the convergence test reads)
+    # joins only under precond, dx only under a diff criterion, the
+    # audit vector only under an armed health spec
     dx_i = 5 if precond is not None else 4
 
     # dxsqr joins the carry only when a diff criterion is active: every
@@ -296,6 +310,8 @@ def _cg_program(A: DeviceMatrix, b, x0, res_atol, res_rtol, diff_atol,
     def body(k, state):
         if trace:
             buf, state = state[-1], state[:-1]
+        if health is not None:
+            aud, state = state[-1], state[:-1]
         x, r, p, gamma = state[:4]
         # NOT the fused dia_spmv_dot: measured in-loop, the in-kernel
         # (p,t) scalar costs ~15% (1,355 vs 1,589 iters/s interleaved
@@ -340,6 +356,22 @@ def _cg_program(A: DeviceMatrix, b, x0, res_atol, res_rtol, diff_atol,
                 # breakdown into a converged exit
                 dx = jnp.where(bad, state[dx_i], dx)
             out = out + (dx,)
+        fire = None
+        if health is not None:
+            # in-loop true-residual audit: b - A x through THIS
+            # program's SpMV, guarded by lax.cond so non-audited
+            # iterations pay only the predicate
+            def compute_gap():
+                return _health.relative_gap(b - spmv_(A, x), r,
+                                            dot, bnrm2, sdt)
+
+            aud, fire = _health.audit_update(aud, health, k, compute_gap)
+            # residual non-decrease, measured on the scalar the
+            # convergence test reads (preconditioned: the carried rr)
+            prog_now = out[4] if precond is not None else gamma_next
+            prog_prev = state[4] if precond is not None else gamma
+            aud = _health.stall_update(aud, health,
+                                       prog_now < prog_prev)
         if detect:
             # a poison that slipped past pdott (e.g. a NaN row of t with
             # a finite dot) lands in r: flag it one iteration deferred.
@@ -348,29 +380,44 @@ def _cg_program(A: DeviceMatrix, b, x0, res_atol, res_rtol, diff_atol,
             deferred = bad | (~jnp.isfinite(gamma_next))
             if precond is not None:
                 deferred = deferred | (gamma_next < 0)
+            if health is not None:
+                if precond is None:
+                    # sign anomaly: a negative computed (r, r) is
+                    # arithmetic poison the finite-value guard misses
+                    deferred = deferred | (gamma_next < 0)
+                deferred = deferred | _health.trip(aud, health)
             out = out + (deferred,)
+        if health is not None:
+            out = out + (aud,)
         if trace:
             # record the RAW scalars (a poisoned pdott/gamma_next stays
             # visible in the window the recovery log quotes); under
             # precond gamma IS the preconditioned residual norm^2
+            audit_col = (_health.ring_gap(aud, fire, sdt)
+                         if health is not None else None)
             out = out + (telemetry.ring_record(buf, k, gamma_next, alpha,
-                                               beta, pdott),)
+                                               beta, pdott,
+                                               audit=audit_col),)
         if progress:
             telemetry.heartbeat(k, gamma_next, progress)
         return out
 
-    # the ring buffer rides LAST in the carry so every existing index
-    # (dx, the deferred-bad freeze reads) is untouched; only the
-    # tail accessors below shift by one
+    # the audit vector and ring buffer ride LAST in the carry (in that
+    # order) so every existing index (dx, the deferred-bad freeze
+    # reads) is untouched; only the tail accessors below shift
     init_state = (x0, r, p, gamma)
     if precond is not None:
         init_state = init_state + (rr,)
     init_state = init_state + ((inf,) if needs_diff else ())
     if detect:
         init_state = init_state + (jnp.asarray(False),)
+    if health is not None:
+        init_state = init_state + (_health.audit_init(sdt),)
     if trace:
-        init_state = init_state + (telemetry.ring_init(trace, sdt),)
-    bad_i = -2 if trace else -1
+        init_state = init_state + (telemetry.ring_init(
+            trace, sdt, audit=health is not None),)
+    ntail = (1 if trace else 0) + (1 if health is not None else 0)
+    bad_i = -1 - ntail
     # the convergence test reads the TRUE residual either way: gamma
     # itself unpreconditioned, the carried rr under precond
     conv_i = 4 if precond is not None else 3
@@ -391,7 +438,12 @@ def _cg_program(A: DeviceMatrix, b, x0, res_atol, res_rtol, diff_atol,
                    r0nrm2=r0nrm2, bnrm2=bnrm2, x0nrm2=x0nrm2,
                    dxnrm2=jnp.sqrt(dxsqr), converged=done,
                    breakdown=breakdown)
-    return (res, state[-1]) if trace else res
+    extras = ()
+    if trace:
+        extras = extras + (state[-1],)
+    if health is not None:
+        extras = extras + (state[-2] if trace else state[-1],)
+    return (res,) + extras if extras else res
 
 
 @functools.partial(jax.jit,
@@ -619,13 +671,13 @@ def _cg_fused_program(A: DeviceMatrix, b, x0, res_atol, res_rtol,
 @functools.partial(jax.jit,
                    static_argnames=("unbounded", "needs_diff", "precise",
                                     "kernels", "detect", "fault", "trace",
-                                    "progress", "precond"))
+                                    "progress", "precond", "health"))
 def _cg_pipelined_program(A: DeviceMatrix, b, x0, res_atol, res_rtol,
                           diff_atol, diff_rtol, maxits, unbounded: bool,
                           needs_diff: bool, precise: bool = False,
                           kernels: str = "xla", detect: bool = False,
                           fault=None, trace: int = 0, progress: int = 0,
-                          precond=None, mstate=None):
+                          precond=None, mstate=None, health=None):
     """Whole pipelined-CG (Ghysels-Vanroose) solve as one XLA program.
 
     ``detect``/``fault``/``trace``/``progress`` as in
@@ -648,7 +700,14 @@ def _cg_pipelined_program(A: DeviceMatrix, b, x0, res_atol, res_rtol,
     the unpreconditioned q = A w.  The fused reduction carries THREE
     scalars (gamma = (r, u), delta = (w, u), rr = (r, r)) so the mesh
     tiers keep the single-allreduce property.  ``None`` compiles the
-    byte-identical unpreconditioned program."""
+    byte-identical unpreconditioned program.
+
+    ``health`` (acg_tpu.health.HealthSpec) arms the in-loop
+    true-residual audit + stagnation/sign detectors exactly as in
+    :func:`_cg_program` -- this is the tier the audit matters MOST for:
+    the pipelined recurrences are the ones whose recursively-updated
+    residual drifts from ``b - A x`` (arXiv:1801.04728), and the audit
+    measures that drift with the loop's own SpMV."""
     dtype = b.dtype
     dot, sdt = _scalar_setup(dtype, precise)
     store = (lambda v: v.astype(dtype)) if sdt != dtype else (lambda v: v)
@@ -672,6 +731,8 @@ def _cg_pipelined_program(A: DeviceMatrix, b, x0, res_atol, res_rtol,
     zeros = jnp.zeros_like(b)
     if trace or progress:
         from acg_tpu import telemetry
+    if health is not None:
+        from acg_tpu import health as _health
 
     def pbody(k, state):
         """Preconditioned GV body: carry (x, r, u, w, p, s, q, z,
@@ -680,7 +741,10 @@ def _cg_pipelined_program(A: DeviceMatrix, b, x0, res_atol, res_rtol,
         A-direction."""
         if trace:
             buf, state = state[-1], state[:-1]
+        if health is not None:
+            aud, state = state[-1], state[:-1]
         x, r, u, w, p, s, q, z, gamma_prev, alpha_prev = state[:10]
+        rr_prev = state[10]
         # the iteration's three reductions, fused (ONE allreduce on a
         # mesh): gamma/delta drive the recurrences, rr feeds the true-
         # residual convergence test (stale by one, like gamma)
@@ -727,13 +791,31 @@ def _cg_pipelined_program(A: DeviceMatrix, b, x0, res_atol, res_rtol,
             if detect:
                 dx = jnp.where(bad, state[11], dx)
             out = out + (dx,)
+        fire = None
+        if health is not None:
+            def compute_gap():
+                return _health.relative_gap(b - spmv_(A, x), r,
+                                            dot, bnrm2, sdt)
+
+            aud, fire = _health.audit_update(aud, health, k, compute_gap)
+            # progress measured on the fused (r, r) scalar (stale by
+            # one, like the convergence test)
+            aud = _health.stall_update(aud, health, rr < rr_prev)
         if detect:
-            out = out + (bad,)
+            flag = bad
+            if health is not None:
+                flag = flag | _health.trip(aud, health)
+            out = out + (flag,)
+        if health is not None:
+            out = out + (aud,)
         if trace:
             # gamma = the PRECONDITIONED residual norm^2 (stale by one,
             # like the convergence test); alpha denominator in pAp slot
+            audit_col = (_health.ring_gap(aud, fire, sdt)
+                         if health is not None else None)
             out = out + (telemetry.ring_record(buf, k, gamma, alpha,
-                                               beta, denom),)
+                                               beta, denom,
+                                               audit=audit_col),)
         if progress:
             telemetry.heartbeat(k, gamma, progress)
         return out
@@ -741,6 +823,8 @@ def _cg_pipelined_program(A: DeviceMatrix, b, x0, res_atol, res_rtol,
     def body(k, state):
         if trace:
             buf, state = state[-1], state[:-1]
+        if health is not None:
+            aud, state = state[-1], state[:-1]
         x, r, w, p, t, z, gamma_prev, alpha_prev = state[:8]
         # both reductions of the iteration, fused (one allreduce on a mesh)
         gamma = dot(r, r)
@@ -758,6 +842,11 @@ def _cg_pipelined_program(A: DeviceMatrix, b, x0, res_atol, res_rtol,
             # the alpha denominator plays the (p, Ap) role here; freeze
             # x/r/w on breakdown (p/t/z are scratch once the loop exits)
             bad, alpha = _breakdown_guard(gamma, denom)
+            if health is not None:
+                # sign anomaly: a negative computed (r, r) is
+                # arithmetic poison (the finite-value guard misses it)
+                bad = bad | (gamma < 0)
+                alpha = jnp.where(bad, jnp.zeros_like(alpha), alpha)
         else:
             alpha = gamma / denom
         # the 6-vector update stays in XLA even under kernels="pallas":
@@ -785,13 +874,29 @@ def _cg_pipelined_program(A: DeviceMatrix, b, x0, res_atol, res_rtol,
                 # must not fake the diff criterion
                 dx = jnp.where(bad, state[8], dx)
             out = out + (dx,)
+        fire = None
+        if health is not None:
+            def compute_gap():
+                return _health.relative_gap(b - spmv_(A, x), r,
+                                            dot, bnrm2, sdt)
+
+            aud, fire = _health.audit_update(aud, health, k, compute_gap)
+            aud = _health.stall_update(aud, health, gamma < gamma_prev)
         if detect:
-            out = out + (bad,)
+            flag = bad
+            if health is not None:
+                flag = flag | _health.trip(aud, health)
+            out = out + (flag,)
+        if health is not None:
+            out = out + (aud,)
         if trace:
             # the carried gamma (stale by one, like the convergence
             # test) and the alpha denominator in the pAp slot
+            audit_col = (_health.ring_gap(aud, fire, sdt)
+                         if health is not None else None)
             out = out + (telemetry.ring_record(buf, k, gamma, alpha,
-                                               beta, denom),)
+                                               beta, denom,
+                                               audit=audit_col),)
         if progress:
             telemetry.heartbeat(k, gamma, progress)
         return out
@@ -817,9 +922,13 @@ def _cg_pipelined_program(A: DeviceMatrix, b, x0, res_atol, res_rtol,
         init_gamma = r0nrm2 * r0nrm2
     if detect:
         init_state = init_state + (jnp.asarray(False),)
+    if health is not None:
+        init_state = init_state + (_health.audit_init(sdt),)
     if trace:
-        init_state = init_state + (telemetry.ring_init(trace, sdt),)
-    bad_i = -2 if trace else -1
+        init_state = init_state + (telemetry.ring_init(
+            trace, sdt, audit=health is not None),)
+    ntail = (1 if trace else 0) + (1 if health is not None else 0)
+    bad_i = -1 - ntail
     k, state, done = _iterate(
         loop_body, init_state, conv_of, maxits,
         res_tol, diff_tol, dx_of,
@@ -841,7 +950,12 @@ def _cg_pipelined_program(A: DeviceMatrix, b, x0, res_atol, res_rtol,
     res = CGResult(x=x, niterations=k, rnrm2=rnrm2, r0nrm2=r0nrm2,
                    bnrm2=bnrm2, x0nrm2=x0nrm2, dxnrm2=jnp.sqrt(dxsqr),
                    converged=done, breakdown=breakdown)
-    return (res, state[-1]) if trace else res
+    extras = ()
+    if trace:
+        extras = extras + (state[-1],)
+    if health is not None:
+        extras = extras + (state[-2] if trace else state[-1],)
+    return (res,) + extras if extras else res
 
 
 class JaxCGSolver:
@@ -857,7 +971,7 @@ class JaxCGSolver:
                  vector_dtype=None, replace_every: int = 0,
                  replace_restart: bool = True, recovery=None,
                  host_matrix=None, trace: int = 0, progress: int = 0,
-                 precond=None):
+                 precond=None, health=None):
         """``recovery`` (a :class:`acg_tpu.solvers.resilience.
         RecoveryPolicy`) arms breakdown detection in the compiled loop
         plus the host-side restart policy; ``host_matrix`` (scipy CSR)
@@ -891,7 +1005,15 @@ class JaxCGSolver:
         None) arms preconditioned CG / pipelined CG: the state is built
         once (lazily, on device) and rides the solve programs as an
         argument; ``None`` leaves every lowered program byte-identical
-        to an unpreconditioned build."""
+        to an unpreconditioned build.
+
+        ``health`` (an :class:`acg_tpu.health.HealthSpec` or None) arms
+        the numerical-health tier: the in-loop true-residual audit
+        (every ``health.every`` iterations, through this tier's own
+        SpMV), the stagnation/sign detectors, and -- for tripping
+        actions -- the breakdown path + recovery hand-off.  ``None``
+        leaves every lowered program byte-identical to an unaudited
+        build (pinned in tests/test_hlo_structure.py)."""
         self.A = A
         self.vector_dtype = vector_dtype
         self.pipelined = pipelined
@@ -993,6 +1115,32 @@ class JaxCGSolver:
         # the preconditioner state pytree (device arrays); built lazily
         # at first dispatch so construction stays zero-transfer
         self._mstate = None
+        # numerical-health tier (acg_tpu.health): the audit/detector
+        # spec rides the direct programs as a static argument; the
+        # replacement/fused tiers have no audit hook (the replacement
+        # segments ARE periodic true-residual recomputation, and the
+        # fused kernels fold the whole iteration), so an armed spec
+        # refuses there rather than silently audit nothing
+        if health is not None:
+            from acg_tpu.health import HealthSpec
+            if not isinstance(health, HealthSpec):
+                raise ValueError("health must be an "
+                                 "acg_tpu.health.HealthSpec or None")
+            if not health.armed:
+                health = None
+        if health is not None:
+            if self.replace_every:
+                raise ValueError(
+                    "the true-residual audit (health) does not compose "
+                    "with replace_every: the replacement segments "
+                    "already recompute b - A x every K iterations -- "
+                    "the audit would measure its own mechanism")
+            if isinstance(kernels, str) and kernels.startswith("fused"):
+                raise ValueError(
+                    "kernels='fused' folds the whole iteration into "
+                    "two streamed kernels and has no audit hook; the "
+                    "health tier needs kernels='xla'/'pallas'")
+        self.health_spec = health
         self.kernels = kernels
         self.recovery = recovery
         self.host_matrix = host_matrix
@@ -1141,6 +1289,10 @@ class JaxCGSolver:
                 # kwarg is passed at all without a spec
                 kwargs["precond"] = self.precond_spec
                 kwargs["mstate"] = self._ensure_precond_state()
+            if self.health_spec is not None:
+                # same discipline: an unaudited build never even names
+                # the kwarg
+                kwargs["health"] = self.health_spec
         tr = self.trace and not (self.replace_every
                                  or (isinstance(self.kernels, str)
                                      and self.kernels.startswith("fused")))
@@ -1165,8 +1317,18 @@ class JaxCGSolver:
         x0 = (jnp.zeros_like(b) if x0 is None
               else jnp.asarray(x0, dtype=dtype))
         program, args, kwargs, _ = self._select_program(
-            b, x0, crit, detect=self.recovery is not None, fault=None)
+            b, x0, crit, detect=self._detect(None), fault=None)
         return program.lower(*args, **kwargs)
+
+    def _detect(self, fault) -> bool:
+        """Whether the compiled loop carries the breakdown flag:
+        recovery armed, an active injector, or a health spec whose
+        detectors trip the breakdown path -- shared by solve() and the
+        lower_solve hook so the analyzed program is the dispatched
+        one."""
+        return (self.recovery is not None or fault is not None
+                or (self.health_spec is not None
+                    and self.health_spec.arms_detect))
 
     def solve(self, b, x0=None, criteria: StoppingCriteria | None = None,
               raise_on_divergence: bool = True, warmup: int = 0,
@@ -1219,10 +1381,11 @@ class JaxCGSolver:
                 f"global vector and cannot target part {fault.part}; "
                 f"drop part= or use the partitioned DistCGSolver path "
                 f"for part-targeted injection")
-        # detection arms with the recovery policy OR an active injector
-        # (an injected fault must surface, never launder into x); the
-        # detect=False programs stay byte-identical to the seed's
-        detect = self.recovery is not None or fault is not None
+        # detection arms with the recovery policy, an active injector
+        # (an injected fault must surface, never launder into x), or a
+        # tripping health spec; the detect=False programs stay
+        # byte-identical to the seed's
+        detect = self._detect(fault)
         # dtype policy (vector_dtype override, f32 replacement outer)
         # lives in _solve_dtype, shared with the lower_solve hook
         dtype = self._solve_dtype()
@@ -1247,10 +1410,17 @@ class JaxCGSolver:
         program, args, kwargs, tr = self._select_program(
             b, x0, crit, detect=detect, fault=fault)
 
+        hl = "health" in kwargs
+
         def run(*a, **kw):
-            """One program invocation, normalised to (CGResult, ring)."""
+            """One program invocation, normalised to
+            (CGResult, ring, audit-vector)."""
             out = program(*a, **kw)
-            return out if tr else (out, None)
+            if not tr and not hl:
+                return out, None, None
+            out = out if isinstance(out, tuple) else (out,)
+            return (out[0], out[1] if tr else None,
+                    out[-1] if hl else None)
 
         def attempt_trace(res, tbuf):
             """The ONE host fetch of a traced solve: un-rotate this
@@ -1279,10 +1449,18 @@ class JaxCGSolver:
                                  time.perf_counter() - t_warm)
         t0 = time.perf_counter()
         with telemetry.annotate("solve"):
-            res, tbuf = run(*args, **kwargs)
+            res, tbuf, aud = run(*args, **kwargs)
             device_sync(res.x)
         niter = int(res.niterations)
         first_norms = None
+        # the first note_audit of this solve resets the health summary;
+        # later attempts MERGE (gap_max keeps the worst gap that
+        # tripped, naudits accumulates across restarts).  gap_tripped
+        # remembers whether the LATEST attempt's exit was a gap trip,
+        # so the no-rungs-left raise below can name the real cause
+        # instead of the generic arithmetic-breakdown diagnosis
+        aud_fresh = True
+        gap_tripped = False
         if detect and bool(res.breakdown):
             # host-side recovery (solvers.resilience): bounded restarts
             # from the last finite iterate -- the program's setup
@@ -1304,11 +1482,35 @@ class JaxCGSolver:
                           crit.residual_rtol * float(res.r0nrm2))
             while bool(res.breakdown):
                 k_done = int(res.niterations)
+                if hl and aud is not None:
+                    # this attempt's audit evidence BEFORE the restart
+                    # decision: an accuracy_degraded event marks a gap
+                    # trip apart from an arithmetic breakdown, and the
+                    # restart's true-residual recompute IS the
+                    # residual-replacement fix
+                    from acg_tpu import health as health_mod
+                    gap_tripped = health_mod.note_audit(
+                        st, aud, self.health_spec, "jax-cg",
+                        fresh=aud_fresh)
+                    aud_fresh = False
                 if tr:
                     # the trajectory that led INTO the breakdown -- the
                     # evidence the post-hoc stats block cannot show
                     st.trace = self.last_trace = attempt_trace(res, tbuf)
                     driver.log_trace_window(st.trace)
+                if gap_tripped and self.health_spec.action == "abort":
+                    # host-tier parity: --on-gap abort is a hard stop,
+                    # the restart budget belongs to replace -- without
+                    # this an armed recovery policy would silently turn
+                    # abort into replace
+                    st.tsolve += time.perf_counter() - t0
+                    st.converged = False
+                    from acg_tpu.errors import BreakdownError
+                    raise BreakdownError(
+                        f"jax-cg: true-residual gap "
+                        f"{st.health.get('gap_max', 0.0):.3e} exceeds "
+                        f"threshold {self.health_spec.threshold:g} at "
+                        f"iteration {niter} (--on-gap abort)")
                 if driver.on_breakdown(k_done):
                     x_next = res.x
                     if not bool(jnp.isfinite(x_next).all()):
@@ -1329,7 +1531,7 @@ class JaxCGSolver:
                             + (jnp.asarray(abs_tol, sdt),
                                jnp.asarray(0.0, sdt)) + args[5:-1]
                             + (jnp.int32(remaining),))
-                    res, tbuf = run(*args, **kwargs)
+                    res, tbuf, aud = run(*args, **kwargs)
                     device_sync(res.x)
                     niter += int(res.niterations)
                     continue
@@ -1342,6 +1544,18 @@ class JaxCGSolver:
                         b, crit, raise_on_divergence, host_result)
                 st.tsolve += time.perf_counter() - t0
                 st.converged = False
+                if gap_tripped:
+                    # name the REAL cause: this exit was an accuracy
+                    # gate, not arithmetic poison (host-tier parity)
+                    from acg_tpu.errors import BreakdownError
+                    raise BreakdownError(
+                        f"jax-cg: true-residual gap "
+                        f"{st.health.get('gap_max', 0.0):.3e} exceeds "
+                        f"threshold {self.health_spec.threshold:g} at "
+                        f"iteration {niter} (--on-gap "
+                        f"{self.health_spec.action}); "
+                        f"{st.nrestarts} restart(s) exhausted and no "
+                        f"fallback available")
                 raise driver.give_up(niter, float(res.rnrm2))
         t_solve = time.perf_counter() - t0
         st.tsolve += t_solve
@@ -1359,6 +1573,12 @@ class JaxCGSolver:
         st.rnrm2 = float(res.rnrm2)
         st.dxnrm2 = float(res.dxnrm2)
         st.converged = bool(res.converged) or crit.unbounded
+        if hl and aud is not None:
+            # the health: section's audit summary + the acg_health_*
+            # metrics + (threshold exceeded) the accuracy_degraded event
+            from acg_tpu import health as health_mod
+            health_mod.note_audit(st, aud, self.health_spec, "jax-cg",
+                                  fresh=aud_fresh)
         # service-metrics tier: one completed solve (no-op disarmed;
         # the sharded subclass reuses this solve, so its comm ledger
         # rides through the same hook)

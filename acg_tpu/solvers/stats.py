@@ -132,6 +132,10 @@ class SolverStats:
     # preconditioner's kind/parameters, analytic applies, and spectral
     # estimates.  Appends after every existing section, like soak
     precond: dict = dataclasses.field(default_factory=dict)
+    # numerical-health tier (acg_tpu.health, stats schema /5): in-loop
+    # true-residual audit summary (gap/count/threshold) and the
+    # post-hoc Lanczos spectrum estimate.  Appends strictly last
+    health: dict = dataclasses.field(default_factory=dict)
 
     def to_dict(self) -> dict:
         """Machine-readable twin of :meth:`fwrite` -- the ``stats`` key
@@ -175,6 +179,7 @@ class SolverStats:
             "memory": dict(self.memory),
             "soak": dict(self.soak),
             "precond": dict(self.precond),
+            "health": dict(self.health),
         }
         if self.trace is not None:
             d["trace"] = self.trace.to_dict()
@@ -262,6 +267,9 @@ class SolverStats:
         if self.precond:
             p("precond:")
             _write_section(p, self.precond, 1)
+        if self.health:
+            p("health:")
+            _write_section(p, self.health, 1)
         text = out.getvalue()
         if f is not None:
             f.write(text)

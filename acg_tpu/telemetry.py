@@ -67,13 +67,24 @@ from acg_tpu.solvers.stats import PHASE_ORDER
 # inside the stats twin (kind/applies/spectral estimates), a "precond"
 # op-class row under "ops", and a manifest "precond" key that joins the
 # bench-diff case key -- additive, so /1../3 consumers keep working
-STATS_SCHEMA = "acg-tpu-stats/4"
+# /5: the numerical-health tier (acg_tpu.health) adds a "health" key
+# inside the stats twin (true-residual audit summary + Lanczos spectrum
+# estimate) and an optional "gap" column in trace records (the audit
+# column; its presence is declared by the trace/meta "fields" list so
+# mixed audited/unaudited windows round-trip) -- additive, so /1../4
+# consumers keep working
+STATS_SCHEMA = "acg-tpu-stats/5"
 CONVERGENCE_SCHEMA = "acg-tpu-convergence/1"
 # default ring capacity (--telemetry-window): 512 iterations x 4 scalars
 # is 8 KiB of f32 carry -- negligible against any solve's vectors, and
 # deep enough to show the drift window leading into a breakdown
 DEFAULT_WINDOW = 512
 TRACE_FIELDS = ("rnrm2", "alpha", "beta", "pAp")
+# the optional 5th ring column (the numerical-health tier's in-loop
+# true-residual audit, acg_tpu.health): relative gap on audited
+# iterations, NaN elsewhere.  Declared through the trace's "fields"
+# list so readers never misalign mixed audited/unaudited windows
+AUDIT_FIELD = "gap"
 # a rank whose solve time exceeds this multiple of the median gets the
 # straggler callout in the cross-rank report
 STRAGGLER_RATIO = 1.2
@@ -81,27 +92,34 @@ STRAGGLER_RATIO = 1.2
 
 # -- device-side ring buffer (inside jit; capacity is static) -----------
 
-def ring_init(capacity: int, dtype):
+def ring_init(capacity: int, dtype, audit: bool = False):
     """The carried ring buffer: ``(capacity, 4)`` slots of
     ``(rnrm2sqr, alpha, beta, pAp)``, NaN-initialised so unwritten
-    slots are detectable host-side."""
+    slots are detectable host-side.  ``audit`` (the numerical-health
+    tier) grows a 5th ``gap`` column for the in-loop true-residual
+    audit; without it the layout is byte-identical to every pre-/5
+    ring."""
     import jax.numpy as jnp
 
-    return jnp.full((max(int(capacity), 1), len(TRACE_FIELDS)),
-                    jnp.nan, dtype=dtype)
+    width = len(TRACE_FIELDS) + (1 if audit else 0)
+    return jnp.full((max(int(capacity), 1), width), jnp.nan, dtype=dtype)
 
 
-def ring_record(buf, k, rnrm2sqr, alpha, beta, pAp):
+def ring_record(buf, k, rnrm2sqr, alpha, beta, pAp, audit=None):
     """Write iteration ``k``'s scalars into slot ``k % capacity``.
     One dynamic_update_slice per iteration -- the documented price of
     telemetry-on (every extra loop-carried array costs; see the
     jax_cg._cg_program carry notes); disarmed programs compile without
-    any of this."""
+    any of this.  ``audit`` fills the optional gap column (a ring built
+    with ``audit=True`` only)."""
     import jax
     import jax.numpy as jnp
 
+    vals = (rnrm2sqr, alpha, beta, pAp)
+    if audit is not None:
+        vals = vals + (audit,)
     row = jnp.stack([jnp.asarray(v, buf.dtype).reshape(())
-                     for v in (rnrm2sqr, alpha, beta, pAp)])[None]
+                     for v in vals])[None]
     slot = jnp.asarray(k, jnp.int32) % buf.shape[0]
     return jax.lax.dynamic_update_slice(buf, row, (slot, jnp.int32(0)))
 
@@ -142,7 +160,11 @@ class ConvergenceTrace:
     0-based iteration index of each row, contiguous and ascending.
     ``wrapped`` marks a ring that overwrote its oldest rows: only the
     trailing ``capacity`` iterations survive (truncation, marked in the
-    JSONL meta record)."""
+    JSONL meta record).  ``fields`` names the record columns -- rings
+    carrying the numerical-health audit column append ``"gap"``
+    (relative true-residual gap on audited iterations, NaN elsewhere),
+    and the JSONL meta line carries the same list so mixed
+    audited/unaudited windows round-trip without misaligned fields."""
 
     capacity: int
     niterations: int
@@ -150,15 +172,19 @@ class ConvergenceTrace:
     iterations: np.ndarray
     wrapped: bool
     solver: str = "cg"
+    fields: tuple = TRACE_FIELDS
 
     @classmethod
     def from_ring(cls, buf, niterations: int, solver: str = "cg",
                   already_norm: bool = False) -> "ConvergenceTrace":
         """Un-rotate a fetched ring buffer: slot ``k % capacity`` holds
         iteration ``k``, so the surviving window is iterations
-        ``[max(0, n - capacity), n)``."""
+        ``[max(0, n - capacity), n)``.  The column names come from the
+        ring's width (4 = the classic tuple, 5 = + the audit column)."""
         buf = np.asarray(buf, dtype=np.float64)
         cap = int(buf.shape[0])
+        fields = tuple(TRACE_FIELDS) + (
+            (AUDIT_FIELD,) if buf.shape[1] > len(TRACE_FIELDS) else ())
         n = int(niterations)
         m = min(n, cap)
         its = np.arange(n - m, n, dtype=np.int64)
@@ -171,7 +197,8 @@ class ConvergenceTrace:
             g = rows[:, 0]
             rows[:, 0] = np.where(g >= 0, np.sqrt(np.abs(g)), g)
         return cls(capacity=cap, niterations=n, records=rows,
-                   iterations=its, wrapped=n > cap, solver=solver)
+                   iterations=its, wrapped=n > cap, solver=solver,
+                   fields=fields)
 
     @property
     def first_iteration(self) -> int:
@@ -188,14 +215,14 @@ class ConvergenceTrace:
             "niterations": self.niterations,
             "first_iteration": self.first_iteration,
             "wrapped": self.wrapped,
-            "fields": list(TRACE_FIELDS),
+            "fields": list(self.fields),
             "records": [self.record_dict(i)
                         for i in range(self.iterations.size)],
         }
 
     def record_dict(self, i: int) -> dict:
         rec = {"it": int(self.iterations[i])}
-        for j, f in enumerate(TRACE_FIELDS):
+        for j, f in enumerate(self.fields):
             rec[f] = _json_float(self.records[i, j])
         return rec
 
@@ -219,40 +246,62 @@ class ConvergenceTrace:
 
     def tail_summary(self, n: int = 5) -> str:
         """The trailing residual window as one human line -- what the
-        recovery driver logs next to a breakdown/restart event."""
+        recovery driver logs next to a breakdown/restart event.  When
+        the audit column is present each audited entry carries its gap
+        inline, and the line says so -- a reader of a mixed window must
+        never mistake audit gaps for residuals."""
         m = min(int(n), self.iterations.size)
         if not m:
             return "trailing residual window: (empty)"
-        parts = [f"it {int(self.iterations[-m + i])}: "
-                 f"{self.records[-m + i, 0]:.3e}" for i in range(m)]
-        return "trailing residual window: " + ", ".join(parts)
+        audited = AUDIT_FIELD in self.fields
+        gi = self.fields.index(AUDIT_FIELD) if audited else None
+        parts = []
+        for i in range(m):
+            row = self.records[-m + i]
+            s = f"it {int(self.iterations[-m + i])}: {row[0]:.3e}"
+            if audited and math.isfinite(row[gi]):
+                s += f" (gap {row[gi]:.3e})"
+            parts.append(s)
+        line = "trailing residual window: " + ", ".join(parts)
+        if audited:
+            line += " [audit gap column present]"
+        return line
 
 
 class EagerTraceRecorder:
     """The eager twin of the device ring for the host solver: same
-    capacity/wrap semantics, recorded per iteration in plain Python."""
+    capacity/wrap semantics, recorded per iteration in plain Python.
+    ``audit=True`` mirrors the health tier's 5-column ring (gap column,
+    NaN on unaudited iterations)."""
 
-    def __init__(self, capacity: int, solver: str = "host-cg"):
+    def __init__(self, capacity: int, solver: str = "host-cg",
+                 audit: bool = False):
         self.capacity = max(int(capacity), 1)
         self.solver = solver
+        self.audit = bool(audit)
         self._rows: list = [None] * self.capacity
         self._n = 0
 
     def record(self, rnrm2: float, alpha: float, beta: float,
-               pAp: float) -> None:
-        self._rows[self._n % self.capacity] = (
-            float(rnrm2), float(alpha), float(beta), float(pAp))
+               pAp: float, gap: float = math.nan) -> None:
+        row = (float(rnrm2), float(alpha), float(beta), float(pAp))
+        if self.audit:
+            row = row + (float(gap),)
+        self._rows[self._n % self.capacity] = row
         self._n += 1
 
     def finish(self) -> ConvergenceTrace:
         n, cap = self._n, self.capacity
+        width = len(TRACE_FIELDS) + (1 if self.audit else 0)
+        fields = tuple(TRACE_FIELDS) + ((AUDIT_FIELD,) if self.audit
+                                        else ())
         m = min(n, cap)
         its = np.arange(n - m, n, dtype=np.int64)
         rows = np.asarray([self._rows[k % cap] for k in its],
-                          dtype=np.float64).reshape(m, len(TRACE_FIELDS))
+                          dtype=np.float64).reshape(m, width)
         return ConvergenceTrace(capacity=cap, niterations=n, records=rows,
                                 iterations=its, wrapped=n > cap,
-                                solver=self.solver)
+                                solver=self.solver, fields=fields)
 
 
 def read_convergence_log(path) -> tuple[dict, list[dict]]:

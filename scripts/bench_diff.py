@@ -52,13 +52,22 @@ def main(argv=None) -> int:
     # jax imports inside the functions that need a device)
     sys.path.insert(0, os.path.dirname(os.path.dirname(
         os.path.abspath(__file__))))
-    from acg_tpu.perfmodel import compare_cases, load_cases
+    from acg_tpu.perfmodel import (compare_cases, load_cases,
+                                   refuse_unavailable)
 
     try:
         old = load_cases(args.baseline)
         new = load_cases(args.candidate)
     except OSError as e:
         print(f"bench-diff: {e}", file=sys.stderr)
+        return 2
+    # a capture that only records the backend-unavailable sentinel
+    # (BENCH_r05-style: the tunnel was down, value 0) describes a run
+    # that never reached hardware -- refuse the comparison outright
+    # instead of "diffing" against nothing (ROADMAP Recent notes r05)
+    old, new, refused = refuse_unavailable(old, new, args.baseline,
+                                           args.candidate)
+    if refused:
         return 2
     lines, nreg, ncmp = compare_cases(old, new, args.fail_on_regress)
     for ln in lines:

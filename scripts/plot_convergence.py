@@ -46,7 +46,14 @@ def _load_conv(path):
     # poisoned values arrive as repr strings ("nan"/"inf"); float()
     # parses those directly, so they stay non-finite for the renderers
     rn = [float(r["rnrm2"]) for r in records]
-    return meta, its, rn
+    # the numerical-health tier's audit column (/5): present when the
+    # meta "fields" list declares it; NaN on unaudited iterations, so
+    # mixed windows align by construction
+    gaps = None
+    if any("gap" in r for r in records):
+        gaps = [float(r["gap"]) if "gap" in r else math.nan
+                for r in records]
+    return meta, its, rn, gaps
 
 
 # -- latency inputs ------------------------------------------------------
@@ -100,9 +107,11 @@ def _load_metrics_textfile(path):
 
 
 def _load_stats_json(path):
-    """Latency evidence out of an ``acg-tpu-stats`` document (single
-    document or the first JSONL line): the soak report's percentiles,
-    plus the registry snapshot's latency buckets when present."""
+    """Latency + health evidence out of an ``acg-tpu-stats`` document
+    (single document or the first JSONL line): the soak report's
+    percentiles, the registry snapshot's latency buckets, and the /5
+    ``health`` section (audit gap summary + Lanczos spectrum) when
+    present."""
     with open(path) as f:
         text = f.read()
     try:
@@ -121,18 +130,21 @@ def _load_stats_json(path):
     if not isinstance(doc, dict) or "stats" not in doc:
         raise ValueError("not an acg-tpu-stats document")
     soak = (doc.get("stats") or {}).get("soak") or {}
+    health = (doc.get("stats") or {}).get("health") or {}
     cum = None
     samples = ((doc.get("metrics") or {}).get("acg_solve_seconds")
                or {}).get("samples") or []
     if samples:
         cum = [((math.inf if ub is None else float(ub)), int(c))
                for ub, c in samples[0].get("buckets", [])]
-    return soak, cum
+    return soak, cum, health
 
 
-def _latency_summary(label, soak, cum):
+def _latency_summary(label, soak, cum, health=None):
     """One record the renderers share: percentiles (soak report first,
-    histogram-derived otherwise) + the occupied bucket histogram."""
+    histogram-derived otherwise) + the occupied bucket histogram + the
+    /5 health annotation (audit gap, kappa estimate, predicted-vs-
+    measured iterations)."""
     pcts = {}
     lat = soak.get("latency") or {}
     for k in ("p50", "p95", "p99"):
@@ -145,7 +157,29 @@ def _latency_summary(label, soak, cum):
                 pcts[k] = v
     return {"label": label, "pcts": pcts, "cum": cum,
             "nsolves": soak.get("nsolves"),
-            "drift": soak.get("drift") or {}}
+            "drift": soak.get("drift") or {},
+            "health": health or {}}
+
+
+def _health_note(health) -> str | None:
+    """The one-line kappa / audit annotation for a /5 health section
+    (shared by the text fallback and the matplotlib title)."""
+    if not health:
+        return None
+    bits = []
+    if health.get("gap_max") is not None:
+        bits.append(f"audit gap max {health['gap_max']:.3g}"
+                    + (f" (x{health['naudits']} audits)"
+                       if health.get("naudits") else ""))
+    spec = health.get("spectrum") or {}
+    if spec.get("kappa"):
+        bits.append(f"kappa~{spec['kappa']:.4g}")
+    if spec.get("predicted_iterations"):
+        bits.append(f"CG bound {spec['predicted_iterations']} its vs "
+                    f"measured {spec.get('measured_iterations', '?')}")
+    if spec.get("precond_effectiveness"):
+        bits.append(f"precond {spec['precond_effectiveness']:.2f}x")
+    return "; ".join(bits) if bits else None
 
 
 def _fmt_s(v: float) -> str:
@@ -182,6 +216,9 @@ def _latency_text(rec) -> list[str]:
         if drift.get("tripped"):
             head += " (TRIPPED)"
     lines = [head]
+    note = _health_note(rec.get("health"))
+    if note:
+        lines.append(f"  health: {note}")
     if rec["cum"]:
         edges, counts = _occupied(rec["cum"])
         if counts and all(math.isinf(e) for e in edges):
@@ -232,14 +269,17 @@ def _classify(path):
     """``("conv", ...) | ("latency", ...)`` by content, not extension:
     a convergence log's first parseable line is the meta record, a
     stats document has a ``stats`` key, anything with an
-    ``acg_solve_seconds`` series is a metrics textfile."""
+    ``acg_solve_seconds`` series is a metrics textfile.  A /5 stats
+    document carrying only a ``health`` section still classifies (the
+    kappa annotation is its evidence)."""
     try:
-        soak, cum = _load_stats_json(path)
-        if soak or cum:
+        soak, cum, health = _load_stats_json(path)
+        if soak or cum or health:
             return ("latency",
-                    _latency_summary(os.path.basename(path), soak, cum))
-        raise ValueError("stats document without latency evidence "
-                         "(no soak section or metrics snapshot)")
+                    _latency_summary(os.path.basename(path), soak, cum,
+                                     health))
+        raise ValueError("stats document without latency or health "
+                         "evidence (no soak/metrics/health section)")
     except ValueError:
         pass
     try:
@@ -248,8 +288,8 @@ def _classify(path):
                 _latency_summary(os.path.basename(path), {}, cum))
     except (ValueError, UnicodeDecodeError):
         pass
-    meta, its, rn = _load_conv(path)
-    return ("conv", (path, meta, its, rn))
+    meta, its, rn, gaps = _load_conv(path)
+    return ("conv", (path, meta, its, rn, gaps))
 
 
 def main(argv=None) -> int:
@@ -286,7 +326,7 @@ def main(argv=None) -> int:
             plt = None
 
     if plt is None:
-        for path, meta, its, rn in conv:
+        for path, meta, its, rn, gaps in conv:
             finite = [v for v in rn if math.isfinite(v) and v > 0]
             label = meta.get("solver", "cg")
             head = (f"{path} [{label}] iterations "
@@ -304,6 +344,13 @@ def main(argv=None) -> int:
                       f"{rn[-1]:.3e}" if math.isfinite(rn[-1])
                       else f"  rnrm2 max {max(finite):.3e}  final "
                            f"{rn[-1]!r} (breakdown)")
+            gfin = [g for g in (gaps or []) if math.isfinite(g)]
+            if gfin:
+                # the true-residual-gap trail (audited iterations only)
+                print("  gap: "
+                      + _sparkline(list(range(len(gfin))), gfin))
+                print(f"  audit gap max {max(gfin):.3e}  last "
+                      f"{gfin[-1]:.3e} ({len(gfin)} audits)")
         for rec in latency:
             for line in _latency_text(rec):
                 print(line)
@@ -313,12 +360,22 @@ def main(argv=None) -> int:
     fig, axes = plt.subplots(1, ncols, figsize=(9 if ncols == 1 else 13, 5))
     axes = [axes] if ncols == 1 else list(axes)
     ax = axes[0] if conv else None
-    for path, meta, its, rn in conv:
+    for path, meta, its, rn, gaps in conv:
         label = os.path.basename(path)
         if meta.get("wrapped"):
             label += " (truncated)"
         ax.semilogy(its, [v if math.isfinite(v) and v > 0 else float("nan")
                           for v in rn], label=label, linewidth=1.2)
+        if gaps is not None:
+            # the true-residual-gap trail on the same log axis: one
+            # marker per audited iteration, dashed between them --
+            # the drift the pipelined recurrences accumulate
+            pts = [(i, g) for i, g in zip(its, gaps)
+                   if math.isfinite(g) and g > 0]
+            if pts:
+                ax.semilogy([p[0] for p in pts], [p[1] for p in pts],
+                            "--o", markersize=4, linewidth=0.9,
+                            alpha=0.8, label=f"{label}: audit gap")
         # mark non-finite records (breakdown evidence) on the x-axis
         bad = [i for i, v in zip(its, rn) if not math.isfinite(v)]
         if bad:
@@ -326,9 +383,15 @@ def main(argv=None) -> int:
                     markersize=8, label=f"{label}: non-finite")
     if conv:
         ax.set_xlabel("iteration")
-        ax.set_ylabel("residual 2-norm")
+        ax.set_ylabel("residual 2-norm / audit gap")
         ax.grid(True, which="both", alpha=0.3)
         ax.legend(fontsize=8)
+        notes = [n for n in (_health_note(rec.get("health"))
+                             for rec in latency) if n]
+        if notes:
+            # kappa / predicted-iterations annotation from a /5 stats
+            # document given alongside the logs
+            ax.set_title("; ".join(notes), fontsize=8)
     if latency:
         lax = axes[-1]
         plotted = False

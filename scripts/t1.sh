@@ -7,8 +7,11 @@
 # T1_SOAK=1 additionally runs the service-soak smoke after the tests: a
 # tiny 3-solve --soak run whose --metrics-file must validate as
 # Prometheus exposition format and whose --stats-json must carry the
-# acg-tpu-stats/4 soak section (the CI soak-smoke step runs the same
-# thing).
+# acg-tpu-stats/5 soak section (the CI soak-smoke step runs the same
+# thing).  T1_HEALTH=1 runs the numerical-health smoke: an audited
+# pipelined solve on the anisotropic generator must leave a health:
+# section with a finite gap, the acg_health_* metric families, and a
+# Lanczos kappa estimate.
 set -o pipefail
 cd "$(dirname "$0")/.."
 rm -f /tmp/_t1.log
@@ -31,7 +34,7 @@ if [ "${T1_SOAK:-0}" = "1" ]; then
     python - <<'PY' || rc=$((rc ? rc : 1))
 import json
 doc = json.load(open("/tmp/_t1_soak.json"))
-assert doc["schema"] == "acg-tpu-stats/4", doc["schema"]
+assert doc["schema"] == "acg-tpu-stats/5", doc["schema"]
 soak = doc["stats"]["soak"]
 assert soak["nsolves"] == 3 and soak["latency"]["p50"] is not None, soak
 assert "metrics" in doc, "registry snapshot missing from /3 document"
@@ -53,7 +56,7 @@ if [ "${T1_PRECOND:-0}" = "1" ]; then
         env PC="$pc" python - <<'PY' || rc=$((rc ? rc : 1))
 import json, os
 doc = json.load(open("/tmp/_t1_precond.json"))
-assert doc["schema"] == "acg-tpu-stats/4", doc["schema"]
+assert doc["schema"] == "acg-tpu-stats/5", doc["schema"]
 st = doc["stats"]
 assert st["converged"] is True, st["rnrm2"]
 assert st["precond"]["kind"] == os.environ["PC"], st["precond"]
@@ -62,5 +65,40 @@ print(f"T1_PRECOND: {os.environ['PC']} OK "
       f"({st['niterations']} iterations)")
 PY
     done
+fi
+if [ "${T1_HEALTH:-0}" = "1" ]; then
+    # numerical-health smoke (the PR-6 acceptance in miniature): an
+    # audited f32 pipelined solve on the ill-conditioned aniso
+    # generator -- whose recurrence residual drifts past the gap
+    # threshold -- must RECOVER to the requested tolerance through
+    # --on-gap replace (residual-replacement restarts), and leave a
+    # health: section with a finite gap, the acg_health_* metric
+    # families, and a Lanczos kappa estimate in the /5 stats document
+    echo "T1_HEALTH: audit smoke"
+    rm -f /tmp/_t1_health.json /tmp/_t1_health.prom /tmp/_t1_health.jsonl
+    timeout -k 10 300 env JAX_PLATFORMS=cpu python -m acg_tpu.cli \
+        gen:poisson2d:32 --aniso 0.05 --solver acg-pipelined \
+        --dtype f32 --comm none --max-iterations 3000 \
+        --residual-rtol 1e-5 --warmup 0 --quiet --audit-every 10 \
+        --gap-threshold 1e-4 --on-gap replace --max-restarts 20 \
+        --convergence-log /tmp/_t1_health.jsonl \
+        --metrics-file /tmp/_t1_health.prom \
+        --stats-json /tmp/_t1_health.json || rc=$((rc ? rc : 1))
+    python scripts/check_metrics_textfile.py /tmp/_t1_health.prom \
+        --require acg_health_residual_gap \
+        --require acg_health_audits_total \
+        --require acg_health_kappa_estimate \
+        --require acg_health_gap_trips_total || rc=$((rc ? rc : 1))
+    python - <<'PY' || rc=$((rc ? rc : 1))
+import json, math
+doc = json.load(open("/tmp/_t1_health.json"))
+assert doc["schema"] == "acg-tpu-stats/5", doc["schema"]
+h = doc["stats"]["health"]
+assert h["naudits"] > 0, h
+assert h["gap_last"] is not None and math.isfinite(h["gap_last"]), h
+assert h["spectrum"]["kappa"] > 1, h["spectrum"]
+print(f"T1_HEALTH: OK (gap {h['gap_last']:.3e}, "
+      f"kappa {h['spectrum']['kappa']:.4g})")
+PY
 fi
 exit $rc

@@ -137,6 +137,63 @@ def test_pcg_collective_counts(prob):
     assert counts(True, "cheby:2") == (5, 3 + 4)
 
 
+# -- numerical-health tier: disarmed audit = byte-identical; armed adds
+# exactly the conditional audit collectives ------------------------------
+
+def test_health_disarmed_is_byte_identical(prob):
+    """--audit-every 0 (default) must lower BYTE-IDENTICAL programs to
+    a build that never mentions the health tier -- single-chip and
+    distributed (the telemetry/faults/precond/perfmodel disarmament
+    contract, extended to the audit)."""
+    from acg_tpu.health import make_spec
+    from acg_tpu.io.generators import poisson2d_coo as _p2
+    from acg_tpu.ops.spmv import device_matrix_from_csr
+    from acg_tpu.solvers.jax_cg import JaxCGSolver
+
+    r, c, v, N = _p2(12)
+    csr = SymCsrMatrix.from_coo(N, r, c, v).to_csr()
+    b1 = np.ones(N)
+    A = device_matrix_from_csr(csr, dtype=jnp.float64)
+    for pipelined in (False, True):
+        plain = JaxCGSolver(A, pipelined=pipelined,
+                            kernels="xla").lower_solve(b1).as_text()
+        none = JaxCGSolver(A, pipelined=pipelined, kernels="xla",
+                           health=None).lower_solve(b1).as_text()
+        armed = JaxCGSolver(
+            A, pipelined=pipelined, kernels="xla",
+            health=make_spec(every=4)).lower_solve(b1).as_text()
+        assert none == plain
+        assert armed != plain
+
+    b2 = np.ones(prob.n)
+    for pipelined in (False, True):
+        d_plain = DistCGSolver(prob,
+                               pipelined=pipelined).lower_solve(
+                                   b2).as_text()
+        d_none = DistCGSolver(prob, pipelined=pipelined,
+                              health=None).lower_solve(b2).as_text()
+        assert d_none == d_plain
+
+
+def test_health_armed_collective_counts(prob):
+    """The armed audit adds EXACTLY one conditional halo'd SpMV (one
+    all_to_all region) and one psum (one all_reduce region) to the
+    distributed program text -- the audit reuses the tier's own
+    machinery, nothing else moves."""
+    from acg_tpu.health import make_spec
+
+    b = np.ones(prob.n)
+
+    def counts(pipelined, hs):
+        s = DistCGSolver(prob, pipelined=pipelined, health=hs)
+        return _counts(s.lower_solve(b).as_text())[:2]
+
+    assert counts(False, None) == (5, 2)
+    assert counts(False, make_spec(every=4)) == (6, 3)
+    assert counts(True, None) == (5, 3)
+    assert counts(True, make_spec(every=4)) == (6, 4)
+
+
 # -- perfmodel tier: disarmed observability changes NOTHING ---------------
 
 def test_lower_solve_is_the_dispatched_program(prob):
