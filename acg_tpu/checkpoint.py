@@ -1,0 +1,297 @@
+"""Solver-state checkpoint/restore -- the survivability substrate.
+
+The reference paper's target regime (long CG runs over large meshes on
+big clusters) is exactly where the two failure classes the resilience
+tier (solvers/resilience) cannot survive dominate: process/host death
+(pod preemption, a controller OOM mid-solve) and silent data corruption
+that never trips a non-finite guard.  This module supplies the first
+half of the fix -- periodic **solver-state snapshots** to disk -- and
+the plumbing the second half (the ABFT checksum SpMV in
+:mod:`acg_tpu.health` and the rollback rung in
+:mod:`acg_tpu.solvers.resilience`) restores from.
+
+Design:
+
+* The compiled solve loops cannot be interrupted mid-dispatch, so an
+  armed checkpoint (``--ckpt FILE --ckpt-every K``) turns the solve
+  into a host-driven CHUNK loop: each dispatch runs at most K
+  iterations of the UNCHANGED recurrence with the full loop carry
+  (x, r, p, pipelined extras, the preconditioned ``rr``) threaded in
+  and out of the program (``state_io``/``carry`` -- static/pytree
+  arguments the disarmed programs never name, so a build without
+  ``--ckpt`` lowers byte-identical code; pinned in
+  tests/test_checkpoint.py).  Because the carry continues the Krylov
+  recurrence exactly, a chunked solve follows the identical iteration
+  trajectory as an uninterrupted one -- no restart penalty per
+  snapshot.
+* Snapshots are written with ATOMIC RENAME (a crash mid-write leaves
+  the previous snapshot intact, never a torn file) and carry a
+  CHECKSUMMED header + payload (CRC32): a corrupted file refuses to
+  load instead of resuming a solve from garbage.
+* ``--resume FILE`` reconstructs the carry and continues to the
+  ORIGINAL tolerance: the snapshot stores the absolute residual target
+  derived from the first attempt's ``r0`` (the recovery-restart
+  convention), so resumed chunks never re-baseline ``rtol`` against an
+  already-small residual.  Total iterations (pre-crash + post-resume)
+  match an uninterrupted run exactly, well inside the acceptance
+  criterion's 10% slack.
+* On the distributed tier every per-part carry leaf is gathered
+  host-side and the snapshot commits under ONE agreed sequence number
+  (:func:`agree_seq` over the erragree plumbing), so all ranks hold
+  the same iteration; the primary writes the file.
+
+The snapshot also records the fault-injection residue (so a
+deterministic ``crash:exit@K`` does not re-fire after resume -- see
+:func:`acg_tpu.faults.maybe_crash`'s crossing semantics) and the
+trailing telemetry-ring window (small, JSON) for post-mortem evidence.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import os
+import zlib
+
+import numpy as np
+
+from acg_tpu.errors import AcgError, ErrorCode
+
+MAGIC = b"ACGCKPT1\n"
+# snapshot container version (bump on layout changes; readers refuse
+# versions they do not know rather than misparse)
+VERSION = 1
+# exit code of a crash:exit fault firing (distinct from peer:dead's 86
+# and erragree's PEER_LOST_EXIT 97; in the 64..113 hole shell
+# conventions leave free)
+CRASH_EXIT_CODE = 94
+
+
+@dataclasses.dataclass(frozen=True)
+class CheckpointConfig:
+    """The armed checkpoint selection a solver carries.
+
+    ``path`` is where snapshots land (None = resume-only: continue a
+    crashed solve without writing further snapshots); ``every`` the
+    chunk length in iterations (must be positive when ``path`` is
+    set); ``resume`` a loaded :class:`SolverSnapshot` consumed by the
+    first solve."""
+
+    path: str | None = None
+    every: int = 0
+    resume: "SolverSnapshot | None" = None
+
+    def __post_init__(self):
+        if self.path is not None and self.every <= 0:
+            raise ValueError("checkpointing needs a positive snapshot "
+                             "period (ckpt_every K)")
+        if self.path is None and self.resume is None:
+            raise ValueError("a CheckpointConfig needs a snapshot path "
+                             "and/or a snapshot to resume from")
+
+    @property
+    def chunk(self) -> int:
+        """The host chunk length: the snapshot period, or (resume-only
+        configurations) unbounded -- one final chunk to convergence."""
+        return self.every if self.every > 0 else 1 << 30
+
+
+@dataclasses.dataclass
+class SolverSnapshot:
+    """One loaded snapshot: validated metadata + named host arrays."""
+
+    meta: dict
+    arrays: dict
+
+    @property
+    def iteration(self) -> int:
+        return int(self.meta["iteration"])
+
+
+# the carry leaves that are psum'd scalars (mesh tiers: replicated,
+# not sharded) -- everything else is a per-part vector
+SCALAR_LEAVES = frozenset({"gamma", "alpha", "rr"})
+
+
+def carry_names(pipelined: bool, precond: bool) -> tuple:
+    """The canonical order of the loop-carry leaves a snapshot stores
+    (x first, then the recurrence vectors, then the scalars) -- ONE
+    layout shared by the snapshot writer, the resume reconstruction,
+    and every tier's ``state_io`` program outputs, so the single- and
+    multi-part tiers' snapshots stay field-compatible."""
+    if not pipelined:
+        names = ("x", "r", "p", "gamma")
+        return names + (("rr",) if precond else ())
+    if precond:
+        return ("x", "r", "u", "w", "p", "s", "q", "z",
+                "gamma", "alpha", "rr")
+    return ("x", "r", "w", "p", "t", "z", "gamma", "alpha")
+
+
+def _crc(data: bytes) -> int:
+    return zlib.crc32(data) & 0xFFFFFFFF
+
+
+def vector_checksum(v) -> int:
+    """CRC32 of a host vector's bytes -- stored for ``b`` so a resume
+    against a different right-hand side refuses instead of silently
+    continuing somebody else's solve."""
+    return _crc(np.ascontiguousarray(np.asarray(v)).tobytes())
+
+
+def save_snapshot(path, meta: dict, arrays: dict) -> int:
+    """Write one snapshot atomically; returns the byte size.
+
+    Layout: ``MAGIC`` + one header line
+    ``{version, header_crc, payload_crc, header_len}`` + the JSON
+    header (meta + per-array manifest) + the raw little-endian array
+    payload.  The file lands under a temporary name and is
+    ``os.replace``d into place, so a crash mid-write can never leave a
+    torn snapshot where a good one stood."""
+    manifest = []
+    blobs = []
+    off = 0
+    for name, arr in arrays.items():
+        a = np.asarray(arr)
+        # record the shape BEFORE ascontiguousarray: it promotes 0-d
+        # scalars (the carried gamma/alpha/rr) to shape (1,), which
+        # would resume a scalar as a 1-vector and break the loop carry
+        shape = list(a.shape)
+        raw = np.ascontiguousarray(a).tobytes()
+        manifest.append({"name": str(name), "dtype": str(a.dtype),
+                         "shape": shape, "offset": off,
+                         "nbytes": len(raw)})
+        blobs.append(raw)
+        off += len(raw)
+    payload = b"".join(blobs)
+    header = json.dumps({"meta": meta, "arrays": manifest},
+                        sort_keys=True).encode("utf-8")
+    preamble = json.dumps({"version": VERSION,
+                           "header_crc": _crc(header),
+                           "payload_crc": _crc(payload),
+                           "header_len": len(header)}).encode("utf-8")
+    tmp = f"{path}.tmp.{os.getpid()}"
+    try:
+        with open(tmp, "wb") as f:
+            f.write(MAGIC)
+            f.write(preamble + b"\n")
+            f.write(header)
+            f.write(payload)
+            f.flush()
+            os.fsync(f.fileno())
+        os.replace(tmp, path)
+    finally:
+        if os.path.exists(tmp):
+            try:
+                os.unlink(tmp)
+            except OSError:
+                pass
+    return len(MAGIC) + len(preamble) + 1 + len(header) + len(payload)
+
+
+def load_snapshot(path) -> SolverSnapshot:
+    """Read + verify one snapshot; raises a typed
+    :class:`~acg_tpu.errors.AcgError` on any integrity failure (bad
+    magic, unknown version, header or payload checksum mismatch,
+    truncation) -- a resumed solve must never start from garbage."""
+    def bad(why: str):
+        return AcgError(ErrorCode.INVALID_VALUE,
+                        f"{path}: not a usable snapshot ({why})")
+
+    try:
+        with open(path, "rb") as f:
+            blob = f.read()
+    except OSError as e:
+        raise AcgError(ErrorCode.INVALID_VALUE, f"{path}: {e}")
+    if not blob.startswith(MAGIC):
+        raise bad("bad magic; not an acg-tpu snapshot")
+    rest = blob[len(MAGIC):]
+    nl = rest.find(b"\n")
+    if nl < 0:
+        raise bad("truncated preamble")
+    try:
+        pre = json.loads(rest[:nl])
+    except ValueError:
+        raise bad("unparseable preamble")
+    if int(pre.get("version", -1)) != VERSION:
+        raise bad(f"unknown snapshot version {pre.get('version')!r}")
+    hlen = int(pre["header_len"])
+    header = rest[nl + 1: nl + 1 + hlen]
+    payload = rest[nl + 1 + hlen:]
+    if len(header) != hlen:
+        raise bad("truncated header")
+    if _crc(header) != int(pre["header_crc"]):
+        raise bad("header checksum mismatch")
+    if _crc(payload) != int(pre["payload_crc"]):
+        raise bad("payload checksum mismatch")
+    doc = json.loads(header)
+    arrays = {}
+    for m in doc["arrays"]:
+        start, n = int(m["offset"]), int(m["nbytes"])
+        raw = payload[start: start + n]
+        if len(raw) != n:
+            raise bad(f"array {m['name']!r} truncated")
+        arrays[m["name"]] = np.frombuffer(
+            raw, dtype=np.dtype(m["dtype"])).reshape(m["shape"]).copy()
+    return SolverSnapshot(meta=doc["meta"], arrays=arrays)
+
+
+def validate_resume(snap: SolverSnapshot, *, tier: str, pipelined: bool,
+                    precond: str | None, n: int, dtype,
+                    b_crc: int | None = None,
+                    nparts: int | None = None) -> None:
+    """Refuse a snapshot that does not describe THIS solve: wrong tier,
+    algorithm, preconditioner, size, dtype, partition count, or
+    right-hand side.  A mismatch here means the operator pointed
+    ``--resume`` at somebody else's solve -- continuing would converge
+    to the wrong answer with a green exit code."""
+    m = snap.meta
+
+    def need(key, want, what):
+        got = m.get(key)
+        if got != want:
+            raise AcgError(
+                ErrorCode.INVALID_VALUE,
+                f"snapshot does not match this solve: {what} is "
+                f"{got!r}, this run has {want!r}")
+
+    need("tier", tier, "solver tier")
+    need("pipelined", bool(pipelined), "algorithm (pipelined)")
+    need("precond", precond, "preconditioner")
+    need("n", int(n), "unknowns")
+    need("dtype", str(np.dtype(dtype)), "vector dtype")
+    if nparts is not None:
+        need("nparts", int(nparts), "partition count")
+    if b_crc is not None and m.get("b_crc") is not None:
+        need("b_crc", int(b_crc), "right-hand-side checksum")
+
+
+def agree_seq(seq: int, iteration: int, timeout: float = 120.0) -> None:
+    """Multi-controller snapshot commit barrier: every controller
+    reports its (sequence, iteration) pair and all verify the pod holds
+    ONE agreed state before the primary writes -- a snapshot whose
+    ranks disagree on the iteration number is corruption with a valid
+    checksum.  Single-process: free."""
+    import jax
+
+    if jax.process_count() == 1:
+        return
+    from acg_tpu.parallel.erragree import allgather_blobs
+
+    mine = f"{int(seq)}:{int(iteration)}"
+    got = allgather_blobs(mine, tag="ckpt-seq", timeout=timeout)
+    if any(g != mine for g in got):
+        raise AcgError(
+            ErrorCode.INVALID_VALUE,
+            f"snapshot sequence disagreement across controllers: "
+            f"{sorted(set(got))} (mine {mine}) -- refusing to commit")
+
+
+def trace_tail(trace, n: int = 8) -> list:
+    """The trailing telemetry-ring rows as small JSON-able dicts (the
+    snapshot's post-mortem evidence; [] without a trace)."""
+    if trace is None:
+        return []
+    m = min(int(n), trace.iterations.size)
+    return [trace.record_dict(trace.iterations.size - m + i)
+            for i in range(m)]

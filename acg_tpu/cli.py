@@ -204,6 +204,69 @@ def make_parser() -> argparse.ArgumentParser:
                         "(with --recover: bounded restarts; default: "
                         "off).  Arms the dot-product sign-anomaly "
                         "guards too")
+    p.add_argument("--abft", action="store_true",
+                   help="survivability tier (acg_tpu.checkpoint/health): "
+                        "arm the Huang-Abraham CHECKSUM-PROTECTED SpMV "
+                        "-- the column checksum c = A^T 1 is computed "
+                        "once through the tier's own SpMV and every "
+                        "--audit-every iterations the in-loop test "
+                        "compares sum(A p) against (c, p) (ONE fused "
+                        "reduction on the mesh tiers), so silent "
+                        "bit-level corruption of the SpMV (sdc:flip) "
+                        "that never trips a non-finite guard is "
+                        "detected ON DEVICE and routed into the "
+                        "breakdown -> rollback/recovery path.  Needs "
+                        "--audit-every K")
+    p.add_argument("--abft-threshold", type=float, default=0.0,
+                   metavar="T",
+                   help="with --abft: relative checksum-mismatch trip "
+                        "level (default 0 = a dtype/size-derived bound, "
+                        "64*sqrt(n)*eps -- generous rounding headroom, "
+                        "orders of magnitude below one flipped "
+                        "element's signature)")
+    p.add_argument("--ckpt", metavar="FILE", default=None,
+                   help="survivability tier (acg_tpu.checkpoint): write "
+                        "SOLVER-STATE SNAPSHOTS -- the full loop carry "
+                        "(x, r, p, pipelined extras, preconditioned "
+                        "rr), iteration, tolerances, fault residue and "
+                        "telemetry tail -- to FILE by atomic rename "
+                        "with a checksummed header, every --ckpt-every "
+                        "iterations.  The solve runs as host chunks of "
+                        "the UNCHANGED recurrence (iteration-identical "
+                        "to an uninterrupted run); on the dist tier "
+                        "every rank's state commits under one agreed "
+                        "sequence number.  A detected breakdown rolls "
+                        "back to the last snapshot before spending the "
+                        "restart budget; a killed process resumes via "
+                        "--resume.  Distinct from the multi-controller "
+                        "STAGE SYNC barriers (--err-timeout), which "
+                        "agree on status codes and store nothing")
+    p.add_argument("--ckpt-every", type=int, default=0, metavar="K",
+                   help="with --ckpt: snapshot period in iterations "
+                        "(required; also the host chunk length)")
+    p.add_argument("--resume", metavar="FILE", default=None,
+                   help="reconstruct the solver state from a --ckpt "
+                        "snapshot and CONTINUE the solve to the "
+                        "original tolerance (the absolute target is "
+                        "stored, so rtol is never re-baselined); "
+                        "refuses snapshots from a different tier/"
+                        "algorithm/preconditioner/size/right-hand side "
+                        "or with a corrupted header.  Total iterations "
+                        "(pre-crash + post-resume) match an "
+                        "uninterrupted run.  Combine with --ckpt to "
+                        "keep snapshotting after the resume")
+    p.add_argument("--heartbeat", type=float, default=0.0,
+                   metavar="SECONDS",
+                   help="multi-controller dead-peer detection DURING "
+                        "the solve collective (erragree."
+                        "DeadlineHeartbeat): each controller bumps a "
+                        "coordination-service key from a daemon thread "
+                        "and declares a peer dead after SECONDS of "
+                        "silence, tearing down with the peer-lost exit "
+                        "code so the supervisor can relaunch with "
+                        "--resume -- the stage-sync watchdog "
+                        "(--err-timeout) cannot see a peer that dies "
+                        "INSIDE a collective (default: off)")
     p.add_argument("--precise-dots", action="store_true",
                    help="compensated (double-float) dot products for the "
                         "CG scalars; lets f32 storage converge past the "
@@ -276,12 +339,14 @@ def make_parser() -> argparse.ArgumentParser:
                         "recovery knobs as with --recover")
     p.add_argument("--err-timeout", type=float, default=120.0,
                    metavar="SECONDS",
-                   help="multi-controller error-agreement watchdog: how "
-                        "long to wait at a stage checkpoint for peers "
-                        "before concluding one died and aborting (the "
-                        "acgerrmpi analog; default: 120).  Must exceed the "
-                        "worst-case arrival SKEW between controllers at "
-                        "any checkpoint (not the stage duration): e.g. a "
+                   help="multi-controller error-agreement watchdog (the "
+                        "STAGE SYNC barriers -- status agreement, not the "
+                        "--ckpt state snapshots): how long to wait at a "
+                        "stage-sync point for peers before concluding one "
+                        "died and aborting (the acgerrmpi analog; default: "
+                        "120).  Must exceed the worst-case arrival SKEW "
+                        "between controllers at any sync point (not the "
+                        "stage duration): e.g. a "
                         "replicated read of a large .mtx from a slow "
                         "filesystem can stagger 'ingest' arrivals by "
                         "minutes -- raise this accordingly or a healthy "
@@ -456,6 +521,24 @@ def _buildinfo(out) -> int:
          f"from the recorded (alpha, beta) in 'health' and the "
          f"--explain convergence verdict; soak tracks gap drift; "
          f"schema {STATS_SCHEMA}"),
+        ("survivability", f"--ckpt FILE --ckpt-every K (solver-state "
+         f"snapshots: full loop carry, atomic rename, checksummed "
+         f"header; chunked solves iteration-identical to "
+         f"uninterrupted; dist commits under one agreed sequence "
+         f"number) + --resume FILE (continue to the ORIGINAL "
+         f"tolerance; pre-crash + post-resume iterations match an "
+         f"uninterrupted run), --abft [--abft-threshold T] "
+         f"(Huang-Abraham checksum SpMV at the --audit-every cadence "
+         f"-- detects silent bit-level SpMV corruption on device, "
+         f"rides ONE fused reduction on the mesh tiers), rollback = "
+         f"the recovery ladder's first rung (before restart/fallback/"
+         f"abort), --heartbeat SECS (dead-peer detection during the "
+         f"solve collective; relaunch with --resume), fault sites "
+         f"sdc:flip@K (finite sign flip, invisible to non-finite "
+         f"guards -- the ABFT test vector) and crash:exit@K "
+         f"(hard os._exit between snapshot commits; refuses without "
+         f"--ckpt); 'ckpt' stats section + acg_ckpt_*/acg_abft_* "
+         f"metrics; schema {STATS_SCHEMA}"),
     ]
     for k, v in rows:
         out.write(f"{k}: {v}\n")
@@ -600,7 +683,8 @@ def _solve_generated_direct(args, dim, n, N, jax, jnp, dtype,
                              recovery=getattr(args, "_recovery", None),
                              trace=args._trace, progress=args.progress,
                              precond=getattr(args, "_precond", None),
-                             health=getattr(args, "_health", None))
+                             health=getattr(args, "_health", None),
+                             ckpt=getattr(args, "_ckpt", None))
     except ValueError as e:
         raise SystemExit(f"acg-tpu: {e}")
     b = jnp.ones(N, dtype=vec_dtype)
@@ -719,11 +803,15 @@ def _attach_health_spectrum(args, solver) -> None:
                          f"({type(e).__name__}: {e})\n")
 
 
-def _checkpoint(args, stage: str, code: int = 0) -> int:
-    """Cross-controller error agreement at a stage boundary (the
-    acgerrmpi analog, parallel/erragree): every controller learns the
-    worst status code so all exit together; a dead peer trips the
-    watchdog instead of wedging the pod in the next collective."""
+def _stage_sync(args, stage: str, code: int = 0) -> int:
+    """Cross-controller STAGE SYNC: error agreement at a pipeline stage
+    boundary (the acgerrmpi analog, parallel/erragree) -- every
+    controller learns the worst status code so all exit together, and a
+    dead peer trips the watchdog instead of wedging the pod in the next
+    collective.  Pure status agreement: nothing is stored.  NOT the
+    solver-state snapshots of ``--ckpt`` (acg_tpu.checkpoint), which
+    serialise the loop carry to disk -- the two were both historically
+    called "checkpoints"; this one is the barrier."""
     if not (args.multihost or args.coordinator is not None):
         return int(code)
     from acg_tpu.parallel.erragree import agree_status
@@ -944,7 +1032,7 @@ def _solve_distributed_read(args, jax, jnp, dtype, vec_dtype) -> int:
     nparts = (bounds.size - 1 if bounds is not None
               else args.nparts or len(jax.devices()))
     # two-phase ingest: the host-local reads (phase 1) are the stage
-    # where one controller can fail alone, and they are checkpointed
+    # where one controller can fail alone, and they are stage-synced
     # BEFORE the uniform-shape allgather of phase 2 -- a failed peer
     # must never leave the others blocked in a mismatched collective
     ingest_rc = 0
@@ -958,7 +1046,7 @@ def _solve_distributed_read(args, jax, jnp, dtype, vec_dtype) -> int:
     except (AcgError, OSError, SystemExit) as e:
         sys.stderr.write(f"acg-tpu: {e}\n")
         ingest_rc = 1
-    rc = _checkpoint(args, "ingest", ingest_rc)
+    rc = _stage_sync(args, "ingest", ingest_rc)
     if rc:
         if not ingest_rc:
             sys.stderr.write("acg-tpu: aborting: a peer controller failed "
@@ -1005,8 +1093,8 @@ def _solve_distributed_read(args, jax, jnp, dtype, vec_dtype) -> int:
         # per-controller WINDOW reads of binary array vectors (the
         # input mirror of the distributed write): I/O stays O(local
         # rows).  Host-local reads can fail one-sided, so agree at a
-        # checkpoint BEFORE entering the solve collective (the ingest
-        # checkpoint rationale).
+        # stage-sync BEFORE entering the solve collective (the ingest
+        # sync rationale).
         rhs_rc = 0
         perm_path = (args.A + ".perm.mtx"
                      if os.path.exists(args.A + ".perm.mtx") else None)
@@ -1018,7 +1106,7 @@ def _solve_distributed_read(args, jax, jnp, dtype, vec_dtype) -> int:
         except (AcgError, OSError) as e:
             sys.stderr.write(f"acg-tpu: {e}\n")
             rhs_rc = 1
-        rc = _checkpoint(args, "rhs", rhs_rc)
+        rc = _stage_sync(args, "rhs", rhs_rc)
         if rc:
             if not rhs_rc:
                 sys.stderr.write("acg-tpu: aborting: a peer controller "
@@ -1037,10 +1125,11 @@ def _solve_distributed_read(args, jax, jnp, dtype, vec_dtype) -> int:
                               recovery=getattr(args, "_recovery", None),
                               trace=args._trace, progress=args.progress,
                               precond=getattr(args, "_precond", None),
-                              health=getattr(args, "_health", None))
+                              health=getattr(args, "_health", None),
+                              ckpt=getattr(args, "_ckpt", None))
     except ValueError as e:
         sys.stderr.write(f"acg-tpu: {e}\n")
-        _checkpoint(args, "solve", 1)
+        _stage_sync(args, "solve", 1)
         return 1
     if args.refine:
         # f64 outer residuals from THIS controller's host blocks only
@@ -1081,19 +1170,19 @@ def _solve_distributed_read(args, jax, jnp, dtype, vec_dtype) -> int:
             solver.stats.fwrite(sys.stderr)
         _emit_telemetry(args, solver, matrix_id=args.A, nparts=nparts,
                         collective=False)
-        _checkpoint(args, "solve", 1)
+        _stage_sync(args, "solve", 1)
         return 1
     except AcgError as e:
         # solve-time configuration refusals (e.g. replace_every + an
         # armed fault injector) carry typed AcgErrors
         sys.stderr.write(f"acg-tpu: {e}\n")
-        _checkpoint(args, "solve", 1)
+        _stage_sync(args, "solve", 1)
         return 1
     finally:
         if args.trace:
             jax.profiler.stop_trace()
     _log(args, "solve:", t0)
-    rc = _checkpoint(args, "solve", 0)
+    rc = _stage_sync(args, "solve", 0)
     if rc:
         sys.stderr.write("acg-tpu: aborting: a peer controller failed "
                          "during the solve\n")
@@ -1312,7 +1401,7 @@ def _distributed_write(args, solver, x_st, xsol, n: int) -> int:
     except OSError as e:
         sys.stderr.write(f"acg-tpu: {args.output}: {e}\n")
         wrc = 1
-    rc = _checkpoint(args, "write", wrc)
+    rc = _stage_sync(args, "write", wrc)
     if rc:
         if not wrc:
             sys.stderr.write("acg-tpu: aborting: a peer controller "
@@ -1459,7 +1548,8 @@ def _solve_generated_sharded(args, dim, n, N, jax, jnp, dtype,
             recovery=getattr(args, "_recovery", None),
             trace=args._trace, progress=args.progress,
             precond=getattr(args, "_precond", None),
-            health=getattr(args, "_health", None))
+            health=getattr(args, "_health", None),
+            ckpt=getattr(args, "_ckpt", None))
     except ValueError as e:
         raise SystemExit(f"acg-tpu: {e}")
     _log(args, f"assemble sharded DIA planes on device ({nparts} parts):",
@@ -1491,7 +1581,7 @@ def _solve_generated_sharded(args, dim, n, N, jax, jnp, dtype,
             if not dev < tol:
                 sys.stderr.write("acg-tpu: manufactured b FAILED the "
                                  "independent spot check\n")
-                _checkpoint(args, "solve", 1)
+                _stage_sync(args, "solve", 1)
                 return 1
     else:
         b = solver.ones_b()
@@ -1524,19 +1614,19 @@ def _solve_generated_sharded(args, dim, n, N, jax, jnp, dtype,
             solver.stats.fwrite(sys.stderr)
         _emit_telemetry(args, solver, matrix_id=args.A, nparts=nparts,
                         collective=False)
-        _checkpoint(args, "solve", 1)
+        _stage_sync(args, "solve", 1)
         return 1
     except AcgError as e:
         # solve-time configuration refusals (e.g. replace_every + an
         # armed fault injector) carry typed AcgErrors
         sys.stderr.write(f"acg-tpu: {e}\n")
-        _checkpoint(args, "solve", 1)
+        _stage_sync(args, "solve", 1)
         return 1
     finally:
         if args.trace:
             jax.profiler.stop_trace()
     _log(args, "solve:", t0)
-    rc = _checkpoint(args, "solve", 0)
+    rc = _stage_sync(args, "solve", 0)
     if rc:
         sys.stderr.write("acg-tpu: aborting: a peer controller failed "
                          "during the solve\n")
@@ -1722,10 +1812,15 @@ def _main(args) -> int:
             "acg-tpu: --gap-threshold needs --audit-every K (the "
             "threshold judges audit gaps; without an audit it could "
             "never fire)")
+    if args.abft and not args.audit_every:
+        raise SystemExit(
+            "acg-tpu: --abft fires the checksum test at the audit "
+            "cadence; add --audit-every K")
     try:
         args._health = _health_mod.make_spec(
             args.audit_every, args.gap_threshold, args.on_gap,
-            args.stall_window)
+            args.stall_window, abft=args.abft,
+            abft_threshold=args.abft_threshold)
     except ValueError as e:
         raise SystemExit(f"acg-tpu: {e}")
     if args._health is not None:
@@ -1745,6 +1840,68 @@ def _main(args) -> int:
             raise SystemExit(
                 f"acg-tpu: --audit-every/--stall-window do not "
                 f"support: {', '.join(unsupported)}")
+    # survivability tier (acg_tpu.checkpoint): validate + load the
+    # resume snapshot BEFORE anything expensive (a corrupted or
+    # mismatched file must refuse here, not after a multi-second
+    # compile), and refuse configurations the chunk drivers cannot
+    # serve (the fault-injector could-never-fire discipline)
+    args._ckpt = None
+    if args.ckpt is not None and args.ckpt_every <= 0:
+        raise SystemExit("acg-tpu: --ckpt needs a positive snapshot "
+                         "period: add --ckpt-every K")
+    if args.ckpt_every and args.ckpt is None:
+        raise SystemExit("acg-tpu: --ckpt-every needs --ckpt FILE "
+                         "(a period with nowhere to write)")
+    if args.heartbeat < 0:
+        raise SystemExit("acg-tpu: --heartbeat must be >= 0 seconds")
+    if 0 < args.heartbeat <= 0.5:
+        # the beat period is floored at 0.5 s (coordinator-KV write
+        # cost) and the deadline must exceed the period
+        raise SystemExit("acg-tpu: --heartbeat deadlines this short "
+                         "cannot be served (beat period is floored at "
+                         "0.5 s); use > 0.5 seconds")
+    if args.ckpt is not None or args.resume is not None:
+        unsupported = [flag for flag, on in [
+            (f"--solver {args.solver} (the external oracles expose no "
+             f"loop carry)", args.solver in ("host-native", "petsc")),
+            ("--replace-every (the replacement segments' inner state "
+             "never leaves the program)", args.replace_every > 0),
+            ("--kernels fused (the two-phase kernels expose no loop "
+             "carry)", args.kernels == "fused"),
+            ("--refine (the refinement outer loop re-enters solve; "
+             "checkpoint the inner tolerance solve instead)",
+             args.refine),
+            ("--explain (an analysis pass; nothing to snapshot)",
+             args.explain),
+            ("--diff-atol/--diff-rtol (the dx scalar is not part of "
+             "the snapshot carry)",
+             args.diff_atol > 0 or args.diff_rtol > 0),
+            # --ckpt+--soak is fine (snapshots carry across the
+            # repetitions; serialisation bills to its own phase, so
+            # the latency histograms stay clean) -- but --resume would
+            # re-enter EVERY repetition from the same snapshot
+            ("--soak with --resume (every repetition would re-resume "
+             "from the same snapshot; resume the solve once, then "
+             "soak)", args.soak > 0 and args.resume is not None),
+        ] if on]
+        if unsupported:
+            raise SystemExit(
+                f"acg-tpu: --ckpt/--resume do not support: "
+                f"{', '.join(unsupported)}")
+        from acg_tpu.checkpoint import CheckpointConfig, load_snapshot
+        resume_snap = None
+        if args.resume is not None:
+            from acg_tpu.errors import AcgError as _AcgError
+            try:
+                resume_snap = load_snapshot(args.resume)
+            except _AcgError as e:
+                raise SystemExit(f"acg-tpu: {e}")
+        try:
+            args._ckpt = CheckpointConfig(path=args.ckpt,
+                                          every=args.ckpt_every,
+                                          resume=resume_snap)
+        except ValueError as e:
+            raise SystemExit(f"acg-tpu: {e}")
     if args.aniso is not None:
         if not 0.0 < args.aniso <= 1.0:
             raise SystemExit("acg-tpu: --aniso EPS must be in (0, 1]")
@@ -1844,6 +2001,16 @@ def _main(args) -> int:
             raise SystemExit(
                 "acg-tpu: solve:slow fires from the soak driver's "
                 "per-solve hook; add --soak N")
+        if spec.site == "crash" and (args._ckpt is None
+                                     or args._ckpt.path is None):
+            # the hard-exit site fires from the checkpoint chunk
+            # drivers between snapshot commits: armed without --ckpt
+            # (incl. a resume-only relaunch, which writes no further
+            # snapshots) it could never fire -- and a crash with no
+            # snapshot to resume from proves nothing (same discipline)
+            raise SystemExit(
+                "acg-tpu: crash:exit fires between snapshot commits; "
+                "arm --ckpt FILE --ckpt-every K")
         os.environ[faults.ENV_VAR] = args.fault_inject
         if (faults.device_fault() is not None
                 and args.solver in ("host-native", "petsc")):
@@ -1907,6 +2074,18 @@ def _main(args) -> int:
         _log(args, f"multihost: process {jax.process_index()} of "
                    f"{jax.process_count()}, {len(jax.local_devices())} local "
                    f"/ {len(jax.devices())} global devices")
+        if args.heartbeat > 0:
+            # dead-peer detection for the whole run (daemon thread;
+            # dies with the process): the stage-sync watchdog cannot
+            # see a peer that dies INSIDE the solve collective
+            from acg_tpu.parallel.erragree import DeadlineHeartbeat
+            args._heartbeat = DeadlineHeartbeat(
+                period=max(args.heartbeat / 6.0, 0.5),
+                deadline=args.heartbeat).start()
+    elif args.heartbeat > 0:
+        sys.stderr.write("acg-tpu: warning: --heartbeat is "
+                         "multi-controller dead-peer detection; no-op "
+                         "without --multihost/--coordinator\n")
     import jax.numpy as jnp
     from acg_tpu.errors import (AcgError, BreakdownError,
                                 NotConvergedError)
@@ -1939,8 +2118,8 @@ def _main(args) -> int:
         from acg_tpu.perfmodel import run_explain
         return run_explain(args, dtype=dtype, vec_dtype=vec_dtype)
 
-    def checkpoint(stage: str, code: int = 0) -> int:
-        return _checkpoint(args, stage, code)
+    def stage_sync(stage: str, code: int = 0) -> int:
+        return _stage_sync(args, stage, code)
 
     if args.verbose >= 2:
         # part -> device mapping dump (the reference's rank -> CPU/GPU
@@ -1954,7 +2133,7 @@ def _main(args) -> int:
 
     # stages 1-4 under the ingest error-agreement guard: these are
     # the host-local stages (file I/O, partitioning) where one
-    # controller can fail alone; the checkpoint below is the last
+    # controller can fail alone; the stage-sync below is the last
     # point before the first collective
     ingest_rc = 0
     t_ingest = time.perf_counter()
@@ -2087,7 +2266,7 @@ def _main(args) -> int:
     except (AcgError, OSError) as e:
         sys.stderr.write(f"acg-tpu: {e}\n")
         ingest_rc = 1
-    rc = checkpoint("ingest", ingest_rc)
+    rc = stage_sync("ingest", ingest_rc)
     if rc:
         if not ingest_rc:
             sys.stderr.write("acg-tpu: aborting: a peer controller "
@@ -2104,13 +2283,13 @@ def _main(args) -> int:
         sys.stderr.write("acg-tpu: --replace-every applies to the "
                          "device bf16 solvers (use --refine for "
                          "f64-grade accuracy on host paths)\n")
-        checkpoint("solve", 1)
+        stage_sync("solve", 1)
         return 1
     if args.replace_every and (args.diff_atol > 0 or args.diff_rtol > 0):
         sys.stderr.write("acg-tpu: --replace-every supports residual "
                          "criteria only (--diff-atol/--diff-rtol have "
                          "no meaning across replacement segments)\n")
-        checkpoint("solve", 1)
+        stage_sync("solve", 1)
         return 1
     comm_mtx_out = None
     if args.trace:
@@ -2155,6 +2334,13 @@ def _main(args) -> int:
                         "--audit-every/--stall-window have no hooks in "
                         "the multi-part host solver; use --nparts 1 or "
                         "the device solvers")
+                if args._ckpt is not None:
+                    # armed snapshots that would never be written
+                    raise AcgError(
+                        ErrorCode.INVALID_VALUE,
+                        "--ckpt/--resume have no hooks in the "
+                        "multi-part host solver; use --nparts 1 or "
+                        "the device solvers")
                 if args._recovery is not None:
                     sys.stderr.write(
                         "acg-tpu: warning: --recover has no effect on "
@@ -2171,7 +2357,8 @@ def _main(args) -> int:
                                       trace=args._trace,
                                       progress=args.progress,
                                       precond=args._precond,
-                                      health=args._health)
+                                      health=args._health,
+                                      ckpt=args._ckpt)
             x = _run_solve(args, solver, b, x0=x0, criteria=criteria)
         elif args.solver == "petsc":
             # external cross-implementation oracle (the KSPCG role,
@@ -2193,7 +2380,8 @@ def _main(args) -> int:
                                      trace=args._trace,
                                      progress=args.progress,
                                      precond=args._precond,
-                                     health=args._health)
+                                     health=args._health,
+                                     ckpt=args._ckpt)
             except ValueError as e:
                 raise SystemExit(f"acg-tpu: {e}")
             if args.refine:
@@ -2230,7 +2418,8 @@ def _main(args) -> int:
                                       trace=args._trace,
                                       progress=args.progress,
                                       precond=args._precond,
-                                      health=args._health)
+                                      health=args._health,
+                                      ckpt=args._ckpt)
             except ValueError as e:
                 raise SystemExit(f"acg-tpu: {e}")
             if args.refine:
@@ -2248,17 +2437,17 @@ def _main(args) -> int:
         # divergence/breakdown (no collective gather on this path)
         _emit_telemetry(args, solver, matrix_id=args.A, nparts=nparts,
                         comm=comm, collective=False)
-        checkpoint("solve", 1)
+        stage_sync("solve", 1)
         return 1
     except AcgError as e:
         sys.stderr.write(f"acg-tpu: {e}\n")
-        checkpoint("solve", 1)
+        stage_sync("solve", 1)
         return 1
     finally:
         if args.trace:
             jax.profiler.stop_trace()
     _log(args, "solve:", t0)
-    rc = checkpoint("solve", 0)
+    rc = stage_sync("solve", 0)
     if rc:
         sys.stderr.write("acg-tpu: aborting: a peer controller failed "
                          "during the solve\n")

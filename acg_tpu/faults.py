@@ -18,6 +18,11 @@ firing condition:
                             non-SPD-preconditioner breakdown path;
                             needs an armed --precond)
   * ``dot:nan@5``           NaN into the dot scalar
+  * ``sdc:flip@7``          SIGN-FLIP one SpMV output element (finite:
+                            invisible to the non-finite guards, caught
+                            only by the ABFT checksum test, --abft)
+  * ``crash:exit@20``       hard os._exit once a checkpointed solve
+                            crosses 20 iterations (needs --ckpt)
   * ``peer:dead:proc=1``    controller 1 dies before its next
                             error-agreement checkpoint
   * ``peer:stall:proc=1:secs=30``  controller 1 stalls instead
@@ -46,11 +51,21 @@ import time
 
 import numpy as np
 
-DEVICE_SITES = ("spmv", "dot", "halo", "precond")
-_SITES = DEVICE_SITES + ("peer", "backend", "solve")
+DEVICE_SITES = ("spmv", "dot", "halo", "precond", "sdc")
+_SITES = DEVICE_SITES + ("peer", "backend", "solve", "crash")
 _MODES = {
     "spmv": ("nan", "inf"),
     "halo": ("nan", "inf"),
+    # silent data corruption in the SpMV output: ONE element's sign is
+    # flipped at the armed iteration -- a finite value, so the
+    # non-finite breakdown guards can NEVER catch it; only the ABFT
+    # checksum test (acg_tpu.health, --abft) detects it on device
+    "sdc": ("flip",),
+    # host-side hard process death between checkpoint chunks
+    # (``crash:exit@K``: os._exit once the chunked solve crosses K
+    # total iterations) -- the --ckpt/--resume survivability test
+    # vector; refuses without an armed checkpoint (it could never fire)
+    "crash": ("exit",),
     # the preconditioner apply's output z = M^-1 r (PCG tier,
     # acg_tpu.precond): a poisoned z drives the (r, z) scalar non-finite
     # or negative -- the non-SPD-M breakdown path, made deterministic
@@ -114,8 +129,19 @@ class FaultSpec:
         return jnp.where(self._fire(k, part_index), y.at[idx].set(bad), y)
 
     def apply_spmv(self, y, k, part_index=None):
-        """Poison one element of an SpMV output at the armed iteration."""
-        if self.site != "spmv" or k is None:
+        """Poison one element of an SpMV output at the armed iteration.
+        ``sdc:flip`` flips the element's SIGN instead of writing a
+        non-finite -- bit-level corruption the finiteness guards are
+        blind to (the ABFT test vector)."""
+        if k is None:
+            return y
+        if self.site == "sdc":
+            import jax.numpy as jnp
+
+            idx = self.seed % max(int(y.shape[0]), 1)
+            return jnp.where(self._fire(k, part_index),
+                             y.at[idx].set(-y[idx]), y)
+        if self.site != "spmv":
             return y
         return self._poison(y, k, part_index)
 
@@ -148,11 +174,14 @@ class FaultSpec:
     # -- host-side application (eager numpy) ----------------------------
 
     def apply_spmv_np(self, y: np.ndarray, k: int) -> np.ndarray:
-        if self.site != "spmv" or k != self.iteration:
+        if self.site not in ("spmv", "sdc") or k != self.iteration:
             return y
         y = np.array(y, copy=True)
-        y[self.seed % max(y.size, 1)] = (np.nan if self.mode == "nan"
-                                         else np.inf)
+        idx = self.seed % max(y.size, 1)
+        if self.site == "sdc":
+            y[idx] = -y[idx]
+        else:
+            y[idx] = np.nan if self.mode == "nan" else np.inf
         return y
 
     def apply_precond_np(self, z: np.ndarray, k: int) -> np.ndarray:
@@ -204,7 +233,7 @@ def parse_fault_spec(text: str) -> FaultSpec:
             kwargs[key] = float(val) if key == "secs" else int(val)
         except ValueError:
             raise ValueError(f"fault spec {text!r}: bad value {kv!r}")
-    if site in DEVICE_SITES and "iteration" not in kwargs:
+    if site in DEVICE_SITES + ("crash",) and "iteration" not in kwargs:
         raise ValueError(f"fault spec {text!r}: site {site!r} needs a "
                          f"firing iteration (e.g. {site}:{mode}@5)")
     if site == "solve" and "secs" not in kwargs:
@@ -324,6 +353,33 @@ def maybe_slow_solve(solve_index: int) -> float:
         return 0.0
     time.sleep(spec.secs)
     return spec.secs
+
+
+def maybe_crash(before: int, after: int) -> None:
+    """Checkpoint-chunk hook (``crash:exit@K``): hard ``os._exit`` the
+    first time the chunked solve CROSSES K total iterations -- i.e.
+    ``before < K <= after``, where ``before``/``after`` are the
+    cumulative iteration counts around one chunk.  Crossing (not
+    threshold) semantics matter for ``--resume``: a resumed solve
+    starts at the last snapshot, which already lies at-or-past K, so
+    the same inherited spec does not re-kill the relaunch.  Fires
+    AFTER the chunk's snapshot committed (the chunk drivers call this
+    right after their atomic write), modelling preemption between
+    iterations."""
+    spec = active_fault()
+    if spec is None or spec.site != "crash":
+        return
+    K = max(int(spec.iteration), 0)
+    if not (int(before) < K <= int(after)):
+        return
+    import sys
+
+    from acg_tpu.checkpoint import CRASH_EXIT_CODE
+
+    sys.stderr.write(f"acg-tpu: fault injector: hard exit at "
+                     f"{int(after)} iterations (crash:exit@{K})\n")
+    sys.stderr.flush()
+    os._exit(CRASH_EXIT_CODE)
 
 
 def maybe_hang_backend() -> None:

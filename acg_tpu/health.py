@@ -64,6 +64,14 @@ AUD_GAP_MAX = 1    # running max over the solve's audits
 AUD_COUNT = 2      # audits performed
 AUD_STALL = 3      # consecutive non-decreasing-residual iterations
 AUD_SLOTS = 4
+# ABFT extension (spec.abft -- the Huang-Abraham checksum SpMV test,
+# part of the survivability tier): four more slots, present ONLY when
+# abft is armed so an abft-off spec keeps the historical 4-slot vector
+ABFT_REL = 4       # latest relative checksum mismatch
+ABFT_REL_MAX = 5   # running max
+ABFT_COUNT = 6     # checks performed
+ABFT_TRIPS = 7     # checks whose mismatch exceeded the threshold
+ABFT_SLOTS = 8
 
 
 @dataclasses.dataclass(frozen=True)
@@ -81,12 +89,25 @@ class HealthSpec:
     (breakdown-path exit with no restart budget).
     ``stall_window``: consecutive non-decreasing-residual iterations
     before the stagnation detector trips the breakdown path (0 = off).
+    ``abft``: arm the Huang-Abraham checksum-protected SpMV (the
+    survivability tier): the column checksum ``c = A^T 1`` (= ``A 1``
+    for the SPD systems this suite solves) is computed once through the
+    tier's own SpMV, and every ``every`` iterations the in-loop test
+    compares ``sum(A p)`` against ``(c, p)`` -- an identity that holds
+    to rounding, so SILENT bit-level corruption of the SpMV output
+    (``sdc:flip``) is detected on device at machine-epsilon scale,
+    far below any useful gap threshold, and routed into the breakdown
+    -> rollback/recovery path.  ``abft_threshold``: relative mismatch
+    trip level (0 = a dtype/size-derived default,
+    :func:`abft_default_threshold`).
     """
 
     every: int = 0
     threshold: float = 0.0
     action: str = "warn"
     stall_window: int = 0
+    abft: bool = False
+    abft_threshold: float = 0.0
 
     def __post_init__(self):
         if self.every < 0:
@@ -103,6 +124,17 @@ class HealthSpec:
                 f"on-gap action {self.action!r} needs an armed audit "
                 f"(every > 0) AND a positive gap threshold -- a gate "
                 f"that could never trip must refuse, not silently warn")
+        if self.abft and not self.every:
+            raise ValueError(
+                "the ABFT checksum test fires at the audit cadence; "
+                "arm it with a positive audit period (every > 0)")
+        if self.abft_threshold < 0:
+            raise ValueError("ABFT threshold must be >= 0 (0 = the "
+                             "dtype-derived default)")
+        if self.abft_threshold and not self.abft:
+            raise ValueError("abft_threshold needs abft armed -- a "
+                             "threshold that could never be consulted "
+                             "must refuse")
 
     @property
     def armed(self) -> bool:
@@ -111,10 +143,14 @@ class HealthSpec:
     @property
     def arms_detect(self) -> bool:
         """Whether this spec needs the breakdown-detection machinery in
-        the loop (early exit): tripping gaps and the stagnation/sign
-        detectors do; a record-only audit does not."""
+        the loop (early exit): tripping gaps, the stagnation/sign
+        detectors, and the ABFT test (always a tripper: a detected
+        checksum mismatch that could not exit the loop would be a
+        detector wired to nothing) do; a record-only gap audit does
+        not."""
         return ((self.action != "warn" and self.threshold > 0
-                 and self.every > 0) or self.stall_window > 0)
+                 and self.every > 0) or self.stall_window > 0
+                or self.abft)
 
     def __str__(self) -> str:
         parts = [f"audit-every={self.every}"]
@@ -123,29 +159,42 @@ class HealthSpec:
         parts.append(f"on-gap={self.action}")
         if self.stall_window:
             parts.append(f"stall-window={self.stall_window}")
+        if self.abft:
+            parts.append("abft")
+            if self.abft_threshold:
+                parts.append(f"abft-threshold={self.abft_threshold:g}")
         return ",".join(parts)
 
 
 def make_spec(every: int = 0, threshold: float = 0.0,
               action: str = "warn",
-              stall_window: int = 0) -> HealthSpec | None:
+              stall_window: int = 0, abft: bool = False,
+              abft_threshold: float = 0.0) -> HealthSpec | None:
     """``HealthSpec`` or None when nothing is armed (the CLI entry
     point; None keeps every call site's kwargs untouched so disarmed
     programs stay byte-identical)."""
     spec = HealthSpec(every=int(every), threshold=float(threshold),
-                      action=str(action), stall_window=int(stall_window))
+                      action=str(action), stall_window=int(stall_window),
+                      abft=bool(abft),
+                      abft_threshold=float(abft_threshold))
     return spec if spec.armed else None
 
 
 # -- device-side helpers (inside jit; spec fields are static) ------------
 
-def audit_init(sdt):
+def audit_init(sdt, spec: HealthSpec | None = None):
     """The carried audit vector: ``[gap, gap_max, naudits, stall]``,
     gap NaN until the first audit fires (NaN > threshold is False, so
-    an unaudited solve can never trip)."""
+    an unaudited solve can never trip).  With ABFT armed the vector
+    grows four checksum slots ``[rel, rel_max, nchecks, ntrips]``
+    (rel NaN until the first check) -- abft-off specs keep the
+    historical 4-slot layout."""
     import jax.numpy as jnp
 
-    return jnp.asarray([jnp.nan, 0.0, 0.0, 0.0], dtype=sdt)
+    slots = [jnp.nan, 0.0, 0.0, 0.0]
+    if spec is not None and spec.abft:
+        slots += [jnp.nan, 0.0, 0.0, 0.0]
+    return jnp.asarray(slots, dtype=sdt)
 
 
 def relative_gap(rt, r, dot, bnrm2, sdt):
@@ -177,8 +226,12 @@ def audit_update(aud, spec: HealthSpec, k, compute_gap):
 
     def do(a):
         gap = jnp.asarray(compute_gap(), a.dtype).reshape(())
-        return jnp.stack([gap, jnp.maximum(a[AUD_GAP_MAX], gap),
-                          a[AUD_COUNT] + 1, a[AUD_STALL]])
+        # indexed updates, not a rebuilt stack: the vector's length
+        # varies with the ABFT extension and the trailing slots must
+        # pass through untouched
+        return (a.at[AUD_GAP].set(gap)
+                .at[AUD_GAP_MAX].set(jnp.maximum(a[AUD_GAP_MAX], gap))
+                .at[AUD_COUNT].add(1))
 
     fire = (jnp.asarray(k, jnp.int32) + 1) % jnp.int32(spec.every) == 0
     return jax.lax.cond(fire, do, lambda a: a, aud), fire
@@ -197,10 +250,69 @@ def stall_update(aud, spec: HealthSpec, progressing):
                   aud[AUD_STALL] + 1))
 
 
+def abft_default_threshold(sdt, n: int) -> float:
+    """The relative-mismatch trip level when the spec leaves it 0:
+    generous rounding headroom (the checksum identity holds to a few
+    ulps of the summation; 64*sqrt(n) eps covers the worst observed
+    cancellation) yet orders of magnitude below a single flipped
+    element's signature (~2/n of the denominator for near-uniform
+    SpMV outputs)."""
+    import jax.numpy as jnp
+
+    eps = float(jnp.finfo(jnp.dtype(sdt)).eps)
+    return 64.0 * math.sqrt(max(float(n), 1.0)) * eps
+
+
+def abft_update(aud, spec: HealthSpec, k, y, x, cvec, dot3, sdt,
+                n: int):
+    """The in-loop Huang-Abraham checksum verification of ``y = A x``:
+    at the audit cadence, compare ``sum(y)`` against ``(c, x)`` where
+    ``c = A^T 1`` (precomputed through the tier's own SpMV; equal to
+    ``A 1`` for the symmetric systems this suite solves).  ``dot3`` is
+    the tier's FUSED 3-dot closure (one psum of 3 scalars on the mesh
+    tiers, so the armed delta is exactly +1 all_reduce and ZERO extra
+    SpMVs/halo exchanges -- the checksum test is what makes SDC
+    detection affordable every few iterations).
+
+    The relative mismatch is measured against
+    ``sqrt(n (y, y)) + |sum y| + |(c, x)|``: scale-free in the
+    residual's decay (a flip of one element stays detectable at
+    iteration 400 as at iteration 4) and robust to the cancellation in
+    ``sum(y)`` near convergence.  A mismatch past the (default:
+    dtype-derived) threshold increments the trip slot the breakdown
+    predicate reads."""
+    if not (spec.abft and spec.every):
+        return aud
+    import jax
+    import jax.numpy as jnp
+
+    tau = spec.abft_threshold or abft_default_threshold(sdt, n)
+
+    def do(a):
+        ys = y.astype(sdt)
+        xs = x.astype(sdt)
+        st, cp, tt = dot3(ys, jnp.ones_like(ys), cvec, xs, ys, ys)
+        denom = (jnp.sqrt(jnp.maximum(tt, 0) * jnp.asarray(n, sdt))
+                 + jnp.abs(st) + jnp.abs(cp)
+                 + jnp.asarray(jnp.finfo(sdt).tiny, sdt))
+        rel = jnp.abs(st - cp) / denom
+        tripped = rel > jnp.asarray(tau, sdt)
+        return (a.at[ABFT_REL].set(rel)
+                .at[ABFT_REL_MAX].set(jnp.maximum(a[ABFT_REL_MAX], rel))
+                .at[ABFT_COUNT].add(1)
+                .at[ABFT_TRIPS].add(jnp.where(tripped,
+                                              jnp.ones((), a.dtype),
+                                              jnp.zeros((), a.dtype))))
+
+    fire = (jnp.asarray(k, jnp.int32) + 1) % jnp.int32(spec.every) == 0
+    return jax.lax.cond(fire, do, lambda a: a, aud)
+
+
 def trip(aud, spec: HealthSpec):
     """The breakdown-path predicate this spec contributes: a tripped
-    gap (action != warn) and/or an exhausted stall window.  False
-    dtype-correctly when neither detector is armed."""
+    gap (action != warn), an exhausted stall window, and/or an ABFT
+    checksum mismatch.  False dtype-correctly when no detector is
+    armed."""
     import jax.numpy as jnp
 
     t = jnp.asarray(False)
@@ -209,6 +321,8 @@ def trip(aud, spec: HealthSpec):
     if spec.stall_window:
         t = t | (aud[AUD_STALL]
                  >= jnp.asarray(spec.stall_window, aud.dtype))
+    if spec.abft:
+        t = t | (aud[ABFT_TRIPS] > 0)
     return t
 
 
@@ -247,6 +361,16 @@ def summarize_audit(aud, spec: HealthSpec) -> dict:
     if spec.stall_window:
         out["stall_window"] = int(spec.stall_window)
         out["stall_count"] = _clean(a[AUD_STALL])
+    if spec.abft and a.size >= ABFT_SLOTS:
+        out["abft"] = {
+            "threshold": float(spec.abft_threshold) or None,
+            "nchecks": int(a[ABFT_COUNT]) if math.isfinite(a[ABFT_COUNT])
+            else 0,
+            "rel_last": _clean(a[ABFT_REL]),
+            "rel_max": _clean(a[ABFT_REL_MAX]),
+            "ntrips": int(a[ABFT_TRIPS]) if math.isfinite(a[ABFT_TRIPS])
+            else 0,
+        }
     return out
 
 
@@ -255,7 +379,7 @@ def summarize_audit(aud, spec: HealthSpec) -> dict:
 # previous solve's numbers)
 _AUDIT_KEYS = ("audit_every", "on_gap", "gap_threshold", "naudits",
                "gap_last", "gap_max", "stall_window", "stall_count",
-               "spectrum")
+               "abft", "spectrum")
 
 
 def note_audit(stats, aud, spec: HealthSpec, what: str,
@@ -276,6 +400,11 @@ def note_audit(stats, aud, spec: HealthSpec, what: str,
     summary = summarize_audit(aud, spec)
     attempt_naudits = summary["naudits"]
     attempt_gap_max = summary.get("gap_max")
+    # copy: the fresh=False merge below mutates summary["abft"] in place,
+    # and the metrics/event tail must see only THIS attempt's numbers
+    attempt_abft = summary.get("abft")
+    if attempt_abft is not None:
+        attempt_abft = dict(attempt_abft)
     if fresh:
         for k in _AUDIT_KEYS:
             stats.health.pop(k, None)
@@ -289,11 +418,33 @@ def note_audit(stats, aud, spec: HealthSpec, what: str,
                                   else pm)
         if summary.get("gap_last") is None:
             summary["gap_last"] = prev.get("gap_last")
+        pa = prev.get("abft")
+        if pa is not None and attempt_abft is not None:
+            ab = summary["abft"]
+            ab["nchecks"] += int(pa.get("nchecks") or 0)
+            ab["ntrips"] += int(pa.get("ntrips") or 0)
+            pmx = pa.get("rel_max")
+            if pmx is not None:
+                ab["rel_max"] = (max(pmx, ab["rel_max"])
+                                 if ab["rel_max"] is not None else pmx)
+            if ab.get("rel_last") is None:
+                ab["rel_last"] = pa.get("rel_last")
     stats.health.update(summary)
     # the Prometheus counter gets only THIS attempt's increment (it is
     # cumulative across the process by construction)
     metrics.record_health_audit(summary.get("gap_last"),
                                 attempt_naudits)
+    if attempt_abft is not None:
+        metrics.record_abft(attempt_abft.get("nchecks") or 0,
+                            attempt_abft.get("rel_last"),
+                            attempt_abft.get("ntrips") or 0)
+        if attempt_abft.get("ntrips"):
+            telemetry.record_event(
+                stats, "abft_mismatch",
+                f"{what}: ABFT checksum mismatch "
+                f"{attempt_abft.get('rel_max'):.3e} "
+                f"({attempt_abft['ntrips']} tripped check(s)) -- "
+                f"silent SpMV corruption detected on device")
     exceeded = (spec.threshold > 0
                 and attempt_gap_max is not None
                 and attempt_gap_max > spec.threshold)

@@ -467,6 +467,34 @@ HEALTH_AUDITS = REGISTRY.counter(
 HEALTH_GAP_TRIPS = REGISTRY.counter(
     "acg_health_gap_trips_total", "Audit gaps past --gap-threshold "
     "(each one emitted an accuracy_degraded event).")
+# survivability tier (acg_tpu.checkpoint): solver-state snapshots,
+# resumes, and the recovery ladder's rollback rung
+CKPT_SNAPSHOTS = REGISTRY.counter(
+    "acg_ckpt_snapshots_total", "Solver-state snapshots committed "
+    "(atomic-rename writes; --ckpt).")
+CKPT_BYTES = REGISTRY.counter(
+    "acg_ckpt_bytes_total", "Bytes written by committed snapshots.")
+CKPT_WRITE_SECONDS = REGISTRY.histogram(
+    "acg_ckpt_write_seconds", "Snapshot serialisation + atomic-rename "
+    "seconds (billed to the 'ckpt' phase, excluded from solve "
+    "latency).", buckets=PHASE_SECONDS_BUCKETS)
+CKPT_RESUMES = REGISTRY.counter(
+    "acg_ckpt_resumes_total", "Solves reconstructed from an on-disk "
+    "snapshot (--resume).")
+CKPT_ROLLBACKS = REGISTRY.counter(
+    "acg_ckpt_rollbacks_total", "Breakdowns answered by rolling the "
+    "loop carry back to the last snapshot (the recovery ladder's "
+    "first rung).")
+# ABFT checksum-protected SpMV (acg_tpu.health, --abft)
+ABFT_CHECKS = REGISTRY.counter(
+    "acg_abft_checks_total", "In-loop Huang-Abraham checksum "
+    "verifications of the SpMV.")
+ABFT_TRIPS = REGISTRY.counter(
+    "acg_abft_trips_total", "Checksum mismatches past the ABFT "
+    "threshold (silent SpMV corruption detected on device).")
+ABFT_MISMATCH = REGISTRY.gauge(
+    "acg_abft_mismatch_last", "Latest relative checksum mismatch "
+    "|sum(Ax) - (c, x)| / scale.")
 
 _armed = False
 
@@ -549,6 +577,36 @@ def record_health_audit(gap, naudits: int) -> None:
     if gap is not None and math.isfinite(float(gap)):
         HEALTH_GAP.set(float(gap))
     HEALTH_AUDITS.inc(max(int(naudits), 0))
+
+
+def record_rollback() -> None:
+    if _armed:
+        CKPT_ROLLBACKS.inc()
+
+
+def record_snapshot(nbytes: int, seconds: float) -> None:
+    """One committed solver-state snapshot (the chunk drivers' write
+    tails, acg_tpu.checkpoint)."""
+    if not _armed:
+        return
+    CKPT_SNAPSHOTS.inc()
+    CKPT_BYTES.inc(max(int(nbytes), 0))
+    CKPT_WRITE_SECONDS.observe(max(float(seconds), 0.0))
+
+
+def record_resume() -> None:
+    if _armed:
+        CKPT_RESUMES.inc()
+
+
+def record_abft(nchecks: int, rel_last, ntrips: int) -> None:
+    """One solve attempt's ABFT summary (fed from health.note_audit)."""
+    if not _armed:
+        return
+    ABFT_CHECKS.inc(max(int(nchecks), 0))
+    ABFT_TRIPS.inc(max(int(ntrips), 0))
+    if rel_last is not None and math.isfinite(float(rel_last)):
+        ABFT_MISMATCH.set(float(rel_last))
 
 
 def record_health_kappa(kappa: float) -> None:

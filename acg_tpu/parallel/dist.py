@@ -34,6 +34,7 @@ from __future__ import annotations
 
 import dataclasses
 import functools
+import sys
 import time
 
 import jax
@@ -748,7 +749,7 @@ class DistCGSolver:
                  precise_dots: bool = False, kernels: str = "auto",
                  replace_every: int = 0, replace_restart: bool = True,
                  recovery=None, trace: int = 0, progress: int = 0,
-                 precond=None, health=None):
+                 precond=None, health=None, ckpt=None):
         """``recovery`` (acg_tpu.solvers.resilience.RecoveryPolicy) arms
         in-loop breakdown detection plus the host-side restart ladder:
         bounded restarts from the recomputed true residual, the
@@ -863,6 +864,21 @@ class DistCGSolver:
                 "with replace_every: the replacement segments already "
                 "recompute b - A x every K iterations")
         self.health_spec = health
+        # survivability tier (acg_tpu.checkpoint): an armed
+        # CheckpointConfig turns solve() into the host-chunked snapshot
+        # driver (the JaxCGSolver discipline; same refusals)
+        if ckpt is not None:
+            from acg_tpu.checkpoint import CheckpointConfig
+            if not isinstance(ckpt, CheckpointConfig):
+                raise ValueError("ckpt must be an acg_tpu.checkpoint."
+                                 "CheckpointConfig or None")
+            if self.replace_every:
+                raise ValueError(
+                    "checkpointing (ckpt) does not compose with "
+                    "replace_every: the replacement segments' inner "
+                    "state never leaves the program (use the direct "
+                    "classic/pipelined programs)")
+        self.ckpt = ckpt
         self.recovery = recovery
         self.trace = int(trace)
         self.progress = int(progress)
@@ -893,11 +909,20 @@ class DistCGSolver:
 
     # -- program construction ---------------------------------------------
 
-    def _compile(self, fault=None):
+    def _compile(self, fault=None, state_io: bool = False):
         """Build the whole-solve program.  ``fault`` (a static
         acg_tpu.faults.FaultSpec) bakes the injector into the loop --
         the armed program is a solve-local temporary, never cached on
-        ``self``, so clean solves keep the pristine compilation."""
+        ``self``, so clean solves keep the pristine compilation.
+
+        ``state_io`` (the survivability tier, acg_tpu.checkpoint) makes
+        the program ALSO return the final loop carry -- per-part vector
+        leaves sharded like x, psum'd scalars replicated -- and accept
+        an optional ``carry``/``k_offset`` pair that re-enters the
+        recurrence exactly where a previous chunk left it (the
+        checkpoint chunk driver's plumbing).  Disarmed programs never
+        name any of it and lower byte-identical code (pinned in
+        tests/test_checkpoint.py)."""
         prob = self.problem
         pipelined = self.pipelined
         replace_every = self.replace_every
@@ -934,15 +959,30 @@ class DistCGSolver:
         def psum(v):
             return v if single_shard else lax.psum(v, axis)
 
+        # the loop-carry leaf layout a snapshot stores (acg_tpu.
+        # checkpoint): vector leaves shard per-part, the psum'd scalars
+        # replicate -- shared by shard_body's state_io outputs, the
+        # shard_map specs, and the chunk driver's snapshot writer
+        from acg_tpu.checkpoint import SCALAR_LEAVES, carry_names
+        c_names = carry_names(pipelined, precond_spec is not None)[1:]
+        # the GLOBAL unknown count (the ABFT mismatch scale; local
+        # shapes would understate the rounding headroom)
+        nglobal = int(prob.n)
+
         def shard_body(la, ga, sidx, gsrc, gval, scnt, rcnt, b, x0,
                        tols, maxits, mstate=None, unbounded=False,
-                       needs_diff=False, detect=False):
+                       needs_diff=False, detect=False, carry=None,
+                       k_offset=None):
             # shard_map keeps the sharded parts axis as a leading size-1 dim
             la, ga = (jax.tree.map(lambda a: a[0], t) for t in (la, ga))
             sidx, gsrc, gval, scnt, rcnt, b, x0 = (
                 a[0] for a in (sidx, gsrc, gval, scnt, rcnt, b, x0))
             if precond_spec is not None:
                 mstate = jax.tree.map(lambda a: a[0], mstate)
+            if carry is not None:
+                # vector leaves arrive stacked like b; psum'd scalars
+                # arrive replicated (shape ()) and pass through
+                carry = tuple(a[0] if a.ndim == 2 else a for a in carry)
             maxits = maxits.astype(jnp.int32)
             dtype = b.dtype
             # bf16 storage keeps every scalar in f32 (jax_cg._scalar_setup
@@ -1013,7 +1053,6 @@ class DistCGSolver:
 
             bnrm2 = jnp.sqrt(pdot(b, b))
             x0nrm2 = jnp.sqrt(pdot(x0, x0))
-            r = b - spmv(x0)
             if precond_spec is not None:
                 # papply reuses the tier's halo'd SpMV closure: the
                 # cheby apply's communication is exactly K extra SpMVs
@@ -1025,16 +1064,40 @@ class DistCGSolver:
                         z = fault.apply_precond(z, k, pidx)
                     return z
 
+            if carry is not None:
+                # resume (the survivability tier): the provided carry IS
+                # the loop state -- nothing is recomputed, the Krylov
+                # recurrence continues exactly where the snapshot left
+                # it (x0 holds the snapshot iterate).  The setup SpMV
+                # and its collectives are skipped on every shard alike
+                # (carry is a static python branch, mesh-uniform)
+                r = carry[0]
+                if precond_spec is not None:
+                    r0nrm2 = jnp.sqrt(carry[-1])
+                elif pipelined:
+                    r0nrm2 = jnp.sqrt(jnp.maximum(carry[-2], 0))
+                else:
+                    r0nrm2 = jnp.sqrt(carry[-1])
+            elif precond_spec is not None:
+                r = b - spmv(x0)
                 u0 = store(papply(r))
                 gamma0, rr0 = pdot2_fused(r, u0, r, r)
                 gamma = rr0
                 r0nrm2 = jnp.sqrt(rr0)
             else:
+                r = b - spmv(x0)
                 gamma = pdot(r, r)
                 r0nrm2 = jnp.sqrt(gamma)
             res_tol = jnp.maximum(res_atol, res_rtol * r0nrm2)
             diff_tol = jnp.maximum(diff_atol, diff_rtol * x0nrm2)
             inf = jnp.asarray(jnp.inf, sdt)
+            if health is not None and health.abft:
+                # the column checksum c = A^T 1 (= A 1: symmetric
+                # systems) through the tier's own halo'd SpMV -- one
+                # extra exchange per solve.  The in-loop test rides the
+                # FUSED 3-dot psum (pdot3_fused), so the armed delta is
+                # exactly +1 all_reduce per audit and ZERO extra SpMVs
+                cvec = spmv(jnp.ones_like(b)).astype(sdt)
 
             # Loop structure and convergence logic shared with the
             # single-device solver (jax_cg._iterate / _converged): gamma is
@@ -1185,6 +1248,11 @@ class DistCGSolver:
                         out = out + (dx,)
                     fire = None
                     if health is not None:
+                        # cadence phased to TRAJECTORY iterations: the
+                        # checkpoint chunk driver passes the chunk's
+                        # starting iteration (mesh-uniform, like k)
+                        kk = k if k_offset is None else k + k_offset
+
                         # in-loop audit through the SAME halo'd SpMV:
                         # the cond predicate (k) is mesh-uniform, so
                         # the conditional collectives fire on every
@@ -1194,13 +1262,20 @@ class DistCGSolver:
                                                                                 pdot, bnrm2, sdt)
 
                         aud, fire = _health.audit_update(
-                            aud, health, k, compute_gap)
+                            aud, health, kk, compute_gap)
                         prog_now = (out[4] if precond_spec is not None
                                     else gamma_next)
                         prog_prev = (state[4] if precond_spec is not None
                                      else gamma)
                         aud = _health.stall_update(aud, health,
                                                    prog_now < prog_prev)
+                        if health.abft:
+                            # Huang-Abraham checksum test of this
+                            # iteration's t = A p: sum(t) vs (c, p),
+                            # all three scalars in ONE fused psum
+                            aud = _health.abft_update(
+                                aud, health, kk, t, p, cvec,
+                                pdot3_fused, sdt, nglobal)
                     if detect:
                         deferred = bad | (~jnp.isfinite(gamma_next))
                         if precond_spec is not None:
@@ -1229,7 +1304,9 @@ class DistCGSolver:
                                             leader=leader, what="dist-cg")
                     return out
 
-                if precond_spec is not None:
+                if carry is not None:
+                    init_state = (x0,) + tuple(carry)
+                elif precond_spec is not None:
                     init_state = (x0, r, u0, gamma0, rr0)
                 else:
                     init_state = (x0, r, r, gamma)
@@ -1237,7 +1314,8 @@ class DistCGSolver:
                 if detect:
                     init_state = init_state + (jnp.asarray(False),)
                 if health is not None:
-                    init_state = init_state + (_health.audit_init(sdt),)
+                    init_state = init_state + (_health.audit_init(sdt,
+                                                                  health),)
                 if trace:
                     init_state = init_state + (telemetry.ring_init(
                         trace, sdt, audit=health is not None),)
@@ -1259,7 +1337,8 @@ class DistCGSolver:
                 # preconditioned Ghysels-Vanroose (jax_cg pbody, psum'd):
                 # ONE fused 3-scalar allreduce per iteration, the
                 # preconditioner apply + its SpMV overlapping it
-                w = spmv(u0)
+                if carry is None:
+                    w = spmv(u0)
                 zeros = jnp.zeros_like(b)
 
                 def pbody(k, state):
@@ -1306,14 +1385,21 @@ class DistCGSolver:
                         out = out + (dx,)
                     fire = None
                     if health is not None:
+                        kk = k if k_offset is None else k + k_offset
+
                         def compute_gap():
                             return _health.relative_gap(b - spmv(x), r,
                                                                                 pdot, bnrm2, sdt)
 
                         aud, fire = _health.audit_update(
-                            aud, health, k, compute_gap)
+                            aud, health, kk, compute_gap)
                         aud = _health.stall_update(aud, health,
                                                    rr < rr_prev)
+                        if health.abft:
+                            # checksum test of this iteration's n = A m
+                            aud = _health.abft_update(
+                                aud, health, kk, nvec, m, cvec,
+                                pdot3_fused, sdt, nglobal)
                     if detect:
                         flag = bad
                         if health is not None:
@@ -1333,13 +1419,18 @@ class DistCGSolver:
                                             what="dist-cg")
                     return out
 
-                init_state = (x0, r, u0, w, zeros, zeros, zeros, zeros,
-                              inf, inf, rr0) + (
-                    (inf,) if needs_diff else ())
+                if carry is not None:
+                    init_state = (x0,) + tuple(carry)
+                    rr0 = carry[9]
+                else:
+                    init_state = (x0, r, u0, w, zeros, zeros, zeros,
+                                  zeros, inf, inf, rr0)
+                init_state = init_state + ((inf,) if needs_diff else ())
                 if detect:
                     init_state = init_state + (jnp.asarray(False),)
                 if health is not None:
-                    init_state = init_state + (_health.audit_init(sdt),)
+                    init_state = init_state + (_health.audit_init(sdt,
+                                                                  health),)
                 if trace:
                     init_state = init_state + (telemetry.ring_init(
                         trace, sdt, audit=health is not None),)
@@ -1360,7 +1451,8 @@ class DistCGSolver:
                 # stale-test consistency: see jax_cg._cg_pipelined_program
                 done = jnp.logical_or(done, rnrm2 <= res_tol)
             else:
-                w = spmv(r)
+                if carry is None:
+                    w = spmv(r)
                 zeros = jnp.zeros_like(b)
 
                 def body(k, state):
@@ -1376,6 +1468,9 @@ class DistCGSolver:
                     if fault is not None:
                         delta = fault.apply_dot(delta, k)
                     q = spmv(w, k)  # overlaps the psum under XLA's scheduler
+                    # the SpMV input, before the update rebinds w (the
+                    # ABFT check verifies q against THIS vector)
+                    w_in = w
                     beta = gamma / gamma_prev
                     denom = delta - beta * (gamma / alpha_prev)
                     if detect:
@@ -1410,14 +1505,22 @@ class DistCGSolver:
                         out = out + (dx,)
                     fire = None
                     if health is not None:
+                        kk = k if k_offset is None else k + k_offset
+
                         def compute_gap():
                             return _health.relative_gap(b - spmv(x), r,
                                                                                 pdot, bnrm2, sdt)
 
                         aud, fire = _health.audit_update(
-                            aud, health, k, compute_gap)
+                            aud, health, kk, compute_gap)
                         aud = _health.stall_update(aud, health,
                                                    gamma < gamma_prev)
+                        if health.abft:
+                            # checksum test of this iteration's q = A w
+                            # (w_in: the pre-update input)
+                            aud = _health.abft_update(
+                                aud, health, kk, q, w_in, cvec,
+                                pdot3_fused, sdt, nglobal)
                     if detect:
                         flag = bad
                         if health is not None:
@@ -1441,12 +1544,18 @@ class DistCGSolver:
 
                 # stale-gamma convergence test (see jax_cg): s[6] is the
                 # psum'd ||r||^2 from before the update
-                init_state = (x0, r, w, zeros, zeros, zeros, inf, inf) + (
-                    (inf,) if needs_diff else ())
+                if carry is not None:
+                    init_state = (x0,) + tuple(carry)
+                    init_gamma = carry[5]
+                else:
+                    init_state = (x0, r, w, zeros, zeros, zeros, inf, inf)
+                    init_gamma = gamma
+                init_state = init_state + ((inf,) if needs_diff else ())
                 if detect:
                     init_state = init_state + (jnp.asarray(False),)
                 if health is not None:
-                    init_state = init_state + (_health.audit_init(sdt),)
+                    init_state = init_state + (_health.audit_init(sdt,
+                                                                  health),)
                 if trace:
                     init_state = init_state + (telemetry.ring_init(
                         trace, sdt, audit=health is not None),)
@@ -1455,7 +1564,7 @@ class DistCGSolver:
                 k, state, done = run_iter(
                     body, init_state, lambda s: s[6],
                     (lambda s: s[8]) if needs_diff else (lambda s: inf),
-                    init_gamma=gamma,
+                    init_gamma=init_gamma,
                     bad_of=(lambda s: s[bad_i]) if detect else None)
                 x, r_fin = state[0], state[1]
                 dxsqr = state[8] if needs_diff else inf
@@ -1475,9 +1584,18 @@ class DistCGSolver:
             out = (x[None], k, rnrm2, r0nrm2, bnrm2, x0nrm2, dxnrm2,
                    done, breakdown)
             out = out + ((tbuf,) if trace else ())
-            # the audit vector rides LAST (after the ring) so the
-            # existing out[9] = ring fetch in solve() is untouched
-            return out + ((aud_out,) if health is not None else ())
+            # the audit vector rides after the ring so the existing
+            # out[9] = ring fetch in solve() is untouched
+            out = out + ((aud_out,) if health is not None else ())
+            if state_io:
+                # the final loop carry, strictly last (checkpoint.
+                # carry_names order minus x, which rides the result):
+                # vector leaves re-stack the parts axis, psum'd scalars
+                # stay replicated
+                core = state[1:1 + len(c_names)]
+                out = out + tuple(v[None] if v.ndim else v
+                                  for v in core)
+            return out
 
         with_precond = precond_spec is not None
         if single_shard and not prob.halo.has_ghosts:
@@ -1491,11 +1609,13 @@ class DistCGSolver:
                                                 "detect"))
             def program(la, ga, sidx, gsrc, gval, scnt, rcnt, b, x0,
                         tols, maxits, unbounded, needs_diff,
-                        detect=False, mstate=None):
+                        detect=False, mstate=None, carry=None,
+                        k_offset=None):
                 return shard_body(la, ga, sidx, gsrc, gval, scnt, rcnt,
                                   b, x0, tols, maxits, mstate=mstate,
                                   unbounded=unbounded,
-                                  needs_diff=needs_diff, detect=detect)
+                                  needs_diff=needs_diff, detect=detect,
+                                  carry=carry, k_offset=k_offset)
 
             return program
 
@@ -1515,25 +1635,47 @@ class DistCGSolver:
         out_specs = (pspec,) + (rspec,) * (
             8 + (1 if trace else 0)
             + (1 if self.health_spec is not None else 0))
+        # the state_io carry: vector leaves shard like x, psum'd
+        # scalars replicate (checkpoint.carry_names order)
+        carry_specs = tuple(rspec if nm in SCALAR_LEAVES else pspec
+                            for nm in c_names)
+        if state_io:
+            out_specs = out_specs + carry_specs
 
         @functools.partial(jax.jit,
                            static_argnames=("unbounded", "needs_diff",
                                             "detect"))
         def program(la, ga, sidx, gsrc, gval, scnt, rcnt, b, x0,
                     tols, maxits, unbounded, needs_diff, detect=False,
-                    mstate=None):
+                    mstate=None, carry=None, k_offset=None):
             extra = (mstate,) if with_precond else ()
+            specs = in_specs
+            if carry is not None:
+                extra = extra + (tuple(carry),)
+                specs = specs + (carry_specs,)
+            if k_offset is not None:
+                extra = extra + (k_offset,)
+                specs = specs + (rspec,)
 
             def smb(la, ga, sidx, gsrc, gval, scnt, rcnt, b, x0, tols,
-                    maxits, mstate=None):
+                    maxits, *rest):
+                i = 0
+                ms = cr = ko = None
+                if with_precond:
+                    ms, i = rest[i], i + 1
+                if carry is not None:
+                    cr, i = rest[i], i + 1
+                if k_offset is not None:
+                    ko, i = rest[i], i + 1
                 return shard_body(la, ga, sidx, gsrc, gval, scnt, rcnt,
-                                  b, x0, tols, maxits, mstate=mstate,
+                                  b, x0, tols, maxits, mstate=ms,
                                   unbounded=unbounded,
-                                  needs_diff=needs_diff, detect=detect)
+                                  needs_diff=needs_diff, detect=detect,
+                                  carry=cr, k_offset=ko)
 
             return _shard_map(
                 smb,
-                mesh=self.mesh, in_specs=in_specs, out_specs=out_specs,
+                mesh=self.mesh, in_specs=specs, out_specs=out_specs,
             )(la, ga, sidx, gsrc, gval, scnt, rcnt, b, x0, tols, maxits,
               *extra)
 
@@ -1793,6 +1935,11 @@ class DistCGSolver:
         computation never materialise the full vector anywhere, the
         point of the reference's rank-ordered distributed output
         (``mtxfile_fwrite_mpi_double``)."""
+        if self.ckpt is not None:
+            return self._solve_ckpt(b_global, x0=x0, criteria=criteria,
+                                    raise_on_divergence=raise_on_divergence,
+                                    warmup=warmup,
+                                    host_result=host_result)
         crit = criteria or StoppingCriteria()
         st = self.stats
         st.criteria = crit
@@ -1803,6 +1950,7 @@ class DistCGSolver:
                              "only")
 
         from acg_tpu import faults
+        self._crash_refusal()
         fault = faults.device_fault()
         if (fault is not None and fault.site == "halo"
                 and not prob.halo.has_ghosts):
@@ -1982,6 +2130,7 @@ class DistCGSolver:
                                        "dma -> xla")
                     self.comm = "xla"
                     self._program = None
+                    self._ckpt_program = None
                     if fault is not None:
                         fault = fault.shift(k_done)
                     program = self._program_for(fault)
@@ -2060,6 +2209,46 @@ class DistCGSolver:
                              solver="dist-cg-pipelined" if self.pipelined
                              else "dist-cg")
         metrics.observe_solver_comm(self, niter)
+        self._account_ops(st, niter)
+
+        if host_result:
+            x = prob.gather(get_global(x_st))
+            st.fexcept_arrays = [x]
+        else:
+            x = x_st
+            # device-side scans; only two bools cross the wire (the
+            # JaxCGSolver host_result=False convention)
+            has_nan = bool(jnp.isnan(x_st).any())
+            has_inf = bool(jnp.isinf(x_st).any())
+            st.fexcept_arrays = [np.asarray([np.nan if has_nan else 0.0,
+                                             np.inf if has_inf else 0.0])]
+        if not st.converged and raise_on_divergence:
+            raise NotConvergedError(
+                f"{niter} iterations, residual {st.rnrm2:.3e}")
+        return x
+
+    def _crash_refusal(self) -> None:
+        """``crash:exit`` fires from the checkpoint chunk driver between
+        snapshots: armed without --ckpt it could never fire -- refuse
+        instead of reporting a clean 'fault-tested' solve (the
+        fault-injector discipline)."""
+        from acg_tpu import faults
+        spec = faults.active_fault()
+        if (spec is not None and spec.site == "crash"
+                and (self.ckpt is None or self.ckpt.path is None)):
+            raise AcgError(
+                ErrorCode.INVALID_VALUE,
+                "crash:exit fires from the checkpoint chunk driver "
+                "between snapshots; arm --ckpt FILE --ckpt-every K "
+                "(a crash with no snapshot to resume from proves "
+                "nothing)")
+
+    def _account_ops(self, st, niter: int) -> None:
+        """Analytic flop/byte census of ``niter`` iterations on this
+        configuration -- shared by the plain and checkpoint-chunked
+        solve paths so their stats blocks cannot drift apart."""
+        prob = self.problem
+        dtype = np.dtype(prob.vdtype)
         n = prob.n
         st.nflops += (cg_flops_per_iteration(prob.nnz_total, n, self.pipelined)
                       * niter + 3.0 * prob.nnz_total + 2.0 * n)
@@ -2122,22 +2311,6 @@ class DistCGSolver:
                 st.precond["lambda_max"] = lams[1]
             _metrics.record_precond(spec.kind, nops)
 
-        if host_result:
-            x = prob.gather(get_global(x_st))
-            st.fexcept_arrays = [x]
-        else:
-            x = x_st
-            # device-side scans; only two bools cross the wire (the
-            # JaxCGSolver host_result=False convention)
-            has_nan = bool(jnp.isnan(x_st).any())
-            has_inf = bool(jnp.isinf(x_st).any())
-            st.fexcept_arrays = [np.asarray([np.nan if has_nan else 0.0,
-                                             np.inf if has_inf else 0.0])]
-        if not st.converged and raise_on_divergence:
-            raise NotConvergedError(
-                f"{niter} iterations, residual {st.rnrm2:.3e}")
-        return x
-
     def _host_fallback(self, b_global, crit, raise_on_divergence: bool,
                        host_result: bool):
         """The last recovery rung: re-solve on the distributed host
@@ -2160,3 +2333,365 @@ class DistCGSolver:
         # callers expecting the stacked device layout still get it
         from acg_tpu.parallel.multihost import put_global
         return put_global(self.problem.scatter(x), sharding=self._sharding)
+
+    # -- survivability tier: checkpoint-chunked solve ---------------------
+
+    _ckpt_tier = "dist-cg"
+
+    def _ckpt_program_for(self, fault):
+        """The state_io chunk program: fault-armed compiles are
+        solve-local (static spec changes per chunk as the injector
+        shifts); the pristine one is cached."""
+        if fault is not None:
+            return self._compile(fault=fault, state_io=True)
+        prog = getattr(self, "_ckpt_program", None)
+        if prog is None:
+            prog = self._ckpt_program = self._compile(state_io=True)
+        return prog
+
+    def _solve_ckpt(self, b_global, x0=None, criteria=None,
+                    raise_on_divergence: bool = True, warmup: int = 0,
+                    host_result: bool = True):
+        """Checkpoint-armed solve over the mesh (acg_tpu.checkpoint):
+        the UNCHANGED SPMD recurrence dispatched in host chunks of at
+        most ``ckpt.every`` iterations with the full loop carry
+        threaded through (``state_io``), every per-part leaf gathered
+        host-side and committed under ONE agreed sequence number
+        (checkpoint.agree_seq) so all ranks hold the same iteration,
+        and breakdowns answered by the rollback rung before the
+        restart/fallback ladder.  The carry continues the Krylov
+        recurrence exactly, so the chunked trajectory is
+        iteration-identical to solve()'s (tests/test_checkpoint.py);
+        snapshot time is billed to its own ``ckpt`` phase."""
+        from acg_tpu import checkpoint as ckpt_mod
+        from acg_tpu import faults, metrics, telemetry
+        from acg_tpu import health as health_mod
+        from acg_tpu._platform import block_until_ready_works, device_sync
+        from acg_tpu.solvers.resilience import RecoveryDriver
+
+        cfg = self.ckpt
+        crit = criteria or StoppingCriteria()
+        st = self.stats
+        st.criteria = crit
+        prob = self.problem
+        dtype = self._solve_dtype()
+        sdt = acc_dtype(np.dtype(prob.vdtype))
+        if crit.needs_diff:
+            raise AcgError(
+                ErrorCode.INVALID_VALUE,
+                "checkpointing supports residual criteria only: the "
+                "diff criterion's dx scalar is not part of the "
+                "snapshot carry")
+        fault0 = faults.device_fault()
+        if (fault0 is not None and fault0.site == "halo"
+                and not prob.halo.has_ghosts):
+            raise AcgError(
+                ErrorCode.INVALID_VALUE,
+                "halo fault injection needs a topology with ghost "
+                "exchange; this problem has no halo (single part or "
+                "fully decoupled partition)")
+        if (fault0 is not None and fault0.site == "precond"
+                and self.precond_spec is None):
+            raise AcgError(
+                ErrorCode.INVALID_VALUE,
+                "precond fault injection needs an armed preconditioner "
+                "(--precond jacobi|bjacobi|cheby:K); this solve runs "
+                "unpreconditioned CG")
+        detect = self._detect(fault0)
+        if fault0 is not None:
+            telemetry.record_event(st, "fault-armed",
+                                   f"{fault0.site}:{fault0.mode}"
+                                   f"@{fault0.iteration}")
+        t_xfer = time.perf_counter()
+        with telemetry.annotate("transfer"):
+            dev = self.device_args(b_global, x0)
+            b, x0_dev, la, ga, sidx, gsrc, gval, scnt, rcnt = dev
+        telemetry.add_timing(st, "transfer", time.perf_counter() - t_xfer)
+        b_crc = ckpt_mod.vector_checksum(np.asarray(b_global))
+        kwargs = dict(unbounded=crit.unbounded, needs_diff=False,
+                      detect=detect)
+        if self.precond_spec is not None:
+            self._last_dev_args = dev
+            kwargs["mstate"] = self._ensure_precond_state(dev)
+        fixed = (la, ga, sidx, gsrc, gval, scnt, rcnt, b)
+        hl = self.health_spec is not None
+        tr = self.trace
+        pc_kind = (str(self.precond_spec)
+                   if self.precond_spec is not None else None)
+        names = ckpt_mod.carry_names(self.pipelined,
+                                     self.precond_spec is not None)
+        ncore = len(names) - 1
+        scalar = ckpt_mod.SCALAR_LEAVES
+        put = functools.partial(put_global, sharding=self._sharding)
+        solver_name = ("dist-cg-pipelined" if self.pipelined
+                       else "dist-cg")
+
+        def to_dev(arrs):
+            """Host snapshot arrays -> placed carry leaves (vectors
+            scattered over the mesh, scalars as plain device scalars)."""
+            return tuple(
+                jnp.asarray(arrs[nm], dtype=sdt) if nm in scalar
+                else put(np.asarray(arrs[nm], dtype=dtype))
+                for nm in names[1:])
+
+        def to_host(x_st, core):
+            arrs = {"x": np.asarray(get_global(x_st))}
+            for nm, leaf in zip(names[1:], core):
+                arrs[nm] = np.asarray(get_global(leaf) if nm not in scalar
+                                      else leaf)
+            return arrs
+
+        def run(program, x_cur, atol, rtol, m, carry, k0):
+            tols = jnp.asarray([atol, rtol, 0.0, 0.0], dtype=sdt)
+            koff = jnp.int32(k0) if hl else None
+            out = program(*fixed, x_cur, tols, jnp.int32(m),
+                          carry=carry, k_offset=koff, **kwargs)
+            core = out[-ncore:]
+            ring = out[9] if tr else None
+            aud = out[9 + (1 if tr else 0)] if hl else None
+            return out[:9], ring, aud, core
+
+        # -- resume reconstruction ------------------------------------
+        consumed = 0          # trajectory iterations (incl. pre-crash)
+        executed = 0          # iterations THIS process actually ran
+        resumed_from = None
+        carry = None
+        x_cur = x0_dev
+        abs_tol = None
+        first_norms = None
+        snap = cfg.resume
+        if snap is not None:
+            ckpt_mod.validate_resume(
+                snap, tier=self._ckpt_tier, pipelined=self.pipelined,
+                precond=pc_kind, n=int(prob.n), dtype=dtype,
+                b_crc=b_crc, nparts=int(prob.nparts))
+            consumed = snap.iteration
+            resumed_from = consumed
+            sm = snap.meta
+            abs_tol = float(sm["abs_tol"])
+            first_norms = (float(sm["bnrm2"]), float(sm["x0nrm2"]),
+                           float(sm["r0nrm2"]))
+            x_cur = put(np.asarray(snap.arrays["x"], dtype=dtype))
+            carry = to_dev(snap.arrays)
+            metrics.record_resume()
+            telemetry.record_event(
+                st, "resume",
+                f"resumed from snapshot at iteration {consumed}")
+            sys.stderr.write(f"acg-tpu: {self._ckpt_tier}: resumed "
+                             f"from snapshot at iteration {consumed}\n")
+        last_snap = ((consumed, dict(snap.arrays))
+                     if snap is not None else None)
+
+        driver = RecoveryDriver(self.recovery, st, self._ckpt_tier)
+        program = self._ckpt_program_for(fault0)
+        block_until_ready_works()
+        if warmup > 0:
+            t_w = time.perf_counter()
+            with telemetry.annotate("compile"):
+                device_sync(run(program, x_cur, 0.0, 0.0, 0, carry,
+                                consumed)[0][0])
+            telemetry.add_timing(st, "compile",
+                                 time.perf_counter() - t_w)
+
+        unbounded = crit.unbounded
+        fault = fault0
+        seq = 0
+        nsnaps = 0
+        ck_secs = 0.0
+        aud_fresh = True
+        gap_tripped = False
+        res = None
+        t0 = time.perf_counter()
+        with telemetry.annotate("solve"):
+            while True:
+                remaining = crit.maxits - consumed
+                if remaining <= 0:
+                    break
+                m = min(cfg.chunk, remaining)
+                chunk_fault = (fault.shift(executed)
+                               if fault is not None else None)
+                program = self._ckpt_program_for(chunk_fault)
+                if abs_tol is None:
+                    res, tbuf, aud, core = run(
+                        program, x_cur, crit.residual_atol,
+                        crit.residual_rtol, m, carry, consumed)
+                else:
+                    # later chunks keep the FIRST attempt's absolute
+                    # target (never re-baseline rtol)
+                    res, tbuf, aud, core = run(
+                        program, x_cur, abs_tol, 0.0, m, carry,
+                        consumed)
+                device_sync(res[0])
+                k_chunk = int(res[1])
+                consumed += k_chunk
+                executed += k_chunk
+                if first_norms is None:
+                    first_norms = (float(res[4]), float(res[5]),
+                                   float(res[3]))
+                    abs_tol = max(crit.residual_atol,
+                                  crit.residual_rtol * first_norms[2])
+                if tr:
+                    st.trace = self.last_trace = \
+                        telemetry.ConvergenceTrace.from_ring(
+                            np.asarray(tbuf), k_chunk,
+                            solver=solver_name,
+                            offset=consumed - k_chunk)
+                if hl and aud is not None:
+                    gap_tripped = health_mod.note_audit(
+                        st, np.asarray(aud), self.health_spec,
+                        self._ckpt_tier, fresh=aud_fresh)
+                    aud_fresh = False
+                if detect and bool(res[8]):
+                    if tr:
+                        driver.log_trace_window(st.trace)
+                    if (gap_tripped
+                            and self.health_spec.action == "abort"):
+                        st.tsolve += time.perf_counter() - t0 - ck_secs
+                        st.converged = False
+                        raise BreakdownError(
+                            f"{self._ckpt_tier}: true-residual gap "
+                            f"{st.health.get('gap_max', 0.0):.3e} "
+                            f"exceeds threshold "
+                            f"{self.health_spec.threshold:g} at "
+                            f"iteration {consumed} (--on-gap abort)")
+                    driver.note_breakdown(consumed)
+                    # `fault` stays in the TRAJECTORY frame (the
+                    # per-dispatch shift rebases it): vanish a fired
+                    # fault instead of rebasing, which would make the
+                    # dispatch shift double-subtract a pending one
+                    if (fault is not None and fault.device_site
+                            and fault.iteration <= executed):
+                        fault = None
+                    # FIRST RUNG: roll the carry back to the last
+                    # agreed snapshot (exact pre-corruption Krylov
+                    # state; the restart budget is untouched)
+                    if (last_snap is not None
+                            and driver.on_rollback(consumed,
+                                                   last_snap[0])):
+                        arrs = last_snap[1]
+                        x_cur = put(np.asarray(arrs["x"], dtype=dtype))
+                        carry = to_dev(arrs)
+                        consumed = last_snap[0]
+                        continue
+                    # second rung: restart from the recomputed true
+                    # residual (carry=None re-enters the setup path)
+                    if driver.on_breakdown(consumed, noted=True):
+                        x_next = res[0]
+                        if not bool(jnp.isfinite(x_next).all()):
+                            driver.record("iterate non-finite; "
+                                          "restarting from the "
+                                          "initial guess")
+                            x_next = x0_dev
+                        if self.precond_spec is not None:
+                            from acg_tpu.precond import refresh_state
+                            if refresh_state(self, driver):
+                                kwargs["mstate"] = self._mstate
+                        x_cur = x_next
+                        carry = None
+                        continue
+                    pol = self.recovery
+                    can_host = (pol is not None and pol.fallback_host
+                                and prob.owned_parts is None
+                                and all(s.A_local is not None
+                                        for s in prob.subs))
+                    if can_host:
+                        driver.on_fallback("fallback: distributed host "
+                                           "reference solver")
+                        st.tsolve += time.perf_counter() - t0 - ck_secs
+                        return self._host_fallback(
+                            b_global, crit, raise_on_divergence,
+                            host_result)
+                    st.tsolve += time.perf_counter() - t0 - ck_secs
+                    st.converged = False
+                    raise driver.give_up(consumed, float(res[2]))
+                finished = (consumed >= crit.maxits if unbounded
+                            else bool(res[7]))
+                x_cur = res[0]
+                carry = core
+                if cfg.path is not None and not finished:
+                    t_ck = time.perf_counter()
+                    arrs = to_host(x_cur, core)
+                    seq += 1
+                    meta = {
+                        "tier": self._ckpt_tier,
+                        "pipelined": bool(self.pipelined),
+                        "precond": pc_kind,
+                        "n": int(prob.n),
+                        "nparts": int(prob.nparts),
+                        "dtype": str(np.dtype(dtype)),
+                        "iteration": consumed,
+                        "seq": seq,
+                        "abs_tol": float(abs_tol),
+                        "bnrm2": first_norms[0],
+                        "x0nrm2": first_norms[1],
+                        "r0nrm2": first_norms[2],
+                        "b_crc": b_crc,
+                        "fault": (str(faults.active_fault())
+                                  if faults.active_fault() is not None
+                                  else None),
+                        "trace_tail": ckpt_mod.trace_tail(
+                            st.trace if tr else None),
+                    }
+                    # ONE agreed sequence number across controllers
+                    # before anything touches disk; the primary writes
+                    ckpt_mod.agree_seq(seq, consumed)
+                    if jax.process_index() == 0:
+                        nbytes = ckpt_mod.save_snapshot(cfg.path, meta,
+                                                        arrs)
+                    else:
+                        nbytes = 0
+                    dt = time.perf_counter() - t_ck
+                    ck_secs += dt
+                    telemetry.add_timing(st, "ckpt", dt)
+                    metrics.record_snapshot(nbytes, dt)
+                    nsnaps += 1
+                    last_snap = (consumed, arrs)
+                    # crash:exit models preemption BETWEEN iterations,
+                    # after the snapshot committed
+                    faults.maybe_crash(consumed - k_chunk, consumed)
+                if finished:
+                    break
+        if res is None:
+            raise AcgError(
+                ErrorCode.INVALID_VALUE,
+                f"snapshot iteration {consumed} already meets the "
+                f"iteration cap {crit.maxits}; raise --max-iterations "
+                f"to continue this solve")
+        t_solve = time.perf_counter() - t0 - ck_secs
+        st.tsolve += t_solve
+        telemetry.add_timing(st, "solve", t_solve)
+        st.nsolves += 1
+        st.niterations = executed
+        st.ntotaliterations += executed
+        st.bnrm2, st.x0nrm2, st.r0nrm2 = first_norms
+        st.rnrm2 = float(res[2])
+        st.dxnrm2 = float(res[6])
+        st.converged = bool(res[7]) or crit.unbounded
+        st.ckpt = {
+            "path": cfg.path,
+            "every": int(cfg.every),
+            "snapshots": nsnaps,
+            "iteration": consumed,
+            "rollbacks": driver.rollbacks,
+        }
+        if resumed_from is not None:
+            st.ckpt["resumed_from"] = resumed_from
+        metrics.record_solve(t_solve, executed, st.converged,
+                             solver=solver_name)
+        metrics.observe_solver_comm(self, executed)
+        self._account_ops(st, executed)
+        x_st = res[0]
+        if host_result:
+            x = prob.gather(get_global(x_st))
+            st.fexcept_arrays = [x]
+        else:
+            x = x_st
+            has_nan = bool(jnp.isnan(x_st).any())
+            has_inf = bool(jnp.isinf(x_st).any())
+            st.fexcept_arrays = [np.asarray([np.nan if has_nan else 0.0,
+                                             np.inf if has_inf
+                                             else 0.0])]
+        if not st.converged and raise_on_divergence:
+            raise NotConvergedError(
+                f"{executed} iterations, residual {st.rnrm2:.3e}")
+        return x

@@ -132,6 +132,136 @@ def allgather_blobs(blob: str, tag: str = "blob",
             for q in range(n)]
 
 
+class DeadlineHeartbeat:
+    """Dead-peer detection DURING the solve collective (the
+    survivability tier, acg_tpu.checkpoint).
+
+    :func:`agree_status`'s watchdog only guards the agreement
+    checkpoints BETWEEN stages -- a controller that dies mid-solve
+    leaves its peers wedged inside an XLA collective that no Python
+    watchdog wraps, until the scheduler's global timeout.  The
+    heartbeat closes that hole: every controller bumps a
+    coordination-service key every ``period`` seconds from a daemon
+    thread (plain gRPC to the coordinator -- runs happily while the
+    main thread is blocked in a device collective), and watches its
+    peers' keys; a peer whose beat has not advanced for ``deadline``
+    seconds is declared dead and THIS process tears down with
+    :data:`PEER_LOST_EXIT` -- at which point the supervisor relaunches
+    the pod with ``--resume`` and the solve continues from the last
+    agreed snapshot (rollback), or operators abort.  That relaunch IS
+    the rollback-vs-abort decision for a process killed outright: the
+    survivors cannot vote with a dead peer, so the policy lives in the
+    snapshot (a ``--ckpt``-armed solve rolls back; an unarmed one can
+    only abort).
+
+    Single-process (or no coordination service): :meth:`start` is a
+    no-op and :meth:`stop` returns immediately, so the call sites need
+    no gating.  ``on_lost`` overrides the hard exit (tests)."""
+
+    def __init__(self, period: float = 5.0, deadline: float = 30.0,
+                 what: str = "solve", on_lost=None, client=None,
+                 nprocs: int | None = None, me: int | None = None):
+        if period <= 0 or deadline <= period:
+            raise ValueError("heartbeat needs 0 < period < deadline "
+                             f"(got period={period}, deadline={deadline})")
+        self.period = float(period)
+        self.deadline = float(deadline)
+        self.what = str(what)
+        self.on_lost = on_lost
+        self._client = client
+        self._nprocs = nprocs
+        self._me = me
+        self._stop = threading.Event()
+        self._thread = None
+        self._gen = next(_blob_seq)
+
+    def _lost(self, peer: int, age: float) -> None:
+        if self.on_lost is not None:
+            self.on_lost(peer, age)
+            return
+        sys.stderr.write(
+            f"acg-tpu: heartbeat ({self.what}): controller {peer} "
+            f"silent for {age:.0f}s (deadline {self.deadline:.0f}s) -- "
+            f"peer died mid-solve; aborting this process (relaunch "
+            f"with --resume to roll back to the last snapshot)\n")
+        sys.stderr.flush()
+        os._exit(PEER_LOST_EXIT)
+
+    def _run(self, client, n: int, me: int) -> None:
+        import time as _time
+
+        base = f"acg_tpu/heartbeat/{self._gen}"
+        beat = 0
+        # (last seen value, wall time it changed) per peer
+        seen: dict[int, tuple[str, float]] = {}
+        while not self._stop.wait(self.period):
+            beat += 1
+            try:
+                client.key_value_set(f"{base}/{me}/{beat}", "1")
+            except Exception:  # noqa: BLE001 -- coordinator gone: the
+                # erragree watchdogs own that teardown, not us
+                return
+            if beat > 1:
+                try:
+                    # retire the previous beat so a multi-hour solve
+                    # does not grow the coordinator's store (and the
+                    # peers' directory listings) without bound
+                    client.key_value_delete(f"{base}/{me}/{beat - 1}")
+                except Exception:  # noqa: BLE001 -- delete unsupported
+                    pass               # on this client: keys just pile up
+            now = _time.monotonic()
+            for q in range(n):
+                if q == me:
+                    continue
+                try:
+                    # the peer's progress counter is the HIGHEST beat
+                    # index under its directory (not the row count:
+                    # beaters retire old keys when the client allows)
+                    rows = client.key_value_dir_get(f"{base}/{q}")
+                    val = str(max(
+                        (int(str(k).rsplit("/", 1)[-1])
+                         for k, _ in rows), default=0))
+                except Exception:  # noqa: BLE001 -- not written yet
+                    val = ""
+                prev = seen.get(q)
+                if prev is None or prev[0] != val:
+                    seen[q] = (val, now)
+                    continue
+                age = now - prev[1]
+                if age > self.deadline:
+                    self._lost(q, age)
+                    return
+
+    def start(self) -> "DeadlineHeartbeat":
+        import jax
+
+        n = self._nprocs if self._nprocs is not None else jax.process_count()
+        me = self._me if self._me is not None else jax.process_index()
+        client = self._client if self._client is not None else _coord_client()
+        if n == 1 or client is None:
+            return self
+        if not hasattr(client, "key_value_dir_get"):
+            return self
+        self._thread = threading.Thread(
+            target=self._run, args=(client, n, me),
+            name="acg-heartbeat", daemon=True)
+        self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=2 * self.period)
+            self._thread = None
+
+    def __enter__(self):
+        return self.start()
+
+    def __exit__(self, *exc):
+        self.stop()
+        return False
+
+
 def agree_status(code: int, what: str = "", timeout: float = 120.0) -> int:
     """Collective max of per-process status codes (0 = OK).
 

@@ -222,12 +222,17 @@ class ShardedDiaCGSolver(JaxCGSolver):
     into neighbour collective-permutes (``kernels="xla-roll"``).
     """
 
+    # snapshots from this tier name their own provenance: the sharded
+    # roll programs' carry is the global-vector layout (JaxCGSolver's),
+    # but a resume must re-enter the SAME SpMV selection
+    _ckpt_tier = "sharded-dia"
+
     def __init__(self, A: DiaMatrix, mesh: Mesh | None = None,
                  pipelined: bool = False, precise_dots: bool = False,
                  vector_dtype=None, stencil: tuple[int, int] | None = None,
                  replace_every: int = 0, replace_restart: bool = True,
                  recovery=None, trace: int = 0, progress: int = 0,
-                 precond=None, health=None):
+                 precond=None, health=None, ckpt=None):
         if A.ncols_padded != A.nrows:
             raise ValueError("sharded DIA solve needs a square matrix")
         # replace_every (the sound bf16 tier, _cg_replaced_program)
@@ -249,12 +254,16 @@ class ShardedDiaCGSolver(JaxCGSolver):
         # SPMD partitioner), its norm psums through sharding
         # propagation like the CG scalars, and the audit vector comes
         # back replicated exactly like the result scalars
+        # ckpt (acg_tpu.checkpoint) rides the inherited chunk driver:
+        # the roll programs' state_io carry shards into the same
+        # boundary collective-permutes as every other output, and the
+        # snapshot stores the gathered global vectors
         super().__init__(A, pipelined=pipelined, precise_dots=precise_dots,
                          kernels="xla-roll", vector_dtype=vector_dtype,
                          replace_every=replace_every,
                          replace_restart=replace_restart,
                          recovery=recovery, trace=trace, progress=progress,
-                         precond=precond, health=health)
+                         precond=precond, health=health, ckpt=ckpt)
         self.mesh = mesh if mesh is not None else solve_mesh()
         # fault-injection diagnosis hook (JaxCGSolver.solve): this tier
         # is multi-part but still cannot honour part= targeting
@@ -629,7 +638,7 @@ def build_sharded_poisson_solver(n: int, dim: int, nparts: int | None = None,
                                  kernels: str = "xla-roll",
                                  recovery=None, trace: int = 0,
                                  progress: int = 0, precond=None,
-                                 health=None):
+                                 health=None, ckpt=None):
     """Assemble a sharded Poisson problem and its solver in one call
     (the gen-direct CLI path under ``--nparts``/``--multihost``).
 
@@ -663,7 +672,7 @@ def build_sharded_poisson_solver(n: int, dim: int, nparts: int | None = None,
                                 replace_restart=replace_restart,
                                 recovery=recovery, trace=trace,
                                 progress=progress, precond=precond,
-                                health=health)
+                                health=health, ckpt=ckpt)
     if kernels == "pallas-roll":
         solver.use_pallas_roll(n, dim)
     return solver

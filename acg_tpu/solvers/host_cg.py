@@ -51,9 +51,19 @@ class HostCGSolver:
 
     def __init__(self, A: SymCsrMatrix | sp.spmatrix, epsilon: float = 0.0,
                  recovery=None, trace: int = 0, progress: int = 0,
-                 precond=None, health=None):
+                 precond=None, health=None, ckpt=None):
         self.A = as_csr(A, epsilon)
         self.n = self.A.shape[0]
+        # survivability tier (acg_tpu.checkpoint): the eager twin of
+        # the compiled chunk drivers -- snapshots written in-loop every
+        # ``ckpt.every`` iterations, breakdowns answered by the
+        # rollback rung first
+        if ckpt is not None:
+            from acg_tpu.checkpoint import CheckpointConfig
+            if not isinstance(ckpt, CheckpointConfig):
+                raise ValueError("ckpt must be an acg_tpu.checkpoint."
+                                 "CheckpointConfig or None")
+        self.ckpt = ckpt
         self.nnz_full = self.A.nnz
         self.recovery = recovery
         # numerical-health tier (acg_tpu.health): the EAGER twin of the
@@ -95,6 +105,15 @@ class HostCGSolver:
         dbl = 8
         from acg_tpu import faults
         fault = faults.device_fault()
+        _spec_all = faults.active_fault()
+        if (_spec_all is not None and _spec_all.site == "crash"
+                and (self.ckpt is None or self.ckpt.path is None)):
+            from acg_tpu.errors import AcgError, ErrorCode
+            raise AcgError(
+                ErrorCode.INVALID_VALUE,
+                "crash:exit fires between snapshot commits; arm "
+                "--ckpt FILE --ckpt-every K (a crash with no snapshot "
+                "to resume from proves nothing)")
         if fault is not None and (fault.site == "halo" or fault.part > 0):
             from acg_tpu.errors import AcgError, ErrorCode
             raise AcgError(
@@ -141,6 +160,26 @@ class HostCGSolver:
         audited = hspec is not None and hspec.every > 0
         # audit bookkeeping mirroring the device tiers' carried vector
         h_gap, h_gap_max, h_naud, h_stall = float("nan"), 0.0, 0, 0
+        # ABFT checksum bookkeeping (the eager Huang-Abraham twin):
+        # column checksum c = A^T 1 = A 1 (symmetric), compared against
+        # sum(A p) at the audit cadence with the device tiers' exact
+        # mismatch scale
+        abft_armed = hspec is not None and hspec.abft
+        ab_rel, ab_max, ab_n, ab_trips = float("nan"), 0.0, 0, 0
+        if abft_armed:
+            from acg_tpu.health import abft_default_threshold
+            cvec = A @ np.ones(n)
+            ab_tau = (hspec.abft_threshold
+                      or abft_default_threshold(np.float64, n))
+
+        def aud_vec():
+            """The device tiers' fetched audit vector, rebuilt from the
+            eager counters (8 slots with ABFT armed, 4 without)."""
+            base = [h_gap, h_gap_max, h_naud, h_stall]
+            if abft_armed:
+                base += [ab_rel, ab_max, ab_n, ab_trips]
+            return base
+
         rr_prev = float("inf")
         recorder = None
         if self.trace:
@@ -153,6 +192,9 @@ class HostCGSolver:
             return st.trace
 
         tstart = time.perf_counter()
+        # st.timings["ckpt"] accumulates across solves on a shared
+        # stats object; bill only THIS solve's snapshot seconds below
+        ck_base = st.timings.get("ckpt", 0.0)
         st.bnrm2 = float(np.linalg.norm(b))
         st.x0nrm2 = float(np.linalg.norm(x))
 
@@ -204,15 +246,109 @@ class HostCGSolver:
         converged = (not crit.unbounded) and self._test(crit, st, res_tol)
         k = 0
 
+        # -- survivability tier: resume reconstruction + snapshot state
+        ck = self.ckpt
+        pc_kind = (str(self.precond_spec)
+                   if self.precond_spec is not None else None)
+        resumed_from = None
+        nsnaps = 0
+        last_snap = None
+        if ck is not None and ck.resume is not None:
+            from acg_tpu import checkpoint as ckpt_mod
+            from acg_tpu import metrics as _m
+            from acg_tpu.telemetry import record_event
+            snap = ck.resume
+            ckpt_mod.validate_resume(
+                snap, tier="host-cg", pipelined=False, precond=pc_kind,
+                n=n, dtype=np.float64,
+                b_crc=ckpt_mod.vector_checksum(b))
+            x = np.array(snap.arrays["x"], dtype=np.float64)
+            r = np.array(snap.arrays["r"], dtype=np.float64)
+            p = np.array(snap.arrays["p"], dtype=np.float64)
+            gamma = float(snap.arrays["gamma"])
+            rr = (float(snap.arrays["rr"]) if "rr" in snap.arrays
+                  else gamma)
+            k = resumed_from = snap.iteration
+            sm = snap.meta
+            # the FIRST attempt's absolute target and norms (never
+            # re-baseline rtol against an already-small residual)
+            res_tol = float(sm["abs_tol"])
+            st.bnrm2 = float(sm["bnrm2"])
+            st.x0nrm2 = float(sm["x0nrm2"])
+            st.r0nrm2 = float(sm["r0nrm2"])
+            st.rnrm2 = float(np.sqrt(rr))
+            last_snap = (k, dict(snap.arrays))
+            converged = ((not crit.unbounded)
+                         and self._test(crit, st, res_tol))
+            _m.record_resume()
+            record_event(st, "resume",
+                         f"resumed from snapshot at iteration {k}")
+
+        def _commit_snapshot():
+            """One snapshot at the current iteration boundary (atomic
+            rename, checkpoint.save_snapshot); billed to the 'ckpt'
+            phase so solve latency stays clean."""
+            nonlocal nsnaps, last_snap
+            from acg_tpu import checkpoint as ckpt_mod
+            from acg_tpu import metrics as _m
+            from acg_tpu.telemetry import add_timing
+            t_ck = time.perf_counter()
+            arrs = {"x": x.copy(), "r": r.copy(), "p": p.copy(),
+                    "gamma": np.float64(gamma)}
+            if M is not None:
+                arrs["rr"] = np.float64(rr)
+            meta = {
+                "tier": "host-cg", "pipelined": False,
+                "precond": pc_kind, "n": int(n), "dtype": "float64",
+                "iteration": int(k), "seq": nsnaps + 1,
+                "abs_tol": float(res_tol),
+                "bnrm2": st.bnrm2, "x0nrm2": st.x0nrm2,
+                "r0nrm2": st.r0nrm2,
+                "b_crc": ckpt_mod.vector_checksum(b),
+                "fault": (str(faults.active_fault())
+                          if faults.active_fault() is not None else None),
+                "trace_tail": ckpt_mod.trace_tail(None),
+            }
+            nbytes = ckpt_mod.save_snapshot(ck.path, meta, arrs)
+            dt = time.perf_counter() - t_ck
+            add_timing(st, "ckpt", dt)
+            _m.record_snapshot(nbytes, dt)
+            prev = last_snap[0] if last_snap is not None else (
+                resumed_from or 0)
+            nsnaps += 1
+            last_snap = (int(k), arrs)
+            # crash:exit models preemption between iterations, after
+            # the snapshot committed (crossing semantics: a resumed
+            # solve starting at-or-past K does not re-kill itself)
+            faults.maybe_crash(prev, k)
+
         def _breakdown(why: str):
-            """Detected-breakdown restart (eager twin of the compiled
-            solvers' recovery, same RecoveryDriver bookkeeping):
-            recompute the true residual from the last finite iterate and
-            rebuild the Krylov space; raise once the policy's restarts
-            are exhausted."""
-            nonlocal x, r, p, gamma, rr, M
+            """Detected-breakdown recovery (eager twin of the compiled
+            chunk drivers, same RecoveryDriver bookkeeping): FIRST roll
+            the Krylov state back to the last snapshot when one exists;
+            else recompute the true residual from the last finite
+            iterate and rebuild the Krylov space; raise once the
+            policy's restarts are exhausted."""
+            nonlocal x, r, p, gamma, rr, M, k, fault
             driver.log_trace_window(finish_trace())
-            if not driver.on_breakdown(k):
+            driver.note_breakdown(k)
+            # a deterministically-injected fault that already fired
+            # must not re-fire after the rollback rewinds k
+            if (fault is not None and fault.device_site
+                    and fault.iteration < k):
+                fault = None
+            if (last_snap is not None
+                    and driver.on_rollback(k, last_snap[0])):
+                ks, arrs = last_snap
+                x = np.array(arrs["x"])
+                r = np.array(arrs["r"])
+                p = np.array(arrs["p"])
+                gamma = float(arrs["gamma"])
+                rr = float(arrs.get("rr", gamma))
+                k = ks
+                st.rnrm2 = float(np.sqrt(rr))
+                return
+            if not driver.on_breakdown(k, noted=True):
                 st.tsolve += time.perf_counter() - tstart
                 st.converged = False
                 st.fexcept_arrays = [x, r]
@@ -220,8 +356,7 @@ class HostCGSolver:
                     # the audits that ran must reach the health
                     # surfaces on exactly the failing solves
                     from acg_tpu.health import note_audit
-                    note_audit(st, [h_gap, h_gap_max, h_naud, h_stall],
-                               hspec, "host-cg")
+                    note_audit(st, aud_vec(), hspec, "host-cg")
                 raise driver.give_up(k, st.rnrm2)
             if not np.isfinite(x).all():
                 x = (np.array(x0, dtype=np.float64, copy=True)
@@ -260,6 +395,26 @@ class HostCGSolver:
                 t = fault.apply_spmv_np(t, k)
             self._op("gemv", time.perf_counter() - t0,
                      self.nnz_full * (dbl + 4) + 2 * n * dbl, 3.0 * self.nnz_full)
+
+            if abft_armed and (k + 1) % hspec.every == 0:
+                # the eager Huang-Abraham check of THIS iteration's
+                # t = A p: sum(t) vs (c, p), the device tiers' exact
+                # mismatch scale -- a sign-flipped element (sdc:flip)
+                # is finite, so only this test can see it
+                ssum, cp, tt = float(t.sum()), float(cvec @ p), float(t @ t)
+                denom = (np.sqrt(max(tt, 0.0) * n) + abs(ssum) + abs(cp)
+                         + np.finfo(np.float64).tiny)
+                rel = abs(ssum - cp) / denom
+                ab_rel, ab_n = rel, ab_n + 1
+                ab_max = max(ab_max, rel)
+                if rel > ab_tau:
+                    ab_trips += 1
+                    k += 1
+                    st.niterations = k
+                    st.ntotaliterations += 1
+                    _breakdown("ABFT checksum mismatch")
+                    converged = self._test(crit, st, res_tol)
+                    continue
 
             t0 = time.perf_counter()
             pdott = float(p @ t)
@@ -357,8 +512,7 @@ class HostCGSolver:
                         finish_trace()
                         from acg_tpu.errors import BreakdownError
                         from acg_tpu.health import note_audit
-                        note_audit(st, [h_gap, h_gap_max, h_naud,
-                                        h_stall], hspec, "host-cg")
+                        note_audit(st, aud_vec(), hspec, "host-cg")
                         raise BreakdownError(
                             f"host-cg: true-residual gap {gap:.3e} "
                             f"exceeds threshold {hspec.threshold:g} at "
@@ -379,8 +533,7 @@ class HostCGSolver:
                             finish_trace()
                             from acg_tpu.errors import BreakdownError
                             from acg_tpu.health import note_audit
-                            note_audit(st, [h_gap, h_gap_max, h_naud,
-                                            h_stall], hspec, "host-cg")
+                            note_audit(st, aud_vec(), hspec, "host-cg")
                             raise BreakdownError(
                                 f"host-cg: true-residual gap {gap:.3e} "
                                 f"exceeds threshold "
@@ -444,16 +597,36 @@ class HostCGSolver:
                                  f"residual 2-norm {st.rnrm2:.6e}\n")
             if not crit.unbounded:
                 converged = self._test(crit, st, res_tol)
+            if (ck is not None and ck.path is not None and not converged
+                    and k < crit.maxits and k % ck.every == 0):
+                _commit_snapshot()
 
         t_solve = time.perf_counter() - tstart
+        # snapshot serialisation is billed to its own phase, never the
+        # solve (the compiled chunk drivers' convention)
+        t_solve -= st.timings.get("ckpt", 0.0) - ck_base
         st.tsolve += t_solve
         from acg_tpu.telemetry import add_timing
         add_timing(st, "solve", t_solve)
         st.converged = converged or crit.unbounded
+        if ck is not None:
+            # niterations reports iterations THIS process executed (the
+            # compiled chunk drivers' convention); the trajectory
+            # iteration lives in the ckpt section
+            if resumed_from is not None:
+                st.niterations = max(k - resumed_from, 0)
+            st.ckpt = {
+                "path": ck.path,
+                "every": int(ck.every),
+                "snapshots": nsnaps,
+                "iteration": int(k),
+                "rollbacks": driver.rollbacks if driver is not None else 0,
+            }
+            if resumed_from is not None:
+                st.ckpt["resumed_from"] = resumed_from
         if hspec is not None:
             from acg_tpu.health import note_audit
-            note_audit(st, [h_gap, h_gap_max, h_naud, h_stall], hspec,
-                       "host-cg")
+            note_audit(st, aud_vec(), hspec, "host-cg")
         from acg_tpu import metrics
         metrics.record_solve(t_solve, st.niterations, st.converged,
                              solver="host-cg")

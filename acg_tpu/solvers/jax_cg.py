@@ -27,6 +27,7 @@ from __future__ import annotations
 
 import dataclasses
 import functools
+import sys
 import time
 
 import jax
@@ -221,13 +222,15 @@ def _iterate(iter_body, init_state, gamma_of, maxits, res_tol,
 @functools.partial(jax.jit,
                    static_argnames=("unbounded", "needs_diff", "precise",
                                     "kernels", "detect", "fault", "trace",
-                                    "progress", "precond", "health"))
+                                    "progress", "precond", "health",
+                                    "state_io"))
 def _cg_program(A: DeviceMatrix, b, x0, res_atol, res_rtol, diff_atol,
                 diff_rtol, maxits, unbounded: bool, needs_diff: bool,
                 precise: bool = False, kernels: str = "xla",
                 detect: bool = False, fault=None, trace: int = 0,
                 progress: int = 0, precond=None, mstate=None,
-                health=None):
+                health=None, state_io: bool = False, carry=None,
+                k_offset=None):
     """Whole classic-CG solve as one XLA program.
 
     ``precise`` switches the CG scalars' dot products to the compensated
@@ -270,23 +273,55 @@ def _cg_program(A: DeviceMatrix, b, x0, res_atol, res_rtol, diff_atol,
     vector (returned with the result; an extra ``gap`` ring column
     when telemetry is also armed); the stagnation/sign detectors and a
     tripped gap feed the breakdown flag (``detect`` must then be
-    armed).  ``None`` compiles the byte-identical unaudited program."""
+    armed).  ``None`` compiles the byte-identical unaudited program.
+    ``health.abft`` additionally arms the Huang-Abraham
+    checksum-protected SpMV: the column checksum ``c = A^T 1`` is
+    computed once at setup through this program's own SpMV and a
+    ``lax.cond``-guarded in-loop test compares ``sum(A p)`` against
+    ``(c, p)`` at the audit cadence -- silent bit-level corruption
+    (``sdc:flip``) detected on device and routed into the breakdown
+    path.
+
+    ``state_io``/``carry`` (the survivability tier, acg_tpu.
+    checkpoint): ``state_io`` makes the program ALSO return the final
+    loop carry ``(r, p, gamma[, rr])`` (x rides the result already),
+    and a non-None ``carry`` of that shape re-enters the recurrence
+    exactly where a previous chunk left it (``x0`` then holds the
+    snapshot iterate; the setup ``r = b - A x0`` is skipped, so the
+    chunked trajectory is ITERATION-IDENTICAL to an uninterrupted
+    run).  ``k_offset`` (chunked dispatches only; None otherwise) is
+    the trajectory iteration this chunk starts at, so the health
+    tier's audit/ABFT cadence stays phased to GLOBAL iteration
+    numbers across chunk boundaries.  Disarmed programs never name
+    any of the three and lower byte-identical code (pinned in
+    tests/test_checkpoint.py)."""
     dtype = b.dtype
     dot, sdt = _scalar_setup(dtype, precise)
     store = (lambda v: v.astype(dtype)) if sdt != dtype else (lambda v: v)
     spmv_ = _spmv_fn(kernels)
     bnrm2 = jnp.sqrt(dot(b, b))
     x0nrm2 = jnp.sqrt(dot(x0, x0))
-    r = b - spmv_(A, x0)
     if precond is not None:
         from acg_tpu.precond import make_apply
         papply = make_apply(precond, spmv_)
+    if carry is not None:
+        # resume: the provided carry IS the loop state; nothing is
+        # recomputed, so the Krylov recurrence continues exactly
+        if precond is not None:
+            r, p, gamma, rr = carry
+            r0nrm2 = jnp.sqrt(rr)
+        else:
+            r, p, gamma = carry
+            r0nrm2 = jnp.sqrt(gamma)
+    elif precond is not None:
+        r = b - spmv_(A, x0)
         z0 = papply(mstate, A, r)
         p = store(z0)
         gamma = dot(r, z0)
         rr = dot(r, r)
         r0nrm2 = jnp.sqrt(rr)
     else:
+        r = b - spmv_(A, x0)
         p = r
         gamma = dot(r, r)
         r0nrm2 = jnp.sqrt(gamma)
@@ -298,6 +333,14 @@ def _cg_program(A: DeviceMatrix, b, x0, res_atol, res_rtol, diff_atol,
         from acg_tpu import telemetry
     if health is not None:
         from acg_tpu import health as _health
+    if health is not None and health.abft:
+        # the column checksum c = A^T 1 (= A 1: symmetric systems),
+        # through THIS program's own SpMV selection -- one extra SpMV
+        # per solve, zero per-check SpMVs
+        cvec = spmv_(A, jnp.ones_like(b)).astype(sdt)
+
+        def dot3(a1, c1, a2, c2, a3, c3):
+            return dot(a1, c1), dot(a2, c2), dot(a3, c3)
 
     # carry layout: (x, r, p, gamma [, rr] [, dx] [, bad] [, aud]
     # [, ring]) -- rr (the true residual the convergence test reads)
@@ -358,6 +401,12 @@ def _cg_program(A: DeviceMatrix, b, x0, res_atol, res_rtol, diff_atol,
             out = out + (dx,)
         fire = None
         if health is not None:
+            # the health cadence is phased to TRAJECTORY iterations:
+            # chunked dispatches (the checkpoint tier) pass the chunk's
+            # starting iteration so audits fire at the same global
+            # iterations as an uninterrupted run
+            kk = k if k_offset is None else k + k_offset
+
             # in-loop true-residual audit: b - A x through THIS
             # program's SpMV, guarded by lax.cond so non-audited
             # iterations pay only the predicate
@@ -365,13 +414,18 @@ def _cg_program(A: DeviceMatrix, b, x0, res_atol, res_rtol, diff_atol,
                 return _health.relative_gap(b - spmv_(A, x), r,
                                             dot, bnrm2, sdt)
 
-            aud, fire = _health.audit_update(aud, health, k, compute_gap)
+            aud, fire = _health.audit_update(aud, health, kk, compute_gap)
             # residual non-decrease, measured on the scalar the
             # convergence test reads (preconditioned: the carried rr)
             prog_now = out[4] if precond is not None else gamma_next
             prog_prev = state[4] if precond is not None else gamma
             aud = _health.stall_update(aud, health,
                                        prog_now < prog_prev)
+            if health.abft:
+                # Huang-Abraham checksum test of this iteration's
+                # t = A p against the precomputed column checksum
+                aud = _health.abft_update(aud, health, kk, t, p, cvec,
+                                          dot3, sdt, t.shape[0])
         if detect:
             # a poison that slipped past pdott (e.g. a NaN row of t with
             # a finite dot) lands in r: flag it one iteration deferred.
@@ -412,7 +466,7 @@ def _cg_program(A: DeviceMatrix, b, x0, res_atol, res_rtol, diff_atol,
     if detect:
         init_state = init_state + (jnp.asarray(False),)
     if health is not None:
-        init_state = init_state + (_health.audit_init(sdt),)
+        init_state = init_state + (_health.audit_init(sdt, health),)
     if trace:
         init_state = init_state + (telemetry.ring_init(
             trace, sdt, audit=health is not None),)
@@ -443,6 +497,14 @@ def _cg_program(A: DeviceMatrix, b, x0, res_atol, res_rtol, diff_atol,
         extras = extras + (state[-1],)
     if health is not None:
         extras = extras + (state[-2] if trace else state[-1],)
+    if state_io:
+        # the loop carry, strictly last (x rides the result already):
+        # what the checkpoint chunk driver snapshots and threads into
+        # the next dispatch's ``carry``
+        core = (r, p, gamma)
+        if precond is not None:
+            core = core + (state[4],)
+        extras = extras + (core,)
     return (res,) + extras if extras else res
 
 
@@ -671,13 +733,16 @@ def _cg_fused_program(A: DeviceMatrix, b, x0, res_atol, res_rtol,
 @functools.partial(jax.jit,
                    static_argnames=("unbounded", "needs_diff", "precise",
                                     "kernels", "detect", "fault", "trace",
-                                    "progress", "precond", "health"))
+                                    "progress", "precond", "health",
+                                    "state_io"))
 def _cg_pipelined_program(A: DeviceMatrix, b, x0, res_atol, res_rtol,
                           diff_atol, diff_rtol, maxits, unbounded: bool,
                           needs_diff: bool, precise: bool = False,
                           kernels: str = "xla", detect: bool = False,
                           fault=None, trace: int = 0, progress: int = 0,
-                          precond=None, mstate=None, health=None):
+                          precond=None, mstate=None, health=None,
+                          state_io: bool = False, carry=None,
+                          k_offset=None):
     """Whole pipelined-CG (Ghysels-Vanroose) solve as one XLA program.
 
     ``detect``/``fault``/``trace``/``progress`` as in
@@ -714,15 +779,31 @@ def _cg_pipelined_program(A: DeviceMatrix, b, x0, res_atol, res_rtol,
     spmv_ = _spmv_fn(kernels)
     bnrm2 = jnp.sqrt(dot(b, b))
     x0nrm2 = jnp.sqrt(dot(x0, x0))
-    r = b - spmv_(A, x0)
     if precond is not None:
         from acg_tpu.precond import make_apply
         papply = make_apply(precond, spmv_)
+    # resume (the survivability tier): a provided carry re-enters the
+    # GV recurrence exactly -- x0 holds the snapshot iterate, and the
+    # carried vectors (incl. w = A-image and the z/t/q scratch whose
+    # recurrences the pipelined variant never rebuilds) replace the
+    # whole setup.  carry layout matches checkpoint.carry_names
+    c_in = None
+    if carry is not None:
+        c_in = carry
+        if precond is not None:
+            r, rr0 = c_in[0], c_in[9]
+            r0nrm2 = jnp.sqrt(rr0)
+        else:
+            r = c_in[0]
+            r0nrm2 = jnp.sqrt(jnp.maximum(c_in[5], 0))
+    elif precond is not None:
+        r = b - spmv_(A, x0)
         u0 = store(papply(mstate, A, r))
         w = spmv_(A, u0)
         rr0 = dot(r, r)
         r0nrm2 = jnp.sqrt(rr0)
     else:
+        r = b - spmv_(A, x0)
         w = spmv_(A, r)
         r0nrm2 = jnp.sqrt(dot(r, r))
     res_tol = jnp.maximum(res_atol, res_rtol * r0nrm2)
@@ -733,6 +814,13 @@ def _cg_pipelined_program(A: DeviceMatrix, b, x0, res_atol, res_rtol,
         from acg_tpu import telemetry
     if health is not None:
         from acg_tpu import health as _health
+    if health is not None and health.abft:
+        # column checksum through this program's own SpMV (see
+        # _cg_program); the pipelined test verifies q = A w / n = A m
+        cvec = spmv_(A, jnp.ones_like(b)).astype(sdt)
+
+        def dot3(a1, c1, a2, c2, a3, c3):
+            return dot(a1, c1), dot(a2, c2), dot(a3, c3)
 
     def pbody(k, state):
         """Preconditioned GV body: carry (x, r, u, w, p, s, q, z,
@@ -793,14 +881,21 @@ def _cg_pipelined_program(A: DeviceMatrix, b, x0, res_atol, res_rtol,
             out = out + (dx,)
         fire = None
         if health is not None:
+            kk = k if k_offset is None else k + k_offset
+
             def compute_gap():
                 return _health.relative_gap(b - spmv_(A, x), r,
                                             dot, bnrm2, sdt)
 
-            aud, fire = _health.audit_update(aud, health, k, compute_gap)
+            aud, fire = _health.audit_update(aud, health, kk, compute_gap)
             # progress measured on the fused (r, r) scalar (stale by
             # one, like the convergence test)
             aud = _health.stall_update(aud, health, rr < rr_prev)
+            if health.abft:
+                # checksum test of this iteration's n = A m
+                aud = _health.abft_update(aud, health, kk, nvec, m,
+                                          cvec, dot3, sdt,
+                                          nvec.shape[0])
         if detect:
             flag = bad
             if health is not None:
@@ -836,6 +931,9 @@ def _cg_pipelined_program(A: DeviceMatrix, b, x0, res_atol, res_rtol,
         q = spmv_(A, w)
         if fault is not None:
             q = fault.apply_spmv(q, k)
+        # the SpMV input, before the 6-vector update rebinds w below
+        # (the ABFT check verifies q against THIS vector)
+        w_in = w
         beta = gamma / gamma_prev               # inf -> 0 on first iteration
         denom = delta - beta * (gamma / alpha_prev)
         if detect:
@@ -876,12 +974,19 @@ def _cg_pipelined_program(A: DeviceMatrix, b, x0, res_atol, res_rtol,
             out = out + (dx,)
         fire = None
         if health is not None:
+            kk = k if k_offset is None else k + k_offset
+
             def compute_gap():
                 return _health.relative_gap(b - spmv_(A, x), r,
                                             dot, bnrm2, sdt)
 
-            aud, fire = _health.audit_update(aud, health, k, compute_gap)
+            aud, fire = _health.audit_update(aud, health, kk, compute_gap)
             aud = _health.stall_update(aud, health, gamma < gamma_prev)
+            if health.abft:
+                # checksum test of this iteration's q = A w (w_in: the
+                # pre-update input that produced q)
+                aud = _health.abft_update(aud, health, kk, q, w_in,
+                                          cvec, dot3, sdt, q.shape[0])
         if detect:
             flag = bad
             if health is not None:
@@ -907,23 +1012,32 @@ def _cg_pipelined_program(A: DeviceMatrix, b, x0, res_atol, res_rtol,
     # preconditioned carry tests the carried rr (same staleness), so
     # tolerances keep the true-residual meaning
     if precond is not None:
-        init_state = (x0, r, u0, w, zeros, zeros, zeros, zeros, inf, inf,
-                      rr0) + ((inf,) if needs_diff else ())
+        if c_in is not None:
+            init_state = (x0,) + tuple(c_in) + (
+                (inf,) if needs_diff else ())
+        else:
+            init_state = (x0, r, u0, w, zeros, zeros, zeros, zeros, inf,
+                          inf, rr0) + ((inf,) if needs_diff else ())
         loop_body = pbody
         conv_of = lambda s: s[10]
         dx_of = (lambda s: s[11]) if needs_diff else (lambda s: inf)
         init_gamma = rr0
     else:
-        init_state = (x0, r, w, zeros, zeros, zeros, inf, inf) + (
-            (inf,) if needs_diff else ())
+        if c_in is not None:
+            init_state = (x0,) + tuple(c_in) + (
+                (inf,) if needs_diff else ())
+            init_gamma = c_in[5]
+        else:
+            init_state = (x0, r, w, zeros, zeros, zeros, inf, inf) + (
+                (inf,) if needs_diff else ())
+            init_gamma = r0nrm2 * r0nrm2
         loop_body = body
         conv_of = lambda s: s[6]
         dx_of = (lambda s: s[8]) if needs_diff else (lambda s: inf)
-        init_gamma = r0nrm2 * r0nrm2
     if detect:
         init_state = init_state + (jnp.asarray(False),)
     if health is not None:
-        init_state = init_state + (_health.audit_init(sdt),)
+        init_state = init_state + (_health.audit_init(sdt, health),)
     if trace:
         init_state = init_state + (telemetry.ring_init(
             trace, sdt, audit=health is not None),)
@@ -955,6 +1069,12 @@ def _cg_pipelined_program(A: DeviceMatrix, b, x0, res_atol, res_rtol,
         extras = extras + (state[-1],)
     if health is not None:
         extras = extras + (state[-2] if trace else state[-1],)
+    if state_io:
+        # the GV loop carry, strictly last (checkpoint.carry_names
+        # order minus x, which rides the result)
+        core = tuple(state[1:11] if precond is not None
+                     else state[1:8])
+        extras = extras + (core,)
     return (res,) + extras if extras else res
 
 
@@ -971,7 +1091,7 @@ class JaxCGSolver:
                  vector_dtype=None, replace_every: int = 0,
                  replace_restart: bool = True, recovery=None,
                  host_matrix=None, trace: int = 0, progress: int = 0,
-                 precond=None, health=None):
+                 precond=None, health=None, ckpt=None):
         """``recovery`` (a :class:`acg_tpu.solvers.resilience.
         RecoveryPolicy`) arms breakdown detection in the compiled loop
         plus the host-side restart policy; ``host_matrix`` (scipy CSR)
@@ -1141,6 +1261,29 @@ class JaxCGSolver:
                     "two streamed kernels and has no audit hook; the "
                     "health tier needs kernels='xla'/'pallas'")
         self.health_spec = health
+        # survivability tier (acg_tpu.checkpoint): an armed
+        # CheckpointConfig turns solve() into the host-chunked
+        # snapshot driver.  The chunking threads the FULL loop carry
+        # through the direct programs, which the replacement/fused
+        # tiers cannot expose -- refuse rather than silently skip
+        # snapshots (the fault-injector discipline)
+        if ckpt is not None:
+            from acg_tpu.checkpoint import CheckpointConfig
+            if not isinstance(ckpt, CheckpointConfig):
+                raise ValueError("ckpt must be an acg_tpu.checkpoint."
+                                 "CheckpointConfig or None")
+            if replace_every:
+                raise ValueError(
+                    "checkpointing (ckpt) does not compose with "
+                    "replace_every: the replacement segments' inner "
+                    "state never leaves the program (use the direct "
+                    "classic/pipelined programs)")
+            if isinstance(kernels, str) and kernels.startswith("fused"):
+                raise ValueError(
+                    "kernels='fused' folds the whole iteration into "
+                    "two streamed kernels and exposes no loop carry; "
+                    "checkpointing needs kernels='xla'/'pallas'")
+        self.ckpt = ckpt
         self.kernels = kernels
         self.recovery = recovery
         self.host_matrix = host_matrix
@@ -1330,22 +1473,23 @@ class JaxCGSolver:
                 or (self.health_spec is not None
                     and self.health_spec.arms_detect))
 
-    def solve(self, b, x0=None, criteria: StoppingCriteria | None = None,
-              raise_on_divergence: bool = True, warmup: int = 0,
-              host_result: bool = True) -> np.ndarray:
-        """Solve Ax=b.  ``host_result=False`` returns the device array
-        instead of copying x to the host -- at pod-filling sizes the
-        copy dwarfs the solve (537 MB for 512^3), and a caller that only
-        needs the timing/stats (benchmarks) or feeds x to another device
-        computation should not pay it.  The FP-exception report then
-        comes from a device-side finiteness check instead of the host
-        scan."""
-        crit = criteria or StoppingCriteria()
-        st = self.stats
-        st.criteria = crit
+    def _fault_refusals(self, fault) -> None:
+        """Armed-injector configurations this tier can never fire:
+        refuse instead of reporting a clean 'fault-tested' solve --
+        shared by the plain and checkpoint-chunked solve paths."""
         from acg_tpu import faults
-        fault = faults.device_fault()
-        if fault is not None and fault.site == "halo":
+        spec = faults.active_fault()
+        if (spec is not None and spec.site == "crash"
+                and (self.ckpt is None or self.ckpt.path is None)):
+            raise AcgError(
+                ErrorCode.INVALID_VALUE,
+                "crash:exit fires from the checkpoint chunk driver "
+                "between snapshots; arm --ckpt FILE --ckpt-every K "
+                "(a crash with no snapshot to resume from proves "
+                "nothing)")
+        if fault is None:
+            return
+        if fault.site == "halo":
             # no halo exists on the single-device solver: an armed
             # injector that can never fire must refuse, not report a
             # clean "fault-tested" solve (the replace_every rationale)
@@ -1381,6 +1525,32 @@ class JaxCGSolver:
                 f"global vector and cannot target part {fault.part}; "
                 f"drop part= or use the partitioned DistCGSolver path "
                 f"for part-targeted injection")
+
+    def solve(self, b, x0=None, criteria: StoppingCriteria | None = None,
+              raise_on_divergence: bool = True, warmup: int = 0,
+              host_result: bool = True) -> np.ndarray:
+        """Solve Ax=b.  ``host_result=False`` returns the device array
+        instead of copying x to the host -- at pod-filling sizes the
+        copy dwarfs the solve (537 MB for 512^3), and a caller that only
+        needs the timing/stats (benchmarks) or feeds x to another device
+        computation should not pay it.  The FP-exception report then
+        comes from a device-side finiteness check instead of the host
+        scan.
+
+        An armed checkpoint (``ckpt``) routes through the survivability
+        tier's chunked driver (:meth:`_solve_ckpt`): same programs,
+        dispatched in snapshot-bounded chunks."""
+        if self.ckpt is not None:
+            return self._solve_ckpt(b, x0=x0, criteria=criteria,
+                                    raise_on_divergence=raise_on_divergence,
+                                    warmup=warmup,
+                                    host_result=host_result)
+        crit = criteria or StoppingCriteria()
+        st = self.stats
+        st.criteria = crit
+        from acg_tpu import faults
+        fault = faults.device_fault()
+        self._fault_refusals(fault)
         # detection arms with the recovery policy, an active injector
         # (an injected fault must surface, never launder into x), or a
         # tripping health spec; the detect=False programs stay
@@ -1587,6 +1757,28 @@ class JaxCGSolver:
                              solver="cg-pipelined" if self.pipelined
                              else "cg")
         metrics.observe_solver_comm(self, niter)
+        self._account_ops(st, niter, dtype)
+        if host_result:
+            x = np.asarray(res.x)
+            st.fexcept_arrays = [x]
+        else:
+            x = res.x
+            # device-side scans; only two bools cross the wire.  The
+            # sentinels reproduce the host report's NaN/Inf distinction
+            # (errors.fexcept_str).
+            has_nan = bool(jnp.isnan(res.x).any())
+            has_inf = bool(jnp.isinf(res.x).any())
+            st.fexcept_arrays = [np.asarray([np.nan if has_nan else 0.0,
+                                             np.inf if has_inf else 0.0])]
+        if not st.converged and raise_on_divergence:
+            raise NotConvergedError(
+                f"{niter} iterations, residual {st.rnrm2:.3e}")
+        return x
+
+    def _account_ops(self, st, niter: int, dtype) -> None:
+        """Analytic flop/byte census of ``niter`` iterations on this
+        configuration -- shared by the plain and checkpoint-chunked
+        solve paths so their stats blocks cannot drift apart."""
         n = self.A.nrows
         per_it = cg_flops_per_iteration(self._spmv_flops / 3.0, n,
                                         self.pipelined)
@@ -1637,22 +1829,6 @@ class JaxCGSolver:
                 st.ops["copy"].add(1, 0.0, 2 * n * dbl)
             if self.precond_spec is not None:
                 self._account_precond(st, niter, n, dbl, mat_bytes)
-        if host_result:
-            x = np.asarray(res.x)
-            st.fexcept_arrays = [x]
-        else:
-            x = res.x
-            # device-side scans; only two bools cross the wire.  The
-            # sentinels reproduce the host report's NaN/Inf distinction
-            # (errors.fexcept_str).
-            has_nan = bool(jnp.isnan(res.x).any())
-            has_inf = bool(jnp.isinf(res.x).any())
-            st.fexcept_arrays = [np.asarray([np.nan if has_nan else 0.0,
-                                             np.inf if has_inf else 0.0])]
-        if not st.converged and raise_on_divergence:
-            raise NotConvergedError(
-                f"{niter} iterations, residual {st.rnrm2:.3e}")
-        return x
 
     def _account_precond(self, st, niter: int, n: int, dbl: int,
                          mat_bytes: int) -> None:
@@ -1702,3 +1878,330 @@ class JaxCGSolver:
                          raise_on_divergence=raise_on_divergence)
         adopt_host_stats(self.stats, hs.stats)
         return x if host_result else jnp.asarray(x)
+
+    # -- survivability tier: checkpoint-chunked solve ---------------------
+
+    _ckpt_tier = "jax-cg"
+
+    def _solve_ckpt(self, b, x0=None, criteria=None,
+                    raise_on_divergence: bool = True, warmup: int = 0,
+                    host_result: bool = True):
+        """Checkpoint-armed solve (acg_tpu.checkpoint): the UNCHANGED
+        direct program dispatched in host chunks of at most
+        ``ckpt.every`` iterations with the full loop carry threaded
+        through (``state_io``), a checksummed snapshot committed by
+        atomic rename at every boundary, and detected breakdowns
+        answered by the recovery ladder's new FIRST rung -- rollback to
+        the last snapshot -- before the existing restart/fallback/abort
+        ladder.  Because the carry continues the recurrence exactly,
+        the chunked trajectory is iteration-identical to solve()'s
+        (asserted in tests/test_checkpoint.py); snapshot time is billed
+        to its own ``ckpt`` phase, never the solve."""
+        from acg_tpu import checkpoint as ckpt_mod
+        from acg_tpu import faults, metrics, telemetry
+        from acg_tpu import health as health_mod
+        from acg_tpu._platform import (block_until_ready_works,
+                                       device_sync)
+        from acg_tpu.solvers.resilience import RecoveryDriver
+
+        cfg = self.ckpt
+        crit = criteria or StoppingCriteria()
+        st = self.stats
+        st.criteria = crit
+        fault0 = faults.device_fault()
+        self._fault_refusals(fault0)
+        detect = self._detect(fault0)
+        dtype = self._solve_dtype()
+        sdt = acc_dtype(dtype)
+        if fault0 is not None:
+            telemetry.record_event(st, "fault-armed",
+                                   f"{fault0.site}:{fault0.mode}"
+                                   f"@{fault0.iteration}")
+        t_xfer = time.perf_counter()
+        with telemetry.annotate("transfer"):
+            b_host = np.asarray(b, dtype=dtype)
+            b_dev = jnp.asarray(b_host)
+            x0_dev = (jnp.zeros_like(b_dev) if x0 is None
+                      else jnp.asarray(x0, dtype=dtype))
+        telemetry.add_timing(st, "transfer",
+                             time.perf_counter() - t_xfer)
+        b_crc = ckpt_mod.vector_checksum(b_host)
+        program, base, kwargs, tr = self._select_program(
+            b_dev, x0_dev, crit, detect=detect, fault=fault0)
+        kwargs = dict(kwargs)
+        kwargs["state_io"] = True
+        hl = "health" in kwargs
+        pc_kind = (str(self.precond_spec)
+                   if self.precond_spec is not None else None)
+        names = ckpt_mod.carry_names(self.pipelined,
+                                     self.precond_spec is not None)
+        solver_name = ("cg-pipelined" if self.pipelined else "cg")
+
+        def chunk_args(x_dev, atol, rtol, m):
+            return (base[0], base[1], x_dev,
+                    jnp.asarray(atol, sdt), jnp.asarray(rtol, sdt),
+                    base[5], base[6], jnp.int32(m))
+
+        def run(a, carry, k0):
+            # the chunk's starting trajectory iteration keeps the
+            # health tier's audit/ABFT cadence phased to GLOBAL
+            # iteration numbers (a dynamic arg: chunks never retrace)
+            koff = jnp.int32(k0) if hl else None
+            out = program(*a, carry=carry, k_offset=koff, **kwargs)
+            i = 1
+            ring = out[i] if tr else None
+            i += 1 if tr else 0
+            aud = out[i] if hl else None
+            i += 1 if hl else 0
+            return out[0], ring, aud, out[i]
+
+        # -- resume reconstruction ------------------------------------
+        consumed = 0          # trajectory iterations (incl. pre-crash)
+        executed = 0          # iterations THIS process actually ran
+        resumed_from = None
+        carry = None
+        x_cur = x0_dev
+        abs_tol = None
+        first_norms = None
+        snap = cfg.resume
+        if snap is not None:
+            ckpt_mod.validate_resume(
+                snap, tier=self._ckpt_tier, pipelined=self.pipelined,
+                precond=pc_kind, n=int(self.A.nrows), dtype=dtype,
+                b_crc=b_crc)
+            consumed = snap.iteration
+            resumed_from = consumed
+            sm = snap.meta
+            abs_tol = float(sm["abs_tol"])
+            first_norms = (float(sm["bnrm2"]), float(sm["x0nrm2"]),
+                           float(sm["r0nrm2"]))
+            x_cur = jnp.asarray(snap.arrays["x"])
+            carry = tuple(jnp.asarray(snap.arrays[nm])
+                          for nm in names[1:])
+            metrics.record_resume()
+            telemetry.record_event(
+                st, "resume",
+                f"resumed from snapshot at iteration {consumed}")
+            sys.stderr.write(f"acg-tpu: {self._ckpt_tier}: resumed "
+                             f"from snapshot at iteration "
+                             f"{consumed}\n")
+        last_snap = ((consumed, {"x": np.asarray(x_cur),
+                                 **{nm: np.asarray(leaf)
+                                    for nm, leaf in zip(names[1:],
+                                                        carry)}})
+                     if carry is not None else None)
+
+        driver = RecoveryDriver(self.recovery, st, self._ckpt_tier)
+        block_until_ready_works()
+        if warmup > 0:
+            # ONE zero-iteration dispatch absorbs the chunk program's
+            # compile outside the timed window (further chunk variants
+            # -- the carry-armed retrace -- land in the solve phase)
+            t_w = time.perf_counter()
+            with telemetry.annotate("compile"):
+                device_sync(run(chunk_args(x_cur, 0.0, 0.0, 0),
+                                carry, consumed)[0].x)
+            telemetry.add_timing(st, "compile",
+                                 time.perf_counter() - t_w)
+
+        unbounded = crit.unbounded
+        fault = fault0
+        seq = 0
+        nsnaps = 0
+        ck_secs = 0.0
+        aud_fresh = True
+        gap_tripped = False
+        res = None
+        t0 = time.perf_counter()
+        with telemetry.annotate("solve"):
+            while True:
+                remaining = crit.maxits - consumed
+                if remaining <= 0:
+                    break
+                m = min(cfg.chunk, remaining)
+                if abs_tol is None:
+                    a = chunk_args(x_cur, crit.residual_atol,
+                                   crit.residual_rtol, m)
+                else:
+                    # later chunks keep the FIRST attempt's absolute
+                    # target (the recovery-restart convention: never
+                    # re-baseline rtol against an already-small
+                    # residual)
+                    a = chunk_args(x_cur, abs_tol, 0.0, m)
+                if "fault" in kwargs:
+                    kwargs["fault"] = (fault.shift(executed)
+                                       if fault is not None else None)
+                res, tbuf, aud, core = run(a, carry, consumed)
+                device_sync(res.x)
+                k_chunk = int(res.niterations)
+                consumed += k_chunk
+                executed += k_chunk
+                if first_norms is None:
+                    first_norms = (float(res.bnrm2), float(res.x0nrm2),
+                                   float(res.r0nrm2))
+                    abs_tol = max(crit.residual_atol,
+                                  crit.residual_rtol * first_norms[2])
+                if tr:
+                    st.trace = self.last_trace = \
+                        telemetry.ConvergenceTrace.from_ring(
+                            np.asarray(tbuf), k_chunk,
+                            solver=solver_name,
+                            offset=consumed - k_chunk)
+                if hl and aud is not None:
+                    gap_tripped = health_mod.note_audit(
+                        st, aud, self.health_spec, self._ckpt_tier,
+                        fresh=aud_fresh)
+                    aud_fresh = False
+                if detect and bool(res.breakdown):
+                    if tr:
+                        driver.log_trace_window(st.trace)
+                    if (gap_tripped
+                            and self.health_spec.action == "abort"):
+                        st.tsolve += time.perf_counter() - t0 - ck_secs
+                        st.converged = False
+                        from acg_tpu.errors import BreakdownError
+                        raise BreakdownError(
+                            f"{self._ckpt_tier}: true-residual gap "
+                            f"{st.health.get('gap_max', 0.0):.3e} "
+                            f"exceeds threshold "
+                            f"{self.health_spec.threshold:g} at "
+                            f"iteration {consumed} (--on-gap abort)")
+                    driver.note_breakdown(consumed)
+                    # a fault that fired (that is what broke the solve)
+                    # must not deterministically re-fire after the
+                    # rollback/restart; `fault` stays in the TRAJECTORY
+                    # frame (the per-dispatch shift above rebases it),
+                    # so vanish it once its iteration has executed --
+                    # rebasing here would make the dispatch shift
+                    # double-subtract a still-pending fault
+                    if (fault is not None and fault.device_site
+                            and fault.iteration <= executed):
+                        fault = None
+                    # FIRST RUNG: roll the carry back to the last
+                    # committed snapshot -- exact pre-corruption Krylov
+                    # state, restart budget untouched
+                    if (last_snap is not None
+                            and driver.on_rollback(consumed,
+                                                   last_snap[0])):
+                        arrs = last_snap[1]
+                        x_cur = jnp.asarray(arrs["x"])
+                        carry = tuple(jnp.asarray(arrs[nm])
+                                      for nm in names[1:])
+                        consumed = last_snap[0]
+                        continue
+                    # second rung: restart from the recomputed true
+                    # residual (carry=None re-enters the setup path)
+                    if driver.on_breakdown(consumed, noted=True):
+                        x_next = res.x
+                        if not bool(jnp.isfinite(x_next).all()):
+                            driver.record("iterate non-finite; "
+                                          "restarting from the "
+                                          "initial guess")
+                            x_next = x0_dev
+                        if self.precond_spec is not None:
+                            from acg_tpu.precond import refresh_state
+                            if refresh_state(self, driver):
+                                kwargs["mstate"] = self._mstate
+                        x_cur = x_next
+                        carry = None
+                        continue
+                    pol = self.recovery
+                    if (pol is not None and pol.fallback_host
+                            and self.host_matrix is not None):
+                        driver.on_fallback(
+                            "fallback: host reference solver")
+                        st.tsolve += time.perf_counter() - t0 - ck_secs
+                        return self._host_fallback(
+                            b_host, crit, raise_on_divergence,
+                            host_result)
+                    st.tsolve += time.perf_counter() - t0 - ck_secs
+                    st.converged = False
+                    raise driver.give_up(consumed, float(res.rnrm2))
+                finished = (consumed >= crit.maxits if unbounded
+                            else bool(res.converged))
+                x_cur = res.x
+                carry = core
+                if cfg.path is not None and not finished:
+                    t_ck = time.perf_counter()
+                    arrs = {"x": np.asarray(res.x)}
+                    for nm, leaf in zip(names[1:], core):
+                        arrs[nm] = np.asarray(leaf)
+                    seq += 1
+                    meta = {
+                        "tier": self._ckpt_tier,
+                        "pipelined": bool(self.pipelined),
+                        "precond": pc_kind,
+                        "n": int(self.A.nrows),
+                        "dtype": str(np.dtype(dtype)),
+                        "iteration": consumed,
+                        "seq": seq,
+                        "abs_tol": float(abs_tol),
+                        "bnrm2": first_norms[0],
+                        "x0nrm2": first_norms[1],
+                        "r0nrm2": first_norms[2],
+                        "b_crc": b_crc,
+                        "fault": (str(faults.active_fault())
+                                  if faults.active_fault() is not None
+                                  else None),
+                        "trace_tail": ckpt_mod.trace_tail(
+                            st.trace if tr else None),
+                    }
+                    ckpt_mod.agree_seq(seq, consumed)
+                    nbytes = ckpt_mod.save_snapshot(cfg.path, meta,
+                                                    arrs)
+                    dt = time.perf_counter() - t_ck
+                    ck_secs += dt
+                    telemetry.add_timing(st, "ckpt", dt)
+                    metrics.record_snapshot(nbytes, dt)
+                    nsnaps += 1
+                    last_snap = (consumed, arrs)
+                    # the crash:exit site models preemption BETWEEN
+                    # iterations, after the snapshot committed
+                    faults.maybe_crash(consumed - k_chunk, consumed)
+                if finished:
+                    break
+        if res is None:
+            # a resumed snapshot already at (or past) the iteration
+            # cap: no chunk ever ran -- nothing sensible to report
+            raise AcgError(
+                ErrorCode.INVALID_VALUE,
+                f"snapshot iteration {consumed} already meets the "
+                f"iteration cap {crit.maxits}; raise --max-iterations "
+                f"to continue this solve")
+        t_solve = time.perf_counter() - t0 - ck_secs
+        st.tsolve += t_solve
+        telemetry.add_timing(st, "solve", t_solve)
+        st.nsolves += 1
+        st.niterations = executed
+        st.ntotaliterations += executed
+        st.bnrm2, st.x0nrm2, st.r0nrm2 = first_norms
+        st.rnrm2 = float(res.rnrm2)
+        st.dxnrm2 = float(res.dxnrm2)
+        st.converged = bool(res.converged) or crit.unbounded
+        st.ckpt = {
+            "path": cfg.path,
+            "every": int(cfg.every),
+            "snapshots": nsnaps,
+            "iteration": consumed,
+            "rollbacks": driver.rollbacks,
+        }
+        if resumed_from is not None:
+            st.ckpt["resumed_from"] = resumed_from
+        metrics.record_solve(t_solve, executed, st.converged,
+                             solver=solver_name)
+        metrics.observe_solver_comm(self, executed)
+        self._account_ops(st, executed, dtype)
+        if host_result:
+            x = np.asarray(res.x)
+            st.fexcept_arrays = [x]
+        else:
+            x = res.x
+            has_nan = bool(jnp.isnan(res.x).any())
+            has_inf = bool(jnp.isinf(res.x).any())
+            st.fexcept_arrays = [np.asarray([np.nan if has_nan else 0.0,
+                                             np.inf if has_inf
+                                             else 0.0])]
+        if not st.converged and raise_on_divergence:
+            raise NotConvergedError(
+                f"{executed} iterations, residual {st.rnrm2:.3e}")
+        return x

@@ -58,6 +58,13 @@ class RecoveryPolicy:
     fallback_comm: bool = True
     fallback_host: bool = True
     agree_timeout: float = 120.0
+    # the survivability tier's FIRST rung (acg_tpu.checkpoint): on a
+    # detected breakdown, roll the loop carry back to the last on-disk
+    # snapshot BEFORE spending the restart budget -- a rollback resumes
+    # the exact pre-corruption Krylov state, where a restart discards
+    # it.  Only consulted by the checkpoint-armed chunk drivers (no
+    # snapshot, no rung); 0 disables
+    max_rollbacks: int = 1
 
 
 def adopt_host_stats(st, host_stats) -> None:
@@ -88,6 +95,7 @@ class RecoveryDriver:
         self.stats = stats
         self.what = what
         self.restarts = 0
+        self.rollbacks = 0
 
     def record(self, event: str, kind: str = "recovery") -> None:
         self.stats.recovery_log.append(event)
@@ -105,13 +113,9 @@ class RecoveryDriver:
             return
         self.record(trace.tail_summary(), kind="trace-window")
 
-    def on_breakdown(self, niter: int) -> bool:
-        """Account one detected breakdown; returns True when the policy
-        grants a restart (after the backoff sleep), False when retries
-        are exhausted (caller falls back or raises).  Multi-controller,
-        the decision is ERROR-AGREED first: if any controller is out of
-        retries (or dead), every controller refuses the restart
-        together."""
+    def note_breakdown(self, niter: int) -> None:
+        """Account one detected breakdown (counter + metric + event) --
+        exactly once per detection, whichever rung then handles it."""
         st = self.stats
         st.nbreakdowns += 1
         from acg_tpu import metrics
@@ -119,6 +123,49 @@ class RecoveryDriver:
         from acg_tpu.telemetry import record_event
         record_event(st, "breakdown",
                      f"breakdown detected at iteration {niter}")
+
+    def on_rollback(self, niter: int, snapshot_iteration: int) -> bool:
+        """The survivability tier's FIRST rung: roll the loop carry back
+        to the last snapshot (acg_tpu.checkpoint).  Returns True when
+        the policy grants it -- the caller restores the snapshot carry
+        and re-enters the chunk loop; False sends the breakdown down
+        the existing restart/fallback/abort ladder.  Multi-controller
+        the verdict is error-agreed like a restart's (every controller
+        rolls back to the SAME agreed snapshot or none does).  Does NOT
+        consume the restart budget: a rollback resumes exact Krylov
+        state, a restart rebuilds it -- they are different medicines
+        and are bounded separately (``max_rollbacks``)."""
+        pol = self.policy
+        want = (pol is not None
+                and self.rollbacks < getattr(pol, "max_rollbacks", 0))
+        if not self._agree(0 if want else 1):
+            if want:
+                self.record("rollback vetoed: a peer controller cannot "
+                            "roll back")
+            return False
+        if not want:
+            return False
+        self.rollbacks += 1
+        self.stats.nrollbacks += 1
+        from acg_tpu import metrics
+        metrics.record_rollback()
+        self.record(f"breakdown at iteration {niter}: rolling back to "
+                    f"the snapshot at iteration {snapshot_iteration} "
+                    f"(rollback {self.rollbacks}/{pol.max_rollbacks})",
+                    kind="rollback")
+        return True
+
+    def on_breakdown(self, niter: int, noted: bool = False) -> bool:
+        """Account one detected breakdown; returns True when the policy
+        grants a restart (after the backoff sleep), False when retries
+        are exhausted (caller falls back or raises).  Multi-controller,
+        the decision is ERROR-AGREED first: if any controller is out of
+        retries (or dead), every controller refuses the restart
+        together.  ``noted=True`` (the rollback-rung callers) skips the
+        breakdown accounting already done by :meth:`note_breakdown`."""
+        st = self.stats
+        if not noted:
+            self.note_breakdown(niter)
         pol = self.policy
         want_restart = pol is not None and self.restarts < pol.max_restarts
         if not self._agree(0 if want_restart else 1):
@@ -130,6 +177,7 @@ class RecoveryDriver:
             return False
         self.restarts += 1
         st.nrestarts += 1
+        from acg_tpu import metrics
         metrics.record_restart()
         if pol.backoff > 0:
             time.sleep(pol.backoff * (2 ** (self.restarts - 1)))

@@ -38,8 +38,11 @@ _OPTIONAL_OPS = ("precond",)
 # telemetry tier's always-on phase timer); phases recorded out of order
 # -- solvers record transfer/compile/solve, the CLI records the rest --
 # still report in this order
+# "ckpt" is the survivability tier's snapshot serialisation + atomic
+# rename (acg_tpu.checkpoint), billed to its OWN phase so solve (and
+# the soak latency histograms) never absorb checkpoint time
 PHASE_ORDER = ("ingest", "partition", "transfer", "compile", "solve",
-               "writeback")
+               "ckpt", "writeback")
 
 
 @dataclasses.dataclass
@@ -109,6 +112,9 @@ class SolverStats:
     nbreakdowns: int = 0
     nrestarts: int = 0
     nfallbacks: int = 0
+    # survivability tier (acg_tpu.checkpoint): rollbacks to the last
+    # on-disk snapshot -- the recovery ladder's new first rung
+    nrollbacks: int = 0
     recovery_log: list = dataclasses.field(default_factory=list)
     # telemetry tier (acg_tpu.telemetry): timestamped resilience/fault
     # events for the structured sink, pipeline-phase seconds, and the
@@ -136,6 +142,10 @@ class SolverStats:
     # true-residual audit summary (gap/count/threshold) and the
     # post-hoc Lanczos spectrum estimate.  Appends strictly last
     health: dict = dataclasses.field(default_factory=dict)
+    # survivability tier (acg_tpu.checkpoint, stats schema /6): the
+    # armed snapshot configuration, snapshots written/resumed, and the
+    # last committed iteration.  Appends after health
+    ckpt: dict = dataclasses.field(default_factory=dict)
 
     def to_dict(self) -> dict:
         """Machine-readable twin of :meth:`fwrite` -- the ``stats`` key
@@ -171,6 +181,7 @@ class SolverStats:
                 "nbreakdowns": self.nbreakdowns,
                 "nrestarts": self.nrestarts,
                 "nfallbacks": self.nfallbacks,
+                "nrollbacks": self.nrollbacks,
                 "log": list(self.recovery_log),
             },
             "events": list(self.events),
@@ -180,6 +191,7 @@ class SolverStats:
             "soak": dict(self.soak),
             "precond": dict(self.precond),
             "health": dict(self.health),
+            "ckpt": dict(self.ckpt),
         }
         if self.trace is not None:
             d["trace"] = self.trace.to_dict()
@@ -234,9 +246,16 @@ class SolverStats:
         p(f"  floating-point exceptions: {fexcept_str(*self.fexcept_arrays)}")
         # resilience lines appear only when something happened, so the
         # report stays byte-identical to the reference's on clean solves
-        if self.nbreakdowns or self.nrestarts or self.nfallbacks:
+        if (self.nbreakdowns or self.nrestarts or self.nfallbacks
+                or self.nrollbacks):
+            # the rollback count appends only when rollbacks happened,
+            # so pre-survivability report consumers see the exact
+            # historical line
+            rb = (f", {self.nrollbacks} rollbacks" if self.nrollbacks
+                  else "")
             p(f"  resilience: {self.nbreakdowns} breakdowns detected, "
-              f"{self.nrestarts} restarts, {self.nfallbacks} fallbacks")
+              f"{self.nrestarts} restarts, {self.nfallbacks} fallbacks"
+              + rb)
             for ev in self.recovery_log:
                 p(f"    {ev}")
         # phase timings appear only when a phase timer ran (the CLI's
@@ -270,6 +289,9 @@ class SolverStats:
         if self.health:
             p("health:")
             _write_section(p, self.health, 1)
+        if self.ckpt:
+            p("ckpt:")
+            _write_section(p, self.ckpt, 1)
         text = out.getvalue()
         if f is not None:
             f.write(text)

@@ -73,7 +73,12 @@ from acg_tpu.solvers.stats import PHASE_ORDER
 # column; its presence is declared by the trace/meta "fields" list so
 # mixed audited/unaudited windows round-trip) -- additive, so /1../4
 # consumers keep working
-STATS_SCHEMA = "acg-tpu-stats/5"
+# /6: the survivability tier (acg_tpu.checkpoint) adds a "ckpt" key
+# inside the stats twin (armed snapshot configuration, snapshots
+# written, resume provenance), an "nrollbacks" counter inside
+# "resilience", and an "abft" sub-dict inside "health" (checksum-SpMV
+# verification summary) -- additive, so /1../5 consumers keep working
+STATS_SCHEMA = "acg-tpu-stats/6"
 CONVERGENCE_SCHEMA = "acg-tpu-convergence/1"
 # default ring capacity (--telemetry-window): 512 iterations x 4 scalars
 # is 8 KiB of f32 carry -- negligible against any solve's vectors, and
@@ -176,16 +181,22 @@ class ConvergenceTrace:
 
     @classmethod
     def from_ring(cls, buf, niterations: int, solver: str = "cg",
-                  already_norm: bool = False) -> "ConvergenceTrace":
+                  already_norm: bool = False,
+                  offset: int = 0) -> "ConvergenceTrace":
         """Un-rotate a fetched ring buffer: slot ``k % capacity`` holds
         iteration ``k``, so the surviving window is iterations
         ``[max(0, n - capacity), n)``.  The column names come from the
-        ring's width (4 = the classic tuple, 5 = + the audit column)."""
+        ring's width (4 = the classic tuple, 5 = + the audit column).
+        ``offset`` (the checkpoint chunk drivers) renumbers the window
+        to TRAJECTORY iterations: the ring held chunk-local indices,
+        and iterations before the chunk are marked truncated exactly
+        like a wrapped ring's."""
         buf = np.asarray(buf, dtype=np.float64)
         cap = int(buf.shape[0])
         fields = tuple(TRACE_FIELDS) + (
             (AUDIT_FIELD,) if buf.shape[1] > len(TRACE_FIELDS) else ())
         n = int(niterations)
+        off = int(offset)
         m = min(n, cap)
         its = np.arange(n - m, n, dtype=np.int64)
         rows = buf[its % cap] if m else buf[:0]
@@ -196,9 +207,9 @@ class ConvergenceTrace:
             # negative "norm" must stay visibly wrong, not become NaN
             g = rows[:, 0]
             rows[:, 0] = np.where(g >= 0, np.sqrt(np.abs(g)), g)
-        return cls(capacity=cap, niterations=n, records=rows,
-                   iterations=its, wrapped=n > cap, solver=solver,
-                   fields=fields)
+        return cls(capacity=cap, niterations=n + off, records=rows,
+                   iterations=its + off, wrapped=n > cap or off > 0,
+                   solver=solver, fields=fields)
 
     @property
     def first_iteration(self) -> int:

@@ -112,7 +112,11 @@ def check(path: str, require=()) -> list[str]:
                     f"{path}: {base}{list(key)}: +Inf bucket "
                     f"{rows[-1][1]} != _count {counts[base][key]}")
     for name in require:
-        if name not in seen:
+        # exact series name, or a family prefix (trailing '_'):
+        # --require acg_ckpt_ asserts the whole family exposed
+        if name not in seen and not (
+                name.endswith("_")
+                and any(s.startswith(name) for s in seen)):
             problems.append(f"{path}: required series {name!r} absent")
     return problems
 

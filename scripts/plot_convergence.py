@@ -137,10 +137,20 @@ def _load_stats_json(path):
     if samples:
         cum = [((math.inf if ub is None else float(ub)), int(c))
                for ub, c in samples[0].get("buckets", [])]
-    return soak, cum, health
+    # survivability evidence (/6): rollback / resume / restart events
+    # with their iteration numbers, for the residual-trail markers
+    events = []
+    for ev in (doc.get("stats") or {}).get("events") or []:
+        kind = ev.get("kind")
+        if kind not in ("rollback", "resume", "restart", "breakdown"):
+            continue
+        m = re.search(r"iteration (\d+)", str(ev.get("detail", "")))
+        if m:
+            events.append((kind, int(m.group(1))))
+    return soak, cum, health, events
 
 
-def _latency_summary(label, soak, cum, health=None):
+def _latency_summary(label, soak, cum, health=None, events=None):
     """One record the renderers share: percentiles (soak report first,
     histogram-derived otherwise) + the occupied bucket histogram + the
     /5 health annotation (audit gap, kappa estimate, predicted-vs-
@@ -158,7 +168,8 @@ def _latency_summary(label, soak, cum, health=None):
     return {"label": label, "pcts": pcts, "cum": cum,
             "nsolves": soak.get("nsolves"),
             "drift": soak.get("drift") or {},
-            "health": health or {}}
+            "health": health or {},
+            "events": events or []}
 
 
 def _health_note(health) -> str | None:
@@ -273,13 +284,14 @@ def _classify(path):
     document carrying only a ``health`` section still classifies (the
     kappa annotation is its evidence)."""
     try:
-        soak, cum, health = _load_stats_json(path)
-        if soak or cum or health:
+        soak, cum, health, events = _load_stats_json(path)
+        if soak or cum or health or events:
             return ("latency",
                     _latency_summary(os.path.basename(path), soak, cum,
-                                     health))
-        raise ValueError("stats document without latency or health "
-                         "evidence (no soak/metrics/health section)")
+                                     health, events))
+        raise ValueError("stats document without latency, health or "
+                         "survivability evidence (no soak/metrics/"
+                         "health/events section)")
     except ValueError:
         pass
     try:
@@ -354,6 +366,12 @@ def main(argv=None) -> int:
         for rec in latency:
             for line in _latency_text(rec):
                 print(line)
+            evs = rec.get("events") or []
+            if evs:
+                # survivability evidence (/6): where the solve rolled
+                # back / resumed / restarted
+                print("  events: "
+                      + ", ".join(f"{k}@{i}" for k, i in evs))
         return 0
 
     ncols = (1 if not latency else 2) if conv else 1
@@ -382,6 +400,23 @@ def main(argv=None) -> int:
             ax.plot(bad, [ax.get_ylim()[0]] * len(bad), "rx",
                     markersize=8, label=f"{label}: non-finite")
     if conv:
+        # rollback/resume/restart markers from a /6 stats document
+        # given alongside the log (the gap-overlay pattern): vertical
+        # guides at the event iterations on the residual trail, so a
+        # recovered solve shows WHERE it rolled back / resumed
+        ev_style = {"rollback": ("tab:red", ":"),
+                    "resume": ("tab:green", "--"),
+                    "restart": ("tab:orange", ":"),
+                    "breakdown": ("tab:red", "-.")}
+        seen_kinds = set()
+        for rec in latency:
+            for kind, it in rec.get("events", []):
+                c, ls = ev_style[kind]
+                ax.axvline(it, color=c, linestyle=ls, alpha=0.7,
+                           linewidth=1.1,
+                           label=(kind if kind not in seen_kinds
+                                  else None))
+                seen_kinds.add(kind)
         ax.set_xlabel("iteration")
         ax.set_ylabel("residual 2-norm / audit gap")
         ax.grid(True, which="both", alpha=0.3)

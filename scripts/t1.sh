@@ -7,11 +7,13 @@
 # T1_SOAK=1 additionally runs the service-soak smoke after the tests: a
 # tiny 3-solve --soak run whose --metrics-file must validate as
 # Prometheus exposition format and whose --stats-json must carry the
-# acg-tpu-stats/5 soak section (the CI soak-smoke step runs the same
+# acg-tpu-stats/6 soak section (the CI soak-smoke step runs the same
 # thing).  T1_HEALTH=1 runs the numerical-health smoke: an audited
 # pipelined solve on the anisotropic generator must leave a health:
 # section with a finite gap, the acg_health_* metric families, and a
-# Lanczos kappa estimate.
+# Lanczos kappa estimate.  T1_CKPT=1 runs the crash/resume smoke: a
+# soak solve is killed mid-flight by crash:exit@K, relaunched with
+# --resume, and must converge with the acg_ckpt_* families exposed.
 set -o pipefail
 cd "$(dirname "$0")/.."
 rm -f /tmp/_t1.log
@@ -34,7 +36,7 @@ if [ "${T1_SOAK:-0}" = "1" ]; then
     python - <<'PY' || rc=$((rc ? rc : 1))
 import json
 doc = json.load(open("/tmp/_t1_soak.json"))
-assert doc["schema"] == "acg-tpu-stats/5", doc["schema"]
+assert doc["schema"] == "acg-tpu-stats/6", doc["schema"]
 soak = doc["stats"]["soak"]
 assert soak["nsolves"] == 3 and soak["latency"]["p50"] is not None, soak
 assert "metrics" in doc, "registry snapshot missing from /3 document"
@@ -56,7 +58,7 @@ if [ "${T1_PRECOND:-0}" = "1" ]; then
         env PC="$pc" python - <<'PY' || rc=$((rc ? rc : 1))
 import json, os
 doc = json.load(open("/tmp/_t1_precond.json"))
-assert doc["schema"] == "acg-tpu-stats/5", doc["schema"]
+assert doc["schema"] == "acg-tpu-stats/6", doc["schema"]
 st = doc["stats"]
 assert st["converged"] is True, st["rnrm2"]
 assert st["precond"]["kind"] == os.environ["PC"], st["precond"]
@@ -92,13 +94,52 @@ if [ "${T1_HEALTH:-0}" = "1" ]; then
     python - <<'PY' || rc=$((rc ? rc : 1))
 import json, math
 doc = json.load(open("/tmp/_t1_health.json"))
-assert doc["schema"] == "acg-tpu-stats/5", doc["schema"]
+assert doc["schema"] == "acg-tpu-stats/6", doc["schema"]
 h = doc["stats"]["health"]
 assert h["naudits"] > 0, h
 assert h["gap_last"] is not None and math.isfinite(h["gap_last"]), h
 assert h["spectrum"]["kappa"] > 1, h["spectrum"]
 print(f"T1_HEALTH: OK (gap {h['gap_last']:.3e}, "
       f"kappa {h['spectrum']['kappa']:.4g})")
+PY
+fi
+if [ "${T1_CKPT:-0}" = "1" ]; then
+    # crash/resume smoke (the PR-7 acceptance in miniature): a
+    # checkpointed solve is hard-killed mid-flight by the crash:exit@K
+    # fault (exit 94), relaunched with --resume from the committed
+    # snapshot, and must reach the original tolerance; the metrics
+    # textfile must expose the acg_ckpt_* family and the /6 stats
+    # document the ckpt section with resume provenance
+    echo "T1_CKPT: crash/resume smoke"
+    rm -f /tmp/_t1_ckpt /tmp/_t1_ckpt.json /tmp/_t1_ckpt.prom
+    timeout -k 10 300 env JAX_PLATFORMS=cpu python -m acg_tpu.cli \
+        gen:poisson2d:24 --manufactured-solution --dtype f32 \
+        --comm none --max-iterations 500 --residual-rtol 1e-5 \
+        --warmup 0 --quiet --ckpt /tmp/_t1_ckpt --ckpt-every 8 \
+        --fault-inject crash:exit@20
+    crash_rc=$?
+    if [ "$crash_rc" != "94" ]; then
+        echo "T1_CKPT: expected crash exit 94, got $crash_rc"
+        rc=$((rc ? rc : 1))
+    fi
+    timeout -k 10 300 env JAX_PLATFORMS=cpu python -m acg_tpu.cli \
+        gen:poisson2d:24 --manufactured-solution --dtype f32 \
+        --comm none --max-iterations 500 --residual-rtol 1e-5 \
+        --warmup 0 --quiet --resume /tmp/_t1_ckpt \
+        --metrics-file /tmp/_t1_ckpt.prom \
+        --stats-json /tmp/_t1_ckpt.json || rc=$((rc ? rc : 1))
+    python scripts/check_metrics_textfile.py /tmp/_t1_ckpt.prom \
+        --require acg_ckpt_ || rc=$((rc ? rc : 1))
+    python - <<'PY' || rc=$((rc ? rc : 1))
+import json
+doc = json.load(open("/tmp/_t1_ckpt.json"))
+assert doc["schema"] == "acg-tpu-stats/6", doc["schema"]
+st = doc["stats"]
+assert st["converged"] is True, st["rnrm2"]
+ck = st["ckpt"]
+assert ck.get("resumed_from", 0) > 0, ck
+print(f"T1_CKPT: OK (resumed at {ck['resumed_from']}, "
+      f"+{st['niterations']} iterations to tolerance)")
 PY
 fi
 exit $rc
