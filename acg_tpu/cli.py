@@ -432,7 +432,23 @@ def make_parser() -> argparse.ArgumentParser:
                         "ACG_ENABLE_PROFILING tier")
     p.add_argument("--trace", metavar="DIR", default=None,
                    help="write a jax.profiler trace of the solve to DIR "
-                        "(the reference's nsys-trace tier; view with xprof)")
+                        "(the reference's nsys-trace tier; view with "
+                        "xprof).  The capture is also ANALYZED after the "
+                        "solve: measured per-op-class device seconds, "
+                        "overlap efficiency and straggler attribution "
+                        "land in the 'tracing:' stats section, and "
+                        "measured seconds replace the --profile-ops "
+                        "replay estimates where the capture resolves an "
+                        "op class")
+    p.add_argument("--timeline", metavar="FILE", default=None,
+                   help="write a cross-rank span timeline of this run "
+                        "as Chrome trace-event JSON (one pid per part; "
+                        "load in Perfetto / chrome://tracing).  Spans "
+                        "come from the pipeline phases, checkpoint "
+                        "chunk boundaries and telemetry events; "
+                        "multi-controller runs gather spans over the "
+                        "erragree KV plumbing with barrier-timestamp "
+                        "clock alignment")
     p.add_argument("-q", "--quiet", action="store_true",
                    help="do not write the solution vector to stdout")
     p.add_argument("-o", "--output", metavar="FILE", default=None,
@@ -491,6 +507,16 @@ def _buildinfo(out) -> int:
         ("profiling", "--profile-ops (per-op replay, chain_overhead "
          "correction term), --trace "
          "(jax.profiler Perfetto, acg:* phase annotations)"),
+        ("timeline tracing", f"--timeline FILE (cross-rank span "
+         f"timeline as Chrome trace-event JSON, one pid per part, "
+         f"barrier-timestamp clock alignment; "
+         f"scripts/check_timeline.py validates, "
+         f"scripts/trace_report.py summarises), --trace capture "
+         f"analysis (measured per-op-class seconds, "
+         f"overlap-efficiency score, straggler attribution; feeds the "
+         f"--explain measured-vs-predicted comm verdict and replaces "
+         f"--profile-ops replay estimates); 'tracing' section + "
+         f"acg_trace_* metrics; schema {STATS_SCHEMA}"),
         ("perf observability", f"--explain (compiled cost_analysis/"
          f"memory_analysis introspection, comm ledger, roofline "
          f"verdict); 'costmodel'/'memory' keys in the {STATS_SCHEMA} "
@@ -693,30 +719,31 @@ def _solve_generated_direct(args, dim, n, N, jax, jnp, dtype,
         residual_atol=args.residual_atol, residual_rtol=args.residual_rtol,
         diff_atol=args.diff_atol, diff_rtol=args.diff_rtol)
     t0 = time.perf_counter()
-    if args.trace:
-        jax.profiler.start_trace(args.trace)
-    try:
-        x = _run_solve(args, solver, b, criteria=criteria,
-                       warmup=args.warmup,
-                       host_result=bool(not args.quiet or args.output))
-    except (NotConvergedError, BreakdownError) as e:
-        sys.stderr.write(f"acg-tpu: {e}\n")
-        _fold_phases(args, solver)
-        solver.stats.fwrite(sys.stderr)
-        _emit_telemetry(args, solver, matrix_id=args.A, collective=False)
-        return 1
-    except AcgError as e:
-        sys.stderr.write(f"acg-tpu: {e}\n")
-        return 1
-    finally:
-        if args.trace:
-            jax.profiler.stop_trace()
+    from acg_tpu.tracing import profiler_trace
+    with profiler_trace(args.trace):
+        try:
+            x = _run_solve(args, solver, b, criteria=criteria,
+                           warmup=args.warmup,
+                           host_result=bool(not args.quiet or args.output))
+        except (NotConvergedError, BreakdownError) as e:
+            sys.stderr.write(f"acg-tpu: {e}\n")
+            _fold_phases(args, solver)
+            solver.stats.fwrite(sys.stderr)
+            _emit_telemetry(args, solver, matrix_id=args.A,
+                            collective=False)
+            return 1
+        except AcgError as e:
+            sys.stderr.write(f"acg-tpu: {e}\n")
+            return 1
     _log(args, "solve:", t0)
 
     if args.profile_ops is not None:
         from acg_tpu.solvers.profile import profile_ops
         per_call = profile_ops(solver, b, reps=max(args.profile_ops, 1))
         _report_chain_overhead(per_call)
+    # AFTER the replay tier: where the capture measured an op class,
+    # the measured seconds supersede the replay estimate
+    _attach_trace_analysis(args, solver)
     _fold_phases(args, solver)
     solver.stats.fwrite(sys.stderr)
     t_wb = time.perf_counter()
@@ -847,6 +874,84 @@ def _fold_phases(args, solver) -> None:
     timer.merge_into(st.timings)
 
 
+def _attach_trace_analysis(args, solver) -> None:
+    """After the profiler stopped: parse the ``--trace`` capture into
+    the ``tracing:`` stats section (measured per-op-class seconds,
+    overlap efficiency, straggler attribution), replacing the
+    --profile-ops replay estimates where the capture resolved an op
+    class.  Analysis failures degrade to a self-describing section --
+    a solve that succeeded must never die for its observability."""
+    if not args.trace or solver is None:
+        return
+    from acg_tpu import tracing
+
+    an = tracing.analyze_trace(args.trace)
+    # the PRINTED stats (under --refine: the wrapper's block) carry the
+    # section, same target _emit_telemetry writes to --stats-json
+    tracing.attach(solver.stats, an)
+    if not an.get("available"):
+        sys.stderr.write(f"acg-tpu: --trace: capture analysis "
+                         f"unavailable ({an.get('why', '?')})\n")
+
+
+def _timeline_parts(solver, nparts: int) -> list[int]:
+    """The part ids this controller's spans describe: the distributed
+    problem's owned parts where one exists, else every part (single
+    controller -- the SPMD program runs them in lockstep)."""
+    inner = _inner_solver(solver)
+    prob = getattr(inner, "problem", None)
+    owned = getattr(prob, "owned_parts", None) if prob is not None else None
+    if owned is not None:
+        return [int(p) for p in owned]
+    n = max(int(nparts), 1)
+    import jax
+
+    if jax.process_count() > 1:
+        # sharded/multihost tiers without an explicit owned_parts list
+        # shard parts contiguously across controllers (the mesh builds
+        # process-major); the even split mirrors that layout
+        pc, pi = jax.process_count(), jax.process_index()
+        per = max(n // pc, 1)
+        lo = min(pi * per, n)
+        hi = n if pi == pc - 1 else min(lo + per, n)
+        return list(range(lo, hi))
+    return list(range(n))
+
+
+def _emit_timeline(args, solver, nparts=1, collective=True) -> None:
+    """Gather every controller's spans (clock-aligned) and write the
+    Chrome trace-event timeline -- primary writes, everyone gathers
+    (the _emit_telemetry collectivity contract)."""
+    if not getattr(args, "timeline", None) \
+            or getattr(args, "_timeline_written", False):
+        return
+    from acg_tpu import tracing
+    from acg_tpu.parallel.multihost import is_primary
+
+    payloads, clock = tracing.gather_timeline(
+        parts=_timeline_parts(solver, nparts),
+        timeout=args.err_timeout, collective=collective)
+    # the once-only flag is set on EVERY rank right after the gather:
+    # were it primary-only, a second _emit_telemetry call would skip
+    # the collective on the primary while the peers enter the barrier
+    # -- a mismatched collective
+    args._timeline_written = True
+    if not is_primary():
+        return
+    try:
+        summary = tracing.export_chrome_trace(
+            args.timeline, payloads, nparts=max(int(nparts), 1),
+            clock=clock)
+    except OSError as e:
+        sys.stderr.write(f"acg-tpu: --timeline {args.timeline}: {e}\n")
+        return
+    tracing.attach(solver.stats, None, timeline=summary)
+    sys.stderr.write(f"acg-tpu: timeline: {summary['nspans']} spans "
+                     f"over {summary['nparts']} part(s) from "
+                     f"{summary['nranks']} rank(s) -> "
+                     f"{args.timeline}\n")
+
+
 def _emit_telemetry(args, solver, *, matrix_id, nparts=1,
                     comm=None, collective=True) -> None:
     """The telemetry sinks: --convergence-log JSONL, the cross-rank
@@ -856,12 +961,16 @@ def _emit_telemetry(args, solver, *, matrix_id, nparts=1,
     primary-only.  Error paths pass ``collective=False``: a possibly
     one-sided failure must not enter a gather its peers may never
     reach (the erragree mismatched-collective rationale)."""
-    if not (args.convergence_log or args.stats_json):
+    if not (args.convergence_log or args.stats_json
+            or getattr(args, "timeline", None)):
         return
     from acg_tpu import telemetry
     from acg_tpu.parallel.multihost import is_primary
 
     _fold_phases(args, solver)
+    # the span timeline rides the same call points (success AND error
+    # paths) so its gather keeps the collectivity contract below
+    _emit_timeline(args, solver, nparts=nparts, collective=collective)
     inner = _inner_solver(solver)
     st = solver.stats
     trace = st.trace if st.trace is not None else inner.stats.trace
@@ -1148,39 +1257,37 @@ def _solve_distributed_read(args, jax, jnp, dtype, vec_dtype) -> int:
                                inner_rtol=args.refine_rtol,
                                inner_maxits=args.refine_inner_maxits)
     t0 = time.perf_counter()
-    if args.trace:
-        jax.profiler.start_trace(args.trace)
-    try:
-        if args.refine:
-            # refined solutions come back as host f64 (the outer
-            # iteration lives there); the distributed write then
-            # range-writes host windows instead of device shards
-            x = solver.solve(b, x0=x0, criteria=criteria,
-                             warmup=args.warmup)
-        else:
-            x = solver.solve(b, x0=x0, criteria=criteria,
-                             warmup=args.warmup,
-                             host_result=not args.output)
-    except (NotConvergedError, BreakdownError) as e:
-        # the stats block carries the resilience event log -- most
-        # needed exactly when recovery failed
-        sys.stderr.write(f"acg-tpu: {e}\n")
-        _fold_phases(args, solver)
-        if is_primary():
-            solver.stats.fwrite(sys.stderr)
-        _emit_telemetry(args, solver, matrix_id=args.A, nparts=nparts,
-                        collective=False)
-        _stage_sync(args, "solve", 1)
-        return 1
-    except AcgError as e:
-        # solve-time configuration refusals (e.g. replace_every + an
-        # armed fault injector) carry typed AcgErrors
-        sys.stderr.write(f"acg-tpu: {e}\n")
-        _stage_sync(args, "solve", 1)
-        return 1
-    finally:
-        if args.trace:
-            jax.profiler.stop_trace()
+    from acg_tpu.tracing import profiler_trace
+    with profiler_trace(args.trace):
+        try:
+            if args.refine:
+                # refined solutions come back as host f64 (the outer
+                # iteration lives there); the distributed write then
+                # range-writes host windows instead of device shards
+                x = solver.solve(b, x0=x0, criteria=criteria,
+                                 warmup=args.warmup)
+            else:
+                x = solver.solve(b, x0=x0, criteria=criteria,
+                                 warmup=args.warmup,
+                                 host_result=not args.output)
+        except (NotConvergedError, BreakdownError) as e:
+            # the stats block carries the resilience event log -- most
+            # needed exactly when recovery failed
+            sys.stderr.write(f"acg-tpu: {e}\n")
+            _fold_phases(args, solver)
+            if is_primary():
+                solver.stats.fwrite(sys.stderr)
+            _emit_telemetry(args, solver, matrix_id=args.A,
+                            nparts=nparts, collective=False)
+            _stage_sync(args, "solve", 1)
+            return 1
+        except AcgError as e:
+            # solve-time configuration refusals (e.g. replace_every + an
+            # armed fault injector) carry typed AcgErrors
+            sys.stderr.write(f"acg-tpu: {e}\n")
+            _stage_sync(args, "solve", 1)
+            return 1
+    _attach_trace_analysis(args, solver)
     _log(args, "solve:", t0)
     rc = _stage_sync(args, "solve", 0)
     if rc:
@@ -1591,40 +1698,39 @@ def _solve_generated_sharded(args, dim, n, N, jax, jnp, dtype,
         residual_atol=args.residual_atol, residual_rtol=args.residual_rtol,
         diff_atol=args.diff_atol, diff_rtol=args.diff_rtol)
     t0 = time.perf_counter()
-    if args.trace:
-        jax.profiler.start_trace(args.trace)
-    try:
-        # device-resident result: the gather to host happens only when
-        # the solution is actually written
-        if args.refine:
-            xh, xl = solver.solve_refined(
-                b, criteria=criteria, inner_rtol=args.refine_rtol,
-                inner_maxits=args.refine_inner_maxits, warmup=args.warmup)
-            x = xh
-        else:
-            x = _run_solve(args, solver, b, criteria=criteria,
-                           warmup=args.warmup, host_result=False)
-            xl = None
-    except (NotConvergedError, BreakdownError) as e:
-        # the stats block carries the resilience event log -- most
-        # needed exactly when recovery failed
-        sys.stderr.write(f"acg-tpu: {e}\n")
-        _fold_phases(args, solver)
-        if is_primary():
-            solver.stats.fwrite(sys.stderr)
-        _emit_telemetry(args, solver, matrix_id=args.A, nparts=nparts,
-                        collective=False)
-        _stage_sync(args, "solve", 1)
-        return 1
-    except AcgError as e:
-        # solve-time configuration refusals (e.g. replace_every + an
-        # armed fault injector) carry typed AcgErrors
-        sys.stderr.write(f"acg-tpu: {e}\n")
-        _stage_sync(args, "solve", 1)
-        return 1
-    finally:
-        if args.trace:
-            jax.profiler.stop_trace()
+    from acg_tpu.tracing import profiler_trace
+    with profiler_trace(args.trace):
+        try:
+            # device-resident result: the gather to host happens only
+            # when the solution is actually written
+            if args.refine:
+                xh, xl = solver.solve_refined(
+                    b, criteria=criteria, inner_rtol=args.refine_rtol,
+                    inner_maxits=args.refine_inner_maxits,
+                    warmup=args.warmup)
+                x = xh
+            else:
+                x = _run_solve(args, solver, b, criteria=criteria,
+                               warmup=args.warmup, host_result=False)
+                xl = None
+        except (NotConvergedError, BreakdownError) as e:
+            # the stats block carries the resilience event log -- most
+            # needed exactly when recovery failed
+            sys.stderr.write(f"acg-tpu: {e}\n")
+            _fold_phases(args, solver)
+            if is_primary():
+                solver.stats.fwrite(sys.stderr)
+            _emit_telemetry(args, solver, matrix_id=args.A,
+                            nparts=nparts, collective=False)
+            _stage_sync(args, "solve", 1)
+            return 1
+        except AcgError as e:
+            # solve-time configuration refusals (e.g. replace_every + an
+            # armed fault injector) carry typed AcgErrors
+            sys.stderr.write(f"acg-tpu: {e}\n")
+            _stage_sync(args, "solve", 1)
+            return 1
+    _attach_trace_analysis(args, solver)
     _log(args, "solve:", t0)
     rc = _stage_sync(args, "solve", 0)
     if rc:
@@ -1717,6 +1823,12 @@ def main(argv=None) -> int:
                 sys.stderr.write(
                     f"acg-tpu: --metrics-file {args.metrics_file}: "
                     f"{e}\n")
+        if args.timeline:
+            # the span recorder is process-wide, scoped to THIS
+            # invocation (the faults-install discipline): disarm AND
+            # clear so in-process callers never leak spans across runs
+            from acg_tpu import tracing
+            tracing.disarm()
         if args.fault_inject:
             # _main exports the spec (env var = how children inherit it)
             # and installs it process-wide; both are scoped to THIS
@@ -1739,6 +1851,13 @@ def _main(args) -> int:
     # block's timings: section), and the in-loop trace/progress knobs
     from acg_tpu.telemetry import PhaseTimer
     args._phases = PhaseTimer()
+    # timeline tier (acg_tpu.tracing): arm the span recorder BEFORE the
+    # first phase runs so ingest/partition land on the timeline; scoped
+    # to this invocation (main() disarms in its finally)
+    if args.timeline:
+        from acg_tpu import tracing
+        tracing.arm()
+        args._timeline_written = False
     if args.explain:
         # refuse incompatible modes BEFORE anything expensive or
         # blocking runs: multihost init would block waiting for peers,
@@ -1769,6 +1888,9 @@ def _main(args) -> int:
             ("--audit-every (--explain computes its own convergence "
              "verdict from the host oracle)", args.audit_every > 0),
             ("--stall-window", args.stall_window > 0),
+            ("--timeline (the analysis solves are not the pipeline "
+             "the timeline describes; --trace works and feeds the "
+             "measured verdict)", args.timeline is not None),
         ] if on]
         if ignored:
             raise SystemExit(
@@ -2292,160 +2414,157 @@ def _main(args) -> int:
         stage_sync("solve", 1)
         return 1
     comm_mtx_out = None
-    if args.trace:
-        jax.profiler.start_trace(args.trace)
-    try:
-        if args.solver == "host-native":
-            from acg_tpu.solvers.host_cg import NativeHostCGSolver
-            try:
-                solver = NativeHostCGSolver(csr)
-            except RuntimeError as e:
-                sys.stderr.write(f"acg-tpu: {e}\n")
-                return 1
-            x = _run_solve(args, solver, b, x0=x0, criteria=criteria)
-        elif args.solver == "host":
-            if nparts > 1 and comm != "none":
-                # the acgsolver_solvempi analog (cg.c:408): same
-                # partitioned layout as the device path, pure host
-                from acg_tpu import faults
-                from acg_tpu.errors import ErrorCode
-                from acg_tpu.graph import partition_matrix as _pm
-                from acg_tpu.solvers.host_cg import HostDistCGSolver
-                if faults.device_fault() is not None:
-                    # the distributed host oracle has no injection
-                    # sites either: refuse (replace_every rationale)
-                    raise AcgError(
-                        ErrorCode.INVALID_VALUE,
-                        "fault injection has no injection sites in the "
-                        "multi-part host solver; use the serial host "
-                        "solver (--nparts 1) or the device solvers")
-                if args._precond is not None:
-                    # silently running UNpreconditioned CG would not be
-                    # the solve the user asked for (the fault-injector
-                    # could-never-fire discipline): refuse
-                    raise AcgError(
-                        ErrorCode.INVALID_VALUE,
-                        "--precond has no hooks in the multi-part host "
-                        "solver; use --nparts 1 or the device solvers")
-                if args._health is not None:
-                    # an armed audit that could never run (same rule)
-                    raise AcgError(
-                        ErrorCode.INVALID_VALUE,
-                        "--audit-every/--stall-window have no hooks in "
-                        "the multi-part host solver; use --nparts 1 or "
-                        "the device solvers")
-                if args._ckpt is not None:
-                    # armed snapshots that would never be written
-                    raise AcgError(
-                        ErrorCode.INVALID_VALUE,
-                        "--ckpt/--resume have no hooks in the "
-                        "multi-part host solver; use --nparts 1 or "
-                        "the device solvers")
-                if args._recovery is not None:
-                    sys.stderr.write(
-                        "acg-tpu: warning: --recover has no effect on "
-                        "the multi-part host solver (no breakdown "
-                        "detection there)\n")
-                if args._trace or args.progress:
-                    sys.stderr.write(
-                        "acg-tpu: warning: --convergence-log/--progress "
-                        "have no hooks in the multi-part host solver; "
-                        "use --nparts 1 or the device solvers\n")
-                solver = HostDistCGSolver(_pm(csr, part, nparts))
+    from acg_tpu.tracing import profiler_trace
+    with profiler_trace(args.trace):
+        try:
+            if args.solver == "host-native":
+                from acg_tpu.solvers.host_cg import NativeHostCGSolver
+                try:
+                    solver = NativeHostCGSolver(csr)
+                except RuntimeError as e:
+                    sys.stderr.write(f"acg-tpu: {e}\n")
+                    return 1
+                x = _run_solve(args, solver, b, x0=x0, criteria=criteria)
+            elif args.solver == "host":
+                if nparts > 1 and comm != "none":
+                    # the acgsolver_solvempi analog (cg.c:408): same
+                    # partitioned layout as the device path, pure host
+                    from acg_tpu import faults
+                    from acg_tpu.errors import ErrorCode
+                    from acg_tpu.graph import partition_matrix as _pm
+                    from acg_tpu.solvers.host_cg import HostDistCGSolver
+                    if faults.device_fault() is not None:
+                        # the distributed host oracle has no injection
+                        # sites either: refuse (replace_every rationale)
+                        raise AcgError(
+                            ErrorCode.INVALID_VALUE,
+                            "fault injection has no injection sites in the "
+                            "multi-part host solver; use the serial host "
+                            "solver (--nparts 1) or the device solvers")
+                    if args._precond is not None:
+                        # silently running UNpreconditioned CG would not be
+                        # the solve the user asked for (the fault-injector
+                        # could-never-fire discipline): refuse
+                        raise AcgError(
+                            ErrorCode.INVALID_VALUE,
+                            "--precond has no hooks in the multi-part host "
+                            "solver; use --nparts 1 or the device solvers")
+                    if args._health is not None:
+                        # an armed audit that could never run (same rule)
+                        raise AcgError(
+                            ErrorCode.INVALID_VALUE,
+                            "--audit-every/--stall-window have no hooks in "
+                            "the multi-part host solver; use --nparts 1 or "
+                            "the device solvers")
+                    if args._ckpt is not None:
+                        # armed snapshots that would never be written
+                        raise AcgError(
+                            ErrorCode.INVALID_VALUE,
+                            "--ckpt/--resume have no hooks in the "
+                            "multi-part host solver; use --nparts 1 or "
+                            "the device solvers")
+                    if args._recovery is not None:
+                        sys.stderr.write(
+                            "acg-tpu: warning: --recover has no effect on "
+                            "the multi-part host solver (no breakdown "
+                            "detection there)\n")
+                    if args._trace or args.progress:
+                        sys.stderr.write(
+                            "acg-tpu: warning: --convergence-log/--progress "
+                            "have no hooks in the multi-part host solver; "
+                            "use --nparts 1 or the device solvers\n")
+                    solver = HostDistCGSolver(_pm(csr, part, nparts))
+                else:
+                    solver = HostCGSolver(csr, recovery=args._recovery,
+                                          trace=args._trace,
+                                          progress=args.progress,
+                                          precond=args._precond,
+                                          health=args._health,
+                                          ckpt=args._ckpt)
+                x = _run_solve(args, solver, b, x0=x0, criteria=criteria)
+            elif args.solver == "petsc":
+                # external cross-implementation oracle (the KSPCG role,
+                # cgpetsc.c:181) backed by scipy.sparse.linalg.cg
+                from acg_tpu.solvers.petsc_cg import PetscBaselineSolver
+                solver = PetscBaselineSolver(csr, pipelined=pipelined)
+                x = _run_solve(args, solver, b, x0=x0, criteria=criteria)
+            elif comm == "none" or nparts == 1:
+                dev = device_matrix_from_csr(csr, dtype=dtype,
+                                             format=args.spmv_format)
+                try:
+                    solver = JaxCGSolver(dev, pipelined=pipelined,
+                                         precise_dots=args.precise_dots,
+                                         kernels=args.kernels,
+                                         vector_dtype=vec_dtype,
+                                         replace_every=args.replace_every,
+                                         recovery=args._recovery,
+                                         host_matrix=csr,
+                                         trace=args._trace,
+                                         progress=args.progress,
+                                         precond=args._precond,
+                                         health=args._health,
+                                         ckpt=args._ckpt)
+                except ValueError as e:
+                    raise SystemExit(f"acg-tpu: {e}")
+                if args.refine:
+                    solver = RefinedSolver(solver, csr,
+                                           inner_rtol=args.refine_rtol)
+                x = _run_solve(args, solver, b, x0=x0, criteria=criteria,
+                               warmup=args.warmup)
             else:
-                solver = HostCGSolver(csr, recovery=args._recovery,
-                                      trace=args._trace,
-                                      progress=args.progress,
-                                      precond=args._precond,
-                                      health=args._health,
-                                      ckpt=args._ckpt)
-            x = _run_solve(args, solver, b, x0=x0, criteria=criteria)
-        elif args.solver == "petsc":
-            # external cross-implementation oracle (the KSPCG role,
-            # cgpetsc.c:181) backed by scipy.sparse.linalg.cg
-            from acg_tpu.solvers.petsc_cg import PetscBaselineSolver
-            solver = PetscBaselineSolver(csr, pipelined=pipelined)
-            x = _run_solve(args, solver, b, x0=x0, criteria=criteria)
-        elif comm == "none" or nparts == 1:
-            dev = device_matrix_from_csr(csr, dtype=dtype,
-                                         format=args.spmv_format)
-            try:
-                solver = JaxCGSolver(dev, pipelined=pipelined,
-                                     precise_dots=args.precise_dots,
-                                     kernels=args.kernels,
-                                     vector_dtype=vec_dtype,
-                                     replace_every=args.replace_every,
-                                     recovery=args._recovery,
-                                     host_matrix=csr,
-                                     trace=args._trace,
-                                     progress=args.progress,
-                                     precond=args._precond,
-                                     health=args._health,
-                                     ckpt=args._ckpt)
-            except ValueError as e:
-                raise SystemExit(f"acg-tpu: {e}")
-            if args.refine:
-                solver = RefinedSolver(solver, csr,
-                                       inner_rtol=args.refine_rtol)
-            x = _run_solve(args, solver, b, x0=x0, criteria=criteria,
-                           warmup=args.warmup)
-        else:
-            from acg_tpu.parallel.mesh import solve_mesh
-            mesh = solve_mesh(nparts)
-            # multi-controller: each process assembles matrix blocks and
-            # host arrays ONLY for the parts its mesh devices own --
-            # per-controller preprocessing memory is O(N/P), the role of
-            # the reference's root-read + subgraph scatter
-            # (graph.c:1529-1897) without the scatter
-            owned = None
-            if jax.process_count() > 1:
-                pi = jax.process_index()
-                owned = tuple(p for p in range(nparts)
-                              if mesh.devices.flat[p].process_index == pi)
-            subs = partition_matrix(csr, part, nparts, owned_parts=owned)
-            if args.output_comm_matrix:
-                comm_mtx_out = comm_matrix(subs, nparts)
-            prob = DistributedProblem.build(csr, part, nparts, dtype=dtype,
-                                            subs=subs,
-                                            vector_dtype=vec_dtype,
-                                            owned_parts=owned)
-            try:
-                solver = DistCGSolver(prob, pipelined=pipelined, comm=comm,
-                                      precise_dots=args.precise_dots,
-                                      kernels=args.kernels, mesh=mesh,
-                                      replace_every=args.replace_every,
-                                      recovery=args._recovery,
-                                      trace=args._trace,
-                                      progress=args.progress,
-                                      precond=args._precond,
-                                      health=args._health,
-                                      ckpt=args._ckpt)
-            except ValueError as e:
-                raise SystemExit(f"acg-tpu: {e}")
-            if args.refine:
-                solver = RefinedSolver(solver, csr,
-                                       inner_rtol=args.refine_rtol)
-            x = _run_solve(args, solver, b, x0=x0, criteria=criteria,
-                           warmup=args.warmup)
-    except (NotConvergedError, BreakdownError) as e:
-        sys.stderr.write(f"acg-tpu: {e}\n")
-        _fold_phases(args, solver)
-        if is_primary():  # stats block from "rank 0" only
-            solver.stats.fwrite(sys.stderr)
-        # the convergence log is most needed exactly when the solve
-        # failed: the trailing window shows the trajectory into the
-        # divergence/breakdown (no collective gather on this path)
-        _emit_telemetry(args, solver, matrix_id=args.A, nparts=nparts,
-                        comm=comm, collective=False)
-        stage_sync("solve", 1)
-        return 1
-    except AcgError as e:
-        sys.stderr.write(f"acg-tpu: {e}\n")
-        stage_sync("solve", 1)
-        return 1
-    finally:
-        if args.trace:
-            jax.profiler.stop_trace()
+                from acg_tpu.parallel.mesh import solve_mesh
+                mesh = solve_mesh(nparts)
+                # multi-controller: each process assembles matrix blocks and
+                # host arrays ONLY for the parts its mesh devices own --
+                # per-controller preprocessing memory is O(N/P), the role of
+                # the reference's root-read + subgraph scatter
+                # (graph.c:1529-1897) without the scatter
+                owned = None
+                if jax.process_count() > 1:
+                    pi = jax.process_index()
+                    owned = tuple(p for p in range(nparts)
+                                  if mesh.devices.flat[p].process_index == pi)
+                subs = partition_matrix(csr, part, nparts, owned_parts=owned)
+                if args.output_comm_matrix:
+                    comm_mtx_out = comm_matrix(subs, nparts)
+                prob = DistributedProblem.build(csr, part, nparts, dtype=dtype,
+                                                subs=subs,
+                                                vector_dtype=vec_dtype,
+                                                owned_parts=owned)
+                try:
+                    solver = DistCGSolver(prob, pipelined=pipelined, comm=comm,
+                                          precise_dots=args.precise_dots,
+                                          kernels=args.kernels, mesh=mesh,
+                                          replace_every=args.replace_every,
+                                          recovery=args._recovery,
+                                          trace=args._trace,
+                                          progress=args.progress,
+                                          precond=args._precond,
+                                          health=args._health,
+                                          ckpt=args._ckpt)
+                except ValueError as e:
+                    raise SystemExit(f"acg-tpu: {e}")
+                if args.refine:
+                    solver = RefinedSolver(solver, csr,
+                                           inner_rtol=args.refine_rtol)
+                x = _run_solve(args, solver, b, x0=x0, criteria=criteria,
+                               warmup=args.warmup)
+        except (NotConvergedError, BreakdownError) as e:
+            sys.stderr.write(f"acg-tpu: {e}\n")
+            _fold_phases(args, solver)
+            if is_primary():  # stats block from "rank 0" only
+                solver.stats.fwrite(sys.stderr)
+            # the convergence log is most needed exactly when the solve
+            # failed: the trailing window shows the trajectory into the
+            # divergence/breakdown (no collective gather on this path)
+            _emit_telemetry(args, solver, matrix_id=args.A, nparts=nparts,
+                            comm=comm, collective=False)
+            stage_sync("solve", 1)
+            return 1
+        except AcgError as e:
+            sys.stderr.write(f"acg-tpu: {e}\n")
+            stage_sync("solve", 1)
+            return 1
     _log(args, "solve:", t0)
     rc = stage_sync("solve", 0)
     if rc:
@@ -2459,6 +2578,9 @@ def _main(args) -> int:
         from acg_tpu.solvers.profile import profile_ops
         per_call = profile_ops(solver, b, reps=max(args.profile_ops, 1))
         _report_chain_overhead(per_call)
+    # AFTER the replay tier: where the capture measured an op class,
+    # the measured seconds supersede the replay estimate
+    _attach_trace_analysis(args, solver)
 
     # every controller solves; only "rank 0" speaks (the reference's
     # fwritempi / mtxfile_fwrite_mpi_double root-rank output convention)

@@ -495,6 +495,25 @@ ABFT_TRIPS = REGISTRY.counter(
 ABFT_MISMATCH = REGISTRY.gauge(
     "acg_abft_mismatch_last", "Latest relative checksum mismatch "
     "|sum(Ax) - (c, x)| / scale.")
+# timeline-tracing tier (acg_tpu.tracing): span-timeline recording and
+# profiler-capture analysis
+TRACE_SPANS = REGISTRY.counter(
+    "acg_trace_spans_total", "Timeline spans/instants recorded by the "
+    "span recorder (--timeline), by category.",
+    labelnames=("cat",))
+TRACE_EXPORTS = REGISTRY.counter(
+    "acg_trace_exports_total", "Chrome trace-event timeline files "
+    "written (--timeline).")
+TRACE_OP_SECONDS = REGISTRY.gauge(
+    "acg_trace_op_seconds", "Measured per-op-class device seconds "
+    "from the last analyzed --trace capture.", labelnames=("op",))
+TRACE_OVERLAP = REGISTRY.gauge(
+    "acg_trace_overlap_efficiency", "Fraction of collective device "
+    "time hidden under compute in the last analyzed capture (1.0 = "
+    "fully overlapped; absent collectives leave the gauge untouched).")
+TRACE_EXPOSED_SECONDS = REGISTRY.gauge(
+    "acg_trace_exposed_collective_seconds", "Collective device time "
+    "NOT overlapped by compute in the last analyzed capture.")
 
 _armed = False
 
@@ -617,6 +636,31 @@ def record_health_kappa(kappa: float) -> None:
 def record_gap_trip() -> None:
     if _armed:
         HEALTH_GAP_TRIPS.inc()
+
+
+def record_trace_span(cat: str) -> None:
+    """One recorded timeline span/instant (acg_tpu.tracing)."""
+    if _armed:
+        TRACE_SPANS.labels(cat=str(cat)).inc()
+
+
+def record_timeline_export() -> None:
+    if _armed:
+        TRACE_EXPORTS.inc()
+
+
+def record_trace_analysis(analysis: dict) -> None:
+    """One --trace capture analysis: per-op-class measured seconds on
+    the gauges, overlap efficiency where collectives were measured."""
+    if not _armed or not analysis.get("available"):
+        return
+    for cls, secs in analysis.get("op_seconds", {}).items():
+        TRACE_OP_SECONDS.labels(op=str(cls)).set(float(secs))
+    eff = analysis.get("overlap_efficiency")
+    if eff is not None and math.isfinite(float(eff)):
+        TRACE_OVERLAP.set(float(eff))
+        TRACE_EXPOSED_SECONDS.set(
+            float(analysis.get("exposed_collective_seconds", 0.0)))
 
 
 def record_comm(ledger: dict, iterations: int) -> None:

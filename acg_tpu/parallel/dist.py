@@ -2364,7 +2364,7 @@ class DistCGSolver:
         iteration-identical to solve()'s (tests/test_checkpoint.py);
         snapshot time is billed to its own ``ckpt`` phase."""
         from acg_tpu import checkpoint as ckpt_mod
-        from acg_tpu import faults, metrics, telemetry
+        from acg_tpu import faults, metrics, telemetry, tracing
         from acg_tpu import health as health_mod
         from acg_tpu._platform import block_until_ready_works, device_sync
         from acg_tpu.solvers.resilience import RecoveryDriver
@@ -2511,6 +2511,7 @@ class DistCGSolver:
                 chunk_fault = (fault.shift(executed)
                                if fault is not None else None)
                 program = self._ckpt_program_for(chunk_fault)
+                t_chunk = time.time()
                 if abs_tol is None:
                     res, tbuf, aud, core = run(
                         program, x_cur, crit.residual_atol,
@@ -2523,6 +2524,12 @@ class DistCGSolver:
                         consumed)
                 device_sync(res[0])
                 k_chunk = int(res[1])
+                # timeline tier: one span per chunked dispatch, named
+                # by its trajectory window (no-op disarmed)
+                tracing.record_span(
+                    f"chunk k{consumed}..{consumed + k_chunk}",
+                    t_chunk, time.time(), cat="chunk",
+                    k_offset=consumed, iterations=k_chunk)
                 consumed += k_chunk
                 executed += k_chunk
                 if first_norms is None:

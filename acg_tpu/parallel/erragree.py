@@ -89,6 +89,19 @@ def _gather_codes(code: int, seq: int, timeout: float) -> list[int]:
 _blob_seq = itertools.count()
 
 
+def barrier(tag: str = "barrier", timeout: float = 120.0) -> float:
+    """Rendezvous all controller processes and return ``time.time()``
+    taken IMMEDIATELY after every rank exited -- the timeline tier's
+    clock-alignment stamp (acg_tpu.tracing.align_payloads): the true
+    exit event is simultaneous up to gather jitter, so any difference
+    between ranks' stamps is clock skew.  Rides the blob-gather
+    plumbing (same symmetric-call-site contract)."""
+    import time
+
+    allgather_blobs("1", tag=tag, timeout=timeout)
+    return time.time()
+
+
 def allgather_blobs(blob: str, tag: str = "blob",
                     timeout: float = 120.0) -> list[str]:
     """Allgather one small UTF-8 string per process (the telemetry

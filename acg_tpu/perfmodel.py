@@ -582,64 +582,78 @@ def run_explain(args, dtype, vec_dtype) -> int:
     from acg_tpu.solvers.jax_cg import JaxCGSolver
 
     rows = []
-    # ONE device assembly serves both single-chip tiers (A is immutable;
-    # rebuilding it per tier would re-upload every plane)
-    A = device_matrix_from_csr(csr, dtype=dtype, format=args.spmv_format)
-    for name, pipelined in (("cg", False), ("cg-pipelined", True)):
+    # under --trace the WHOLE tier sweep runs inside one profiler
+    # capture (acg_tpu.tracing): the measured section below then
+    # confronts the static ledger with per-op-class device time from
+    # the same programs the verdicts describe
+    from acg_tpu.tracing import profiler_trace
+    with profiler_trace(args.trace):
+        # ONE device assembly serves both single-chip tiers (A is immutable;
+        # rebuilding it per tier would re-upload every plane)
+        A = device_matrix_from_csr(csr, dtype=dtype, format=args.spmv_format)
+        for name, pipelined in (("cg", False), ("cg-pipelined", True)):
+            try:
+                # the session's recovery policy rides along (--recover):
+                # lower_solve arms detect exactly like solve(), so the
+                # analyzed/timed programs are the configured ones
+                solver = JaxCGSolver(A, pipelined=pipelined,
+                                     precise_dots=args.precise_dots,
+                                     kernels=args.kernels,
+                                     vector_dtype=vec_dtype,
+                                     recovery=getattr(args, "_recovery",
+                                                      None),
+                                     precond=getattr(args, "_precond", None))
+                pc = getattr(args, "_precond", None)
+                row = _explain_tier(
+                    f"{name} ({solver.kernels} kernels, {args.dtype}"
+                    + (f", precond {pc}" if pc is not None else "") + ")",
+                    solver, jnp.asarray(b, solver._solve_dtype()), csr, K, bw,
+                    disp, on_tpu, err)
+                if row:
+                    rows.append((row, solver))
+            except Exception as e:  # noqa: BLE001 -- one tier must not sink the rest
+                err.write(f"acg-tpu: explain tier {name} failed: "
+                          f"{type(e).__name__}: {e}\n")
+
+        # one distributed tier: the halo'd multi-part program over however
+        # many devices this host exposes (capped -- the ledger and verdict,
+        # not scaling, are the point here)
+        nparts = args.nparts or min(len(jax.devices()), 4)
         try:
-            # the session's recovery policy rides along (--recover):
-            # lower_solve arms detect exactly like solve(), so the
-            # analyzed/timed programs are the configured ones
-            solver = JaxCGSolver(A, pipelined=pipelined,
-                                 precise_dots=args.precise_dots,
-                                 kernels=args.kernels,
-                                 vector_dtype=vec_dtype,
-                                 recovery=getattr(args, "_recovery",
-                                                  None),
-                                 precond=getattr(args, "_precond", None))
+            from acg_tpu.parallel.dist import DistCGSolver, DistributedProblem
+            from acg_tpu.partition import partition_rows
+
+            method = "band" if prefers_dia(csr) else "graph"
+            part = partition_rows(csr, nparts, seed=args.seed, method=method)
+            prob = DistributedProblem.build(csr, part, nparts, dtype=dtype,
+                                            vector_dtype=vec_dtype)
+            comm = {"mpi": "xla", "nccl": "xla",
+                    "nvshmem": "dma"}.get(args.comm, args.comm)
+            solver = DistCGSolver(prob, pipelined=False,
+                                  comm=comm if comm != "none" else "xla",
+                                  precise_dots=args.precise_dots,
+                                  kernels=args.kernels,
+                                  recovery=getattr(args, "_recovery", None),
+                                  precond=getattr(args, "_precond", None))
             pc = getattr(args, "_precond", None)
-            row = _explain_tier(
-                f"{name} ({solver.kernels} kernels, {args.dtype}"
-                + (f", precond {pc}" if pc is not None else "") + ")",
-                solver, jnp.asarray(b, solver._solve_dtype()), csr, K, bw,
-                disp, on_tpu, err)
+            row = _explain_tier(f"dist-cg (nparts={nparts}, {solver.kernels} "
+                                f"kernels, {args.dtype}"
+                                + (f", precond {pc}" if pc is not None
+                                   else "") + ")", solver, b, csr, K,
+                                bw, disp, on_tpu, err)
             if row:
                 rows.append((row, solver))
-        except Exception as e:  # noqa: BLE001 -- one tier must not sink the rest
-            err.write(f"acg-tpu: explain tier {name} failed: "
+        except Exception as e:  # noqa: BLE001
+            err.write(f"acg-tpu: explain tier dist-cg failed: "
                       f"{type(e).__name__}: {e}\n")
 
-    # one distributed tier: the halo'd multi-part program over however
-    # many devices this host exposes (capped -- the ledger and verdict,
-    # not scaling, are the point here)
-    nparts = args.nparts or min(len(jax.devices()), 4)
-    try:
-        from acg_tpu.parallel.dist import DistCGSolver, DistributedProblem
-        from acg_tpu.partition import partition_rows
-
-        method = "band" if prefers_dia(csr) else "graph"
-        part = partition_rows(csr, nparts, seed=args.seed, method=method)
-        prob = DistributedProblem.build(csr, part, nparts, dtype=dtype,
-                                        vector_dtype=vec_dtype)
-        comm = {"mpi": "xla", "nccl": "xla",
-                "nvshmem": "dma"}.get(args.comm, args.comm)
-        solver = DistCGSolver(prob, pipelined=False,
-                              comm=comm if comm != "none" else "xla",
-                              precise_dots=args.precise_dots,
-                              kernels=args.kernels,
-                              recovery=getattr(args, "_recovery", None),
-                              precond=getattr(args, "_precond", None))
-        pc = getattr(args, "_precond", None)
-        row = _explain_tier(f"dist-cg (nparts={nparts}, {solver.kernels} "
-                            f"kernels, {args.dtype}"
-                            + (f", precond {pc}" if pc is not None
-                               else "") + ")", solver, b, csr, K,
-                            bw, disp, on_tpu, err)
-        if row:
-            rows.append((row, solver))
-    except Exception as e:  # noqa: BLE001
-        err.write(f"acg-tpu: explain tier dist-cg failed: "
-                  f"{type(e).__name__}: {e}\n")
+    # with a capture: confront the ledgers above with MEASURED device
+    # time from the very programs the verdicts describe (acg_tpu.
+    # tracing) -- per-op-class seconds, overlap efficiency, and the
+    # measured-vs-predicted comm line.  Without --trace this section is
+    # absent and the static verdict stands unchanged
+    if args.trace:
+        _explain_measured(args, rows, K, err)
 
     # the numerical-health tier's convergence verdict: kappa from the
     # Lanczos tridiagonal of a traced host-oracle solve, the CG-bound
@@ -662,6 +676,43 @@ def run_explain(args, dtype, vec_dtype) -> int:
         except OSError as e:
             err.write(f"acg-tpu: {args.stats_json}: {e}\n")
     return 0 if rows else 1
+
+
+def _explain_measured(args, rows, K: int, err) -> dict | None:
+    """The ``--explain`` measured section: parse the capture the tier
+    sweep just wrote, print per-op-class device seconds + the
+    overlap-efficiency score, and confront the static ledger's
+    predicted collective seconds (each tier's comm component x its K
+    timed iterations) with the measured ones.  Degrades to a one-line
+    why when the capture is unusable (xplane-only schema, failed
+    profiler start) -- the static verdict above stands either way."""
+    from acg_tpu import tracing
+
+    analysis = tracing.analyze_trace(args.trace)
+    err.write("== explain: measured (profiler trace) ==\n")
+    for line in tracing.format_analysis(analysis):
+        err.write(line + "\n")
+    if analysis.get("available"):
+        predicted = sum(
+            row["components_s"].get("comm-bound", 0.0) * K
+            for row, _ in rows)
+        err.write(tracing.measured_comm_line(
+            analysis, predicted,
+            label=f"comm ledger x {K} iters/tier") + "\n")
+        # the tracing: stats section rides every tier's --stats-json
+        # document (one capture covers the whole sweep, so no per-tier
+        # op attribution is claimed -- ops rows stay as analyzed)
+        # None values (no straggler, overlap n/a) are suppressed, the
+        # way tracing.attach builds the section
+        compact = {k: analysis[k] for k in
+                   ("available", "nfiles", "op_seconds",
+                    "collective_seconds", "exposed_collective_seconds",
+                    "overlap_efficiency", "straggler")
+                   if analysis.get(k) is not None}
+        for _, solver in rows:
+            solver.stats.tracing.update(compact)
+    err.write("\n")
+    return analysis
 
 
 def _explain_convergence(args, csr, rows, err) -> dict | None:

@@ -35,7 +35,7 @@ import math
 import sys
 import time
 
-from acg_tpu import metrics, telemetry
+from acg_tpu import metrics, telemetry, tracing
 
 # EWMA smoothing for the drift detector: 0.2 remembers ~the last 10
 # solves -- slow enough to ride out one contended solve, fast enough to
@@ -177,12 +177,18 @@ def run_soak(solver, b, *, nsolves: int, x0=None, criteria=None,
         if i == 0 and first_solve_kwargs:
             kw.update(first_solve_kwargs)
         t0 = time.perf_counter()
+        t0_wall = time.time()
         # the injected-slowdown site (solve:slow@K:secs=S) sleeps
         # INSIDE the timed window -- a deterministic stand-in for
         # contention/throttling that the drift detector must catch
         faults.maybe_slow_solve(i)
         x = solver.solve(b, x0=x0, criteria=criteria, **kw)
         lat = time.perf_counter() - t0
+        # timeline tier: an INDEXED span per soak solve (the solver's
+        # own "solve" phase spans are indistinguishable across N
+        # repeats; a drift timeline needs to say which solve slowed)
+        tracing.record_span(f"{what}[{i}]", t0_wall, t0_wall + lat,
+                            cat="chunk", index=i)
         lat_hist.observe(lat)
         it_hist.observe(max(int(st.niterations), 0))
         latencies_max = max(latencies_max, lat)

@@ -1898,7 +1898,7 @@ class JaxCGSolver:
         (asserted in tests/test_checkpoint.py); snapshot time is billed
         to its own ``ckpt`` phase, never the solve."""
         from acg_tpu import checkpoint as ckpt_mod
-        from acg_tpu import faults, metrics, telemetry
+        from acg_tpu import faults, metrics, telemetry, tracing
         from acg_tpu import health as health_mod
         from acg_tpu._platform import (block_until_ready_works,
                                        device_sync)
@@ -2031,9 +2031,16 @@ class JaxCGSolver:
                 if "fault" in kwargs:
                     kwargs["fault"] = (fault.shift(executed)
                                        if fault is not None else None)
+                t_chunk = time.time()
                 res, tbuf, aud, core = run(a, carry, consumed)
                 device_sync(res.x)
                 k_chunk = int(res.niterations)
+                # timeline tier: one span per chunked dispatch, named
+                # by its trajectory window (no-op disarmed)
+                tracing.record_span(
+                    f"chunk k{consumed}..{consumed + k_chunk}",
+                    t_chunk, time.time(), cat="chunk",
+                    k_offset=consumed, iterations=k_chunk)
                 consumed += k_chunk
                 executed += k_chunk
                 if first_norms is None:

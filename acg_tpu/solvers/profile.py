@@ -29,7 +29,13 @@ Honest caveats, also noted in the stats block docs:
     its result back into the carried vector to keep the data
     dependence, which adds ~one vector read+write per repetition --
     those entries are therefore upper bounds by roughly one
-    axpy-equivalent (reported alongside, so readers can discount it).
+    axpy-equivalent (reported alongside, so readers can discount it);
+  * a ``--trace`` capture SUPERSEDES this tier where it can: the CLI
+    applies :func:`acg_tpu.tracing.apply_measured_ops` after the
+    replay, so any op class the profiler resolved to real device
+    events (TPU captures carry per-HLO-op timelines) reports MEASURED
+    seconds instead of the replayed estimate, and the stats block's
+    ``tracing: ops_source`` line says which rows were replaced.
 """
 
 from __future__ import annotations

@@ -146,6 +146,11 @@ class SolverStats:
     # armed snapshot configuration, snapshots written/resumed, and the
     # last committed iteration.  Appends after health
     ckpt: dict = dataclasses.field(default_factory=dict)
+    # timeline-tracing tier (acg_tpu.tracing, stats schema /7): the
+    # profiler-capture analysis (measured per-op-class seconds, overlap
+    # efficiency, straggler attribution) and the --timeline export
+    # summary.  Appends strictly last
+    tracing: dict = dataclasses.field(default_factory=dict)
 
     def to_dict(self) -> dict:
         """Machine-readable twin of :meth:`fwrite` -- the ``stats`` key
@@ -192,6 +197,7 @@ class SolverStats:
             "precond": dict(self.precond),
             "health": dict(self.health),
             "ckpt": dict(self.ckpt),
+            "tracing": dict(self.tracing),
         }
         if self.trace is not None:
             d["trace"] = self.trace.to_dict()
@@ -292,6 +298,9 @@ class SolverStats:
         if self.ckpt:
             p("ckpt:")
             _write_section(p, self.ckpt, 1)
+        if self.tracing:
+            p("tracing:")
+            _write_section(p, self.tracing, 1)
         text = out.getvalue()
         if f is not None:
             f.write(text)

@@ -78,7 +78,12 @@ from acg_tpu.solvers.stats import PHASE_ORDER
 # written, resume provenance), an "nrollbacks" counter inside
 # "resilience", and an "abft" sub-dict inside "health" (checksum-SpMV
 # verification summary) -- additive, so /1../5 consumers keep working
-STATS_SCHEMA = "acg-tpu-stats/6"
+# /7: the timeline-tracing tier (acg_tpu.tracing) adds a "tracing" key
+# inside the stats twin (profiler-capture analysis: measured per-op-
+# class seconds, overlap efficiency, straggler attribution; plus the
+# --timeline export summary) -- additive, so /1../6 consumers keep
+# working
+STATS_SCHEMA = "acg-tpu-stats/7"
 CONVERGENCE_SCHEMA = "acg-tpu-convergence/1"
 # default ring capacity (--telemetry-window): 512 iterations x 4 scalars
 # is 8 KiB of f32 carry -- negligible against any solve's vectors, and
@@ -371,8 +376,11 @@ class PhaseTimer:
     def add(self, name: str, seconds: float) -> None:
         self.phases[name] = self.phases.get(name, 0.0) + float(seconds)
         # service-metrics tier: phase-time histogram (no-op disarmed)
-        from acg_tpu import metrics
+        from acg_tpu import metrics, tracing
         metrics.record_phase(name, seconds)
+        # timeline tier: the same phase as a wall-clock span (--timeline;
+        # no-op disarmed)
+        tracing.record_phase_span(name, seconds)
 
     @contextlib.contextmanager
     def phase(self, name: str):
@@ -419,19 +427,22 @@ def annotate(name: str):
 def add_timing(stats, name: str, seconds: float) -> None:
     """Accumulate one phase's seconds onto ``stats.timings``."""
     stats.timings[name] = stats.timings.get(name, 0.0) + float(seconds)
-    from acg_tpu import metrics
+    from acg_tpu import metrics, tracing
     metrics.record_phase(name, seconds)
+    tracing.record_phase_span(name, seconds)
 
 
 def record_event(stats, kind: str, detail: str) -> None:
     """Append one timestamped event (resilience, fault injection) for
     the structured sink; the human-readable ``recovery_log`` is separate
     and unchanged.  Every event also bumps the service-metrics
-    by-kind counter (``acg_events_total``; no-op disarmed)."""
+    by-kind counter (``acg_events_total``; no-op disarmed) and lands as
+    an instant on the ``--timeline`` span timeline (no-op disarmed)."""
     stats.events.append({"t": time.time(), "kind": kind,
                          "detail": str(detail)})
-    from acg_tpu import metrics
+    from acg_tpu import metrics, tracing
     metrics.record_event_kind(kind)
+    tracing.record_instant(kind, detail=str(detail))
 
 
 # -- structured stats sink ----------------------------------------------

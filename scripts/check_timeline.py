@@ -1,0 +1,149 @@
+#!/usr/bin/env python3
+"""Validate a ``--timeline`` Chrome trace-event file.
+
+The CI trace-smoke step (and any pipeline consuming ``--timeline``
+output) needs a mechanical check that the exporter keeps its contract:
+
+* the document is valid JSON with a ``traceEvents`` list (the Chrome
+  trace-event JSON-object form Perfetto loads);
+* every complete (``X``) event carries a name and numeric ``ts``/``dur``
+  with ``dur >= 0``;
+* ``ts`` is monotone non-decreasing per (pid, tid) track -- the
+  exporter sorts, so a violation means a torn write or a foreign tool;
+* every pid that carries span events has ``process_name`` metadata;
+* with ``--parts N``: span events cover exactly N distinct pids (the
+  one-pid-per-part contract of acg_tpu.tracing.export_chrome_trace);
+* with ``--require-span NAME`` (repeatable): at least one ``X`` event
+  with exactly that name exists;
+* cross-rank clock alignment left no negative skew: the metadata's
+  ``clock.max_skew_s`` is recorded and, when alignment ran, every
+  rank's spans start at or after the timeline origin (ts >= 0).
+
+Exit status: 0 valid, 1 invalid (each failure is printed), 2 usage.
+Stdlib-only on purpose -- runs on a bare pod VM with no repo install.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import math
+import sys
+
+
+def validate(doc, parts=None, require_spans=()) -> list[str]:
+    """All contract violations in ``doc`` (empty = valid)."""
+    errs: list[str] = []
+    if not isinstance(doc, dict):
+        return ["document is not a JSON object (the Chrome trace-event "
+                "JSON-array form carries no metadata; the exporter "
+                "always writes the object form)"]
+    events = doc.get("traceEvents")
+    if not isinstance(events, list):
+        return ["no traceEvents list"]
+
+    named_pids: set[int] = set()
+    span_pids: set[int] = set()
+    span_names: set[str] = set()
+    tracks: dict[tuple, float] = {}
+    for i, e in enumerate(events):
+        if not isinstance(e, dict) or "ph" not in e:
+            errs.append(f"event {i}: not an event object (no ph)")
+            continue
+        ph = e["ph"]
+        if ph == "M":
+            if e.get("name") == "process_name":
+                named_pids.add(e.get("pid"))
+            continue
+        if ph not in ("X", "i", "I"):
+            continue
+        name = e.get("name")
+        ts = e.get("ts")
+        if not name or not isinstance(name, str):
+            errs.append(f"event {i}: {ph} event without a name")
+            continue
+        if not isinstance(ts, (int, float)) or not math.isfinite(ts):
+            errs.append(f"event {i} ({name}): non-numeric ts {ts!r}")
+            continue
+        if ts < 0:
+            errs.append(f"event {i} ({name}): negative ts {ts} -- a "
+                        f"span precedes the aligned timeline origin "
+                        f"(negative inter-rank skew)")
+        track = (e.get("pid"), e.get("tid"))
+        last = tracks.get(track)
+        if last is not None and ts < last:
+            errs.append(f"event {i} ({name}): ts {ts} < previous "
+                        f"{last} on track pid={track[0]} "
+                        f"tid={track[1]} (non-monotone)")
+        tracks[track] = ts
+        if ph == "X":
+            dur = e.get("dur")
+            if not isinstance(dur, (int, float)) \
+                    or not math.isfinite(dur) or dur < 0:
+                errs.append(f"event {i} ({name}): bad dur {dur!r}")
+            span_pids.add(e.get("pid"))
+            span_names.add(name)
+
+    unnamed = span_pids - named_pids
+    if unnamed:
+        errs.append(f"pids without process_name metadata: "
+                    f"{sorted(unnamed)}")
+    if parts is not None and len(span_pids) != parts:
+        errs.append(f"expected spans on exactly {parts} pids (one per "
+                    f"part), found {len(span_pids)}: "
+                    f"{sorted(span_pids)}")
+    for want in require_spans:
+        if want not in span_names:
+            errs.append(f"required span {want!r} absent (have: "
+                        f"{', '.join(sorted(span_names)) or 'none'})")
+    return errs
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        description="validate a --timeline Chrome trace-event file")
+    ap.add_argument("file", help="timeline JSON file")
+    ap.add_argument("--parts", type=int, default=None, metavar="N",
+                    help="require spans on exactly N pids (one per "
+                         "part)")
+    ap.add_argument("--require-span", action="append", default=[],
+                    metavar="NAME",
+                    help="require a span with this exact name "
+                         "(repeatable)")
+    args = ap.parse_args(argv)
+
+    try:
+        with open(args.file) as f:
+            doc = json.load(f)
+    except (OSError, ValueError) as e:
+        print(f"check_timeline: {args.file}: {e}", file=sys.stderr)
+        return 1
+    errs = validate(doc, parts=args.parts,
+                    require_spans=args.require_span)
+    if errs:
+        for e in errs:
+            print(f"check_timeline: {args.file}: {e}", file=sys.stderr)
+        return 1
+    nspans = sum(1 for e in doc["traceEvents"]
+                 if isinstance(e, dict) and e.get("ph") == "X")
+    pids = {e.get("pid") for e in doc["traceEvents"]
+            if isinstance(e, dict) and e.get("ph") == "X"}
+    md = doc.get("metadata", {})
+    clock = md.get("clock", {})
+    print(f"check_timeline: {args.file}: OK ({nspans} spans over "
+          f"{len(pids)} pid(s), {md.get('nranks', 1)} rank(s), "
+          f"max skew {clock.get('max_skew_s', 0.0):.6f} s)")
+    return 0
+
+
+if __name__ == "__main__":
+    try:
+        sys.exit(main())
+    except BrokenPipeError:
+        # stdout consumer (head, grep -m) closed early -- the cli.py
+        # SIGPIPE recipe: point the fd at devnull so the interpreter's
+        # exit flush cannot print a traceback after a clean verdict
+        import os
+
+        os.dup2(os.open(os.devnull, os.O_WRONLY), sys.stdout.fileno())
+        sys.exit(0)

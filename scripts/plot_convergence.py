@@ -276,13 +276,75 @@ def _sparkline(its, rn, width: int = 72) -> str:
     return "".join(out)
 
 
+def _load_timeline(path):
+    """A ``--timeline`` Chrome trace-event file (acg-tpu-timeline/1)
+    -> one span-summary record: per-name earliest start / latest end /
+    total seconds aggregated over pids (a controller-wide span is
+    replicated per part; the Gantt shows each name once).  The parse +
+    shape check is tracing.read_timeline -- ONE reader for the format,
+    shared with trace_report.py."""
+    from acg_tpu.tracing import read_timeline
+
+    doc = read_timeline(path)
+    md = doc.get("metadata", {})
+    if not str(md.get("schema", "")).startswith("acg-tpu-timeline"):
+        raise ValueError("not an acg-tpu --timeline document")
+    by_name: dict = {}
+    nspans = 0
+    for e in doc["traceEvents"]:
+        if not isinstance(e, dict) or e.get("ph") != "X":
+            continue
+        nspans += 1
+        t0 = e.get("ts", 0.0) * 1e-6
+        t1 = t0 + e.get("dur", 0.0) * 1e-6
+        name = e.get("name", "?")
+        row = by_name.setdefault(name, [t0, t1, 0.0, set()])
+        row[0] = min(row[0], t0)
+        row[1] = max(row[1], t1)
+        row[3].add(e.get("pid"))
+    for name, row in by_name.items():
+        # total = the span's wall window (replicas overlap exactly on
+        # a single controller; across ranks the window includes skew)
+        row[2] = row[1] - row[0]
+    rows = sorted(({"name": n, "t0": r[0], "t1": r[1], "total": r[2],
+                    "npids": len(r[3])} for n, r in by_name.items()),
+                  key=lambda r: (r["t0"], r["t1"]))
+    return {"path": path, "rows": rows, "nspans": nspans,
+            "nparts": md.get("nparts", 0), "nranks": md.get("nranks", 1),
+            "skew": md.get("clock", {}).get("max_skew_s", 0.0)}
+
+
+def _gantt_lines(rec, width: int = 56) -> list:
+    """Ascii Gantt of a timeline record -- the bare-pod-VM fallback."""
+    rows = rec["rows"]
+    t_end = max((r["t1"] for r in rows), default=0.0)
+    lines = [f"{rec['path']}: {rec['nspans']} spans, "
+             f"{rec['nparts']} part(s), {rec['nranks']} rank(s), "
+             f"{t_end:.3f} s"]
+    if t_end <= 0:
+        return lines
+    label_w = min(max((len(r["name"]) for r in rows), default=4), 24)
+    for r in rows:
+        a = int(r["t0"] / t_end * width)
+        b = max(int(r["t1"] / t_end * width), a + 1)
+        bar = " " * a + "#" * (b - a) + " " * (width - b)
+        lines.append(f"  {r['name'][:label_w]:<{label_w}} |{bar}| "
+                     f"{r['t0']:.3f}-{r['t1']:.3f}s")
+    return lines
+
+
 def _classify(path):
-    """``("conv", ...) | ("latency", ...)`` by content, not extension:
-    a convergence log's first parseable line is the meta record, a
-    stats document has a ``stats`` key, anything with an
-    ``acg_solve_seconds`` series is a metrics textfile.  A /5 stats
-    document carrying only a ``health`` section still classifies (the
-    kappa annotation is its evidence)."""
+    """``("conv", ...) | ("latency", ...) | ("timeline", ...)`` by
+    content, not extension: a convergence log's first parseable line is
+    the meta record, a stats document has a ``stats`` key, anything
+    with an ``acg_solve_seconds`` series is a metrics textfile, and an
+    ``acg-tpu-timeline`` trace-event document renders as a per-phase
+    span Gantt.  A /5 stats document carrying only a ``health`` section
+    still classifies (the kappa annotation is its evidence)."""
+    try:
+        return ("timeline", _load_timeline(path))
+    except (ValueError, UnicodeDecodeError):
+        pass
     try:
         soak, cum, health, events = _load_stats_json(path)
         if soak or cum or health or events:
@@ -318,14 +380,19 @@ def main(argv=None) -> int:
                          "is installed")
     args = ap.parse_args(argv)
 
-    conv, latency = [], []
+    conv, latency, timelines = [], [], []
     for path in args.logs:
         try:
             kind, rec = _classify(path)
         except (OSError, ValueError, KeyError) as e:
             print(f"plot_convergence: {path}: {e}", file=sys.stderr)
             return 1
-        (conv if kind == "conv" else latency).append(rec)
+        if kind == "conv":
+            conv.append(rec)
+        elif kind == "timeline":
+            timelines.append(rec)
+        else:
+            latency.append(rec)
 
     plt = None
     if not args.ascii:
@@ -372,10 +439,17 @@ def main(argv=None) -> int:
                 # back / resumed / restarted
                 print("  events: "
                       + ", ".join(f"{k}@{i}" for k, i in evs))
+        for rec in timelines:
+            # per-phase span summary of a --timeline file (/7)
+            for line in _gantt_lines(rec):
+                print(line)
         return 0
 
-    ncols = (1 if not latency else 2) if conv else 1
-    fig, axes = plt.subplots(1, ncols, figsize=(9 if ncols == 1 else 13, 5))
+    ncols = ((1 if conv else 0) + (1 if latency else 0)
+             + (1 if timelines else 0)) or 1
+    fig, axes = plt.subplots(1, ncols,
+                             figsize=(9 if ncols == 1 else 6.5 * ncols,
+                                      5))
     axes = [axes] if ncols == 1 else list(axes)
     ax = axes[0] if conv else None
     for path, meta, its, rn, gaps in conv:
@@ -428,7 +502,7 @@ def main(argv=None) -> int:
             # document given alongside the logs
             ax.set_title("; ".join(notes), fontsize=8)
     if latency:
-        lax = axes[-1]
+        lax = axes[1 if conv else 0]
         plotted = False
         for rec in latency:
             if not rec["cum"]:
@@ -462,6 +536,26 @@ def main(argv=None) -> int:
             lax.set_title(summary, fontsize=8)
         if plotted:
             lax.legend(fontsize=8)
+    if timelines:
+        # one Gantt panel (broken_barh per span name) for the first
+        # timeline; additional files fall back to the ascii summary so
+        # N files never explode the figure
+        tax = axes[-1]
+        rec = timelines[0]
+        rows = rec["rows"]
+        for i, r in enumerate(rows):
+            tax.broken_barh([(r["t0"], max(r["t1"] - r["t0"], 1e-9))],
+                            (i - 0.4, 0.8), alpha=0.85)
+        tax.set_yticks(range(len(rows)))
+        tax.set_yticklabels([r["name"] for r in rows], fontsize=7)
+        tax.invert_yaxis()
+        tax.set_xlabel("seconds since timeline origin")
+        tax.set_title(f"{os.path.basename(rec['path'])}: "
+                      f"{rec['nparts']} part(s), {rec['nranks']} "
+                      f"rank(s)", fontsize=8)
+        for extra in timelines[1:]:
+            for line in _gantt_lines(extra):
+                print(line)
     fig.tight_layout()
     if args.output:
         fig.savefig(args.output, dpi=130)

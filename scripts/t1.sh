@@ -7,13 +7,18 @@
 # T1_SOAK=1 additionally runs the service-soak smoke after the tests: a
 # tiny 3-solve --soak run whose --metrics-file must validate as
 # Prometheus exposition format and whose --stats-json must carry the
-# acg-tpu-stats/6 soak section (the CI soak-smoke step runs the same
+# acg-tpu-stats/7 soak section (the CI soak-smoke step runs the same
 # thing).  T1_HEALTH=1 runs the numerical-health smoke: an audited
 # pipelined solve on the anisotropic generator must leave a health:
 # section with a finite gap, the acg_health_* metric families, and a
 # Lanczos kappa estimate.  T1_CKPT=1 runs the crash/resume smoke: a
 # soak solve is killed mid-flight by crash:exit@K, relaunched with
 # --resume, and must converge with the acg_ckpt_* families exposed.
+# T1_TRACE=1 runs the timeline-tracing smoke: an 8-part CPU-mesh solve
+# under --trace/--timeline must leave a Chrome trace-event timeline
+# that validates (scripts/check_timeline.py: one pid per part, spans
+# for ingest/partition/compile/solve), a /7 stats document carrying
+# the tracing: section, and the acg_trace_* metric families.
 set -o pipefail
 cd "$(dirname "$0")/.."
 rm -f /tmp/_t1.log
@@ -36,7 +41,7 @@ if [ "${T1_SOAK:-0}" = "1" ]; then
     python - <<'PY' || rc=$((rc ? rc : 1))
 import json
 doc = json.load(open("/tmp/_t1_soak.json"))
-assert doc["schema"] == "acg-tpu-stats/6", doc["schema"]
+assert doc["schema"] == "acg-tpu-stats/7", doc["schema"]
 soak = doc["stats"]["soak"]
 assert soak["nsolves"] == 3 and soak["latency"]["p50"] is not None, soak
 assert "metrics" in doc, "registry snapshot missing from /3 document"
@@ -58,7 +63,7 @@ if [ "${T1_PRECOND:-0}" = "1" ]; then
         env PC="$pc" python - <<'PY' || rc=$((rc ? rc : 1))
 import json, os
 doc = json.load(open("/tmp/_t1_precond.json"))
-assert doc["schema"] == "acg-tpu-stats/6", doc["schema"]
+assert doc["schema"] == "acg-tpu-stats/7", doc["schema"]
 st = doc["stats"]
 assert st["converged"] is True, st["rnrm2"]
 assert st["precond"]["kind"] == os.environ["PC"], st["precond"]
@@ -94,7 +99,7 @@ if [ "${T1_HEALTH:-0}" = "1" ]; then
     python - <<'PY' || rc=$((rc ? rc : 1))
 import json, math
 doc = json.load(open("/tmp/_t1_health.json"))
-assert doc["schema"] == "acg-tpu-stats/6", doc["schema"]
+assert doc["schema"] == "acg-tpu-stats/7", doc["schema"]
 h = doc["stats"]["health"]
 assert h["naudits"] > 0, h
 assert h["gap_last"] is not None and math.isfinite(h["gap_last"]), h
@@ -133,13 +138,52 @@ if [ "${T1_CKPT:-0}" = "1" ]; then
     python - <<'PY' || rc=$((rc ? rc : 1))
 import json
 doc = json.load(open("/tmp/_t1_ckpt.json"))
-assert doc["schema"] == "acg-tpu-stats/6", doc["schema"]
+assert doc["schema"] == "acg-tpu-stats/7", doc["schema"]
 st = doc["stats"]
 assert st["converged"] is True, st["rnrm2"]
 ck = st["ckpt"]
 assert ck.get("resumed_from", 0) > 0, ck
 print(f"T1_CKPT: OK (resumed at {ck['resumed_from']}, "
       f"+{st['niterations']} iterations to tolerance)")
+PY
+fi
+if [ "${T1_TRACE:-0}" = "1" ]; then
+    # timeline-tracing smoke (the PR-8 acceptance in miniature): an
+    # 8-part CPU-mesh solve under --trace + --timeline must emit a
+    # Chrome trace-event timeline with one pid per part and spans for
+    # ingest/partition/compile/solve, a /7 stats document carrying the
+    # tracing: section, and the acg_trace_* metric families; the
+    # capture analysis must degrade gracefully on this CPU backend
+    # (trace_report still exits 0 on the timeline)
+    echo "T1_TRACE: 8-part timeline smoke"
+    rm -rf /tmp/_t1_trace_capture
+    rm -f /tmp/_t1_trace.json /tmp/_t1_timeline.json /tmp/_t1_trace.prom
+    timeout -k 10 300 env JAX_PLATFORMS=cpu \
+        XLA_FLAGS="--xla_force_host_platform_device_count=8" \
+        python -m acg_tpu.cli gen:poisson2d:24 --nparts 8 \
+        --max-iterations 200 --residual-rtol 1e-8 --warmup 1 --quiet \
+        --trace /tmp/_t1_trace_capture \
+        --timeline /tmp/_t1_timeline.json \
+        --metrics-file /tmp/_t1_trace.prom \
+        --stats-json /tmp/_t1_trace.json || rc=$((rc ? rc : 1))
+    python scripts/check_timeline.py /tmp/_t1_timeline.json --parts 8 \
+        --require-span ingest --require-span partition \
+        --require-span compile --require-span solve \
+        || rc=$((rc ? rc : 1))
+    python scripts/trace_report.py /tmp/_t1_timeline.json \
+        || rc=$((rc ? rc : 1))
+    python scripts/check_metrics_textfile.py /tmp/_t1_trace.prom \
+        --require acg_trace_ || rc=$((rc ? rc : 1))
+    python - <<'PY' || rc=$((rc ? rc : 1))
+import json
+doc = json.load(open("/tmp/_t1_trace.json"))
+assert doc["schema"] == "acg-tpu-stats/7", doc["schema"]
+tr = doc["stats"]["tracing"]
+tl = tr["timeline"]
+assert tl["nparts"] == 8 and tl["nspans"] > 0, tl
+assert "available" in tr, tr
+print(f"T1_TRACE: OK ({tl['nspans']} spans over {tl['nparts']} parts, "
+      f"capture analysis available={tr['available']})")
 PY
 fi
 exit $rc

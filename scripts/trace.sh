@@ -1,24 +1,60 @@
 #!/usr/bin/env bash
-# Capture a jax.profiler trace of a solve -- the role of the reference's
-# scripts/trace_{mpi,nvshmem}.sh (nsys profile -t cuda,nvtx): the trace
-# contains the XLA op timeline with the solver's named scopes; view with
-# xprof/tensorboard.
+# Trace harness: solver x comm sweep under profiler capture -- the role
+# of the reference's scripts/trace_mpi.sh / trace_nvshmem.sh, which wrap
+# every solver variant in `nsys profile -t cuda,nvtx` and leave one
+# .nsys-rep per (solver, transport) cell.
 #
-# Usage: scripts/trace.sh [TRACE_DIR] [extra acg-tpu args...]
+# Mapping from the nsys workflow:
+#   nsys profile -t cuda,nvtx ./acg-cuda ...   ->  --trace DIR
+#       (jax.profiler capture: XLA op timeline + the solver's acg:*
+#        phase annotations, the NVTX-range analog; view with xprof/
+#        tensorboard, or summarise with scripts/trace_report.py DIR)
+#   nsys stats / the GUI timeline               ->  --timeline FILE
+#       (cross-rank span timeline as Chrome trace-event JSON, one pid
+#        per part; load in Perfetto / chrome://tracing, validate with
+#        scripts/check_timeline.py, summarise with trace_report.py)
+#   trace_mpi.sh vs trace_nvshmem.sh            ->  the COMM axis below
+#       (xla collectives vs pallas remote DMA; `none` = single chip)
+#
+# Output layout: $OUT/<solver>-<comm>/capture/  (profiler capture)
+#                $OUT/<solver>-<comm>/timeline.json
+#                $OUT/<solver>-<comm>/stats.json
+#
+# Usage: scripts/trace.sh [OUT_DIR] [extra acg-tpu args...]
+#   TRACE_SOLVERS="acg acg-pipelined"  override the solver axis
+#   TRACE_COMMS="none xla"             override the comm axis
+#   TRACE_SPEC=gen:poisson2d:64        override the test system
+#   TRACE_NPARTS=0                     mesh size for comm != none
+#                                      (0 = all local devices)
 
 set -euo pipefail
 cd "$(dirname "$0")/.."
-
-TRACE_DIR=${1:-/tmp/acg-tpu-trace}
-shift || true
-WORKDIR=$(mktemp -d)
-trap 'rm -rf "$WORKDIR"' EXIT
 export PYTHONPATH=${PYTHONPATH:-$PWD}
 
-MTX="$WORKDIR/poisson2d.mtx"
-python -m acg_tpu.tools.genmatrix -n 512 --dim 2 -o "$MTX"
+OUT=${1:-/tmp/acg-tpu-trace}
+shift || true
 
-python -m acg_tpu.cli "$MTX" --comm none --solver acg --dtype f32 \
-    --max-iterations 200 --residual-rtol 0 --warmup 1 --quiet \
-    --trace "$TRACE_DIR" "$@"
-echo "trace written to $TRACE_DIR"
+SOLVERS=${TRACE_SOLVERS:-"acg acg-pipelined"}
+COMMS=${TRACE_COMMS:-"none xla"}
+SPEC=${TRACE_SPEC:-gen:poisson2d:64}
+NPARTS=${TRACE_NPARTS:-0}
+
+for solver in $SOLVERS; do
+    for comm in $COMMS; do
+        cell="$OUT/$solver-$comm"
+        mkdir -p "$cell"
+        args=(--solver "$solver" --comm "$comm" --dtype f32
+              --max-iterations 200 --residual-rtol 0 --warmup 1 --quiet
+              --trace "$cell/capture" --timeline "$cell/timeline.json"
+              --stats-json "$cell/stats.json")
+        if [ "$comm" != "none" ] && [ "$NPARTS" != "1" ]; then
+            args+=(--nparts "$NPARTS")
+        fi
+        echo "== trace: $solver / $comm =="
+        python -m acg_tpu.cli "$SPEC" "${args[@]}" "$@"
+        python scripts/check_timeline.py "$cell/timeline.json"
+        python scripts/trace_report.py "$cell/capture" || true
+        python scripts/trace_report.py "$cell/timeline.json"
+    done
+done
+echo "traces written under $OUT (load timeline.json files in Perfetto)"

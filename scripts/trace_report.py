@@ -1,0 +1,120 @@
+#!/usr/bin/env python3
+"""Summarise a ``--trace`` capture dir or a ``--timeline`` file.
+
+The role of eyeballing an nsys timeline, as a table:
+
+* a ``--trace`` DIRECTORY (jax.profiler capture) is parsed by
+  :func:`acg_tpu.tracing.analyze_trace` into measured per-op-class
+  device seconds, the overlap-efficiency score (collective time hidden
+  under compute vs exposed), per-phase seconds, and the cross-rank
+  straggler attribution;
+* a ``--timeline`` FILE (Chrome trace-event JSON from
+  acg_tpu.tracing.export_chrome_trace) is summarised per part: span
+  counts and per-name seconds, clock-alignment skew, event pins.
+
+Input kind is sniffed from the filesystem (directory vs file), the
+same content-over-extension discipline as plot_convergence.py.
+
+Usage:
+    python scripts/trace_report.py /tmp/acg-trace-dir
+    python scripts/trace_report.py timeline.json
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+from collections import defaultdict
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+
+def report_capture(path: str) -> int:
+    from acg_tpu import tracing
+
+    an = tracing.analyze_trace(path)
+    print(f"trace capture: {path}")
+    for line in tracing.format_analysis(an):
+        print(line)
+    if not an.get("available"):
+        return 1
+    per_rank = an.get("per_rank", [])
+    if len(per_rank) > 1:
+        print("  per-rank phase seconds:")
+        for r in per_rank:
+            ph = ", ".join(f"{k} {v:.3f}s"
+                           for k, v in r.get("phase_seconds",
+                                             {}).items())
+            print(f"    {r['rank']}: {ph or '(no phase brackets)'} "
+                  f"[busy {r.get('busy_seconds', 0.0):.3f}s]")
+    return 0
+
+
+def report_timeline(path: str) -> int:
+    from acg_tpu import tracing
+
+    doc = tracing.read_timeline(path)
+    md = doc.get("metadata", {})
+    events = doc["traceEvents"]
+    pid_names: dict[int, str] = {}
+    spans = defaultdict(lambda: defaultdict(float))   # pid -> name -> s
+    counts: dict[int, int] = defaultdict(int)
+    instants: dict[int, int] = defaultdict(int)
+    t_max = 0.0
+    for e in events:
+        if not isinstance(e, dict):
+            continue
+        if e.get("ph") == "M" and e.get("name") == "process_name":
+            pid_names[e.get("pid")] = e.get("args", {}).get("name", "")
+        elif e.get("ph") == "X":
+            pid = e.get("pid")
+            spans[pid][e.get("name", "?")] += e.get("dur", 0.0) * 1e-6
+            counts[pid] += 1
+            t_max = max(t_max, (e.get("ts", 0.0)
+                                + e.get("dur", 0.0)) * 1e-6)
+        elif e.get("ph") in ("i", "I"):
+            instants[e.get("pid")] += 1
+    clock = md.get("clock", {})
+    print(f"timeline: {path} ({md.get('schema', 'unknown schema')})")
+    print(f"  {md.get('nparts', len(spans))} part(s), "
+          f"{md.get('nranks', 1)} rank(s), span {t_max:.3f} s, "
+          f"clock max skew {clock.get('max_skew_s', 0.0):.6f} s"
+          + (" (aligned)" if clock.get("aligned") else ""))
+    for pid in sorted(spans):
+        label = pid_names.get(pid, f"pid {pid}")
+        body = ", ".join(f"{name} {secs:.3f}s"
+                         for name, secs in sorted(spans[pid].items()))
+        pins = (f", {instants[pid]} event pin(s)"
+                if instants.get(pid) else "")
+        print(f"  {label}: {counts[pid]} span(s): {body}{pins}")
+    return 0
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        description="summarise a --trace capture dir or a --timeline "
+                    "file")
+    ap.add_argument("path", help="jax.profiler capture directory, or "
+                                 "Chrome trace-event timeline file")
+    args = ap.parse_args(argv)
+    if os.path.isdir(args.path):
+        return report_capture(args.path)
+    try:
+        return report_timeline(args.path)
+    except (OSError, ValueError) as e:
+        print(f"trace_report: {args.path}: {e}", file=sys.stderr)
+        return 1
+
+
+if __name__ == "__main__":
+    try:
+        sys.exit(main())
+    except BrokenPipeError:
+        # stdout consumer (head, grep -m) closed early -- the cli.py
+        # SIGPIPE recipe: point the fd at devnull so the interpreter's
+        # exit flush cannot print a traceback after a clean summary
+        os.dup2(os.open(os.devnull, os.O_WRONLY), sys.stdout.fileno())
+        sys.exit(0)

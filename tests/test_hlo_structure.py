@@ -278,6 +278,64 @@ def test_metrics_layer_leaves_programs_byte_identical(prob):
             metrics.disarm()
 
 
+def test_tracing_layer_leaves_programs_byte_identical(prob):
+    """The timeline-tracing tier is host-side bookkeeping only: arming
+    the span recorder, recording phase spans / instants, and solving
+    under it must leave the lowered solve programs byte-identical,
+    single-chip and distributed (the metrics-layer disarmament
+    contract, extended to PR 8's layer)."""
+    from acg_tpu import tracing
+    from acg_tpu.io.generators import poisson2d_coo as _p2
+    from acg_tpu.ops.spmv import device_matrix_from_csr
+    from acg_tpu.solvers.jax_cg import JaxCGSolver
+    from acg_tpu.solvers.stats import StoppingCriteria
+
+    r, c, v, N = _p2(12)
+    csr = SymCsrMatrix.from_coo(N, r, c, v).to_csr()
+    b1 = np.ones(N)
+    s1 = JaxCGSolver(device_matrix_from_csr(csr, dtype=jnp.float64),
+                     kernels="xla")
+    s2 = DistCGSolver(prob)
+    b2 = np.ones(prob.n)
+    before1 = s1.lower_solve(b1).as_text()
+    before2 = s2.lower_solve(b2).as_text()
+    try:
+        tracing.arm()
+        s1.solve(b1, criteria=StoppingCriteria(maxits=10),
+                 raise_on_divergence=False)
+        s2.solve(b2, criteria=StoppingCriteria(maxits=10),
+                 raise_on_divergence=False)
+        assert tracing.nspans() > 0  # the hooks DID record
+        assert s1.lower_solve(b1).as_text() == before1
+        assert s2.lower_solve(b2).as_text() == before2
+    finally:
+        tracing.disarm()
+
+
+def test_tracing_section_appends_only():
+    """Like costmodel:/soak:/ckpt:, the tracing: section appends
+    strictly after every existing section -- a report without it is a
+    byte-prefix of one with it, so pre-/7 consumers see the exact
+    historical block."""
+    from acg_tpu.solvers.stats import SolverStats
+
+    st = SolverStats(unknowns=7)
+    st.timings["solve"] = 0.25
+    st.ckpt.update({"every": 8})
+    base = st.fwrite()
+    st.tracing.update({"available": True,
+                       "op_seconds": {"dot": 0.01},
+                       "overlap_efficiency": 0.75,
+                       "timeline": {"nspans": 5, "nparts": 2}})
+    txt = st.fwrite()
+    assert txt.startswith(base)
+    tail = txt[len(base):]
+    assert tail.index("tracing:") >= 0
+    assert base.index("ckpt:") < len(base)  # tracing: renders after it
+    d = st.to_dict()
+    assert d["tracing"]["timeline"]["nparts"] == 2
+
+
 def test_soak_section_appends_only():
     """Like costmodel:/memory:, the soak: section appends strictly
     after the reference-format block -- a report without it is a

@@ -1,0 +1,633 @@
+"""Timeline-tracing subsystem tests (acg_tpu.tracing): the span
+recorder, the hoisted profiler context manager, capture analysis with
+graceful degradation, the Chrome trace-event exporter + clock
+alignment, the --trace/--timeline CLI paths end-to-end on the CPU
+backend, and the validator/report/plot tooling."""
+
+import gzip
+import json
+import os
+import subprocess
+import sys
+
+import pytest
+
+from acg_tpu import tracing
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+ENV_KEYS = {"JAX_PLATFORMS": "cpu",
+            "XLA_FLAGS": "--xla_force_host_platform_device_count=8"}
+
+
+def run_cli(module, argv, **kw):
+    env = dict(os.environ)
+    env.update(ENV_KEYS)
+    kw.setdefault("timeout", 600)
+    return subprocess.run([sys.executable, "-m", module, *argv],
+                          capture_output=True, text=True, env=env, **kw)
+
+
+def run_script(name, argv, **kw):
+    kw.setdefault("timeout", 300)
+    env = dict(os.environ)
+    env.update(ENV_KEYS)
+    return subprocess.run(
+        [sys.executable, os.path.join(REPO, "scripts", name), *argv],
+        capture_output=True, text=True, env=env, **kw)
+
+
+@pytest.fixture
+def recorder():
+    """Armed span recorder, disarmed (and cleared) afterwards."""
+    tracing.arm()
+    yield tracing
+    tracing.disarm()
+
+
+# -- span recorder -------------------------------------------------------
+
+def test_recorder_disarmed_is_noop():
+    assert not tracing.armed()
+    tracing.record_span("x", 0.0, 1.0)
+    tracing.record_phase_span("solve", 0.5)
+    tracing.record_instant("breakdown", detail="d")
+    assert tracing.nspans() == 0
+
+
+def test_recorder_records_and_clears(recorder):
+    tracing.record_span("solve", 10.0, 11.0, cat="phase")
+    tracing.record_span("chunk k0..8", 10.2, 10.4, cat="chunk",
+                        k_offset=0, iterations=8)
+    tracing.record_instant("restart", detail="it 5")
+    assert tracing.nspans() == 3
+    p = tracing.local_payload(parts=[0, 1])
+    assert p["parts"] == [0, 1]
+    assert [s["name"] for s in p["spans"]] == ["solve", "chunk k0..8"]
+    assert p["spans"][1]["args"] == {"k_offset": 0, "iterations": 8}
+    assert p["instants"][0]["name"] == "restart"
+    tracing.disarm()
+    assert tracing.nspans() == 0  # disarm clears
+
+
+def test_phase_span_end_is_now(recorder):
+    import time
+
+    t_before = time.time()
+    tracing.record_phase_span("ingest", 2.0)
+    s = tracing.local_payload()["spans"][0]
+    assert s["t1"] >= t_before
+    assert s["t1"] - s["t0"] == pytest.approx(2.0, abs=0.1)
+
+
+# -- clock alignment -----------------------------------------------------
+
+def test_align_payloads_removes_negative_skew():
+    """Two ranks whose clocks disagree by 3 s: after the barrier-stamp
+    alignment both barrier stamps are EQUAL (no negative inter-rank
+    skew) and the laggard's spans shifted forward, never backward."""
+    mk = lambda rank, tb, t0: {
+        "process": rank, "parts": [rank], "t_barrier": tb,
+        "spans": [{"name": "solve", "t0": t0, "t1": t0 + 1.0,
+                   "cat": "phase"}],
+        "instants": [{"name": "e", "t": t0 + 0.5}]}
+    fast = mk(0, 103.0, 100.0)   # clock runs 3 s ahead
+    slow = mk(1, 100.0, 97.0)
+    info = tracing.align_payloads([fast, slow])
+    assert info["aligned"] and info["max_skew_s"] == pytest.approx(3.0)
+    assert fast["t_barrier"] == slow["t_barrier"] == 103.0
+    # the slow clock's spans moved FORWARD by the offset
+    assert slow["spans"][0]["t0"] == pytest.approx(100.0)
+    assert slow["instants"][0]["t"] == pytest.approx(100.5)
+    assert slow["clock_offset_s"] == pytest.approx(3.0)
+    # the reference rank is untouched
+    assert fast["spans"][0]["t0"] == pytest.approx(100.0)
+
+
+def test_gather_timeline_single_process(recorder):
+    tracing.record_span("solve", 1.0, 2.0)
+    got = tracing.gather_timeline(parts=[0, 1, 2])
+    assert got is not None
+    payloads, clock = got
+    assert len(payloads) == 1 and clock["ranks"] == 1
+    assert payloads[0]["parts"] == [0, 1, 2]
+
+
+# -- Chrome trace export + validator ------------------------------------
+
+def _payload(rank, parts, spans, instants=()):
+    return {"process": rank, "parts": parts, "t_barrier": 0.0,
+            "spans": list(spans), "instants": list(instants)}
+
+
+def test_export_one_pid_per_part(tmp_path):
+    out = tmp_path / "tl.json"
+    spans = [{"name": "ingest", "t0": 1.0, "t1": 1.5, "cat": "phase"},
+             {"name": "solve", "t0": 1.5, "t1": 3.0, "cat": "phase"},
+             {"name": "chunk k0..4", "t0": 1.6, "t1": 2.0,
+              "cat": "chunk"}]
+    summary = tracing.export_chrome_trace(
+        out, [_payload(0, [0, 1, 2, 3], spans,
+                       [{"name": "restart", "t": 2.5,
+                         "detail": "it 3"}])], nparts=4)
+    assert summary["nparts"] == 4
+    assert summary["nspans"] == 3 * 4  # controller spans replicated
+    doc = json.loads(out.read_text())
+    xs = [e for e in doc["traceEvents"] if e["ph"] == "X"]
+    assert {e["pid"] for e in xs} == {1, 2, 3, 4}
+    # chunk spans land on their own track, instants on the events one
+    assert {e["tid"] for e in xs if e["cat"] == "chunk"} == {2}
+    pins = [e for e in doc["traceEvents"] if e["ph"] == "i"]
+    assert pins and pins[0]["args"]["detail"] == "it 3"
+    # per-track monotone ts (the exporter sorts)
+    per_track = {}
+    for e in xs:
+        key = (e["pid"], e["tid"])
+        assert e["ts"] >= per_track.get(key, -1.0)
+        per_track[key] = e["ts"]
+
+
+def test_export_part_scoped_span_stays_on_its_pid(tmp_path):
+    out = tmp_path / "tl.json"
+    spans = [{"name": "solve", "t0": 0.0, "t1": 1.0, "cat": "phase"},
+             {"name": "hot", "t0": 0.2, "t1": 0.4, "cat": "phase",
+              "part": 1}]
+    tracing.export_chrome_trace(out, [_payload(0, [0, 1], spans)],
+                                nparts=2)
+    doc = json.loads(out.read_text())
+    hot = [e for e in doc["traceEvents"]
+           if e.get("ph") == "X" and e["name"] == "hot"]
+    assert len(hot) == 1 and hot[0]["pid"] == 2
+
+
+def test_check_timeline_validator(tmp_path):
+    out = tmp_path / "tl.json"
+    spans = [{"name": n, "t0": float(i), "t1": i + 1.0, "cat": "phase"}
+             for i, n in enumerate(("ingest", "partition", "compile",
+                                    "solve"))]
+    tracing.export_chrome_trace(out, [_payload(0, [0, 1], spans)],
+                                nparts=2)
+    r = run_script("check_timeline.py",
+                   [str(out), "--parts", "2", "--require-span", "solve"])
+    assert r.returncode == 0, r.stderr
+    assert "OK" in r.stdout
+    # wrong part count refuses
+    r = run_script("check_timeline.py", [str(out), "--parts", "3"])
+    assert r.returncode == 1
+    assert "expected spans on exactly 3 pids" in r.stderr
+    # missing required span refuses
+    r = run_script("check_timeline.py",
+                   [str(out), "--require-span", "ckpt"])
+    assert r.returncode == 1
+    # corrupt ts refuses (non-monotone injected by hand)
+    doc = json.loads(out.read_text())
+    xs = [e for e in doc["traceEvents"] if e["ph"] == "X"]
+    xs[-1]["ts"] = -5.0
+    bad = tmp_path / "bad.json"
+    bad.write_text(json.dumps(doc))
+    r = run_script("check_timeline.py", [str(bad)])
+    assert r.returncode == 1
+    assert "negative ts" in r.stderr or "non-monotone" in r.stderr
+
+
+# -- capture analysis ----------------------------------------------------
+
+def _write_capture(root, events, host="vm"):
+    d = root / "plugins" / "profile" / "2026_01_01_00_00_00"
+    d.mkdir(parents=True, exist_ok=True)
+    doc = {"displayTimeUnit": "ns", "metadata": {},
+           "traceEvents": events}
+    with gzip.open(d / f"{host}.trace.json.gz", "wt") as f:
+        json.dump(doc, f)
+    return d
+
+
+def test_analyze_trace_missing_dir(tmp_path):
+    an = tracing.analyze_trace(tmp_path / "nope")
+    assert an["available"] is False
+    assert "no profiler capture" in an["why"]
+
+
+def test_analyze_trace_xplane_only_degrades(tmp_path):
+    """An xplane-only capture (the schema we deliberately do not parse)
+    degrades to a self-describing record instead of raising."""
+    d = tmp_path / "plugins" / "profile" / "r"
+    d.mkdir(parents=True)
+    (d / "vm.xplane.pb").write_bytes(b"\x00proto")
+    an = tracing.analyze_trace(tmp_path)
+    assert an["available"] is False
+    assert "xplane" in an["why"]
+    assert an["xplane_files"] == 1
+
+
+def test_analyze_trace_corrupt_json_degrades(tmp_path):
+    d = tmp_path / "plugins" / "profile" / "r"
+    d.mkdir(parents=True)
+    with gzip.open(d / "vm.trace.json.gz", "wt") as f:
+        f.write("{torn")
+    an = tracing.analyze_trace(tmp_path)
+    assert an["available"] is False
+
+
+def test_analyze_trace_classifies_and_scores_overlap(tmp_path):
+    """Synthetic TPU-shaped capture: HLO op instances classify into
+    op classes, compile-pass names do NOT, and the overlap score is
+    exposed/total over the interval algebra (here: 2 s of all-reduce,
+    1 s of it under fusion compute -> efficiency 0.5)."""
+    us = 1e6
+    events = [
+        {"ph": "X", "pid": 1, "tid": 2, "name": "fusion.3",
+         "ts": 0.0, "dur": 1.0 * us},
+        {"ph": "X", "pid": 1, "tid": 3, "name": "all-reduce.1",
+         "ts": 0.5 * us, "dur": 2.0 * us},
+        {"ph": "X", "pid": 1, "tid": 2, "name": "dot.7",
+         "ts": 4.0 * us, "dur": 0.25 * us},
+        {"ph": "X", "pid": 1, "tid": 2, "name": "collective-permute.2",
+         "ts": 4.0 * us, "dur": 0.25 * us},
+        # the traps: pass names and python frames must NOT classify
+        {"ph": "X", "pid": 1, "tid": 9,
+         "name": "batch-dot-simplification", "ts": 0.0, "dur": 9 * us},
+        {"ph": "X", "pid": 1, "tid": 9, "name": "fusion",
+         "ts": 0.0, "dur": 9 * us},
+        {"ph": "X", "pid": 1, "tid": 9, "name": "$builtins isinstance",
+         "ts": 0.0, "dur": 9 * us},
+        # a phase bracket (the acg:* annotation, prefix stripped by the
+        # profiler on some backends)
+        {"ph": "X", "pid": 1, "tid": 1, "name": "solve",
+         "ts": 0.0, "dur": 5.0 * us},
+    ]
+    _write_capture(tmp_path, events)
+    an = tracing.analyze_trace(tmp_path)
+    assert an["available"] is True
+    ops = an["op_seconds"]
+    assert ops["fusion"] == pytest.approx(1.0)
+    assert ops["allreduce"] == pytest.approx(2.0)
+    assert ops["dot"] == pytest.approx(0.25)
+    assert ops["halo"] == pytest.approx(0.25)
+    assert "program" not in ops  # no pjit wrappers in this capture
+    assert an["collective_seconds"] == pytest.approx(2.25)
+    # all-reduce [0.5, 2.5] overlaps fusion [0, 1] for 0.5 s; the
+    # permute [4, 4.25] is fully under dot [4, 4.25] -> exposed 1.5
+    assert an["exposed_collective_seconds"] == pytest.approx(1.5)
+    assert an["overlap_efficiency"] == pytest.approx(1 - 1.5 / 2.25,
+                                                     abs=1e-5)
+    assert an["phase_seconds"]["solve"] == pytest.approx(5.0)
+    # the solve bracket [0, 5] windows the per-solve attribution: the
+    # all-reduce/fusion midpoints fall inside, the dot/permute at
+    # t=4..4.25 too -- everything here ran inside the one timed solve
+    assert an["solve_windows"] == 1
+    assert an["op_seconds_in_solve"]["allreduce"] == pytest.approx(2.0)
+    assert an["collective_seconds_in_solve"] == pytest.approx(2.25)
+
+
+def test_analyze_trace_overlap_is_per_file(tmp_path):
+    """Interval algebra must stay within one capture file: each host
+    has its own profiler timebase, and host1's compute must not "hide"
+    host0's fully-exposed allreduce at the same nominal ts."""
+    us = 1e6
+    _write_capture(tmp_path, [
+        {"ph": "X", "pid": 1, "tid": 1, "name": "all-reduce.1",
+         "ts": 0.0, "dur": 1.0 * us}], host="h0")
+    _write_capture(tmp_path, [
+        {"ph": "X", "pid": 1, "tid": 1, "name": "fusion.1",
+         "ts": 0.0, "dur": 1.0 * us}], host="h1")
+    an = tracing.analyze_trace(tmp_path)
+    assert an["available"]
+    assert an["exposed_collective_seconds"] == pytest.approx(1.0)
+    assert an["overlap_efficiency"] == pytest.approx(0.0)
+
+
+def test_analyze_trace_straggler_two_ranks(tmp_path):
+    """The TRUE median (np.median convention): across exactly 2 hosts
+    at 1.0 s / 2.0 s the median is 1.5 and the slow host IS a
+    straggler -- the same verdict telemetry.aggregate_ranks gives."""
+    us = 1e6
+    for host, secs in (("h0", 1.0), ("h1", 2.0)):
+        _write_capture(tmp_path, [
+            {"ph": "X", "pid": 1, "tid": 1, "name": "solve",
+             "ts": 0.0, "dur": secs * us}], host=host)
+    an = tracing.analyze_trace(tmp_path)
+    strag = an["straggler"]
+    assert strag is not None and strag["rank"] == "h1"
+    assert strag["ratio_to_median"] == pytest.approx(2.0 / 1.5,
+                                                     rel=1e-3)
+
+
+def test_analyze_trace_straggler_across_ranks(tmp_path):
+    """Per-host trace files = ranks; a rank whose solve bracket exceeds
+    STRAGGLER_RATIO x median gets the callout."""
+    us = 1e6
+    for host, secs in (("h0", 1.0), ("h1", 1.1), ("h2", 2.0)):
+        _write_capture(tmp_path, [
+            {"ph": "X", "pid": 1, "tid": 1, "name": "acg:solve",
+             "ts": 0.0, "dur": secs * us}], host=host)
+    an = tracing.analyze_trace(tmp_path)
+    assert an["available"] and len(an["per_rank"]) == 3
+    strag = an["straggler"]
+    assert strag is not None and strag["rank"] == "h2"
+    assert strag["ratio_to_median"] == pytest.approx(2.0 / 1.1,
+                                                     rel=1e-3)
+
+
+def test_apply_measured_ops_overrides_replay():
+    from acg_tpu.solvers.stats import SolverStats
+
+    st = SolverStats()
+    st.ops["dot"].add(n=10, t=99.0)
+    st.ops["gemv"].add(n=5, t=99.0)
+    an = {"available": True, "solve_windows": 2,
+          "op_seconds": {"dot": 9.0, "gemv": 9.0, "allreduce": 9.0},
+          "op_seconds_in_solve": {"dot": 1.0, "gemv": 0.0,
+                                  "allreduce": 1.0}}
+    filled = tracing.apply_measured_ops(st, an)
+    assert filled == ["dot"]           # gemv 0 s and allreduce n=0 skip
+    # solve-windowed seconds SUMMED over windows (the rows' n/bytes
+    # accumulate across soak repeats too -- the replay tier's
+    # cumulative t = per_call * n convention), never the capture
+    # totals (those include the warmup executions)
+    assert st.ops["dot"].t == 1.0
+    assert st.ops["gemv"].t == 99.0
+    # a capture without solve brackets overwrites nothing
+    st2 = SolverStats()
+    st2.ops["dot"].add(n=10, t=99.0)
+    assert tracing.apply_measured_ops(
+        st2, {"available": True, "solve_windows": 0,
+              "op_seconds": {"dot": 1.0},
+              "op_seconds_in_solve": {}}) == []
+    assert st2.ops["dot"].t == 99.0
+
+
+def test_measured_comm_line_verdicts():
+    line = tracing.measured_comm_line(
+        {"collective_seconds": 1.0}, predicted_comm_s=0.9)
+    assert "ledger consistent" in line
+    line = tracing.measured_comm_line(
+        {"collective_seconds": 10.0}, predicted_comm_s=1.0)
+    assert "underestimates" in line
+    line = tracing.measured_comm_line(
+        {"collective_seconds": 0.0}, predicted_comm_s=1.0)
+    assert "no collective device events" in line
+    # with solve brackets, the verdict uses the WINDOWED collectives:
+    # the capture total includes the warmup solves' (here 2x) which
+    # would spuriously flip an accurate ledger to "underestimates"
+    line = tracing.measured_comm_line(
+        {"solve_windows": 1, "collective_seconds": 2.1,
+         "collective_seconds_in_solve": 1.0}, predicted_comm_s=1.0)
+    assert "ledger consistent" in line and "(solve windows)" in line
+
+
+# -- profiler context manager -------------------------------------------
+
+def test_profiler_trace_none_is_noop():
+    with tracing.profiler_trace(None):
+        pass
+    with tracing.profiler_trace(""):
+        pass
+
+
+def test_profiler_trace_captures(tmp_path):
+    import jax.numpy as jnp
+
+    d = tmp_path / "cap"
+    with tracing.profiler_trace(d):
+        (jnp.ones((64, 64)) @ jnp.ones((64, 64))).block_until_ready()
+    cap = tracing.find_capture(d)
+    assert cap["trace_json"], "profiler wrote no trace.json capture"
+    an = tracing.analyze_trace(d)
+    assert an["available"] is True
+
+
+def test_profiler_trace_failed_start_warns_not_raises(tmp_path, capsys):
+    """A second start while a trace runs raises inside jax; the context
+    manager must degrade to an unprofiled body, and the OUTER capture
+    must still stop cleanly."""
+    ran = False
+    with tracing.profiler_trace(tmp_path / "outer"):
+        with tracing.profiler_trace(tmp_path / "inner"):
+            ran = True
+    assert ran
+    err = capsys.readouterr().err
+    assert "profiler start failed" in err
+    assert tracing.find_capture(tmp_path / "outer")["trace_json"]
+    assert not tracing.find_capture(tmp_path / "inner")["trace_json"]
+
+
+# -- CLI end-to-end ------------------------------------------------------
+
+def test_cli_trace_capture_and_analysis(tmp_path):
+    """--trace end-to-end on the CPU backend: capture dir created,
+    tracing: section lands in the report and the /7 stats twin, and
+    the ops source is marked when a class was measured."""
+    cap = tmp_path / "cap"
+    stats = tmp_path / "st.json"
+    r = run_cli("acg_tpu.cli",
+                ["gen:poisson2d:16", "--comm", "none",
+                 "--max-iterations", "50", "--residual-rtol", "1e-8",
+                 "--warmup", "0", "--quiet", "--trace", str(cap),
+                 "--stats-json", str(stats)])
+    assert r.returncode == 0, r.stderr
+    assert tracing.find_capture(cap)["trace_json"]
+    assert "tracing:" in r.stderr
+    doc = json.loads(stats.read_text())
+    assert doc["schema"] == "acg-tpu-stats/7"
+    tr = doc["stats"]["tracing"]
+    assert tr["available"] is True
+    assert tr["capture_files"] >= 1
+
+
+def test_cli_trace_analysis_degrades_without_capture(tmp_path,
+                                                     monkeypatch):
+    """When the profiler start fails (here: a second trace already
+    running in-process), the solve must still succeed and the section
+    must say why the analysis is unavailable."""
+    import jax
+
+    from acg_tpu import cli
+
+    cap = tmp_path / "cap"
+    jax.profiler.start_trace(str(tmp_path / "hog"))
+    try:
+        rc = cli.main(["gen:poisson2d:12", "--comm", "none",
+                       "--max-iterations", "20", "--residual-rtol",
+                       "1e-6", "--warmup", "0", "--quiet",
+                       "--trace", str(cap)])
+    finally:
+        jax.profiler.stop_trace()
+    assert rc == 0
+    assert not tracing.find_capture(cap)["trace_json"]
+
+
+def test_cli_timeline_8part(tmp_path):
+    """The acceptance path: an 8-part CPU-mesh solve under --timeline
+    emits a validating Chrome trace-event file with one pid per part
+    and spans for ingest/partition/compile/solve."""
+    tl = tmp_path / "tl.json"
+    stats = tmp_path / "st.json"
+    r = run_cli("acg_tpu.cli",
+                ["gen:poisson2d:24", "--nparts", "8",
+                 "--max-iterations", "100", "--residual-rtol", "1e-8",
+                 "--warmup", "1", "--quiet", "--timeline", str(tl),
+                 "--stats-json", str(stats)])
+    assert r.returncode == 0, r.stderr
+    assert "timeline:" in r.stderr
+    doc = json.loads(tl.read_text())
+    assert doc["metadata"]["schema"] == tracing.TIMELINE_SCHEMA
+    xs = [e for e in doc["traceEvents"] if e.get("ph") == "X"]
+    assert {e["pid"] for e in xs} == set(range(1, 9))
+    names = {e["name"] for e in xs}
+    assert {"ingest", "partition", "compile", "solve"} <= names
+    r = run_script("check_timeline.py",
+                   [str(tl), "--parts", "8", "--require-span", "ingest",
+                    "--require-span", "partition", "--require-span",
+                    "compile", "--require-span", "solve"])
+    assert r.returncode == 0, r.stderr
+    twin = json.loads(stats.read_text())
+    assert twin["stats"]["tracing"]["timeline"]["nparts"] == 8
+
+
+def test_cli_timeline_ckpt_chunks(tmp_path):
+    """Checkpoint-armed solves put their chunked-dispatch boundaries on
+    the timeline (cat=chunk, k_offset args)."""
+    tl = tmp_path / "tl.json"
+    r = run_cli("acg_tpu.cli",
+                ["gen:poisson2d:12", "--comm", "none", "--dtype", "f32",
+                 "--max-iterations", "60", "--residual-rtol", "1e-6",
+                 "--warmup", "0", "--quiet", "--ckpt",
+                 str(tmp_path / "ck"), "--ckpt-every", "10",
+                 "--timeline", str(tl)])
+    assert r.returncode == 0, r.stderr
+    doc = json.loads(tl.read_text())
+    chunks = [e for e in doc["traceEvents"]
+              if e.get("ph") == "X" and e.get("cat") == "chunk"
+              and e["name"].startswith("chunk k")]
+    assert chunks, "no chunk spans on the timeline"
+    assert chunks[0]["args"]["k_offset"] == 0
+
+
+def test_cli_timeline_refused_under_explain():
+    r = run_cli("acg_tpu.cli",
+                ["gen:poisson2d:12", "--explain", "--timeline",
+                 "/tmp/never.json"])
+    assert r.returncode != 0
+    assert "--timeline" in r.stderr
+
+
+def test_cli_explain_measured_section(tmp_path):
+    """--explain --trace prints the measured section (per-op-class
+    seconds, overlap score, measured-vs-predicted comm line); without
+    --trace the section is absent and the static verdict unchanged."""
+    r = run_cli("acg_tpu.cli",
+                ["gen:poisson2d:12", "--explain", "--max-iterations",
+                 "16", "--warmup", "0", "--quiet", "--trace",
+                 str(tmp_path / "cap")])
+    assert r.returncode == 0, r.stderr
+    assert "== explain: measured (profiler trace) ==" in r.stderr
+    assert ("overlap efficiency" in r.stderr
+            or "no usable capture" in r.stderr)
+    assert "comm: predicted" in r.stderr or "no usable" in r.stderr
+    r2 = run_cli("acg_tpu.cli",
+                 ["gen:poisson2d:12", "--explain", "--max-iterations",
+                  "16", "--warmup", "0", "--quiet"])
+    assert r2.returncode == 0, r2.stderr
+    assert "measured (profiler trace)" not in r2.stderr
+
+
+def test_cli_buildinfo_advertises_tracing():
+    r = run_cli("acg_tpu.cli", ["--buildinfo"])
+    assert r.returncode == 0
+    for token in ("timeline tracing", "--timeline", "acg_trace_",
+                  "acg-tpu-stats/7"):
+        assert token in r.stdout, token
+
+
+# -- tooling -------------------------------------------------------------
+
+def test_trace_report_on_capture_and_timeline(tmp_path):
+    us = 1e6
+    _write_capture(tmp_path / "cap", [
+        {"ph": "X", "pid": 1, "tid": 1, "name": "dot.1", "ts": 0.0,
+         "dur": 1.0 * us}])
+    r = run_script("trace_report.py", [str(tmp_path / "cap")])
+    assert r.returncode == 0, r.stderr
+    assert "dot" in r.stdout
+    tl = tmp_path / "tl.json"
+    tracing.export_chrome_trace(
+        tl, [_payload(0, [0, 1],
+                      [{"name": "solve", "t0": 0.0, "t1": 1.0,
+                        "cat": "phase"}])], nparts=2)
+    r = run_script("trace_report.py", [str(tl)])
+    assert r.returncode == 0, r.stderr
+    assert "2 part(s)" in r.stdout and "solve" in r.stdout
+    r = run_script("trace_report.py", [str(tmp_path / "missing.json")])
+    assert r.returncode == 1
+
+
+def test_plot_convergence_timeline_gantt(tmp_path):
+    tl = tmp_path / "tl.json"
+    spans = [{"name": "ingest", "t0": 0.0, "t1": 0.2, "cat": "phase"},
+             {"name": "solve", "t0": 0.2, "t1": 1.0, "cat": "phase"}]
+    tracing.export_chrome_trace(tl, [_payload(0, [0], spans)], nparts=1)
+    r = run_script("plot_convergence.py", [str(tl), "--ascii"])
+    assert r.returncode == 0, r.stderr
+    assert "ingest" in r.stdout and "#" in r.stdout
+    # and next to a residual plot (mixed inputs classify independently)
+    from acg_tpu.telemetry import EagerTraceRecorder
+
+    rec = EagerTraceRecorder(16)
+    for k in range(8):
+        rec.record(10.0 ** -k, 1.0, 0.5, 2.0)
+    conv = tmp_path / "conv.jsonl"
+    rec.finish().write_jsonl(str(conv))
+    r = run_script("plot_convergence.py",
+                   [str(conv), str(tl), "--ascii"])
+    assert r.returncode == 0, r.stderr
+    assert "rnrm2" in r.stdout and "spans" in r.stdout
+
+
+def test_old_schema_docs_still_accepted(tmp_path):
+    """Append-only contract: a /6 document (no tracing key) still loads
+    through bench_diff's case reader and plot_convergence."""
+    doc = {"schema": "acg-tpu-stats/6",
+           "manifest": {"schema": "acg-tpu-stats/6", "metric": "case-a",
+                        "matrix": "gen:poisson2d:16", "dtype": "f64"},
+           "stats": {"unknowns": 256, "niterations": 10,
+                     "tsolve": 0.5, "converged": True,
+                     "soak": {"nsolves": 3,
+                              "latency": {"p50": 0.1, "p95": 0.2,
+                                          "p99": 0.3},
+                              "iterations": {"p50": 10},
+                              "drift": {"tripped": False}},
+                     "events": []}}
+    p = tmp_path / "old.json"
+    p.write_text(json.dumps(doc))
+    from acg_tpu.perfmodel import load_cases
+
+    cases = load_cases(str(p))
+    assert cases, "a /6 capture must still produce comparable cases"
+    r = run_script("plot_convergence.py", [str(p), "--ascii"])
+    assert r.returncode == 0, r.stderr
+
+
+def test_metrics_trace_families(recorder):
+    from acg_tpu import metrics
+
+    was = metrics.armed()
+    try:
+        metrics.arm()
+        # the registry is process-wide and other tests feed it too:
+        # assert the DELTA, not an absolute count
+        v0 = metrics.TRACE_SPANS.labels(cat="phase").value
+        tracing.record_span("solve", 0.0, 1.0)
+        tracing.record_instant("drift")
+        metrics.record_trace_analysis(
+            {"available": True, "op_seconds": {"dot": 0.25},
+             "overlap_efficiency": 0.8,
+             "exposed_collective_seconds": 0.1})
+        assert metrics.TRACE_SPANS.labels(cat="phase").value == v0 + 1
+        text = metrics.expose()
+    finally:
+        if not was:
+            metrics.disarm()
+    assert 'acg_trace_spans_total{cat="phase"}' in text
+    assert 'acg_trace_op_seconds{op="dot"} 0.25' in text
+    assert "acg_trace_overlap_efficiency 0.8" in text
