@@ -186,6 +186,13 @@ def save_snapshot(path, meta: dict, arrays: dict) -> int:
                 os.unlink(tmp)
             except OSError:
                 pass
+    # live-observatory tier: a committed snapshot is status evidence
+    # (the operator's "how stale would a resume be" question; no-op
+    # disarmed)
+    from acg_tpu import observatory
+    observatory.note_event(
+        "snapshot", f"seq {meta.get('seq', '?')} committed at "
+                    f"iteration {meta.get('iteration', '?')}")
     return len(MAGIC) + len(preamble) + 1 + len(header) + len(payload)
 
 

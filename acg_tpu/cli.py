@@ -408,6 +408,51 @@ def make_parser() -> argparse.ArgumentParser:
                    help="serve GET /metrics (Prometheus text format) "
                         "on PORT from a daemon thread for the "
                         "process's lifetime (default: off)")
+    p.add_argument("--status-port", type=int, default=0, metavar="PORT",
+                   help="live in-flight status: serve GET /status (an "
+                        "acg-tpu-status/1 JSON document: phase, "
+                        "iteration, residual trail, iterations/sec, "
+                        "ETA from the Lanczos kappa CG-bound falling "
+                        "back to the measured rate, per-part "
+                        "imbalance, last events, soak progress) on "
+                        "PORT from a daemon thread; the same port "
+                        "also answers /metrics, so one endpoint can "
+                        "serve both planes (default: off)")
+    p.add_argument("--status-file", metavar="FILE", default=None,
+                   help="write the acg-tpu-status/1 document to FILE "
+                        "(atomic rename -- a poller never reads torn "
+                        "JSON), refreshed on every status update at "
+                        "most every 0.2 s and finalised on exit -- "
+                        "the file-based twin of --status-port for "
+                        "pods without a reachable port")
+    p.add_argument("--history", metavar="DIR", default=None,
+                   help="run-history ledger: append this solve's "
+                        "--stats-json document to a date-partitioned "
+                        "JSONL ledger under DIR (one acg-tpu-history/1 "
+                        "index line per solve -- matrix, tier, "
+                        "precond, dtype, latency, iterations, schema "
+                        "-- carrying the full document).  Render "
+                        "trends with scripts/history_report.py; "
+                        "bench_diff.py/check_regression accept DIR as "
+                        "a baseline (--baseline-from-history), "
+                        "picking the best USABLE prior capture and "
+                        "skipping bench_backend_unavailable entries")
+    p.add_argument("--slo", metavar="SPEC", default=None,
+                   help="declare per-solve service-level objectives "
+                        "as latency=SECONDS,iters=N,gap=G (any "
+                        "subset): targets land on the metrics "
+                        "registry as acg_slo_target, every completed "
+                        "solve is judged (breaches bump "
+                        "acg_slo_breaches_total, refresh the "
+                        "cumulative acg_slo_burn_ratio error-budget "
+                        "gauge, and emit slo-breach events into the "
+                        "telemetry/timeline stream), and the verdict "
+                        "lands in an 'slo:' stats section")
+    p.add_argument("--fail-on-slo", action="store_true",
+                   help="with --slo: exit 8 when any declared "
+                        "objective breached during the run (the "
+                        "--fail-on-drift design; works for single "
+                        "solves and --soak runs alike)")
     p.add_argument("--explain", action="store_true",
                    help="performance-observability report instead of a "
                         "normal solve: lower + compile the classic, "
@@ -565,6 +610,21 @@ def _buildinfo(out) -> int:
          f"(hard os._exit between snapshot commits; refuses without "
          f"--ckpt); 'ckpt' stats section + acg_ckpt_*/acg_abft_* "
          f"metrics; schema {STATS_SCHEMA}"),
+        ("live observatory", f"--status-port PORT / --status-file FILE "
+         f"(in-flight acg-tpu-status/1 JSON: phase, iteration, "
+         f"residual trail, iterations/sec, ETA from the Lanczos kappa "
+         f"CG-bound falling back to the measured rate, per-part "
+         f"imbalance, last events, soak progress; the port also "
+         f"answers /metrics), --history DIR (date-partitioned "
+         f"acg-tpu-history/1 run ledger; scripts/history_report.py "
+         f"trends, bench_diff.py --baseline-from-history picks the "
+         f"best USABLE capture and refuses an all-unavailable "
+         f"ledger), --slo latency=S,iters=N,gap=G + --fail-on-slo "
+         f"(acg_slo_target/acg_slo_breaches_total/acg_slo_burn_ratio "
+         f"families, slo-breach events, exit 8); --progress "
+         f"heartbeats carry the same it/s + ETA on every tier incl. "
+         f"the host oracle; 'slo' stats section, schema "
+         f"{STATS_SCHEMA}"),
     ]
     for k, v in rows:
         out.write(f"{k}: {v}\n")
@@ -777,17 +837,35 @@ def _run_solve(args, solver, b, *, x0=None, criteria=None, warmup=None,
     them all.  The soak report lands on ``solver.stats.soak`` (the
     ``soak:`` stats section and its ``--stats-json`` twin) and on
     ``args._soak_report`` for the ``--fail-on-drift`` exit gate."""
+    from acg_tpu import observatory
+
+    # live-observatory tier: the status document's run header +
+    # per-part imbalance.  Recorded HERE so every pipeline that
+    # funnels through _run_solve (replicated read, gen-direct,
+    # sharded-gen) gets the header; the distributed-read pipeline,
+    # which dispatches its own solve, records its own
+    prob = getattr(_inner_solver(solver), "problem", None)
+    observatory.begin_solve(
+        args.solver, criteria.maxits if criteria is not None else 0,
+        rtol=args.residual_rtol, atol=args.residual_atol,
+        matrix=args.A,
+        nparts=int(getattr(prob, "nparts", 0) or args.nparts or 1))
+    observatory.note_solver(solver)
     # the spectrum attach runs in a finally: a not-converged or
     # broken-down exit still gets its kappa estimate next to the
-    # health: section -- that is exactly when it matters
+    # health: section -- that is exactly when it matters.  The SLO
+    # verdict attaches there too (a breach on a failed solve is still
+    # a breach)
     if not getattr(args, "soak", 0):
         if warmup is not None:
             solve_kwargs["warmup"] = warmup
         try:
-            return solver.solve(b, x0=x0, criteria=criteria,
-                                **solve_kwargs)
+            x = solver.solve(b, x0=x0, criteria=criteria,
+                             **solve_kwargs)
         finally:
             _attach_health_spectrum(args, solver)
+            _observe_slo(args, solver)
+        return x
     from acg_tpu.soak import run_soak
 
     try:
@@ -801,8 +879,26 @@ def _run_solve(args, solver, b, *, x0=None, criteria=None, warmup=None,
                             else 0))
     finally:
         _attach_health_spectrum(args, solver)
+        # the soak driver already judged every solve; only the stats
+        # section attach is left
+        observatory.attach_slo(solver.stats)
     args._soak_report = report
     return x
+
+
+def _observe_slo(args, solver) -> None:
+    """Judge a completed single (non-soak) solve against the declared
+    --slo objectives and attach the verdict to the stats block; the
+    soak driver owns the per-solve judging on soak runs."""
+    from acg_tpu import observatory
+    if observatory.installed_slo() is None:
+        return
+    st = solver.stats
+    lat = st.timings.get("solve", st.tsolve)
+    observatory.slo_observe(
+        st, latency=lat, iterations=int(st.niterations),
+        gap=(st.health or {}).get("gap_last"))
+    observatory.attach_slo(st)
 
 
 def _attach_health_spectrum(args, solver) -> None:
@@ -962,7 +1058,8 @@ def _emit_telemetry(args, solver, *, matrix_id, nparts=1,
     one-sided failure must not enter a gather its peers may never
     reach (the erragree mismatched-collective rationale)."""
     if not (args.convergence_log or args.stats_json
-            or getattr(args, "timeline", None)):
+            or getattr(args, "timeline", None)
+            or getattr(args, "history", None)):
         return
     from acg_tpu import telemetry
     from acg_tpu.parallel.multihost import is_primary
@@ -985,7 +1082,7 @@ def _emit_telemetry(args, solver, *, matrix_id, nparts=1,
                     f"in-loop telemetry hooks)\n")
         except OSError as e:
             sys.stderr.write(f"acg-tpu: {args.convergence_log}: {e}\n")
-    if not args.stats_json:
+    if not (args.stats_json or getattr(args, "history", None)):
         return
     ranks = None
     payloads = None
@@ -1050,12 +1147,32 @@ def _emit_telemetry(args, solver, *, matrix_id, nparts=1,
             "nmax_ghost": int(prob.halo.nmax_ghost)
             if hasattr(prob.halo, "nmax_ghost") else None,
         }
-    try:
-        telemetry.write_stats_json(args.stats_json, st,
-                                   manifest=telemetry.run_manifest(**extra),
-                                   ranks=ranks)
-    except OSError as e:
-        sys.stderr.write(f"acg-tpu: {args.stats_json}: {e}\n")
+    doc = None
+    if args.stats_json:
+        try:
+            doc = telemetry.write_stats_json(
+                args.stats_json, st,
+                manifest=telemetry.run_manifest(**extra), ranks=ranks)
+        except OSError as e:
+            sys.stderr.write(f"acg-tpu: {args.stats_json}: {e}\n")
+    # run-history ledger (acg_tpu.observatory, --history DIR): the same
+    # document JSONL-appends to the date-partitioned ledger under one
+    # index line -- error paths append too (a failed run is history
+    # evidence), guarded once-only like the timeline
+    if getattr(args, "history", None) \
+            and not getattr(args, "_history_written", False):
+        args._history_written = True
+        from acg_tpu import observatory
+        if doc is None:
+            doc = telemetry.stats_document(
+                st, manifest=telemetry.run_manifest(**extra),
+                ranks=ranks)
+        try:
+            path = observatory.history_append(args.history, doc)
+            sys.stderr.write(f"acg-tpu: history: appended to {path}\n")
+        except OSError as e:
+            sys.stderr.write(f"acg-tpu: --history {args.history}: "
+                             f"{e}\n")
 
 
 def _solve_distributed_read(args, jax, jnp, dtype, vec_dtype) -> int:
@@ -1256,6 +1373,14 @@ def _solve_distributed_read(args, jax, jnp, dtype, vec_dtype) -> int:
                                nnz=prob.nnz_total,
                                inner_rtol=args.refine_rtol,
                                inner_maxits=args.refine_inner_maxits)
+    # live-observatory run header (this pipeline dispatches its own
+    # solve rather than funnelling through _run_solve)
+    from acg_tpu import observatory
+    observatory.begin_solve(args.solver, criteria.maxits,
+                            rtol=args.residual_rtol,
+                            atol=args.residual_atol, matrix=args.A,
+                            nparts=int(prob.nparts))
+    observatory.note_solver(solver)
     t0 = time.perf_counter()
     from acg_tpu.tracing import profiler_trace
     with profiler_trace(args.trace):
@@ -1804,6 +1929,11 @@ def main(argv=None) -> int:
             # drifted is a service-level failure (exit 7)
             from acg_tpu.soak import gate_exit_code
             rc = gate_exit_code(args._soak_report, args.fail_on_drift)
+        if rc == 0 and args.fail_on_slo:
+            # the --fail-on-slo gate: a clean run that breached a
+            # declared objective is a service-level failure (exit 8)
+            from acg_tpu import observatory
+            rc = observatory.slo_exit_code(True)
         return rc
     except OSError as e:
         sys.stderr.write(f"acg-tpu: {e}\n")
@@ -1829,6 +1959,13 @@ def main(argv=None) -> int:
             # clear so in-process callers never leak spans across runs
             from acg_tpu import tracing
             tracing.disarm()
+        if getattr(args, "_observatory_armed", False):
+            # final --status-file flush (solve marked over) then disarm
+            # AND clear -- the status recorder and SLO state are
+            # process-wide, scoped to THIS invocation (the tracing
+            # discipline); the gate above already read the verdict
+            from acg_tpu import observatory
+            observatory.shutdown()
         if args.fault_inject:
             # _main exports the spec (env var = how children inherit it)
             # and installs it process-wide; both are scoped to THIS
@@ -1891,6 +2028,13 @@ def _main(args) -> int:
             ("--timeline (the analysis solves are not the pipeline "
              "the timeline describes; --trace works and feeds the "
              "measured verdict)", args.timeline is not None),
+            ("--status-port/--status-file (the analysis solves are "
+             "not the solve a status plane watches)",
+             args.status_port > 0 or args.status_file is not None),
+            ("--history (the ledger records solves, not analysis "
+             "passes)", args.history is not None),
+            ("--slo (objectives judge real solves)",
+             args.slo is not None),
         ] if on]
         if ignored:
             raise SystemExit(
@@ -2057,6 +2201,29 @@ def _main(args) -> int:
                 f"run; use --soak 4 or more")
     if args.metrics_port < 0 or args.metrics_port > 65535:
         raise SystemExit("acg-tpu: --metrics-port must be 0-65535")
+    # live-observatory tier (acg_tpu.observatory): validate + arm
+    # BEFORE anything records (the metrics-tier discipline)
+    from acg_tpu import observatory
+    if args.status_port < 0 or args.status_port > 65535:
+        raise SystemExit("acg-tpu: --status-port must be 0-65535")
+    args._slo = None
+    if args.slo is not None:
+        try:
+            args._slo = observatory.parse_slo(args.slo)
+        except ValueError as e:
+            raise SystemExit(f"acg-tpu: {e}")
+    if args.fail_on_slo and args._slo is None:
+        raise SystemExit("acg-tpu: --fail-on-slo needs --slo SPEC "
+                         "(a gate with no declared objectives could "
+                         "never trip)")
+    if (args._slo is not None and args._slo.gap is not None
+            and not args.audit_every):
+        raise SystemExit("acg-tpu: --slo gap=G judges audit gaps; add "
+                         "--audit-every K (without an audit the "
+                         "objective could never be observed)")
+    if args.history is not None and os.path.isfile(args.history):
+        raise SystemExit(f"acg-tpu: --history {args.history} is a "
+                         f"file; the ledger needs a directory")
     if args.soak:
         unsupported = [flag for flag, on in [
             ("--refine (the outer iteration re-enters solve itself)",
@@ -2072,16 +2239,32 @@ def _main(args) -> int:
         if unsupported:
             raise SystemExit(f"acg-tpu: --soak does not support: "
                              f"{', '.join(unsupported)}")
-    if args.metrics_file or args.metrics_port or args.soak:
+    if (args.metrics_file or args.metrics_port or args.soak
+            or args._slo is not None):
         from acg_tpu import metrics
         metrics.arm()
         args._metrics_armed = True
         if args.metrics_file:
             metrics.install_flush_handlers(args.metrics_file)
-        if args.metrics_port:
+        if args.metrics_port and args.metrics_port != args.status_port:
+            # an equal --status-port serves /metrics itself (one
+            # combined endpoint); starting both would fight for the
+            # bind
             srv = metrics.serve(args.metrics_port)
             _log(args, f"metrics: serving /metrics on port "
                        f"{srv.server_address[1]}")
+    if (args.status_port or args.status_file or args.history
+            or args._slo is not None):
+        observatory.arm()
+        args._observatory_armed = True
+        if args._slo is not None:
+            observatory.install_slo(args._slo)
+        if args.status_file:
+            observatory.set_status_file(args.status_file)
+        if args.status_port:
+            ssrv = observatory.serve_status(args.status_port)
+            _log(args, f"status: serving /status (and /metrics) on "
+                       f"port {ssrv.server_address[1]}")
     # the ring buffer arms only when the JSONL sink will read it
     # (--stats-json alone stays compatible with every solver tier,
     # including replace_every/fused which refuse in-loop telemetry)

@@ -626,8 +626,12 @@ def attach_spectrum(stats, trace, rtol: float,
     if rep is None:
         return None
     stats.health["spectrum"] = rep
-    from acg_tpu import metrics
+    from acg_tpu import metrics, observatory
 
     if rep.get("kappa"):
         metrics.record_health_kappa(rep["kappa"])
+        # live-observatory tier: the kappa CG-bound is the status
+        # endpoint's preferred ETA source (no-op disarmed)
+        observatory.note_kappa(rep["kappa"],
+                               rep.get("predicted_iterations"))
     return rep

@@ -514,6 +514,20 @@ TRACE_OVERLAP = REGISTRY.gauge(
 TRACE_EXPOSED_SECONDS = REGISTRY.gauge(
     "acg_trace_exposed_collective_seconds", "Collective device time "
     "NOT overlapped by compute in the last analyzed capture.")
+# live-observatory tier (acg_tpu.observatory, --slo): declared
+# service-level objectives and their error-budget burn
+SLO_TARGET = REGISTRY.gauge(
+    "acg_slo_target", "Declared per-solve service-level objective "
+    "targets (--slo latency=S,iters=N,gap=G).",
+    labelnames=("objective",))
+SLO_BREACHES = REGISTRY.counter(
+    "acg_slo_breaches_total", "Completed solves that breached a "
+    "declared objective (each breach also emits an slo-breach event).",
+    labelnames=("objective",))
+SLO_BURN = REGISTRY.gauge(
+    "acg_slo_burn_ratio", "Fraction of observed solves breaching each "
+    "declared objective (cumulative error-budget burn; 0 = none, "
+    "1 = every solve).", labelnames=("objective",))
 
 _armed = False
 
@@ -538,7 +552,12 @@ def armed() -> bool:
 
 def record_solve(seconds: float, iterations: int, converged: bool,
                  solver: str = "cg") -> None:
-    """One completed solve (called from the solvers' solve() tails)."""
+    """One completed solve (called from the solvers' solve() tails).
+    Also closes out the live-observatory status document's in-flight
+    solve (its own arm gate; no-op disarmed)."""
+    from acg_tpu import observatory
+    observatory.end_solve(bool(converged), int(iterations),
+                          float(seconds))
     if not _armed:
         return
     SOLVES.labels(solver=solver,
@@ -661,6 +680,23 @@ def record_trace_analysis(analysis: dict) -> None:
         TRACE_OVERLAP.set(float(eff))
         TRACE_EXPOSED_SECONDS.set(
             float(analysis.get("exposed_collective_seconds", 0.0)))
+
+
+def record_slo_target(objective: str, target: float) -> None:
+    """One declared objective's target gauge (observatory.install_slo:
+    a scrape shows what the run promised before the first solve)."""
+    if _armed:
+        SLO_TARGET.labels(objective=str(objective)).set(float(target))
+
+
+def record_slo(objective: str, breached: bool, burn: float) -> None:
+    """One judged objective after a completed solve: the breach counter
+    and the cumulative burn-fraction gauge (observatory.slo_observe)."""
+    if not _armed:
+        return
+    if breached:
+        SLO_BREACHES.labels(objective=str(objective)).inc()
+    SLO_BURN.labels(objective=str(objective)).set(float(burn))
 
 
 def record_comm(ledger: dict, iterations: int) -> None:

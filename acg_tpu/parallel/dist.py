@@ -2364,7 +2364,8 @@ class DistCGSolver:
         iteration-identical to solve()'s (tests/test_checkpoint.py);
         snapshot time is billed to its own ``ckpt`` phase."""
         from acg_tpu import checkpoint as ckpt_mod
-        from acg_tpu import faults, metrics, telemetry, tracing
+        from acg_tpu import faults, metrics, observatory, telemetry, \
+            tracing
         from acg_tpu import health as health_mod
         from acg_tpu._platform import block_until_ready_works, device_sync
         from acg_tpu.solvers.resilience import RecoveryDriver
@@ -2543,6 +2544,13 @@ class DistCGSolver:
                             np.asarray(tbuf), k_chunk,
                             solver=solver_name,
                             offset=consumed - k_chunk)
+                # live-observatory tier: real mid-solve sample from the
+                # per-chunk carry return (no-op disarmed; host-side)
+                observatory.note_chunk(
+                    self._ckpt_tier, consumed, float(res[2]),
+                    abs_tol=abs_tol,
+                    trace=(st.trace if tr else None),
+                    rtol=crit.residual_rtol)
                 if hl and aud is not None:
                     gap_tripped = health_mod.note_audit(
                         st, np.asarray(aud), self.health_spec,

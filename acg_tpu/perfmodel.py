@@ -55,6 +55,7 @@ backend.
 from __future__ import annotations
 
 import json
+import os
 import sys
 import time
 
@@ -930,6 +931,10 @@ def load_cases(path) -> dict:
             # the growth driver's BENCH_r0N.json wrapper: the row it
             # parsed from the run's stdout rides under "parsed"
             obj = obj["parsed"]
+        if isinstance(obj.get("doc"), dict) and "ledger" in obj:
+            # a run-history ledger line (acg_tpu.observatory): the
+            # stats document rides under "doc"
+            obj = obj["doc"]
         c = _doc_case(obj) if "stats" in obj else _row_case(obj)
         if c is not None:
             cases[c[0]] = max(cases.get(c[0], float("-inf")), c[1])
@@ -967,17 +972,50 @@ def compare_cases(old: dict, new: dict, pct: float
     return lines, nreg, ncmp
 
 
+def load_baseline_cases(baseline_path) -> dict | None:
+    """Baseline cases for the regression gate.  A DIRECTORY is a
+    run-history ledger (acg_tpu.observatory, ``--history``): the
+    best-known USABLE value per case across every entry, with
+    ``bench_backend_unavailable`` captures skipped automatically (the
+    BENCH_r05 stale-baseline trap).  Prints the refusal and returns
+    None (exit 2) when the ledger is empty or ALL its entries are
+    unusable -- an all-unavailable history must force a re-baseline,
+    never silently pass."""
+    if not os.path.isdir(baseline_path):
+        return load_cases(baseline_path)
+    from acg_tpu.observatory import load_history_baseline
+    cases, all_unavailable, nentries = \
+        load_history_baseline(baseline_path)
+    if all_unavailable:
+        print(f"bench-diff: every capture in {baseline_path} records "
+              f"{UNAVAILABLE_METRIC} (the backend/tunnel was down "
+              f"for all {nentries} entr{'y' if nentries == 1 else 'ies'}"
+              f"): no usable baseline -- re-baseline before trusting "
+              f"--fail-on-regress", file=sys.stderr)
+        return None
+    if not cases:
+        print(f"bench-diff: {baseline_path}: no usable ledger entries "
+              f"(empty history directory?)", file=sys.stderr)
+        return None
+    return cases
+
+
 def check_regression(rows, baseline_path, pct: float) -> int:
     """The ``bench.py --baseline FILE --fail-on-regress PCT`` gate:
-    compare this run's emitted rows against the baseline capture.
+    compare this run's emitted rows against the baseline capture --
+    a file, or a ``--history`` ledger DIRECTORY (the best usable prior
+    capture per case; see :func:`load_baseline_cases`).
     Exit-code contract (shared with scripts/bench_diff.py): 0 = no
     regression, 1 = regression past the threshold, 2 = nothing
-    comparable (unreadable baseline / no common cases) -- 2 is a
-    failure too, so a renamed metric cannot silently green the gate."""
+    comparable (unreadable baseline / no common cases / an
+    all-unavailable history) -- 2 is a failure too, so a renamed
+    metric cannot silently green the gate."""
     try:
-        old = load_cases(baseline_path)
+        old = load_baseline_cases(baseline_path)
     except OSError as e:
         print(f"bench-diff: {baseline_path}: {e}", file=sys.stderr)
+        return 2
+    if old is None:
         return 2
     old, new, refused = refuse_unavailable(old, rows_to_cases(rows),
                                            str(baseline_path),

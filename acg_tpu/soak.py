@@ -35,7 +35,7 @@ import math
 import sys
 import time
 
-from acg_tpu import metrics, telemetry, tracing
+from acg_tpu import metrics, observatory, telemetry, tracing
 
 # EWMA smoothing for the drift detector: 0.2 remembers ~the last 10
 # solves -- slow enough to ride out one contended solve, fast enough to
@@ -195,6 +195,14 @@ def run_soak(solver, b, *, nsolves: int, x0=None, criteria=None,
         g = (st.health or {}).get("gap_last")
         if g is not None and math.isfinite(float(g)):
             gaps.append(float(g))
+        # live-observatory tier: per-solve queue progress for the
+        # status endpoint (no-op disarmed) and the SLO verdict for
+        # this solve (no-op without declared objectives; breaches
+        # land as structured events + acg_slo_* metrics)
+        observatory.note_soak_solve(i, nsolves, lat)
+        observatory.slo_observe(st, latency=lat,
+                                iterations=int(st.niterations),
+                                gap=g)
         if det.update(i, lat):
             msg = (f"latency drift: EWMA {det.ewma:.6f}s is "
                    f"{(det.ratio - 1.0) * 100.0:+.1f}% over the "

@@ -593,8 +593,14 @@ class HostCGSolver:
                                 alpha, beta, pdott, gap=gap)
             if self.progress and k % self.progress == 0:
                 import sys
-                sys.stderr.write(f"acg-tpu: host-cg: iteration {k}: "
-                                 f"residual 2-norm {st.rnrm2:.6e}\n")
+
+                # the observatory's shared heartbeat line: the oracle
+                # path prints the same iterations/sec + ETA shape the
+                # compiled loops' callback does, and feeds the status
+                # endpoint the same samples
+                from acg_tpu import observatory
+                sys.stderr.write(observatory.heartbeat_line(
+                    "host-cg", k, st.rnrm2) + "\n")
             if not crit.unbounded:
                 converged = self._test(crit, st, res_tol)
             if (ck is not None and ck.path is not None and not converged

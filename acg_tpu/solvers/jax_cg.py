@@ -1898,7 +1898,8 @@ class JaxCGSolver:
         (asserted in tests/test_checkpoint.py); snapshot time is billed
         to its own ``ckpt`` phase, never the solve."""
         from acg_tpu import checkpoint as ckpt_mod
-        from acg_tpu import faults, metrics, telemetry, tracing
+        from acg_tpu import faults, metrics, observatory, telemetry, \
+            tracing
         from acg_tpu import health as health_mod
         from acg_tpu._platform import (block_until_ready_works,
                                        device_sync)
@@ -2054,6 +2055,15 @@ class JaxCGSolver:
                             np.asarray(tbuf), k_chunk,
                             solver=solver_name,
                             offset=consumed - k_chunk)
+                # live-observatory tier: the per-chunk carry return is
+                # a REAL mid-solve iteration/residual sample for the
+                # status endpoint (no-op disarmed; host-side only, so
+                # the compiled programs are untouched)
+                observatory.note_chunk(
+                    self._ckpt_tier, consumed, float(res.rnrm2),
+                    abs_tol=abs_tol,
+                    trace=(st.trace if tr else None),
+                    rtol=crit.residual_rtol)
                 if hl and aud is not None:
                     gap_tripped = health_mod.note_audit(
                         st, aud, self.health_spec, self._ckpt_tier,

@@ -151,6 +151,10 @@ class SolverStats:
     # efficiency, straggler attribution) and the --timeline export
     # summary.  Appends strictly last
     tracing: dict = dataclasses.field(default_factory=dict)
+    # live-observatory tier (acg_tpu.observatory, stats schema /8): the
+    # declared --slo objectives and their observation/breach/burn
+    # verdict.  Appends strictly last
+    slo: dict = dataclasses.field(default_factory=dict)
 
     def to_dict(self) -> dict:
         """Machine-readable twin of :meth:`fwrite` -- the ``stats`` key
@@ -198,6 +202,7 @@ class SolverStats:
             "health": dict(self.health),
             "ckpt": dict(self.ckpt),
             "tracing": dict(self.tracing),
+            "slo": dict(self.slo),
         }
         if self.trace is not None:
             d["trace"] = self.trace.to_dict()
@@ -301,6 +306,9 @@ class SolverStats:
         if self.tracing:
             p("tracing:")
             _write_section(p, self.tracing, 1)
+        if self.slo:
+            p("slo:")
+            _write_section(p, self.slo, 1)
         text = out.getvalue()
         if f is not None:
             f.write(text)

@@ -83,7 +83,13 @@ from acg_tpu.solvers.stats import PHASE_ORDER
 # class seconds, overlap efficiency, straggler attribution; plus the
 # --timeline export summary) -- additive, so /1../6 consumers keep
 # working
-STATS_SCHEMA = "acg-tpu-stats/7"
+# /8: the live-observatory tier (acg_tpu.observatory) adds an "slo" key
+# inside the stats twin (declared --slo objectives, per-objective
+# observation/breach counts, cumulative burn fractions) and the
+# slo-breach event kind -- additive, so /1../7 consumers keep working
+# (the run-history ledger wraps whole /N documents, any N, under its
+# own acg-tpu-history/1 index lines)
+STATS_SCHEMA = "acg-tpu-stats/8"
 CONVERGENCE_SCHEMA = "acg-tpu-convergence/1"
 # default ring capacity (--telemetry-window): 512 iterations x 4 scalars
 # is 8 KiB of f32 carry -- negligible against any solve's vectors, and
@@ -145,9 +151,13 @@ def heartbeat(k, rnrm2sqr, every: int, leader=None, what: str = "cg"):
     import jax.numpy as jnp
 
     def emit(kk, g):
-        sys.stderr.write(
-            f"acg-tpu: {what}: iteration {int(kk) + 1}: "
-            f"residual 2-norm {math.sqrt(max(float(g), 0.0)):.6e}\n")
+        # the live-observatory tier derives iterations/sec and the ETA
+        # from the same samples the status endpoint serves -- one line
+        # shape for every tier (observatory.heartbeat_line)
+        from acg_tpu import observatory
+        sys.stderr.write(observatory.heartbeat_line(
+            what, int(kk) + 1,
+            math.sqrt(max(float(g), 0.0))) + "\n")
         sys.stderr.flush()
 
     fire = (jnp.asarray(k, jnp.int32) + 1) % jnp.int32(every) == 0
@@ -413,7 +423,11 @@ class PhaseTimer:
 def annotate(name: str):
     """``jax.profiler.TraceAnnotation("acg:<name>")`` bracket; a cheap
     no-op when no trace is being collected, and tolerant of backends
-    without profiler support."""
+    without profiler support.  Also feeds the live-observatory status
+    document's current-phase field (no-op disarmed) -- every pipeline
+    phase passes through here."""
+    from acg_tpu import observatory
+    observatory.note_phase(name)
     try:
         import jax
 
@@ -440,9 +454,12 @@ def record_event(stats, kind: str, detail: str) -> None:
     an instant on the ``--timeline`` span timeline (no-op disarmed)."""
     stats.events.append({"t": time.time(), "kind": kind,
                          "detail": str(detail)})
-    from acg_tpu import metrics, tracing
+    from acg_tpu import metrics, observatory, tracing
     metrics.record_event_kind(kind)
     tracing.record_instant(kind, detail=str(detail))
+    # live-observatory tier: the status document serves the last K
+    # structured events (no-op disarmed)
+    observatory.note_event(kind, str(detail))
 
 
 # -- structured stats sink ----------------------------------------------

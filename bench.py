@@ -862,7 +862,11 @@ def main(argv=None) -> int:
                          "capture (a --stats-json JSONL or a bench "
                          "row file like BENCH_*.json) and exit nonzero "
                          "on regression -- the enforced form of the "
-                         "BENCH trajectory")
+                         "BENCH trajectory.  A DIRECTORY is a "
+                         "--history run ledger: the best USABLE prior "
+                         "capture per case baselines, with "
+                         "bench_backend_unavailable entries skipped "
+                         "(an all-unavailable ledger refuses, exit 2)")
     ap.add_argument("--fail-on-regress", type=float, default=10.0,
                     metavar="PCT",
                     help="with --baseline: regression threshold in "

@@ -15,14 +15,23 @@ format on either side:
   row's ``value``, keyed by ``metric``.  ``#`` commentary lines are
   skipped.
 
+With ``--baseline-from-history DIR`` the baseline side comes from a
+run-history ledger (``--history``, acg_tpu.observatory): the
+best-known USABLE prior capture per case, with
+``bench_backend_unavailable`` entries skipped automatically; a ledger
+whose entries are ALL unavailable refuses with exit 2 and the
+re-baseline message (the BENCH_r05 stale-baseline trap).
+
 Exit codes (shared with ``bench.py --baseline --fail-on-regress``):
 0 = no regression, 1 = at least one case regressed past the threshold,
-2 = nothing comparable (unreadable input / no common cases) -- 2 fails
-too, so a renamed metric cannot silently green a CI gate.
+2 = nothing comparable (unreadable input / no common cases /
+all-unavailable history) -- 2 fails too, so a renamed metric cannot
+silently green a CI gate.
 
 Examples:
   bench_diff.py BENCH_r04.json BENCH_r05.json
   bench_diff.py old_stats.jsonl new_stats.jsonl --fail-on-regress 5
+  bench_diff.py --baseline-from-history ./history new_stats.jsonl
 """
 
 import argparse
@@ -38,34 +47,53 @@ def main(argv=None) -> int:
                     "trajectory gate).",
         epilog="Exit codes: 0 = ok, 1 = regression past the threshold, "
                "2 = nothing comparable.")
-    ap.add_argument("baseline",
+    ap.add_argument("baseline", nargs="?", default=None,
                     help="prior capture (--stats-json JSONL/document, or "
-                         "bench row JSONL like BENCH_*.json)")
+                         "bench row JSONL like BENCH_*.json); omit when "
+                         "--baseline-from-history supplies the baseline")
     ap.add_argument("candidate", help="new capture, same accepted formats")
+    ap.add_argument("--baseline-from-history", metavar="DIR",
+                    default=None,
+                    help="take the baseline from a --history run ledger "
+                         "instead of a capture file: best USABLE value "
+                         "per case across every entry, "
+                         "bench_backend_unavailable captures skipped; "
+                         "an all-unavailable ledger refuses (exit 2)")
     ap.add_argument("--fail-on-regress", type=float, default=10.0,
                     metavar="PCT",
                     help="regression threshold in percent (default: 10)")
     args = ap.parse_args(argv)
+    if (args.baseline is None) == (args.baseline_from_history is None):
+        ap.error("give a baseline capture OR --baseline-from-history "
+                 "DIR (exactly one)")
 
     # import AFTER parsing so --help answers without touching the
     # package (and never initialises a jax backend -- perfmodel keeps
     # jax imports inside the functions that need a device)
     sys.path.insert(0, os.path.dirname(os.path.dirname(
         os.path.abspath(__file__))))
-    from acg_tpu.perfmodel import (compare_cases, load_cases,
-                                   refuse_unavailable)
+    from acg_tpu.perfmodel import (compare_cases, load_baseline_cases,
+                                   load_cases, refuse_unavailable)
 
+    base = args.baseline or args.baseline_from_history
     try:
-        old = load_cases(args.baseline)
+        # a directory baseline is the run-history ledger path; the
+        # positional form accepts one too (check_regression parity)
+        old = load_baseline_cases(base)
         new = load_cases(args.candidate)
     except OSError as e:
         print(f"bench-diff: {e}", file=sys.stderr)
+        return 2
+    if old is None:
+        # the ledger was empty or ALL its captures were
+        # backend-unavailable: load_baseline_cases printed the
+        # re-baseline refusal
         return 2
     # a capture that only records the backend-unavailable sentinel
     # (BENCH_r05-style: the tunnel was down, value 0) describes a run
     # that never reached hardware -- refuse the comparison outright
     # instead of "diffing" against nothing (ROADMAP Recent notes r05)
-    old, new, refused = refuse_unavailable(old, new, args.baseline,
+    old, new, refused = refuse_unavailable(old, new, base,
                                            args.candidate)
     if refused:
         return 2
@@ -74,7 +102,7 @@ def main(argv=None) -> int:
         print(ln)
     if ncmp == 0:
         print("bench-diff: no comparable cases between "
-              f"{args.baseline} and {args.candidate}", file=sys.stderr)
+              f"{base} and {args.candidate}", file=sys.stderr)
         return 2
     print(f"bench-diff: {ncmp} case(s) compared, {nreg} regression(s) "
           f"past -{args.fail_on_regress:g}%")

@@ -8,7 +8,10 @@ Accepts any mix of:
   latency/iteration percentiles, and the embedded registry snapshot's
   latency histogram when the metrics layer was armed);
 * ``--metrics-file`` Prometheus textfiles (the ``acg_solve_seconds``
-  histogram and its percentiles re-derived from the bucket counts).
+  histogram and its percentiles re-derived from the bucket counts);
+* ``--history`` run-ledger JSONL partitions (acg-tpu-history/1 index
+  lines): a latency-over-time trend panel, one line per case, renders
+  next to the residual plot (ascii: per-case latency sparklines).
 
 With matplotlib: a semilog residual plot (one line per log, wrap
 markers where a ring truncated) and, when any latency input is given,
@@ -276,6 +279,69 @@ def _sparkline(its, rn, width: int = 72) -> str:
     return "".join(out)
 
 
+def _load_history(path):
+    """A ``--history`` run-ledger JSONL partition (or a concatenation
+    of them) -> per-case ``(times, latencies, iterations)`` trails for
+    the latency-over-time trend panel.  Sniffs by content: at least one
+    parseable line must carry the ``acg-tpu-history`` ledger marker.
+    Backend-unavailable captures are skipped (no latency evidence)."""
+    cases: dict[str, dict] = {}
+    nledger = 0
+    with open(path) as f:
+        for raw in f:
+            raw = raw.strip()
+            if not raw:
+                continue
+            try:
+                obj = json.loads(raw)
+            except ValueError:
+                continue
+            if not (isinstance(obj, dict) and str(
+                    obj.get("ledger", "")).startswith("acg-tpu-history")):
+                continue
+            nledger += 1
+            lat = obj.get("latency_s")
+            if not isinstance(lat, (int, float)) or not \
+                    math.isfinite(lat) or lat <= 0:
+                continue
+            case = str(obj.get("case") or "(uncased)")
+            rec = cases.setdefault(case, {"t": [], "lat": [], "it": []})
+            rec["t"].append(float(obj.get("unix_time") or 0.0))
+            rec["lat"].append(float(lat))
+            it = obj.get("iterations")
+            rec["it"].append(int(it) if isinstance(it, (int, float))
+                             else None)
+    if not nledger:
+        raise ValueError("no acg-tpu-history ledger lines")
+    for rec in cases.values():
+        order = sorted(range(len(rec["t"])), key=rec["t"].__getitem__)
+        for key in ("t", "lat", "it"):
+            rec[key] = [rec[key][i] for i in order]
+    return {"path": path, "cases": cases, "nledger": nledger}
+
+
+def _history_lines(rec) -> list[str]:
+    """Ascii trend fallback: one latency sparkline per case (linear
+    blocks over run order -- the drift spike must pop visually)."""
+    lines = [f"{rec['path']}: run-history ledger, {rec['nledger']} "
+             f"entr{'y' if rec['nledger'] == 1 else 'ies'}, "
+             f"{len(rec['cases'])} case(s)"]
+    for case in sorted(rec["cases"]):
+        c = rec["cases"][case]
+        lats = c["lat"]
+        if not lats:
+            lines.append(f"  {case}: (no timed runs)")
+            continue
+        peak = max(lats)
+        bar = "".join(
+            BLOCKS[min(int(v / peak * (len(BLOCKS) - 1) + 0.5),
+                       len(BLOCKS) - 1)] for v in lats)
+        lines.append(f"  {case}: {bar}  latency first "
+                     f"{_fmt_s(lats[0])}  last {_fmt_s(lats[-1])}  "
+                     f"best {_fmt_s(min(lats))} ({len(lats)} runs)")
+    return lines
+
+
 def _load_timeline(path):
     """A ``--timeline`` Chrome trace-event file (acg-tpu-timeline/1)
     -> one span-summary record: per-name earliest start / latest end /
@@ -346,6 +412,13 @@ def _classify(path):
     except (ValueError, UnicodeDecodeError):
         pass
     try:
+        # a --history ledger partition: acg-tpu-history index lines
+        # (must sniff before the stats-document attempt -- the full
+        # stats document rides INSIDE each ledger line)
+        return ("history", _load_history(path))
+    except (ValueError, UnicodeDecodeError):
+        pass
+    try:
         soak, cum, health, events = _load_stats_json(path)
         if soak or cum or health or events:
             return ("latency",
@@ -380,7 +453,7 @@ def main(argv=None) -> int:
                          "is installed")
     args = ap.parse_args(argv)
 
-    conv, latency, timelines = [], [], []
+    conv, latency, timelines, histories = [], [], [], []
     for path in args.logs:
         try:
             kind, rec = _classify(path)
@@ -391,6 +464,8 @@ def main(argv=None) -> int:
             conv.append(rec)
         elif kind == "timeline":
             timelines.append(rec)
+        elif kind == "history":
+            histories.append(rec)
         else:
             latency.append(rec)
 
@@ -443,10 +518,14 @@ def main(argv=None) -> int:
             # per-phase span summary of a --timeline file (/7)
             for line in _gantt_lines(rec):
                 print(line)
+        for rec in histories:
+            # per-case latency-over-time trend of a --history ledger
+            for line in _history_lines(rec):
+                print(line)
         return 0
 
     ncols = ((1 if conv else 0) + (1 if latency else 0)
-             + (1 if timelines else 0)) or 1
+             + (1 if timelines else 0) + (1 if histories else 0)) or 1
     fig, axes = plt.subplots(1, ncols,
                              figsize=(9 if ncols == 1 else 6.5 * ncols,
                                       5))
@@ -539,8 +618,9 @@ def main(argv=None) -> int:
     if timelines:
         # one Gantt panel (broken_barh per span name) for the first
         # timeline; additional files fall back to the ascii summary so
-        # N files never explode the figure
-        tax = axes[-1]
+        # N files never explode the figure (the history panel, when
+        # present, owns the LAST column)
+        tax = axes[(1 if conv else 0) + (1 if latency else 0)]
         rec = timelines[0]
         rows = rec["rows"]
         for i, r in enumerate(rows):
@@ -555,6 +635,30 @@ def main(argv=None) -> int:
                       f"rank(s)", fontsize=8)
         for extra in timelines[1:]:
             for line in _gantt_lines(extra):
+                print(line)
+    if histories:
+        # the latency-over-time trend panel (one line per case) for the
+        # first ledger; additional files fall back to the ascii summary
+        # so N files never explode the figure
+        hax = axes[-1]
+        rec = histories[0]
+        import datetime
+        for case in sorted(rec["cases"]):
+            c = rec["cases"][case]
+            if not c["lat"]:
+                continue
+            xs = [datetime.datetime.fromtimestamp(t) for t in c["t"]]
+            hax.plot(xs, c["lat"], "-o", markersize=3, alpha=0.85,
+                     label=case, linewidth=1.1)
+        hax.set_yscale("log")
+        hax.set_xlabel("capture time")
+        hax.set_ylabel("solve latency (s)")
+        hax.set_title(f"{os.path.basename(rec['path'])}: "
+                      f"{rec['nledger']} runs", fontsize=8)
+        hax.tick_params(axis="x", labelsize=6, rotation=30)
+        hax.legend(fontsize=7)
+        for extra in histories[1:]:
+            for line in _history_lines(extra):
                 print(line)
     fig.tight_layout()
     if args.output:

@@ -7,7 +7,7 @@
 # T1_SOAK=1 additionally runs the service-soak smoke after the tests: a
 # tiny 3-solve --soak run whose --metrics-file must validate as
 # Prometheus exposition format and whose --stats-json must carry the
-# acg-tpu-stats/7 soak section (the CI soak-smoke step runs the same
+# acg-tpu-stats/8 soak section (the CI soak-smoke step runs the same
 # thing).  T1_HEALTH=1 runs the numerical-health smoke: an audited
 # pipelined solve on the anisotropic generator must leave a health:
 # section with a finite gap, the acg_health_* metric families, and a
@@ -19,6 +19,11 @@
 # that validates (scripts/check_timeline.py: one pid per part, spans
 # for ingest/partition/compile/solve), a /7 stats document carrying
 # the tracing: section, and the acg_trace_* metric families.
+# T1_STATUS=1 runs the live-observatory smoke: a chunked 8-part
+# CPU-mesh solve with --status-file + --history + --slo must leave a
+# valid acg-tpu-status/1 document (solve converged, residual trail
+# populated), one acg-tpu-history/1 ledger row that history_report.py
+# renders, and the acg_slo_* metric families in the textfile.
 set -o pipefail
 cd "$(dirname "$0")/.."
 rm -f /tmp/_t1.log
@@ -41,7 +46,7 @@ if [ "${T1_SOAK:-0}" = "1" ]; then
     python - <<'PY' || rc=$((rc ? rc : 1))
 import json
 doc = json.load(open("/tmp/_t1_soak.json"))
-assert doc["schema"] == "acg-tpu-stats/7", doc["schema"]
+assert doc["schema"] == "acg-tpu-stats/8", doc["schema"]
 soak = doc["stats"]["soak"]
 assert soak["nsolves"] == 3 and soak["latency"]["p50"] is not None, soak
 assert "metrics" in doc, "registry snapshot missing from /3 document"
@@ -63,7 +68,7 @@ if [ "${T1_PRECOND:-0}" = "1" ]; then
         env PC="$pc" python - <<'PY' || rc=$((rc ? rc : 1))
 import json, os
 doc = json.load(open("/tmp/_t1_precond.json"))
-assert doc["schema"] == "acg-tpu-stats/7", doc["schema"]
+assert doc["schema"] == "acg-tpu-stats/8", doc["schema"]
 st = doc["stats"]
 assert st["converged"] is True, st["rnrm2"]
 assert st["precond"]["kind"] == os.environ["PC"], st["precond"]
@@ -99,7 +104,7 @@ if [ "${T1_HEALTH:-0}" = "1" ]; then
     python - <<'PY' || rc=$((rc ? rc : 1))
 import json, math
 doc = json.load(open("/tmp/_t1_health.json"))
-assert doc["schema"] == "acg-tpu-stats/7", doc["schema"]
+assert doc["schema"] == "acg-tpu-stats/8", doc["schema"]
 h = doc["stats"]["health"]
 assert h["naudits"] > 0, h
 assert h["gap_last"] is not None and math.isfinite(h["gap_last"]), h
@@ -138,7 +143,7 @@ if [ "${T1_CKPT:-0}" = "1" ]; then
     python - <<'PY' || rc=$((rc ? rc : 1))
 import json
 doc = json.load(open("/tmp/_t1_ckpt.json"))
-assert doc["schema"] == "acg-tpu-stats/7", doc["schema"]
+assert doc["schema"] == "acg-tpu-stats/8", doc["schema"]
 st = doc["stats"]
 assert st["converged"] is True, st["rnrm2"]
 ck = st["ckpt"]
@@ -177,13 +182,61 @@ if [ "${T1_TRACE:-0}" = "1" ]; then
     python - <<'PY' || rc=$((rc ? rc : 1))
 import json
 doc = json.load(open("/tmp/_t1_trace.json"))
-assert doc["schema"] == "acg-tpu-stats/7", doc["schema"]
+assert doc["schema"] == "acg-tpu-stats/8", doc["schema"]
 tr = doc["stats"]["tracing"]
 tl = tr["timeline"]
 assert tl["nparts"] == 8 and tl["nspans"] > 0, tl
 assert "available" in tr, tr
 print(f"T1_TRACE: OK ({tl['nspans']} spans over {tl['nparts']} parts, "
       f"capture analysis available={tr['available']})")
+PY
+fi
+if [ "${T1_STATUS:-0}" = "1" ]; then
+    # live-observatory smoke (the PR-9 acceptance in miniature): a
+    # chunked 8-part CPU-mesh solve with the whole status plane armed
+    # -- the --status-file document must validate (schema, converged
+    # solve, residual-trail chunk samples), the --history ledger must
+    # hold the run's row (history_report.py renders it), and the
+    # declared --slo objectives must expose the acg_slo_* families
+    echo "T1_STATUS: chunked 8-part status smoke"
+    rm -rf /tmp/_t1_history
+    rm -f /tmp/_t1_status.json /tmp/_t1_status.prom /tmp/_t1_status_ck \
+        /tmp/_t1_status_stats.json
+    timeout -k 10 300 env JAX_PLATFORMS=cpu \
+        XLA_FLAGS="--xla_force_host_platform_device_count=8" \
+        python -m acg_tpu.cli gen:poisson2d:24 --nparts 8 \
+        --max-iterations 300 --residual-rtol 1e-8 --warmup 0 --quiet \
+        --ckpt /tmp/_t1_status_ck --ckpt-every 16 \
+        --status-file /tmp/_t1_status.json \
+        --history /tmp/_t1_history \
+        --slo latency=60,iters=280 \
+        --metrics-file /tmp/_t1_status.prom \
+        --stats-json /tmp/_t1_status_stats.json || rc=$((rc ? rc : 1))
+    python scripts/check_metrics_textfile.py /tmp/_t1_status.prom \
+        --require acg_slo_target --require acg_slo_burn_ratio \
+        || rc=$((rc ? rc : 1))
+    python scripts/history_report.py /tmp/_t1_history \
+        || rc=$((rc ? rc : 1))
+    python - <<'PY' || rc=$((rc ? rc : 1))
+import json, os
+doc = json.load(open("/tmp/_t1_status.json"))
+assert doc["schema"] == "acg-tpu-status/1", doc["schema"]
+assert doc["solve"]["converged"] is True, doc["solve"]
+assert doc["solve"]["iteration"] > 0, doc["solve"]
+assert doc["residual_trail"], "no chunk samples on the residual trail"
+assert doc["slo"]["breached"] is False, doc["slo"]
+ledgers = [f for f in os.listdir("/tmp/_t1_history")
+           if f.endswith(".jsonl")]
+assert len(ledgers) == 1, ledgers
+row = json.loads(open(f"/tmp/_t1_history/{ledgers[0]}").readline())
+assert row["ledger"] == "acg-tpu-history/1", row["ledger"]
+assert row["nparts"] == 8 and row["converged"] is True, row
+assert row["doc"]["schema"] == "acg-tpu-stats/8", row["doc"]["schema"]
+sj = json.load(open("/tmp/_t1_status_stats.json"))
+assert sj["stats"]["slo"]["targets"]["iters"] == 280, sj["stats"]["slo"]
+print(f"T1_STATUS: OK (iteration {doc['solve']['iteration']}, "
+      f"{len(doc['residual_trail'])} trail samples, ledger row "
+      f"{row['case']})")
 PY
 fi
 exit $rc

@@ -1,0 +1,623 @@
+"""Live solve observatory (acg_tpu.observatory): in-flight status
+endpoint/file, run-history ledger, and SLO burn tracking.
+
+Covers the PR-9 acceptance criteria: a poller observes iteration and
+residual ADVANCING across >= 2 polls mid-solve (with iterations/sec and
+ETA populated), disarmed programs lower byte-identical on the single
+and dist tiers, the history ledger round-trips through
+history_report/bench_diff/plot_convergence (including /7 documents and
+the all-unavailable exit-2 refusal), concurrent /status + /metrics
+scrapes never see torn output, the --progress heartbeat carries the
+it/s + ETA fields on every tier including the host oracle, and
+--fail-on-slo gates with exit 8.
+"""
+
+import importlib.util
+import json
+import math
+import os
+import subprocess
+import sys
+import threading
+import time
+import urllib.request
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+from acg_tpu import metrics, observatory, telemetry
+from acg_tpu.checkpoint import CheckpointConfig
+from acg_tpu.io.generators import poisson2d_coo
+from acg_tpu.matrix import SymCsrMatrix
+from acg_tpu.ops.spmv import device_matrix_from_csr
+from acg_tpu.parallel.dist import DistCGSolver, DistributedProblem
+from acg_tpu.partition import partition_rows
+from acg_tpu.solvers.jax_cg import JaxCGSolver
+from acg_tpu.solvers.stats import SolverStats, StoppingCriteria
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+SCRIPTS = os.path.join(ROOT, "scripts")
+
+ENV_KEYS = {"JAX_PLATFORMS": "cpu",
+            "XLA_FLAGS": "--xla_force_host_platform_device_count=8"}
+
+
+def run_cli(argv, **kw):
+    env = dict(os.environ)
+    env.update(ENV_KEYS)
+    kw.setdefault("timeout", 600)
+    return subprocess.run([sys.executable, "-m", "acg_tpu.cli", *argv],
+                          capture_output=True, text=True, env=env, **kw)
+
+
+def run_script(name, argv, **kw):
+    kw.setdefault("timeout", 300)
+    return subprocess.run([sys.executable,
+                           os.path.join(SCRIPTS, name), *argv],
+                          capture_output=True, text=True, **kw)
+
+
+@pytest.fixture(autouse=True)
+def _clean_observatory():
+    """Every test leaves the process-wide recorder and SLO state the
+    way it found it (the metrics/tracing discipline)."""
+    yield
+    observatory.shutdown()
+    metrics.disarm()
+
+
+@pytest.fixture(scope="module")
+def csr():
+    r, c, v, N = poisson2d_coo(12)
+    return SymCsrMatrix.from_coo(N, r, c, v).to_csr()
+
+
+def _doc(schema="acg-tpu-stats/8", metric=None, matrix="m", solver="acg",
+         tsolve=0.1, niter=20, soak=None, unix_time=None):
+    """A minimal synthetic stats document (the shape history_append
+    indexes)."""
+    man = {"schema": schema, "matrix": matrix, "solver": solver,
+           "dtype": "f64", "nparts": 1,
+           "unix_time": unix_time if unix_time is not None
+           else time.time()}
+    if metric is not None:
+        man["metric"] = metric
+    st = {"tsolve": tsolve, "niterations": niter, "converged": True}
+    if soak is not None:
+        st["soak"] = soak
+    return {"schema": schema, "manifest": man, "stats": st}
+
+
+# -- the SolveStatus recorder --------------------------------------------
+
+def test_status_document_schema_and_rates():
+    observatory.arm()
+    observatory.begin_solve("cg", maxits=100, rtol=1e-8,
+                            matrix="gen:test", nparts=4)
+    t = [time.time()]
+    observatory.STATUS.trail.append((t[0] - 1.0, 10, 1e-2))
+    observatory.STATUS.sample("cg", 60, 1e-4)
+    observatory.STATUS.note_target(1e-8)
+    doc = observatory.status_document()
+    assert doc["schema"] == "acg-tpu-status/1"
+    assert doc["phase"] is None or isinstance(doc["phase"], str)
+    s = doc["solve"]
+    assert s["what"] == "cg" and s["active"] is True
+    assert s["iteration"] == 60 and s["matrix"] == "gen:test"
+    # two trail samples 1 s apart, 50 iterations -> ~50 it/s
+    assert s["iterations_per_second"] == pytest.approx(50.0, rel=0.5)
+    # decreasing residual + absolute target -> the measured-rate ETA
+    assert s["eta_seconds"] is not None and s["eta_seconds"] > 0
+    assert s["eta_source"] == "measured-rate"
+    assert doc["residual_trail"][-1] == [60, 1e-4]
+
+
+def test_eta_prefers_kappa_bound():
+    observatory.arm()
+    observatory.begin_solve("cg", maxits=1000, rtol=1e-8)
+    observatory.STATUS.trail.append((time.time() - 1.0, 10, 1e-2))
+    observatory.STATUS.sample("cg", 60, 1e-3)
+    observatory.note_kappa(100.0, predicted_total=200)
+    ips, eta, source = observatory.STATUS.rates()
+    assert source == "kappa-bound"
+    # ~140 remaining at ~50 it/s
+    assert eta == pytest.approx(140.0 / ips, rel=1e-6)
+
+
+def test_disarmed_hooks_are_noops():
+    assert not observatory.armed()
+    observatory.note_chunk("cg", 5, 1e-3)
+    observatory.note_event("x", "y")
+    observatory.note_kappa(10.0, 50)
+    observatory.note_imbalance({"count": 2})
+    doc = observatory.status_document()
+    assert not doc["residual_trail"] and "events" not in doc
+    assert "kappa" not in doc and "imbalance" not in doc
+    # begin/end and the heartbeat tracker stay live even disarmed:
+    # they are what gives --progress lines the it/s + ETA fields
+    observatory.begin_solve("cg", maxits=10)
+    assert observatory.status_document()["solve"]["maxits"] == 10
+
+
+def test_trail_resets_when_iteration_goes_backwards():
+    observatory.arm()
+    observatory.STATUS.sample("cg", 50, 1e-3)
+    observatory.STATUS.sample("cg", 60, 1e-4)
+    observatory.STATUS.sample("cg", 5, 1e-1)   # new solve / rollback
+    assert [k for _, k, _ in observatory.STATUS.trail] == [5]
+
+
+def test_status_file_atomic_json(tmp_path):
+    path = tmp_path / "status.json"
+    observatory.arm()
+    observatory.set_status_file(path)
+    observatory.begin_solve("cg", maxits=10)
+    observatory.flush_status(force=True)
+    doc = json.loads(path.read_text())
+    assert doc["schema"] == "acg-tpu-status/1"
+    assert not [p for p in os.listdir(tmp_path)
+                if p.startswith("status.json.tmp")]
+
+
+def test_heartbeat_line_carries_rate_and_eta():
+    observatory.STATUS.reset()
+    line0 = observatory.heartbeat_line("cg", 10, 1.0)
+    assert line0.startswith("acg-tpu: cg: iteration 10: "
+                            "residual 2-norm")
+    assert "it/s" not in line0          # one sample: no rate yet
+    observatory.STATUS.trail.appendleft((time.time() - 1.0, 0, 10.0))
+    line1 = observatory.heartbeat_line("cg", 20, 1e-2)
+    assert "it/s" in line1
+
+
+def test_host_oracle_progress_emits_rate_fields(csr, capfd):
+    from acg_tpu.solvers.host_cg import HostCGSolver
+
+    s = HostCGSolver(csr, progress=5)
+    s.solve(np.ones(csr.shape[0]),
+            criteria=StoppingCriteria(maxits=60, residual_rtol=1e-10))
+    err = capfd.readouterr().err
+    assert "host-cg: iteration 5: residual 2-norm" in err
+    # by the second heartbeat two samples exist -> rate + ETA fields
+    later = [ln for ln in err.splitlines()
+             if "iteration 10:" in ln or "iteration 15:" in ln]
+    assert later and any("it/s" in ln for ln in later)
+
+
+# -- acceptance: polling the endpoint DURING a chunked solve -------------
+
+def test_status_endpoint_advances_during_chunked_solve(tmp_path):
+    """The headline acceptance: a chunked single-tier solve is watched
+    over the HTTP endpoint; iteration and residual must ADVANCE across
+    >= 2 polls with iterations/sec and ETA populated mid-flight."""
+    r, c, v, N = poisson2d_coo(40)
+    csr40 = SymCsrMatrix.from_coo(N, r, c, v).to_csr()
+    A = device_matrix_from_csr(csr40, dtype=jnp.float64)
+    s = JaxCGSolver(A, ckpt=CheckpointConfig(
+        path=str(tmp_path / "ck"), every=4))
+    observatory.arm()
+    observatory.begin_solve("cg", maxits=300, rtol=1e-10,
+                            matrix="gen:poisson2d:40")
+    server = observatory.serve_status(0)
+    port = server.server_address[1]
+    b = np.ones(N)
+    crit = StoppingCriteria(maxits=300, residual_rtol=1e-10)
+    done = threading.Event()
+    err: list = []
+
+    def solve():
+        try:
+            s.solve(b, criteria=crit)
+        except Exception as e:  # noqa: BLE001 -- surfaced below
+            err.append(e)
+        finally:
+            done.set()
+
+    t = threading.Thread(target=solve, daemon=True)
+    t.start()
+    seen: list[dict] = []
+    deadline = time.time() + 120
+    try:
+        while not done.is_set() and time.time() < deadline:
+            with urllib.request.urlopen(
+                    f"http://127.0.0.1:{port}/status", timeout=10) as r_:
+                doc = json.loads(r_.read())
+            sv = doc.get("solve") or {}
+            if sv.get("iteration") and sv.get("residual") is not None:
+                if not seen or sv["iteration"] != \
+                        seen[-1]["iteration"]:
+                    seen.append(sv)
+            time.sleep(0.002)
+        t.join(timeout=120)
+    finally:
+        server.shutdown()
+        server.server_close()
+    assert not err, err
+    mid = [sv for sv in seen if sv.get("active")]
+    assert len(mid) >= 2, f"only {len(mid)} mid-flight polls: {seen}"
+    its = [sv["iteration"] for sv in mid]
+    res = [sv["residual"] for sv in mid]
+    assert its == sorted(its) and its[-1] > its[0]
+    assert res[-1] < res[0]
+    # rate + ETA populated once two chunk samples existed
+    rated = [sv for sv in mid if sv.get("iterations_per_second")]
+    assert rated and any(sv.get("eta_seconds") for sv in rated)
+    assert any(sv.get("eta_source") in ("measured-rate", "kappa-bound",
+                                        "iteration-cap")
+               for sv in rated)
+
+
+# -- disarmed byte-identity (single + dist tiers) ------------------------
+
+def test_disarmed_programs_byte_identical(csr):
+    """Arming the observatory cannot touch the compiled programs: all
+    recording is host-side.  Pinned at the HLO level on both tiers (the
+    telemetry/faults convention)."""
+    b1 = np.ones(csr.shape[0])
+    A = device_matrix_from_csr(csr, dtype=jnp.float64)
+    plain = JaxCGSolver(A, kernels="xla").lower_solve(b1).as_text()
+    observatory.arm()
+    observatory.begin_solve("cg", maxits=100, rtol=1e-8)
+    armed = JaxCGSolver(A, kernels="xla").lower_solve(b1).as_text()
+    assert armed == plain
+
+    part = partition_rows(csr, 4, seed=0, method="band")
+    prob = DistributedProblem.build(csr, part, 4, dtype=jnp.float64)
+    b2 = np.ones(prob.n)
+    observatory.shutdown()
+    d_plain = DistCGSolver(prob).lower_solve(b2).as_text()
+    observatory.arm()
+    d_armed = DistCGSolver(prob).lower_solve(b2).as_text()
+    assert d_armed == d_plain
+
+
+# -- SLO tracking ---------------------------------------------------------
+
+def test_parse_slo():
+    spec = observatory.parse_slo("latency=1.5,iters=100,gap=1e-4")
+    assert spec.latency_s == 1.5 and spec.iters == 100
+    assert spec.gap == pytest.approx(1e-4)
+    assert observatory.parse_slo("latency=2").iters is None
+    for bad in ("", "latency", "latency=-1", "iters=0", "foo=3",
+                "latency=abc"):
+        with pytest.raises(ValueError):
+            observatory.parse_slo(bad)
+
+
+def test_slo_observe_breach_metrics_and_events():
+    metrics.arm()
+    observatory.install_slo(observatory.parse_slo("latency=0.5,iters=10"))
+    st = SolverStats()
+    # first solve: healthy
+    assert not observatory.slo_observe(st, latency=0.1, iterations=5)
+    # second: both objectives breached
+    assert observatory.slo_observe(st, latency=1.0, iterations=50)
+    assert [e["kind"] for e in st.events] == ["slo-breach",
+                                              "slo-breach"]
+    rep = observatory.slo_report()
+    assert rep["breached"] is True
+    assert rep["breaches"] == {"latency": 1, "iters": 1}
+    assert rep["burn"]["latency"] == pytest.approx(0.5)
+    txt = metrics.expose()
+    assert 'acg_slo_target{objective="latency"} 0.5' in txt
+    assert 'acg_slo_breaches_total{objective="iters"} 1' in txt
+    assert 'acg_slo_burn_ratio{objective="latency"} 0.5' in txt
+    assert observatory.slo_exit_code(True) == 8
+    assert observatory.slo_exit_code(False) == 0
+    observatory.attach_slo(st)
+    assert st.slo["targets"]["latency"] == 0.5
+
+
+def test_cli_slo_gate_exit_8(tmp_path):
+    status = tmp_path / "status.json"
+    r = run_cli(["gen:poisson2d:12", "--comm", "none",
+                 "--max-iterations", "100", "--residual-rtol", "1e-8",
+                 "--warmup", "0", "--quiet",
+                 "--slo", "latency=0.000001", "--fail-on-slo",
+                 "--status-file", str(status),
+                 "--stats-json", str(tmp_path / "s.json")])
+    assert r.returncode == 8, (r.returncode, r.stderr)
+    assert "SLO breach: latency" in r.stderr
+    doc = json.loads(status.read_text())
+    assert doc["schema"] == "acg-tpu-status/1"
+    assert doc["phase"] == "exited"
+    assert doc["solve"]["active"] is False
+    assert doc["slo"]["breached"] is True
+    sj = json.loads((tmp_path / "s.json").read_text())
+    assert sj["schema"] == "acg-tpu-stats/8"
+    assert sj["stats"]["slo"]["breaches"]["latency"] == 1
+    assert any(e["kind"] == "slo-breach"
+               for e in sj["stats"]["events"])
+
+
+def test_cli_flag_validation():
+    r = run_cli(["gen:poisson2d:12", "--comm", "none", "--quiet",
+                 "--fail-on-slo"])
+    assert r.returncode != 0 and "--fail-on-slo needs --slo" in r.stderr
+    r = run_cli(["gen:poisson2d:12", "--comm", "none", "--quiet",
+                 "--slo", "bogus=3"])
+    assert r.returncode != 0 and "--slo" in r.stderr
+    r = run_cli(["gen:poisson2d:12", "--comm", "none", "--quiet",
+                 "--slo", "gap=1e-3"])
+    assert r.returncode != 0 and "--audit-every" in r.stderr
+    r = run_cli(["gen:poisson2d:12", "--comm", "none", "--quiet",
+                 "--status-port", "99999"])
+    assert r.returncode != 0 and "--status-port" in r.stderr
+
+
+def test_cli_history_refuses_file_path(tmp_path):
+    f = tmp_path / "ledger"
+    f.write_text("x")
+    r = run_cli(["gen:poisson2d:12", "--comm", "none", "--quiet",
+                 "--history", str(f)])
+    assert r.returncode != 0 and "needs a directory" in r.stderr
+
+
+# -- run-history ledger ---------------------------------------------------
+
+def test_history_append_scan_roundtrip(tmp_path):
+    d = tmp_path / "hist"
+    p1 = observatory.history_append(d, _doc(tsolve=0.1, niter=10))
+    p2 = observatory.history_append(d, _doc(tsolve=0.2, niter=12))
+    assert p1 == p2 and p1.endswith(".jsonl")
+    entries = observatory.history_scan(d)
+    assert len(entries) == 2
+    idx = entries[0]
+    assert idx["ledger"] == "acg-tpu-history/1"
+    assert idx["schema"] == "acg-tpu-stats/8"
+    assert idx["matrix"] == "m" and idx["dtype"] == "f64"
+    assert idx["iterations"] == 10
+    assert idx["latency_s"] == pytest.approx(0.1)
+    assert idx["case"] == "acg:m"
+    assert idx["doc"]["stats"]["niterations"] == 10
+    # a torn trailing append yields the usable prefix, not an error
+    with open(p1, "a") as f:
+        f.write('{"ledger": "acg-tpu-history/1", "trunc')
+    assert len(observatory.history_scan(d)) == 2
+
+
+def test_history_baseline_picks_best_usable_and_skips_unavailable(
+        tmp_path):
+    d = tmp_path / "hist"
+    observatory.history_append(d, _doc(tsolve=0.2, niter=20))   # 100/s
+    observatory.history_append(d, _doc(tsolve=0.1, niter=20))   # 200/s
+    observatory.history_append(
+        d, _doc(metric="bench_backend_unavailable", tsolve=1.0,
+                niter=1))
+    cases, all_unavail, n = observatory.load_history_baseline(d)
+    assert n == 3 and not all_unavail
+    assert cases == {"acg:m": pytest.approx(200.0)}
+
+
+def test_history_all_unavailable_refuses_exit_2(tmp_path):
+    d = tmp_path / "hist"
+    for _ in range(2):
+        observatory.history_append(
+            d, _doc(metric="bench_backend_unavailable", tsolve=1.0,
+                    niter=1))
+    cases, all_unavail, _ = observatory.load_history_baseline(d)
+    assert all_unavail and not cases
+    # the library gate
+    from acg_tpu.perfmodel import check_regression
+    rows = [{"metric": "solve", "value": 100.0}]
+    assert check_regression(rows, str(d), 10.0) == 2
+    # the script gate, with the re-baseline message
+    cand = tmp_path / "cand.json"
+    cand.write_text(json.dumps(_doc()) + "\n")
+    r = run_script("bench_diff.py",
+                   ["--baseline-from-history", str(d), str(cand)])
+    assert r.returncode == 2, (r.returncode, r.stdout, r.stderr)
+    assert "re-baseline" in r.stderr
+    # a ledger of FAILED runs (no usable value, but not the sentinel)
+    # still refuses -- with the generic message, never the
+    # backend-was-down diagnosis
+    d2 = tmp_path / "hist-failed"
+    observatory.history_append(d2, _doc(tsolve=0.0, niter=0))
+    cases, all_unavail, _ = observatory.load_history_baseline(d2)
+    assert not cases and not all_unavail
+    r = run_script("bench_diff.py",
+                   ["--baseline-from-history", str(d2), str(cand)])
+    assert r.returncode == 2
+    assert "re-baseline" not in r.stderr
+    assert "no usable ledger entries" in r.stderr
+
+
+def test_bench_diff_from_history_and_regression(tmp_path):
+    d = tmp_path / "hist"
+    observatory.history_append(d, _doc(tsolve=0.1, niter=20))   # 200/s
+    good = tmp_path / "good.json"
+    good.write_text(json.dumps(_doc(tsolve=0.11, niter=20)) + "\n")
+    r = run_script("bench_diff.py",
+                   ["--baseline-from-history", str(d), str(good),
+                    "--fail-on-regress", "20"])
+    assert r.returncode == 0, (r.stdout, r.stderr)
+    bad = tmp_path / "bad.json"
+    bad.write_text(json.dumps(_doc(tsolve=0.4, niter=20)) + "\n")
+    r = run_script("bench_diff.py",
+                   ["--baseline-from-history", str(d), str(bad),
+                    "--fail-on-regress", "20"])
+    assert r.returncode == 1 and "REGRESSION" in r.stdout
+    # exactly one baseline source
+    r = run_script("bench_diff.py", [str(good)])
+    assert r.returncode == 2
+    r = run_script("bench_diff.py",
+                   ["--baseline-from-history", str(d), str(good),
+                    str(bad)])
+    assert r.returncode == 2
+
+
+def test_history_report_flags_dilated_drift(tmp_path):
+    """The acceptance: a ledger whose trailing run was dilated (the
+    solve:slow@K soak shape: same case, inflated latency) gets the
+    DRIFT flag, and --fail-on-drift exits 7 (the soak gate's code)."""
+    d = tmp_path / "hist"
+    t0 = time.time()
+    for i, lat in enumerate([0.1, 0.1, 0.1, 0.1, 1.1]):
+        observatory.history_append(
+            d, _doc(tsolve=lat, niter=20, unix_time=t0 + i))
+    r = run_script("history_report.py", [str(d)])
+    assert r.returncode == 0, r.stderr
+    assert "DRIFT" in r.stdout
+    assert "5 run(s)" in r.stdout
+    r = run_script("history_report.py", [str(d), "--fail-on-drift"])
+    assert r.returncode == 7
+    # a stable ledger never flags
+    d2 = tmp_path / "hist2"
+    for i in range(5):
+        observatory.history_append(
+            d2, _doc(tsolve=0.1, niter=20, unix_time=t0 + i))
+    r = run_script("history_report.py", [str(d2), "--fail-on-drift"])
+    assert r.returncode == 0 and "DRIFT" not in r.stdout
+
+
+def test_plot_convergence_renders_history_trend(tmp_path):
+    d = tmp_path / "hist"
+    for i, lat in enumerate([0.1, 0.2, 0.15]):
+        observatory.history_append(
+            d, _doc(tsolve=lat, niter=20, unix_time=time.time() + i))
+    ledger = os.path.join(str(d), sorted(os.listdir(d))[0])
+    r = run_script("plot_convergence.py", ["--ascii", ledger])
+    assert r.returncode == 0, r.stderr
+    assert "run-history ledger, 3 entries" in r.stdout
+    assert "acg:m" in r.stdout and "latency first" in r.stdout
+
+
+def test_v7_documents_still_load(tmp_path):
+    """The additive-schema acceptance: /7 documents (no slo key) still
+    flow through the ledger, bench_diff and plot_convergence."""
+    doc7 = _doc(schema="acg-tpu-stats/7", tsolve=0.1, niter=20,
+                soak={"nsolves": 3,
+                      "latency": {"p50": 0.1, "p95": 0.12, "p99": 0.2},
+                      "iterations": {"p50": 20},
+                      "drift": {"ratio": 1.0, "tripped": False}})
+    del doc7["stats"]["soak"]["drift"]["tripped"]  # keep it minimal
+    f7 = tmp_path / "v7.json"
+    f7.write_text(json.dumps(doc7))
+    # bench_diff: a /7 capture diffs against itself cleanly
+    r = run_script("bench_diff.py", [str(f7), str(f7)])
+    assert r.returncode == 0, (r.stdout, r.stderr)
+    # plot_convergence classifies the /7 soak capture
+    r = run_script("plot_convergence.py", ["--ascii", str(f7)])
+    assert r.returncode == 0 and "latency" in r.stdout
+    # the ledger indexes it (p50 latency preferred) and baselines it
+    d = tmp_path / "hist"
+    observatory.history_append(d, doc7)
+    e = observatory.history_scan(d)[0]
+    assert e["schema"] == "acg-tpu-stats/7"
+    assert e["latency_s"] == pytest.approx(0.1)
+    cases, all_unavail, _ = observatory.load_history_baseline(d)
+    assert not all_unavail and cases  # p50 its / p50 latency
+    r = run_script("plot_convergence.py",
+                   ["--ascii", os.path.join(str(d),
+                                            sorted(os.listdir(d))[0])])
+    assert r.returncode == 0 and "run-history ledger" in r.stdout
+
+
+# -- concurrent scrapes (satellite): no torn documents -------------------
+
+def test_concurrent_scrapes_mid_soak(csr, tmp_path):
+    """/status and /metrics polled from threads mid-soak must return a
+    valid document on EVERY poll -- no torn JSON, no half-written
+    exposition."""
+    from acg_tpu.soak import run_soak
+
+    spec = importlib.util.spec_from_file_location(
+        "check_metrics_textfile",
+        os.path.join(SCRIPTS, "check_metrics_textfile.py"))
+    checker = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(checker)
+
+    A = device_matrix_from_csr(csr, dtype=jnp.float64)
+    s = JaxCGSolver(A)
+    observatory.arm()
+    metrics.arm()
+    server = observatory.serve_status(0)
+    port = server.server_address[1]
+    done = threading.Event()
+    problems: list = []
+
+    def poll():
+        n = 0
+        while True:
+            n += 1
+            try:
+                with urllib.request.urlopen(
+                        f"http://127.0.0.1:{port}/status",
+                        timeout=10) as r:
+                    doc = json.loads(r.read())
+                if doc.get("schema") != "acg-tpu-status/1":
+                    problems.append(f"bad schema: {doc}")
+                with urllib.request.urlopen(
+                        f"http://127.0.0.1:{port}/metrics",
+                        timeout=10) as r:
+                    text = r.read().decode()
+                prom = tmp_path / f"scrape-{threading.get_ident()}.prom"
+                prom.write_text(text)
+                # format validity on every poll; the solve counters
+                # only EXIST after the first solve, so presence is
+                # asserted once at the end, not mid-poll
+                problems.extend(checker.check(str(prom)))
+            except Exception as e:  # noqa: BLE001 -- a failed poll IS
+                problems.append(repr(e))  # the failure being tested
+            if done.is_set() and n >= 3:
+                break
+
+    threads = [threading.Thread(target=poll, daemon=True)
+               for _ in range(3)]
+    for t in threads:
+        t.start()
+    try:
+        run_soak(s, np.ones(csr.shape[0]), nsolves=6,
+                 criteria=StoppingCriteria(maxits=100,
+                                           residual_rtol=1e-8))
+    finally:
+        done.set()
+        for t in threads:
+            t.join(timeout=60)
+        server.shutdown()
+        server.server_close()
+    assert not problems, problems[:5]
+    # the soak progress reached the status plane, and the final
+    # exposition carries the solve counters
+    doc = observatory.status_document()
+    assert doc["soak"] == {"solve": 6, "nsolves": 6}
+    assert doc["solves_completed"] == 6
+    final = tmp_path / "final.prom"
+    final.write_text(metrics.expose())
+    assert not checker.check(str(final), require=["acg_solves_total"])
+
+
+# -- CLI end-to-end: chunked dist solve with the full plane armed --------
+
+def test_cli_status_file_history_dist_chunked(tmp_path):
+    """The T1_STATUS smoke in miniature: a chunked 8-part CPU-mesh
+    solve with --status-file + --history + --slo; the status document
+    validates, the ledger row lands, and the acg_slo_* families are
+    exposed."""
+    status = tmp_path / "status.json"
+    hist = tmp_path / "hist"
+    prom = tmp_path / "m.prom"
+    r = run_cli(["gen:poisson2d:24", "--nparts", "8",
+                 "--max-iterations", "300", "--residual-rtol", "1e-8",
+                 "--warmup", "0", "--quiet",
+                 "--ckpt", str(tmp_path / "ck"), "--ckpt-every", "16",
+                 "--status-file", str(status),
+                 "--history", str(hist),
+                 "--slo", "latency=30,iters=250",
+                 "--metrics-file", str(prom),
+                 "--stats-json", str(tmp_path / "s.json")])
+    assert r.returncode == 0, r.stderr
+    doc = json.loads(status.read_text())
+    assert doc["schema"] == "acg-tpu-status/1"
+    assert doc["solve"]["converged"] is True
+    assert doc["solve"]["iteration"] > 0
+    assert doc["residual_trail"]  # chunk samples landed
+    assert "snapshot" in {e["kind"] for e in doc.get("events", [])}
+    entries = observatory.history_scan(hist)
+    assert len(entries) == 1
+    assert entries[0]["nparts"] == 8
+    assert entries[0]["doc"]["stats"]["slo"]["targets"]["iters"] == 250
+    txt = prom.read_text()
+    assert "acg_slo_target" in txt and "acg_slo_burn_ratio" in txt
