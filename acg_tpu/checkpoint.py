@@ -55,16 +55,18 @@ import zlib
 
 import numpy as np
 
-from acg_tpu.errors import AcgError, ErrorCode
+from acg_tpu.errors import AcgError, ErrorCode, ExitCode
 
 MAGIC = b"ACGCKPT1\n"
 # snapshot container version (bump on layout changes; readers refuse
-# versions they do not know rather than misparse)
+# versions they do not know rather than misparse).  Version 1 files
+# remain readable: the repartition sidecar and env metadata are
+# ADDITIVE (absent keys degrade to refusals/no-ops, never misparses)
 VERSION = 1
-# exit code of a crash:exit fault firing (distinct from peer:dead's 86
-# and erragree's PEER_LOST_EXIT 97; in the 64..113 hole shell
-# conventions leave free)
-CRASH_EXIT_CODE = 94
+# exit code of a crash:exit fault firing (the process-wide contract
+# lives in errors.ExitCode; distinct from peer:dead's 86 and the
+# erragree teardown's 97)
+CRASH_EXIT_CODE = int(ExitCode.CRASH_INJECTED)
 
 
 @dataclasses.dataclass(frozen=True)
@@ -73,27 +75,59 @@ class CheckpointConfig:
 
     ``path`` is where snapshots land (None = resume-only: continue a
     crashed solve without writing further snapshots); ``every`` the
-    chunk length in iterations (must be positive when ``path`` is
-    set); ``resume`` a loaded :class:`SolverSnapshot` consumed by the
-    first solve."""
+    chunk length in iterations; ``secs`` the WALL-CLOCK snapshot
+    cadence (mutually exclusive with ``every`` -- slow iterations
+    would otherwise stretch the loss window unboundedly; the chunk
+    drivers size each chunk from the measured s/iteration so one chunk
+    targets ~``secs`` of wall time); ``resume`` a loaded
+    :class:`SolverSnapshot` consumed by the first solve;
+    ``repartition`` opts into SHAPE-PORTABLE resume: an N-part
+    snapshot restores onto this solver's (different) partition via the
+    global row-permutation sidecar (:func:`reassemble_global`) --
+    cross-tier resume (dist -> single-device/host and back) falls out
+    of the same path."""
 
     path: str | None = None
     every: int = 0
     resume: "SolverSnapshot | None" = None
+    secs: float = 0.0
+    repartition: bool = False
 
     def __post_init__(self):
-        if self.path is not None and self.every <= 0:
-            raise ValueError("checkpointing needs a positive snapshot "
-                             "period (ckpt_every K)")
+        if self.every > 0 and self.secs > 0:
+            raise ValueError("checkpoint cadence is EITHER ckpt_every "
+                             "K iterations OR ckpt_secs S wall-clock "
+                             "seconds, not both")
+        if self.secs < 0:
+            raise ValueError("ckpt_secs must be positive seconds")
+        if self.path is not None and self.every <= 0 and self.secs <= 0:
+            raise ValueError("checkpointing needs a snapshot cadence "
+                             "(ckpt_every K or ckpt_secs S)")
         if self.path is None and self.resume is None:
             raise ValueError("a CheckpointConfig needs a snapshot path "
                              "and/or a snapshot to resume from")
+        if self.repartition and self.resume is None:
+            raise ValueError("repartition is a resume policy; it needs "
+                             "a snapshot to resume from")
 
-    @property
-    def chunk(self) -> int:
-        """The host chunk length: the snapshot period, or (resume-only
-        configurations) unbounded -- one final chunk to convergence."""
-        return self.every if self.every > 0 else 1 << 30
+    # chunk length of the first dispatch under a wall-clock cadence,
+    # before any s/iteration measurement exists (small, so the probe
+    # costs at most one early snapshot)
+    PROBE_CHUNK = 16
+
+    def chunk_for(self, s_per_iter: float | None) -> int:
+        """The next dispatch's chunk length: the iteration period when
+        one is set; under a wall-clock cadence, ``secs`` divided by the
+        measured seconds/iteration (a probe chunk until one exists);
+        unbounded for resume-only configurations -- one final chunk to
+        convergence."""
+        if self.every > 0:
+            return self.every
+        if self.secs > 0:
+            if not s_per_iter or s_per_iter <= 0:
+                return self.PROBE_CHUNK
+            return max(1, min(int(self.secs / s_per_iter) or 1, 1 << 24))
+        return 1 << 30
 
 
 @dataclasses.dataclass
@@ -128,8 +162,68 @@ def carry_names(pipelined: bool, precond: bool) -> tuple:
     return ("x", "r", "w", "p", "t", "z", "gamma", "alpha")
 
 
+# tiers whose carry leaves are field-compatible global row vectors
+# once reassembled (carry_names is shared): the repartition-resume set.
+# sharded-dia pads rows to the mesh and is excluded -- its vectors are
+# not plain global row order.
+REPARTITION_TIERS = frozenset({"jax-cg", "dist-cg", "host-cg"})
+
+
 def _crc(data: bytes) -> int:
     return zlib.crc32(data) & 0xFFFFFFFF
+
+
+def env_meta() -> dict:
+    """The runtime environment a snapshot was written under
+    (jax/jaxlib versions + backend platform): a resume across a
+    version or backend change is numerically legal but can perturb the
+    trajectory, so :func:`check_resume_env` warns instead of silently
+    continuing."""
+    meta = {}
+    try:
+        import jax
+        import jaxlib
+
+        meta["jax"] = str(jax.__version__)
+        meta["jaxlib"] = str(jaxlib.__version__)
+        try:
+            meta["backend"] = str(jax.default_backend())
+        except Exception:  # noqa: BLE001 -- backend down: still record
+            meta["backend"] = None  # the versions
+    except Exception:  # noqa: BLE001 -- no jax (host-only callers)
+        pass
+    return meta
+
+
+def check_resume_env(snap: SolverSnapshot, stats=None) -> list:
+    """Compare the snapshot's recorded environment against this
+    process's; mismatches WARN (stderr + a structured
+    ``resume-env-mismatch`` event on ``stats``) instead of refusing --
+    the resume is legal, but a changed jax/jaxlib/backend can shift
+    rounding enough to move the iteration count, and the operator
+    should know why.  Returns the mismatch descriptions ([] when clean
+    or when the snapshot predates env recording)."""
+    import sys
+
+    recorded = snap.meta.get("env") or {}
+    if not recorded:
+        return []
+    here = env_meta()
+    mismatches = [
+        f"{key} {recorded.get(key)!r} -> {here.get(key)!r}"
+        for key in ("jax", "jaxlib", "backend")
+        if key in recorded and key in here
+        and recorded.get(key) != here.get(key)]
+    if mismatches:
+        detail = ", ".join(mismatches)
+        sys.stderr.write(
+            f"acg-tpu: warning: resuming across an environment change "
+            f"({detail}); the trajectory may deviate from the "
+            f"pre-crash run's\n")
+        if stats is not None:
+            from acg_tpu.telemetry import record_event
+            record_event(stats, "resume-env-mismatch", detail)
+    return mismatches
 
 
 def vector_checksum(v) -> int:
@@ -147,7 +241,13 @@ def save_snapshot(path, meta: dict, arrays: dict) -> int:
     header (meta + per-array manifest) + the raw little-endian array
     payload.  The file lands under a temporary name and is
     ``os.replace``d into place, so a crash mid-write can never leave a
-    torn snapshot where a good one stood."""
+    torn snapshot where a good one stood.
+
+    The writer stamps the runtime environment (:func:`env_meta`) into
+    the metadata so ``--resume`` across a jax/jaxlib/backend change
+    can warn (:func:`check_resume_env`)."""
+    meta = dict(meta)
+    meta.setdefault("env", env_meta())
     manifest = []
     blobs = []
     off = 0
@@ -246,12 +346,22 @@ def load_snapshot(path) -> SolverSnapshot:
 def validate_resume(snap: SolverSnapshot, *, tier: str, pipelined: bool,
                     precond: str | None, n: int, dtype,
                     b_crc: int | None = None,
-                    nparts: int | None = None) -> None:
+                    nparts: int | None = None,
+                    repartition: bool = False) -> None:
     """Refuse a snapshot that does not describe THIS solve: wrong tier,
     algorithm, preconditioner, size, dtype, partition count, or
     right-hand side.  A mismatch here means the operator pointed
     ``--resume`` at somebody else's solve -- continuing would converge
-    to the wrong answer with a green exit code."""
+    to the wrong answer with a green exit code.
+
+    ``repartition=True`` (the ``--resume-repartition`` opt-in) relaxes
+    EXACTLY the shape checks -- tier and partition count -- for the
+    tiers whose reassembled carries are field-compatible
+    (:data:`REPARTITION_TIERS`): an N-part snapshot may then restore
+    onto an M-part mesh, the single-device tier, or the host oracle.
+    Algorithm, preconditioner, size, dtype and right-hand-side
+    mismatches keep refusing -- those would still converge to the
+    wrong answer."""
     m = snap.meta
 
     def need(key, want, what):
@@ -262,15 +372,129 @@ def validate_resume(snap: SolverSnapshot, *, tier: str, pipelined: bool,
                 f"snapshot does not match this solve: {what} is "
                 f"{got!r}, this run has {want!r}")
 
-    need("tier", tier, "solver tier")
+    if repartition:
+        got_tier = m.get("tier")
+        if tier not in REPARTITION_TIERS or \
+                got_tier not in REPARTITION_TIERS:
+            raise AcgError(
+                ErrorCode.INVALID_VALUE,
+                f"repartition resume supports the "
+                f"{'/'.join(sorted(REPARTITION_TIERS))} tiers; this "
+                f"snapshot is {got_tier!r} and this solve "
+                f"{tier!r}")
+    else:
+        need("tier", tier, "solver tier")
+        if nparts is not None:
+            need("nparts", int(nparts), "partition count")
     need("pipelined", bool(pipelined), "algorithm (pipelined)")
     need("precond", precond, "preconditioner")
     need("n", int(n), "unknowns")
     need("dtype", str(np.dtype(dtype)), "vector dtype")
-    if nparts is not None:
-        need("nparts", int(nparts), "partition count")
     if b_crc is not None and m.get("b_crc") is not None:
         need("b_crc", int(b_crc), "right-hand-side checksum")
+
+
+def reassemble_global(snap: SolverSnapshot) -> SolverSnapshot:
+    """An N-part snapshot's carry vectors reassembled into GLOBAL row
+    order via the stored row-permutation sidecar (``_rowperm`` array +
+    ``part_rows`` metadata), ready to re-slice onto any partition --
+    the shape-portable half of ``--resume-repartition``.  Snapshots
+    from the single-device/host tiers (no sidecar, nparts absent or 1)
+    already store global vectors and pass through unchanged.  A
+    missing, malformed or corrupted sidecar REFUSES with a typed
+    error: scattering rows through a wrong permutation would resume a
+    scrambled Krylov state and converge to a wrong answer."""
+    m = snap.meta
+    nparts = int(m.get("nparts") or 1)
+    if nparts <= 1 and "_rowperm" not in snap.arrays:
+        return snap
+
+    def bad(why: str):
+        return AcgError(
+            ErrorCode.INVALID_VALUE,
+            f"snapshot cannot be repartitioned: {why}")
+
+    n = int(m["n"])
+    perm = snap.arrays.get("_rowperm")
+    part_rows = m.get("part_rows")
+    if perm is None or part_rows is None:
+        raise bad("it lacks the row-permutation sidecar (_rowperm + "
+                  "part_rows; written by checkpoint-armed distributed "
+                  "solves from this release on) -- re-snapshot, or "
+                  "resume on the matching partition without "
+                  "--resume-repartition")
+    perm = np.asarray(perm).reshape(-1).astype(np.int64, copy=False)
+    try:
+        part_rows = [int(r) for r in part_rows]
+    except (TypeError, ValueError):
+        raise bad(f"part_rows is not a row-count list: {part_rows!r}")
+    if len(part_rows) != nparts or any(r < 0 for r in part_rows) \
+            or sum(part_rows) != n:
+        raise bad(f"part_rows {part_rows!r} does not partition "
+                  f"{n} rows into {nparts} parts")
+    from acg_tpu.partition import is_permutation
+    if not is_permutation(perm, n):
+        raise bad(f"the row-permutation sidecar is not a permutation "
+                  f"of {n} rows (corrupted or stale sidecar)")
+
+    arrays = {}
+    for name, a in snap.arrays.items():
+        if name == "_rowperm":
+            continue
+        a = np.asarray(a)
+        if name in SCALAR_LEAVES or a.ndim == 0:
+            arrays[name] = a
+            continue
+        if a.ndim != 2 or a.shape[0] != nparts \
+                or a.shape[1] < max(part_rows, default=0):
+            raise bad(f"carry leaf {name!r} (shape {a.shape}) does "
+                      f"not hold the {nparts}-part stacked layout")
+        out = np.zeros(n, dtype=a.dtype)
+        off = 0
+        for p, rows in enumerate(part_rows):
+            out[perm[off: off + rows]] = a[p, :rows]
+            off += rows
+        arrays[name] = out
+    meta = dict(m)
+    meta["repartitioned_from"] = {"tier": m.get("tier"),
+                                  "nparts": nparts}
+    meta.pop("nparts", None)
+    meta.pop("part_rows", None)
+    return SolverSnapshot(meta=meta, arrays=arrays)
+
+
+def apply_repartition(snap: SolverSnapshot, *, tier: str, nparts: int,
+                      stats, precond_spec=None) -> tuple:
+    """The shared repartition-resume sequence (ONE implementation for
+    the jax-cg / dist-cg / host-cg chunk drivers): reassemble the
+    snapshot's carry into global row order, and when the source shape
+    differs from this solve's, record the repartition metric + the
+    structured event and warn when the preconditioner operator depends
+    on the partition (continuing under a different M is flexible-CG).
+    Returns ``(snapshot, repartitioned)`` -- ``repartitioned`` is
+    ``{"tier", "nparts"}`` of the source, or None when the shapes
+    already matched."""
+    import sys
+
+    src = (snap.meta.get("tier"), int(snap.meta.get("nparts") or 1))
+    snap = reassemble_global(snap)
+    if src == (tier, int(nparts)):
+        return snap, None
+    from acg_tpu import metrics
+    from acg_tpu.telemetry import record_event
+
+    metrics.record_repartition()
+    record_event(stats, "repartition",
+                 f"resumed a {src[1]}-part {src[0]} snapshot on "
+                 f"{int(nparts)}-part {tier}")
+    from acg_tpu.precond import partition_sensitive
+    if precond_spec is not None and partition_sensitive(precond_spec):
+        sys.stderr.write(
+            f"acg-tpu: warning: --precond {precond_spec} depends on "
+            f"the partition; the repartitioned resume continues with "
+            f"a DIFFERENT M (flexible-CG semantics -- expect a few "
+            f"extra iterations)\n")
+    return snap, {"tier": src[0], "nparts": src[1]}
 
 
 def agree_seq(seq: int, iteration: int, timeout: float = 120.0) -> None:

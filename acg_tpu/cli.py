@@ -243,7 +243,17 @@ def make_parser() -> argparse.ArgumentParser:
                         "agree on status codes and store nothing")
     p.add_argument("--ckpt-every", type=int, default=0, metavar="K",
                    help="with --ckpt: snapshot period in iterations "
-                        "(required; also the host chunk length)")
+                        "(also the host chunk length; exactly one of "
+                        "--ckpt-every/--ckpt-secs is required)")
+    p.add_argument("--ckpt-secs", type=float, default=0.0, metavar="S",
+                   help="with --ckpt: WALL-CLOCK snapshot cadence -- "
+                        "the chunk drivers size each dispatch from the "
+                        "measured seconds/iteration so one snapshot "
+                        "commits about every S seconds of solve time "
+                        "(slow iterations no longer stretch the loss "
+                        "window the way a fixed --ckpt-every K does); "
+                        "snapshot time bills to the ckpt phase as "
+                        "usual.  Mutually exclusive with --ckpt-every")
     p.add_argument("--resume", metavar="FILE", default=None,
                    help="reconstruct the solver state from a --ckpt "
                         "snapshot and CONTINUE the solve to the "
@@ -255,6 +265,23 @@ def make_parser() -> argparse.ArgumentParser:
                         "(pre-crash + post-resume) match an "
                         "uninterrupted run.  Combine with --ckpt to "
                         "keep snapshotting after the resume")
+    p.add_argument("--resume-repartition", action="store_true",
+                   help="with --resume: accept a snapshot from a "
+                        "DIFFERENT partition count or solver tier "
+                        "(dist <-> single-device <-> host oracle) -- "
+                        "the carry vectors are reassembled into global "
+                        "row order through the snapshot's row-"
+                        "permutation sidecar, re-sliced onto THIS "
+                        "run's partition (halo plans and "
+                        "preconditioner state rebuild at setup), and "
+                        "the solve continues to the ORIGINAL "
+                        "tolerance.  This is how a solve survives a "
+                        "lost chip: resume on the survivor mesh with "
+                        "fewer --nparts (the --supervise mode does "
+                        "this automatically).  Algorithm/dtype/"
+                        "preconditioner/right-hand-side mismatches "
+                        "still refuse; a corrupted permutation "
+                        "sidecar refuses")
     p.add_argument("--heartbeat", type=float, default=0.0,
                    metavar="SECONDS",
                    help="multi-controller dead-peer detection DURING "
@@ -267,6 +294,58 @@ def make_parser() -> argparse.ArgumentParser:
                         "--resume -- the stage-sync watchdog "
                         "(--err-timeout) cannot see a peer that dies "
                         "INSIDE a collective (default: off)")
+    p.add_argument("--supervise", action="store_true",
+                   help="elastic-recovery tier (acg_tpu.supervisor): "
+                        "run the solve as a SUPERVISED CHILD process "
+                        "and watch the exit-code contract (see "
+                        "--buildinfo): a crash (rc 94), a lost peer "
+                        "(rc 86/97), a signal death or a failed solve "
+                        "relaunches the child with --resume from the "
+                        "last committed snapshot -- shrinking --nparts "
+                        "onto the survivor mesh with "
+                        "--resume-repartition when a peer was lost "
+                        "(--shrink) -- under a bounded relaunch budget "
+                        "with exponential backoff.  Needs --ckpt FILE "
+                        "with a cadence; drift (rc 7) and SLO (rc 8) "
+                        "verdicts pass through.  Relaunch decisions "
+                        "land as acg_recovery_* metrics, a recovery: "
+                        "stats section, and the status document's "
+                        "degraded key")
+    p.add_argument("--relaunch-budget", type=int, default=3, metavar="N",
+                   help="with --supervise: relaunches granted before "
+                        "giving up with exit 95 (default: 3)")
+    p.add_argument("--relaunch-backoff", type=float, default=1.0,
+                   metavar="SECONDS",
+                   help="with --supervise: sleep SECONDS * 2^(n-1) "
+                        "before the n-th relaunch (default: 1)")
+    p.add_argument("--shrink", default="peer-lost",
+                   choices=["never", "peer-lost", "any"],
+                   help="with --supervise: which failures shrink the "
+                        "mesh on relaunch (halving --nparts down to "
+                        "--min-parts, resuming with "
+                        "--resume-repartition): peer-lost = only dead-"
+                        "peer teardowns (rc 86/97; default), any = "
+                        "every relaunchable failure (lets a single-"
+                        "host crash demonstrate the elastic ladder), "
+                        "never = always relaunch on the same mesh")
+    p.add_argument("--min-parts", type=int, default=1, metavar="M",
+                   help="with --supervise: never shrink below M parts "
+                        "(default: 1)")
+    p.add_argument("--chaos", metavar="SEED[:N]", default=None,
+                   help="chaos campaign (acg_tpu.supervisor): generate "
+                        "N (default 20) seeded randomized fault "
+                        "schedules over the existing fault sites "
+                        "(crash:exit, sdc:flip when --abft is armed, "
+                        "spmv/halo/dot corruption, peer:dead under "
+                        "--multihost, solve:slow under --soak), run "
+                        "each through the supervisor, independently "
+                        "VERIFY every green run's true residual "
+                        "against a host-side rebuild of the matrix, "
+                        "and record per-schedule verdicts (converged / "
+                        "agreed-abort / WRONG-ANSWER) to stderr and "
+                        "the --history ledger.  Exit 96 if ANY "
+                        "schedule converged to a wrong answer -- the "
+                        "acceptance bar is zero wrong-answer-green")
     p.add_argument("--precise-dots", action="store_true",
                    help="compensated (double-float) dot products for the "
                         "CG scalars; lets f32 storage converge past the "
@@ -625,9 +704,25 @@ def _buildinfo(out) -> int:
          f"heartbeats carry the same it/s + ETA on every tier incl. "
          f"the host oracle; 'slo' stats section, schema "
          f"{STATS_SCHEMA}"),
+        ("elastic recovery", "--supervise (survivor-mesh process "
+         "supervisor: watches the exit-code contract, relaunches with "
+         "--resume -- shrinking --nparts with --resume-repartition on "
+         "a lost peer -- under --relaunch-budget/--relaunch-backoff; "
+         "recovery: section, acg_recovery_* metrics, status-doc "
+         "degraded key), --resume-repartition (restore an N-part "
+         "snapshot onto an M-part mesh or the single-device/host "
+         "tiers via the global row-permutation sidecar), --ckpt-secs "
+         "S (wall-clock snapshot cadence), --chaos SEED[:N] (seeded "
+         "fault campaign through the supervisor; per-schedule "
+         "converged/agreed-abort/WRONG-ANSWER verdicts into the "
+         "--history ledger, exit 96 on any wrong-answer-green)"),
     ]
     for k, v in rows:
         out.write(f"{k}: {v}\n")
+    from acg_tpu.errors import exit_code_table
+    out.write("exit codes:\n")
+    for code, origin, meaning in exit_code_table():
+        out.write(f"  {code:>3}  [{origin}] {meaning}\n")
     return 0
 
 
@@ -692,6 +787,26 @@ def _parse_gen_spec(spec: str):
         raise SystemExit(
             f"acg-tpu: invalid generator spec {spec!r}: expected "
             f"gen:poisson2d:N | gen:poisson3d:N | gen:irregular:N[:AVGDEG]")
+
+
+def synthesize_host_matrix(spec_str: str, aniso=None, seed: int = 42):
+    """``gen:`` spec -> host :class:`~acg_tpu.matrix.SymCsrMatrix` --
+    ONE dispatch shared by the solve pipeline and the chaos campaign's
+    verification oracle (acg_tpu.supervisor), so the matrix verified
+    against can never drift from the matrix solved."""
+    from acg_tpu.io.generators import (aniso_poisson2d_coo,
+                                       irregular_spd_coo, poisson2d_coo,
+                                       poisson3d_coo)
+    from acg_tpu.matrix import SymCsrMatrix
+
+    kind, dim, n, N, avg = _parse_gen_spec(spec_str)
+    if kind == "poisson" and aniso is not None:
+        r, c, v, N = aniso_poisson2d_coo(n, aniso)
+    elif kind == "poisson":
+        r, c, v, N = (poisson2d_coo if dim == 2 else poisson3d_coo)(n)
+    else:
+        r, c, v, N = irregular_spd_coo(n, avg_degree=avg, seed=seed)
+    return SymCsrMatrix.from_coo(N, r, c, v)
 
 
 def _gen_direct_min() -> int:
@@ -1917,6 +2032,14 @@ def main(argv=None) -> int:
             os.dup2(os.open(os.devnull, os.O_WRONLY), sys.stdout.fileno())
             return 0
     args = make_parser().parse_args(argv)
+    if args.chaos is not None or args.supervise:
+        # elastic-recovery driver modes (acg_tpu.supervisor): the
+        # supervisor owns the child solve processes' lifecycle; none of
+        # the in-process teardown below (fault env, metrics/observatory
+        # finalisation) applies to the supervising parent
+        from acg_tpu.supervisor import run_chaos, run_supervised
+        return (run_chaos(args, list(argv)) if args.chaos is not None
+                else run_supervised(args, list(argv)))
     args.numfmt = _validate_numfmt(args.numfmt)
     import os
 
@@ -2112,12 +2235,23 @@ def _main(args) -> int:
     # compile), and refuse configurations the chunk drivers cannot
     # serve (the fault-injector could-never-fire discipline)
     args._ckpt = None
-    if args.ckpt is not None and args.ckpt_every <= 0:
-        raise SystemExit("acg-tpu: --ckpt needs a positive snapshot "
-                         "period: add --ckpt-every K")
-    if args.ckpt_every and args.ckpt is None:
-        raise SystemExit("acg-tpu: --ckpt-every needs --ckpt FILE "
-                         "(a period with nowhere to write)")
+    if args.ckpt_every > 0 and args.ckpt_secs > 0:
+        raise SystemExit("acg-tpu: --ckpt-every and --ckpt-secs are "
+                         "mutually exclusive cadences; pick one")
+    if args.ckpt_secs < 0:
+        raise SystemExit("acg-tpu: --ckpt-secs must be positive "
+                         "seconds")
+    if args.ckpt is not None and args.ckpt_every <= 0 \
+            and args.ckpt_secs <= 0:
+        raise SystemExit("acg-tpu: --ckpt needs a snapshot cadence: "
+                         "add --ckpt-every K or --ckpt-secs S")
+    if (args.ckpt_every or args.ckpt_secs > 0) and args.ckpt is None:
+        raise SystemExit("acg-tpu: --ckpt-every/--ckpt-secs need "
+                         "--ckpt FILE (a cadence with nowhere to "
+                         "write)")
+    if args.resume_repartition and args.resume is None:
+        raise SystemExit("acg-tpu: --resume-repartition is a resume "
+                         "policy; add --resume FILE")
     if args.heartbeat < 0:
         raise SystemExit("acg-tpu: --heartbeat must be >= 0 seconds")
     if 0 < args.heartbeat <= 0.5:
@@ -2163,9 +2297,10 @@ def _main(args) -> int:
             except _AcgError as e:
                 raise SystemExit(f"acg-tpu: {e}")
         try:
-            args._ckpt = CheckpointConfig(path=args.ckpt,
-                                          every=args.ckpt_every,
-                                          resume=resume_snap)
+            args._ckpt = CheckpointConfig(
+                path=args.ckpt, every=args.ckpt_every,
+                secs=args.ckpt_secs, resume=resume_snap,
+                repartition=args.resume_repartition)
         except ValueError as e:
             raise SystemExit(f"acg-tpu: {e}")
     if args.aniso is not None:
@@ -2343,7 +2478,8 @@ def _main(args) -> int:
                 f"JAX_PLATFORMS=cpu for a host-platform debug solve, or "
                 f"set ACG_TPU_SKIP_BACKEND_PROBE=1 to wait out a slow "
                 f"init\n")
-            return 3
+            from acg_tpu.errors import ExitCode
+            return int(ExitCode.BACKEND_UNAVAILABLE)
 
     # --on-gap replace rides the same recovery machinery as --recover:
     # the gap trip exits through the breakdown path and the driver
@@ -2387,6 +2523,10 @@ def _main(args) -> int:
             args._heartbeat = DeadlineHeartbeat(
                 period=max(args.heartbeat / 6.0, 0.5),
                 deadline=args.heartbeat).start()
+            if getattr(args, "_observatory_armed", False):
+                # live-status tier: the status document's peers: block
+                # exposes per-controller beat ages from this heartbeat
+                observatory.set_heartbeat(args._heartbeat)
     elif args.heartbeat > 0:
         sys.stderr.write("acg-tpu: warning: --heartbeat is "
                          "multi-controller dead-peer detection; no-op "
@@ -2456,18 +2596,8 @@ def _main(args) -> int:
                 return _solve_generated_direct(args, dim, n, N, jax, jnp, dtype,
                                                vec_dtype)
             _log(args, f"synthesizing {args.A} (N={N})")
-            from acg_tpu.io.generators import (aniso_poisson2d_coo,
-                                               irregular_spd_coo,
-                                               poisson2d_coo,
-                                               poisson3d_coo)
-            if kind == "poisson" and args.aniso is not None:
-                r, c, v, N = aniso_poisson2d_coo(n, args.aniso)
-            elif kind == "poisson":
-                r, c, v, N = (poisson2d_coo if dim == 2 else poisson3d_coo)(n)
-            else:
-                r, c, v, N = irregular_spd_coo(n, avg_degree=spec[4],
-                                               seed=args.seed)
-            A = SymCsrMatrix.from_coo(N, r, c, v)
+            A = synthesize_host_matrix(args.A, aniso=args.aniso,
+                                       seed=args.seed)
             _log(args, "synthesize matrix:", t0)
         else:
             _log(args, f"reading matrix from {args.A}")

@@ -107,6 +107,74 @@ class BreakdownError(AcgError):
         super().__init__(ErrorCode.BREAKDOWN, detail)
 
 
+class ExitCode(enum.IntEnum):
+    """The PROCESS exit-code contract -- one registry for every code
+    the CLI, the soak/SLO gates, the fault injector, the erragree
+    watchdogs and the supervisor can return, so the supervisor (and
+    operators' runbooks) read exit statuses from one table instead of
+    grepping four modules.  Codes 86..97 sit in the 64..113 hole shell
+    conventions leave free; rendered by ``--buildinfo``."""
+
+    OK = 0
+    FAILURE = 1                  # solve/config failure, agreed abort
+    NOTHING_COMPARABLE = 2       # bench_diff: no case in common
+    BACKEND_UNAVAILABLE = 3      # bounded backend probe failed
+    DRIFT = 7                    # --fail-on-drift: EWMA latency drift
+    SLO_BREACH = 8               # --fail-on-slo: declared objective
+    PEER_DEAD_INJECTED = 86      # peer:dead fault fired on this rank
+    CRASH_INJECTED = 94          # crash:exit fault fired (resumable)
+    RELAUNCH_BUDGET = 95         # supervisor: relaunch budget spent
+    WRONG_ANSWER = 96            # chaos: converged to a wrong answer
+    PEER_LOST = 97               # erragree watchdog/heartbeat teardown
+
+
+# (code, origin, meaning) -- the table --buildinfo renders and the
+# supervisor's relaunch policy keys off
+EXIT_CONTRACT: tuple = (
+    (ExitCode.OK, "everywhere", "success"),
+    (ExitCode.FAILURE, "cli/solvers",
+     "solve or configuration failure (agreed abort)"),
+    (ExitCode.NOTHING_COMPARABLE, "bench_diff",
+     "no comparable case between captures"),
+    (ExitCode.BACKEND_UNAVAILABLE, "cli",
+     "accelerator backend unavailable (bounded probe failed)"),
+    (ExitCode.DRIFT, "soak",
+     "--fail-on-drift: EWMA solve latency drifted past the gate"),
+    (ExitCode.SLO_BREACH, "observatory",
+     "--fail-on-slo: a declared service-level objective breached"),
+    (ExitCode.PEER_DEAD_INJECTED, "faults",
+     "peer:dead fault injector killed this controller"),
+    (ExitCode.CRASH_INJECTED, "faults/checkpoint",
+     "crash:exit fault injector killed this process between snapshot "
+     "commits (relaunch with --resume)"),
+    (ExitCode.RELAUNCH_BUDGET, "supervisor",
+     "--supervise: relaunch budget exhausted without a converged run"),
+    (ExitCode.WRONG_ANSWER, "supervisor",
+     "--chaos: a schedule converged (rc 0) but failed the independent "
+     "true-residual verification"),
+    (ExitCode.PEER_LOST, "erragree",
+     "a peer controller died (stage-sync watchdog or solve heartbeat); "
+     "this process tore down so the supervisor can relaunch"),
+)
+
+# the supervisor's relaunch policy over the contract: which child exit
+# codes are worth another attempt from the last snapshot, and which of
+# those indicate a LOST PEER (shrink onto the survivor mesh)
+RELAUNCHABLE_CODES = frozenset({
+    int(ExitCode.FAILURE), int(ExitCode.BACKEND_UNAVAILABLE),
+    int(ExitCode.PEER_DEAD_INJECTED), int(ExitCode.CRASH_INJECTED),
+    int(ExitCode.PEER_LOST)})
+PEER_LOST_CODES = frozenset({
+    int(ExitCode.PEER_DEAD_INJECTED), int(ExitCode.PEER_LOST)})
+
+
+def exit_code_table() -> list:
+    """``[(int code, origin, meaning), ...]`` sorted by code -- the
+    ``--buildinfo`` rendering of the contract."""
+    return [(int(c), o, m)
+            for c, o, m in sorted(EXIT_CONTRACT, key=lambda r: int(r[0]))]
+
+
 def fexcept_str(*arrays) -> str:
     """Report floating-point exceptions observable in computed arrays.
 

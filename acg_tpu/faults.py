@@ -98,6 +98,24 @@ class FaultSpec:
     def device_site(self) -> bool:
         return self.site in DEVICE_SITES
 
+    def __str__(self) -> str:
+        """The canonical ``SITE:MODE[@ITER][:KEY=VAL]`` spec string:
+        ``parse_fault_spec(str(spec)) == spec``, so snapshot metadata
+        and the chaos ledger record re-runnable specs instead of
+        dataclass reprs."""
+        s = f"{self.site}:{self.mode}"
+        if self.iteration >= 0:
+            s += f"@{self.iteration}"
+        if self.part >= 0:
+            s += f":part={self.part}"
+        if self.proc != 0:
+            s += f":proc={self.proc}"
+        if self.secs != 300.0:
+            s += f":secs={self.secs:g}"
+        if self.seed != 0:
+            s += f":seed={self.seed}"
+        return s
+
     def shift(self, consumed: int) -> "FaultSpec | None":
         """The spec as seen by a RESTARTED solve that already ran
         ``consumed`` iterations: the firing iteration moves earlier, and
@@ -327,11 +345,13 @@ def maybe_fail_peer(stage: str = "") -> None:
     import sys
 
     if spec.mode == "dead":
+        from acg_tpu.errors import ExitCode
+
         sys.stderr.write(f"acg-tpu: fault injector: controller "
                          f"{spec.proc} dying before checkpoint "
                          f"{stage or '?'}\n")
         sys.stderr.flush()
-        os._exit(86)
+        os._exit(int(ExitCode.PEER_DEAD_INJECTED))
     sys.stderr.write(f"acg-tpu: fault injector: controller {spec.proc} "
                      f"stalling {spec.secs:.0f}s at checkpoint "
                      f"{stage or '?'}\n")

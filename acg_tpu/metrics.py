@@ -485,6 +485,20 @@ CKPT_ROLLBACKS = REGISTRY.counter(
     "acg_ckpt_rollbacks_total", "Breakdowns answered by rolling the "
     "loop carry back to the last snapshot (the recovery ladder's "
     "first rung).")
+CKPT_REPARTITIONS = REGISTRY.counter(
+    "acg_ckpt_repartition_resumes_total", "Shape-portable resumes: "
+    "snapshots reassembled through the row-permutation sidecar onto "
+    "a different partition or tier (--resume-repartition).")
+# elastic-recovery tier (acg_tpu.supervisor, --supervise): child
+# relaunches and time-to-recovery
+RECOVERY_RELAUNCHES = REGISTRY.counter(
+    "acg_recovery_relaunches_total", "Supervisor child relaunches by "
+    "failure reason (crash/peer-lost/failure/backend).",
+    labelnames=("reason",))
+RECOVERY_MTTR = REGISTRY.histogram(
+    "acg_recovery_mttr_seconds", "Seconds from the first failing "
+    "child exit to the eventual converged run (--supervise; observed "
+    "once per recovered incident).", buckets=SOLVE_SECONDS_BUCKETS)
 # ABFT checksum-protected SpMV (acg_tpu.health, --abft)
 ABFT_CHECKS = REGISTRY.counter(
     "acg_abft_checks_total", "In-loop Huang-Abraham checksum "
@@ -635,6 +649,25 @@ def record_snapshot(nbytes: int, seconds: float) -> None:
 def record_resume() -> None:
     if _armed:
         CKPT_RESUMES.inc()
+
+
+def record_repartition() -> None:
+    if _armed:
+        CKPT_REPARTITIONS.inc()
+
+
+def record_relaunch(reason: str) -> None:
+    """One supervisor child relaunch (--supervise), by failure
+    reason."""
+    if _armed:
+        RECOVERY_RELAUNCHES.labels(reason=str(reason)).inc()
+
+
+def record_recovery_mttr(seconds: float) -> None:
+    """One recovered incident's mean-time-to-recovery observation:
+    first failing child exit -> eventual converged run."""
+    if _armed:
+        RECOVERY_MTTR.observe(max(float(seconds), 0.0))
 
 
 def record_abft(nchecks: int, rel_last, ntrips: int) -> None:

@@ -79,9 +79,11 @@ EVENT_CAPACITY = 16
 # thousands of times per second on a tiny solve, and the file sink must
 # not turn the observability plane into an I/O workload
 STATUS_FILE_INTERVAL = 0.2
-# CLI exit code for a tripped --fail-on-slo gate (7 is the soak drift
-# gate's; same contract family)
-SLO_EXIT_CODE = 8
+# CLI exit code for a tripped --fail-on-slo gate (the process-wide
+# contract lives in errors.ExitCode; --buildinfo renders the table)
+from acg_tpu.errors import ExitCode as _ExitCode
+
+SLO_EXIT_CODE = int(_ExitCode.SLO_BREACH)
 
 
 def _finite(v) -> float | None:
@@ -114,6 +116,7 @@ class SolveStatus:
         self.imbalance: dict | None = None
         self.soak: dict | None = None
         self.kappa: dict | None = None
+        self.degraded: dict | None = None
         self.solves_completed = 0
         self.armed_since: float | None = None
 
@@ -196,6 +199,15 @@ class SolveStatus:
     def note_soak(self, i: int, nsolves: int) -> None:
         with self._lock:
             self.soak = {"solve": int(i), "nsolves": int(nsolves)}
+
+    def note_degraded(self, frm, to, reason: str) -> None:
+        """The supervisor relaunched this process on a SHRUNKEN mesh:
+        the status document must say so (``degraded: {from, to,
+        reason}``) -- a poller watching a degraded solve should not
+        mistake it for the full-capacity run."""
+        with self._lock:
+            self.degraded = {"from": int(frm), "to": int(to),
+                             "reason": str(reason)}
 
     def note_kappa(self, kappa, predicted_total=None) -> None:
         k = _finite(kappa)
@@ -286,15 +298,54 @@ class SolveStatus:
                 doc["imbalance"] = dict(self.imbalance)
             if self.soak:
                 doc["soak"] = dict(self.soak)
+            if self.degraded:
+                doc["degraded"] = dict(self.degraded)
             if self.events:
                 doc["events"] = list(self.events)
         rep = slo_report()
         if rep:
             doc["slo"] = rep
+        peers = _peers_block()
+        if peers is not None:
+            doc["peers"] = peers
         return doc
 
 
 STATUS = SolveStatus()
+
+# the erragree DeadlineHeartbeat this run started (--heartbeat with a
+# status plane armed): the status document's peers: block reads its
+# per-peer beat ages.  Duck-typed -- anything with peer_ages() and a
+# deadline attribute serves (tests use a stub).
+_heartbeat = None
+
+# the supervisor tells a relaunched child it runs on a shrunken mesh
+# through this env var ("FROM:TO:REASON"); arm() folds it into the
+# status document's degraded key
+DEGRADED_ENV = "ACG_TPU_DEGRADED"
+
+
+def set_heartbeat(hb) -> None:
+    """Attach the run's dead-peer heartbeat so the status document can
+    expose per-peer liveness (``peers:``)."""
+    global _heartbeat
+    _heartbeat = hb
+
+
+def _peers_block() -> dict | None:
+    hb = _heartbeat
+    if hb is None:
+        return None
+    try:
+        ages = hb.peer_ages()
+    except Exception:  # noqa: BLE001 -- a torn-down heartbeat must
+        return None    # never break a status scrape
+    return {
+        "deadline_seconds": float(getattr(hb, "deadline", 0.0)),
+        "last_beat_age_seconds": {str(q): round(float(a), 3)
+                                  for q, a in sorted(ages.items())},
+    }
+
 
 _armed = False
 _status_file: str | None = None
@@ -313,6 +364,15 @@ def arm() -> None:
     _armed = True
     if STATUS.armed_since is None:
         STATUS.armed_since = time.time()
+    env = os.environ.get(DEGRADED_ENV)
+    if env:
+        # a supervisor relaunch on a shrunken mesh announces itself
+        try:
+            frm, to, reason = env.split(":", 2)
+            STATUS.note_degraded(int(frm), int(to), reason)
+        except ValueError:
+            sys.stderr.write(f"acg-tpu: {DEGRADED_ENV}={env!r} is not "
+                             f"FROM:TO:REASON; ignored\n")
 
 
 def disarm() -> None:
@@ -339,6 +399,7 @@ def shutdown() -> None:
                              f"{e}\n")
     disarm()
     _status_file = None
+    set_heartbeat(None)
     STATUS.reset()
     _clear_slo()
 

@@ -670,6 +670,34 @@ class DistributedProblem:
             rcnt = scnt.T.copy()
         return scnt, rcnt
 
+    def part_rows(self) -> list:
+        """Owned row count per part, in part order -- half of the
+        snapshot repartition sidecar (acg_tpu.checkpoint)."""
+        if self.band_bounds is not None:
+            return [int(self.band_bounds[p + 1] - self.band_bounds[p])
+                    for p in range(self.nparts)]
+        return [int(s.nowned) for s in self.subs]
+
+    def row_permutation(self) -> np.ndarray | None:
+        """Concatenated global row ids in stacked slot order (part 0's
+        owned rows, then part 1's, ...): the permutation half of the
+        snapshot repartition sidecar.  None when this controller
+        cannot derive it (restricted multi-controller builds whose
+        non-owned parts are stubs without band bounds) -- snapshots
+        then omit the sidecar and repartition resume refuses
+        self-describingly."""
+        if self.band_bounds is not None:
+            return np.concatenate([
+                np.arange(self.band_bounds[p], self.band_bounds[p + 1],
+                          dtype=np.int64)
+                for p in range(self.nparts)]) if self.nparts else \
+                np.zeros(0, np.int64)
+        if self.owned_parts is not None:
+            return None
+        return np.concatenate([
+            np.asarray(s.global_ids[: s.nowned], dtype=np.int64)
+            for s in self.subs]) if self.subs else np.zeros(0, np.int64)
+
     def gather(self, stacked: np.ndarray) -> np.ndarray:
         out = np.zeros(self.n, dtype=np.asarray(stacked).dtype)
         if self.band_bounds is not None:
@@ -2461,11 +2489,33 @@ class DistCGSolver:
         abs_tol = None
         first_norms = None
         snap = cfg.resume
+        repartitioned = None
         if snap is not None:
             ckpt_mod.validate_resume(
                 snap, tier=self._ckpt_tier, pipelined=self.pipelined,
                 precond=pc_kind, n=int(prob.n), dtype=dtype,
-                b_crc=b_crc, nparts=int(prob.nparts))
+                b_crc=b_crc, nparts=int(prob.nparts),
+                repartition=cfg.repartition)
+            ckpt_mod.check_resume_env(snap, st)
+            if cfg.repartition:
+                # shape-portable resume: reassemble the stored carry
+                # into global row order via the permutation sidecar,
+                # then RE-SLICE it onto THIS problem's partition (the
+                # halo plans and preconditioner state were already
+                # rebuilt for this mesh at solver setup) -- the Krylov
+                # recurrence continues with the same global state, up
+                # to dot-product re-association across the new layout
+                snap, repartitioned = ckpt_mod.apply_repartition(
+                    snap, tier=self._ckpt_tier,
+                    nparts=int(prob.nparts), stats=st,
+                    precond_spec=self.precond_spec)
+                arrs_g = {}
+                for nm, a in snap.arrays.items():
+                    a = np.asarray(a)
+                    arrs_g[nm] = (a if nm in scalar or a.ndim == 0
+                                  else prob.scatter(a, dtype=a.dtype))
+                snap = ckpt_mod.SolverSnapshot(meta=snap.meta,
+                                               arrays=arrs_g)
             consumed = snap.iteration
             resumed_from = consumed
             sm = snap.meta
@@ -2494,11 +2544,27 @@ class DistCGSolver:
             telemetry.add_timing(st, "compile",
                                  time.perf_counter() - t_w)
 
+        def agreed_chunk(m: int) -> int:
+            """The wall-clock cadence sizes chunks from a LOCALLY
+            measured s/iteration; multi-controller, every rank must
+            dispatch the SPMD program with the SAME iteration cap (a
+            mismatched ``m`` desynchronises the in-loop collectives
+            and agree_seq's iteration agreement).  All ranks gather
+            their proposals and take the minimum -- the slowest
+            rank's loss window stays the bound.  --ckpt-every is
+            static and identical everywhere: no gather."""
+            if cfg.secs <= 0 or jax.process_count() == 1:
+                return m
+            from acg_tpu.parallel.erragree import allgather_blobs
+            got = allgather_blobs(str(int(m)), tag="ckpt-chunk")
+            return max(1, min(int(g) for g in got))
+
         unbounded = crit.unbounded
         fault = fault0
         seq = 0
         nsnaps = 0
         ck_secs = 0.0
+        rate = None
         aud_fresh = True
         gap_tripped = False
         res = None
@@ -2508,7 +2574,7 @@ class DistCGSolver:
                 remaining = crit.maxits - consumed
                 if remaining <= 0:
                     break
-                m = min(cfg.chunk, remaining)
+                m = agreed_chunk(min(cfg.chunk_for(rate), remaining))
                 chunk_fault = (fault.shift(executed)
                                if fault is not None else None)
                 program = self._ckpt_program_for(chunk_fault)
@@ -2524,12 +2590,17 @@ class DistCGSolver:
                         program, x_cur, abs_tol, 0.0, m, carry,
                         consumed)
                 device_sync(res[0])
+                t_end = time.time()
                 k_chunk = int(res[1])
+                if k_chunk > 0:
+                    # measured s/iteration sizes the next chunk under
+                    # the wall-clock cadence (cfg.chunk_for)
+                    rate = (t_end - t_chunk) / k_chunk
                 # timeline tier: one span per chunked dispatch, named
                 # by its trajectory window (no-op disarmed)
                 tracing.record_span(
                     f"chunk k{consumed}..{consumed + k_chunk}",
-                    t_chunk, time.time(), cat="chunk",
+                    t_chunk, t_end, cat="chunk",
                     k_offset=consumed, iterations=k_chunk)
                 consumed += k_chunk
                 executed += k_chunk
@@ -2618,7 +2689,9 @@ class DistCGSolver:
                             host_result)
                     st.tsolve += time.perf_counter() - t0 - ck_secs
                     st.converged = False
-                    raise driver.give_up(consumed, float(res[2]))
+                    raise driver.give_up(
+                        consumed, float(res[2]),
+                        snapshot=cfg.path if nsnaps else None)
                 finished = (consumed >= crit.maxits if unbounded
                             else bool(res[7]))
                 x_cur = res[0]
@@ -2647,6 +2720,15 @@ class DistCGSolver:
                         "trace_tail": ckpt_mod.trace_tail(
                             st.trace if tr else None),
                     }
+                    rp = prob.row_permutation()
+                    if rp is not None:
+                        # the shape-portable sidecar: global row ids
+                        # in stacked slot order + per-part row counts
+                        # let --resume-repartition reassemble this
+                        # carry onto ANY partition (or the single-
+                        # device/host tiers)
+                        arrs["_rowperm"] = rp
+                        meta["part_rows"] = prob.part_rows()
                     # ONE agreed sequence number across controllers
                     # before anything touches disk; the primary writes
                     ckpt_mod.agree_seq(seq, consumed)
@@ -2689,8 +2771,12 @@ class DistCGSolver:
             "iteration": consumed,
             "rollbacks": driver.rollbacks,
         }
+        if cfg.secs > 0:
+            st.ckpt["secs"] = float(cfg.secs)
         if resumed_from is not None:
             st.ckpt["resumed_from"] = resumed_from
+        if repartitioned is not None:
+            st.ckpt["repartitioned_from"] = repartitioned
         metrics.record_solve(t_solve, executed, st.converged,
                              solver=solver_name)
         metrics.observe_solver_comm(self, executed)

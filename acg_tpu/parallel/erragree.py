@@ -25,9 +25,11 @@ import threading
 
 import numpy as np
 
-# exit code for "a peer never reached the checkpoint" (distinct from any
-# ErrorCode value; chosen in the 64..113 hole left by shell conventions)
-PEER_LOST_EXIT = 97
+# exit code for "a peer never reached the checkpoint" (the process-wide
+# contract lives in errors.ExitCode; --buildinfo renders the table)
+from acg_tpu.errors import ExitCode as _ExitCode
+
+PEER_LOST_EXIT = int(_ExitCode.PEER_LOST)
 
 # per-process sequence number making each checkpoint's KV keys unique;
 # stays in lockstep across controllers because every agree_status call
@@ -187,6 +189,11 @@ class DeadlineHeartbeat:
         self._stop = threading.Event()
         self._thread = None
         self._gen = next(_blob_seq)
+        # (last seen value, monotonic time it changed) per peer --
+        # written by the beat thread, read by peer_ages() (the status
+        # document's peers: block); dict assignment is atomic under
+        # the GIL, so no lock
+        self._seen: dict = {}
 
     def _lost(self, peer: int, age: float) -> None:
         if self.on_lost is not None:
@@ -205,8 +212,7 @@ class DeadlineHeartbeat:
 
         base = f"acg_tpu/heartbeat/{self._gen}"
         beat = 0
-        # (last seen value, wall time it changed) per peer
-        seen: dict[int, tuple[str, float]] = {}
+        seen = self._seen
         while not self._stop.wait(self.period):
             beat += 1
             try:
@@ -244,6 +250,18 @@ class DeadlineHeartbeat:
                 if age > self.deadline:
                     self._lost(q, age)
                     return
+
+    def peer_ages(self) -> dict:
+        """Seconds since each watched peer's beat last ADVANCED
+        (controller index -> age; empty before the first watch pass or
+        single-process) -- the live-status ``peers:`` block's payload.
+        An age approaching ``deadline`` is a peer about to be declared
+        dead."""
+        import time as _time
+
+        now = _time.monotonic()
+        return {int(q): max(0.0, now - t)
+                for q, (_v, t) in list(self._seen.items())}
 
     def start(self) -> "DeadlineHeartbeat":
         import jax

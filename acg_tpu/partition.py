@@ -36,6 +36,22 @@ _METIS = None
 _METIS_CHECKED = False
 
 
+def is_permutation(perm, n: int) -> bool:
+    """True when ``perm`` is exactly a permutation of ``[0, n)`` --
+    the integrity test for stored row-permutation sidecars (the
+    checkpoint tier's repartition resume and the mtx2bin perm files):
+    scattering vector rows through anything else silently scrambles
+    them."""
+    perm = np.asarray(perm).reshape(-1)
+    if perm.size != n or n == 0:
+        return perm.size == n
+    if not np.issubdtype(perm.dtype, np.integer):
+        return False
+    if perm.min() < 0 or perm.max() >= n:
+        return False
+    return bool((np.bincount(perm, minlength=n) == 1).all())
+
+
 def _load_metis():
     global _METIS, _METIS_CHECKED
     if _METIS_CHECKED:

@@ -525,6 +525,18 @@ def state_finite(mstate) -> bool:
     return True
 
 
+def partition_sensitive(spec) -> bool:
+    """True when the preconditioner OPERATOR depends on the row
+    partition (bjacobi factors the LOCAL diagonal blocks on the
+    distributed tier, so M changes when the partition does).  The
+    repartition-resume path (acg_tpu.checkpoint) warns on these:
+    continuing a PCG recurrence under a different M is flexible-CG
+    territory -- it converges, but the short recurrence is no longer
+    exactly conjugate.  Jacobi and Chebyshev are partition-invariant
+    (diagonal / SpMV polynomial of the global operator)."""
+    return spec is not None and getattr(spec, "kind", None) == "bjacobi"
+
+
 def refresh_state(solver, driver) -> bool:
     """Recovery hook (solvers' restart loops): PRESERVE the
     preconditioner state across a restart when it is still finite --

@@ -48,9 +48,11 @@ BASELINE_FRACTION = 0.2
 BASELINE_MIN = 3
 # warning threshold when no --fail-on-drift gate is set
 DEFAULT_DRIFT_PCT = 50.0
-# CLI exit code for a tripped --fail-on-drift gate (distinct from 1 =
-# solve failed, 2 = nothing comparable, 3 = backend unavailable)
-DRIFT_EXIT_CODE = 7
+# CLI exit code for a tripped --fail-on-drift gate (the process-wide
+# contract lives in errors.ExitCode; --buildinfo renders the table)
+from acg_tpu.errors import ExitCode as _ExitCode
+
+DRIFT_EXIT_CODE = int(_ExitCode.DRIFT)
 
 
 class DriftDetector:

@@ -261,7 +261,16 @@ class HostCGSolver:
             ckpt_mod.validate_resume(
                 snap, tier="host-cg", pipelined=False, precond=pc_kind,
                 n=n, dtype=np.float64,
-                b_crc=ckpt_mod.vector_checksum(b))
+                b_crc=ckpt_mod.vector_checksum(b),
+                repartition=ck.repartition)
+            ckpt_mod.check_resume_env(snap, st)
+            if ck.repartition:
+                # shape-portable resume: a stacked N-part snapshot
+                # reassembles into the global row vectors this eager
+                # oracle natively carries
+                snap, _rep = ckpt_mod.apply_repartition(
+                    snap, tier="host-cg", nparts=1, stats=st,
+                    precond_spec=self.precond_spec)
             x = np.array(snap.arrays["x"], dtype=np.float64)
             r = np.array(snap.arrays["r"], dtype=np.float64)
             p = np.array(snap.arrays["p"], dtype=np.float64)
@@ -284,6 +293,9 @@ class HostCGSolver:
             record_event(st, "resume",
                          f"resumed from snapshot at iteration {k}")
 
+        # wall-clock cadence (ckpt_secs): time of the last commit
+        last_commit = [time.perf_counter()]
+
         def _commit_snapshot():
             """One snapshot at the current iteration boundary (atomic
             rename, checkpoint.save_snapshot); billed to the 'ckpt'
@@ -293,6 +305,7 @@ class HostCGSolver:
             from acg_tpu import metrics as _m
             from acg_tpu.telemetry import add_timing
             t_ck = time.perf_counter()
+            last_commit[0] = t_ck
             arrs = {"x": x.copy(), "r": r.copy(), "p": p.copy(),
                     "gamma": np.float64(gamma)}
             if M is not None:
@@ -357,7 +370,10 @@ class HostCGSolver:
                     # surfaces on exactly the failing solves
                     from acg_tpu.health import note_audit
                     note_audit(st, aud_vec(), hspec, "host-cg")
-                raise driver.give_up(k, st.rnrm2)
+                raise driver.give_up(
+                    k, st.rnrm2,
+                    snapshot=(ck.path if ck is not None and nsnaps
+                              else None))
             if not np.isfinite(x).all():
                 x = (np.array(x0, dtype=np.float64, copy=True)
                      if x0 is not None else np.zeros(n))
@@ -604,8 +620,12 @@ class HostCGSolver:
             if not crit.unbounded:
                 converged = self._test(crit, st, res_tol)
             if (ck is not None and ck.path is not None and not converged
-                    and k < crit.maxits and k % ck.every == 0):
-                _commit_snapshot()
+                    and k < crit.maxits):
+                due = (k % ck.every == 0 if ck.every > 0
+                       else time.perf_counter() - last_commit[0]
+                       >= ck.secs)
+                if due:
+                    _commit_snapshot()
 
         t_solve = time.perf_counter() - tstart
         # snapshot serialisation is billed to its own phase, never the
@@ -628,6 +648,8 @@ class HostCGSolver:
                 "iteration": int(k),
                 "rollbacks": driver.rollbacks if driver is not None else 0,
             }
+            if ck.secs > 0:
+                st.ckpt["secs"] = float(ck.secs)
             if resumed_from is not None:
                 st.ckpt["resumed_from"] = resumed_from
         if hspec is not None:

@@ -1965,11 +1965,23 @@ class JaxCGSolver:
         abs_tol = None
         first_norms = None
         snap = cfg.resume
+        repartitioned = None
         if snap is not None:
             ckpt_mod.validate_resume(
                 snap, tier=self._ckpt_tier, pipelined=self.pipelined,
                 precond=pc_kind, n=int(self.A.nrows), dtype=dtype,
-                b_crc=b_crc)
+                b_crc=b_crc, repartition=cfg.repartition)
+            ckpt_mod.check_resume_env(snap, st)
+            if cfg.repartition:
+                # shape-portable resume: reassemble the carry into
+                # global row order (an N-part snapshot's vectors come
+                # back as plain length-n arrays -- this tier's native
+                # layout); the recurrence continues with the same
+                # global Krylov state, so convergence carries over up
+                # to dot-product re-association
+                snap, repartitioned = ckpt_mod.apply_repartition(
+                    snap, tier=self._ckpt_tier, nparts=1, stats=st,
+                    precond_spec=self.precond_spec)
             consumed = snap.iteration
             resumed_from = consumed
             sm = snap.meta
@@ -2010,6 +2022,7 @@ class JaxCGSolver:
         seq = 0
         nsnaps = 0
         ck_secs = 0.0
+        rate = None
         aud_fresh = True
         gap_tripped = False
         res = None
@@ -2019,7 +2032,7 @@ class JaxCGSolver:
                 remaining = crit.maxits - consumed
                 if remaining <= 0:
                     break
-                m = min(cfg.chunk, remaining)
+                m = min(cfg.chunk_for(rate), remaining)
                 if abs_tol is None:
                     a = chunk_args(x_cur, crit.residual_atol,
                                    crit.residual_rtol, m)
@@ -2035,12 +2048,17 @@ class JaxCGSolver:
                 t_chunk = time.time()
                 res, tbuf, aud, core = run(a, carry, consumed)
                 device_sync(res.x)
+                t_end = time.time()
                 k_chunk = int(res.niterations)
+                if k_chunk > 0:
+                    # measured s/iteration sizes the next chunk under
+                    # the wall-clock cadence (cfg.chunk_for)
+                    rate = (t_end - t_chunk) / k_chunk
                 # timeline tier: one span per chunked dispatch, named
                 # by its trajectory window (no-op disarmed)
                 tracing.record_span(
                     f"chunk k{consumed}..{consumed + k_chunk}",
-                    t_chunk, time.time(), cat="chunk",
+                    t_chunk, t_end, cat="chunk",
                     k_offset=consumed, iterations=k_chunk)
                 consumed += k_chunk
                 executed += k_chunk
@@ -2133,7 +2151,9 @@ class JaxCGSolver:
                             host_result)
                     st.tsolve += time.perf_counter() - t0 - ck_secs
                     st.converged = False
-                    raise driver.give_up(consumed, float(res.rnrm2))
+                    raise driver.give_up(
+                        consumed, float(res.rnrm2),
+                        snapshot=cfg.path if nsnaps else None)
                 finished = (consumed >= crit.maxits if unbounded
                             else bool(res.converged))
                 x_cur = res.x
@@ -2202,8 +2222,12 @@ class JaxCGSolver:
             "iteration": consumed,
             "rollbacks": driver.rollbacks,
         }
+        if cfg.secs > 0:
+            st.ckpt["secs"] = float(cfg.secs)
         if resumed_from is not None:
             st.ckpt["resumed_from"] = resumed_from
+        if repartitioned is not None:
+            st.ckpt["repartitioned_from"] = repartitioned
         metrics.record_solve(t_solve, executed, st.converged,
                              solver=solver_name)
         metrics.observe_solver_comm(self, executed)

@@ -206,10 +206,20 @@ class RecoveryDriver:
         return agree_status(code, what=f"{self.what} recovery",
                             timeout=timeout) == 0
 
-    def give_up(self, niter: int, rnrm2: float):
-        """The no-rungs-left exit: a diagnosis-carrying exception."""
+    def give_up(self, niter: int, rnrm2: float,
+                snapshot: str | None = None):
+        """The no-IN-PROCESS-rungs-left exit: a diagnosis-carrying
+        exception.  When a committed snapshot exists the diagnosis
+        names the next rung OUT of process -- the survivor-mesh
+        supervisor (acg_tpu.supervisor, ``--supervise``) relaunches
+        with ``--resume`` from exactly that file, so the operator (or
+        runbook) reads the recovery action off the error instead of
+        grepping docs mid-incident."""
+        hint = (f"; a committed snapshot exists at {snapshot} -- "
+                f"relaunch with --resume (or run under --supervise "
+                f"to automate it)" if snapshot else "")
         return BreakdownError(
             f"{self.what}: breakdown (non-finite residual or "
             f"non-positive p^T A p) at iteration {niter}, residual "
             f"{rnrm2:.3e}; {self.stats.nrestarts} restart(s) exhausted "
-            f"and no fallback available")
+            f"and no fallback available{hint}")

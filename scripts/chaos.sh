@@ -1,0 +1,58 @@
+#!/usr/bin/env bash
+# Chaos campaign driver: seeded randomized fault schedules through the
+# survivor-mesh supervisor on the 8-part CPU mesh (the elastic-recovery
+# acceptance, ISSUE 10).  Every schedule must end converged or
+# agreed-abort; a single wrong-answer-green run fails the campaign
+# (exit 96, errors.ExitCode.WRONG_ANSWER).
+#
+# Usage: scripts/chaos.sh [SEED[:N]] [extra acg-tpu flags...]
+#   SEED[:N]   campaign seed and schedule count (default 1234:20)
+#
+# Environment:
+#   CHAOS_MATRIX   matrix spec (default gen:poisson2d:20)
+#   CHAOS_NPARTS   mesh size (default 8; 0 = single device)
+#   CHAOS_DIR      scratch/ledger directory (default a mktemp dir)
+#
+# The campaign arms --abft --audit-every (so sdc:flip schedules are
+# detectable), snapshots every 8 iterations (so crash:exit schedules
+# are resumable), and records per-schedule verdicts into the
+# $CHAOS_DIR/history ledger plus the acg_recovery_* metric families in
+# $CHAOS_DIR/chaos.prom.
+set -o pipefail
+cd "$(dirname "$0")/.."
+
+SPEC="${1:-1234:20}"
+shift 2>/dev/null || true
+MATRIX="${CHAOS_MATRIX:-gen:poisson2d:20}"
+NPARTS="${CHAOS_NPARTS:-8}"
+DIR="${CHAOS_DIR:-$(mktemp -d /tmp/acg-chaos.XXXXXX)}"
+mkdir -p "$DIR"
+
+PARTS_FLAGS=()
+ENV_FLAGS=(JAX_PLATFORMS=cpu)
+if [ "$NPARTS" -gt 1 ]; then
+    PARTS_FLAGS=(--nparts "$NPARTS" --shrink any)
+    ENV_FLAGS+=("XLA_FLAGS=--xla_force_host_platform_device_count=$NPARTS")
+else
+    PARTS_FLAGS=(--comm none)
+fi
+
+echo "chaos.sh: campaign $SPEC on $MATRIX ($NPARTS parts) -> $DIR"
+env "${ENV_FLAGS[@]}" python -m acg_tpu.cli "$MATRIX" \
+    "${PARTS_FLAGS[@]}" \
+    --max-iterations 400 --residual-rtol 1e-8 --warmup 0 --quiet \
+    --ckpt "$DIR/ck" --ckpt-every 8 \
+    --audit-every 5 --abft \
+    --chaos "$SPEC" --relaunch-backoff 0 \
+    --history "$DIR/history" \
+    --metrics-file "$DIR/chaos.prom" \
+    "$@"
+rc=$?
+if [ $rc -eq 96 ]; then
+    echo "chaos.sh: WRONG-ANSWER-GREEN detected (exit 96) -- see $DIR"
+elif [ $rc -ne 0 ]; then
+    echo "chaos.sh: campaign driver failed (exit $rc)"
+else
+    echo "chaos.sh: campaign clean (ledger: $DIR/history)"
+fi
+exit $rc

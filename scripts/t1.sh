@@ -24,6 +24,12 @@
 # valid acg-tpu-status/1 document (solve converged, residual trail
 # populated), one acg-tpu-history/1 ledger row that history_report.py
 # renders, and the acg_slo_* metric families in the textfile.
+# T1_CHAOS=1 runs the elastic-recovery smoke: crash:exit kills an
+# 8-part checkpointed solve mid-flight, the supervisor relaunches it
+# with --resume --resume-repartition on 4 parts (shrink), and the
+# answer must verify against the host matrix; then a small seeded
+# chaos campaign must end every schedule converged-or-agreed-abort
+# (zero wrong-answer-green) with the acg_recovery_* families present.
 set -o pipefail
 cd "$(dirname "$0")/.."
 rm -f /tmp/_t1.log
@@ -237,6 +243,64 @@ assert sj["stats"]["slo"]["targets"]["iters"] == 280, sj["stats"]["slo"]
 print(f"T1_STATUS: OK (iteration {doc['solve']['iteration']}, "
       f"{len(doc['residual_trail'])} trail samples, ledger row "
       f"{row['case']})")
+PY
+fi
+if [ "${T1_CHAOS:-0}" = "1" ]; then
+    # elastic-recovery smoke (the ISSUE-10 acceptance in miniature):
+    # (1) kill -> supervisor shrink-resume -> converged: crash:exit
+    # hard-kills an 8-part checkpointed solve (rc 94), the supervisor
+    # relaunches on 4 parts with --resume --resume-repartition, and
+    # the answer must verify against a host-side rebuild of the
+    # matrix; (2) a small seeded chaos campaign must end every
+    # schedule converged-or-agreed-abort with zero wrong-answer-green
+    # and the acg_recovery_* families present
+    echo "T1_CHAOS: supervisor shrink-resume + seeded campaign"
+    rm -rf /tmp/_t1_chaos_hist
+    rm -f /tmp/_t1_chaos_ck /tmp/_t1_chaos_x.mtx /tmp/_t1_chaos.prom
+    timeout -k 10 600 env JAX_PLATFORMS=cpu \
+        XLA_FLAGS="--xla_force_host_platform_device_count=8" \
+        python -m acg_tpu.cli gen:poisson2d:20 --nparts 8 \
+        --max-iterations 400 --residual-rtol 1e-8 --warmup 0 --quiet \
+        --ckpt /tmp/_t1_chaos_ck --ckpt-every 8 \
+        --fault-inject crash:exit@20 \
+        --supervise --shrink any --relaunch-backoff 0 \
+        --metrics-file /tmp/_t1_chaos.prom \
+        -o /tmp/_t1_chaos_x.mtx || rc=$((rc ? rc : 1))
+    python scripts/check_metrics_textfile.py /tmp/_t1_chaos.prom \
+        --require acg_recovery_ || rc=$((rc ? rc : 1))
+    python - <<'PY' || rc=$((rc ? rc : 1))
+import numpy as np
+from acg_tpu.io.generators import poisson_mtx
+from acg_tpu.matrix import SymCsrMatrix
+from acg_tpu.supervisor import verify_solution
+csr = SymCsrMatrix.from_mtx(poisson_mtx(20, dim=2)).to_csr()
+ok, rel = verify_solution(csr, np.ones(csr.shape[0]),
+                          "/tmp/_t1_chaos_x.mtx", 1e-8)
+assert ok, f"shrink-resumed answer fails verification ({rel:.3e})"
+print(f"T1_CHAOS: shrink-resume OK (true rel residual {rel:.3e})")
+PY
+    timeout -k 10 900 env JAX_PLATFORMS=cpu \
+        XLA_FLAGS="--xla_force_host_platform_device_count=8" \
+        python -m acg_tpu.cli gen:poisson2d:20 --nparts 8 \
+        --max-iterations 400 --residual-rtol 1e-8 --warmup 0 --quiet \
+        --ckpt /tmp/_t1_chaos_ck --ckpt-every 8 \
+        --audit-every 5 --abft --shrink any \
+        --chaos 1234:6 --relaunch-backoff 0 \
+        --history /tmp/_t1_chaos_hist || rc=$((rc ? rc : 1))
+    python - <<'PY' || rc=$((rc ? rc : 1))
+import json, os
+rows = []
+for name in os.listdir("/tmp/_t1_chaos_hist"):
+    for line in open(f"/tmp/_t1_chaos_hist/{name}"):
+        obj = json.loads(line)
+        if obj.get("schema") == "acg-tpu-chaos/1":
+            rows.append(obj["doc"]["chaos"])
+assert len(rows) == 6, len(rows)
+outcomes = [r["outcome"] for r in rows]
+assert "WRONG-ANSWER" not in outcomes, outcomes
+print(f"T1_CHAOS: campaign OK ({outcomes.count('converged')} "
+      f"converged, {outcomes.count('agreed-abort')} agreed-abort, "
+      f"0 wrong-answer)")
 PY
 fi
 exit $rc

@@ -104,6 +104,10 @@ def pytest_configure(config):
         "markers",
         "two_process_collectives: needs cross-process XLA collectives "
         "(skipped when the CPU backend lacks them; probe in conftest)")
+    config.addinivalue_line(
+        "markers",
+        "slow: excluded from the tier-1 run (-m 'not slow'); full "
+        "campaigns and long soak scenarios")
 
 
 def pytest_collection_modifyitems(config, items):

@@ -682,7 +682,7 @@ def test_heartbeat_validation_and_noop():
 
 def test_checkpoint_config_validation(system):
     csr, _, _ = system
-    with pytest.raises(ValueError, match="positive snapshot"):
+    with pytest.raises(ValueError, match="snapshot cadence"):
         CheckpointConfig(path="x", every=0)
     with pytest.raises(ValueError, match="snapshot path"):
         CheckpointConfig()
