@@ -162,11 +162,33 @@ def carry_names(pipelined: bool, precond: bool) -> tuple:
     return ("x", "r", "w", "p", "t", "z", "gamma", "alpha")
 
 
+# the batched tier's per-RHS carry leaves that are (B,)-shaped column
+# vectors rather than per-row vectors: replicated on the mesh tiers
+# (like the psum'd scalars), passed through untouched by repartition
+BATCHED_COL_LEAVES = frozenset({"gamma", "rr", "done", "iters"})
+
+
+def batched_carry_names(precond: bool) -> tuple:
+    """Loop-carry leaves of the BATCHED classic recurrence
+    (acg_tpu.solvers.batched): x/r/p are (n, B) column blocks --
+    per-RHS leaves, one column per right-hand side -- and
+    gamma[/rr]/done/iters are (B,) per-RHS vectors.  A snapshot of
+    this layout is what lets a whole BATCH survive preemption with
+    every RHS's progress (including already-frozen columns) intact."""
+    names = ("x", "r", "p", "gamma")
+    if precond:
+        names = names + ("rr",)
+    return names + ("done", "iters")
+
+
 # tiers whose carry leaves are field-compatible global row vectors
 # once reassembled (carry_names is shared): the repartition-resume set.
 # sharded-dia pads rows to the mesh and is excluded -- its vectors are
-# not plain global row order.
+# not plain global row order.  The batched tiers repartition among
+# themselves (their leaves carry a trailing per-RHS axis).
 REPARTITION_TIERS = frozenset({"jax-cg", "dist-cg", "host-cg"})
+BATCHED_REPARTITION_TIERS = frozenset({"jax-cg-batched",
+                                       "dist-cg-batched"})
 
 
 def _crc(data: bytes) -> int:
@@ -347,7 +369,8 @@ def validate_resume(snap: SolverSnapshot, *, tier: str, pipelined: bool,
                     precond: str | None, n: int, dtype,
                     b_crc: int | None = None,
                     nparts: int | None = None,
-                    repartition: bool = False) -> None:
+                    repartition: bool = False,
+                    nrhs: int | None = None) -> None:
     """Refuse a snapshot that does not describe THIS solve: wrong tier,
     algorithm, preconditioner, size, dtype, partition count, or
     right-hand side.  A mismatch here means the operator pointed
@@ -374,12 +397,16 @@ def validate_resume(snap: SolverSnapshot, *, tier: str, pipelined: bool,
 
     if repartition:
         got_tier = m.get("tier")
-        if tier not in REPARTITION_TIERS or \
-                got_tier not in REPARTITION_TIERS:
+        # batched tiers repartition among themselves: their carry
+        # leaves carry a trailing per-RHS axis the single-RHS tiers'
+        # reconstruction cannot consume (and vice versa)
+        allowed = (BATCHED_REPARTITION_TIERS if nrhs is not None
+                   else REPARTITION_TIERS)
+        if tier not in allowed or got_tier not in allowed:
             raise AcgError(
                 ErrorCode.INVALID_VALUE,
                 f"repartition resume supports the "
-                f"{'/'.join(sorted(REPARTITION_TIERS))} tiers; this "
+                f"{'/'.join(sorted(allowed))} tiers; this "
                 f"snapshot is {got_tier!r} and this solve "
                 f"{tier!r}")
     else:
@@ -390,6 +417,10 @@ def validate_resume(snap: SolverSnapshot, *, tier: str, pipelined: bool,
     need("precond", precond, "preconditioner")
     need("n", int(n), "unknowns")
     need("dtype", str(np.dtype(dtype)), "vector dtype")
+    if nrhs is not None:
+        # a batch must resume as the SAME batch: per-RHS leaves of a
+        # different width would scramble every column's Krylov state
+        need("nrhs", int(nrhs), "right-hand-side count")
     if b_crc is not None and m.get("b_crc") is not None:
         need("b_crc", int(b_crc), "right-hand-side checksum")
 
@@ -437,13 +468,32 @@ def reassemble_global(snap: SolverSnapshot) -> SolverSnapshot:
         raise bad(f"the row-permutation sidecar is not a permutation "
                   f"of {n} rows (corrupted or stale sidecar)")
 
+    batched = int(m.get("nrhs") or 0) > 1
     arrays = {}
     for name, a in snap.arrays.items():
         if name == "_rowperm":
             continue
         a = np.asarray(a)
-        if name in SCALAR_LEAVES or a.ndim == 0:
+        if name in SCALAR_LEAVES or a.ndim == 0 \
+                or (batched and name in BATCHED_COL_LEAVES):
+            # per-RHS column vectors (gamma/done/iters of the batched
+            # carry) are replicated, not row-partitioned: pass through
             arrays[name] = a
+            continue
+        if batched:
+            # batched per-RHS leaves stack as (nparts, pad, B): the
+            # row permutation applies to axis 1, columns ride along
+            if a.ndim != 3 or a.shape[0] != nparts \
+                    or a.shape[1] < max(part_rows, default=0):
+                raise bad(f"carry leaf {name!r} (shape {a.shape}) "
+                          f"does not hold the {nparts}-part batched "
+                          f"stacked layout")
+            out = np.zeros((n, a.shape[2]), dtype=a.dtype)
+            off = 0
+            for p, rows in enumerate(part_rows):
+                out[perm[off: off + rows]] = a[p, :rows]
+                off += rows
+            arrays[name] = out
             continue
         if a.ndim != 2 or a.shape[0] != nparts \
                 or a.shape[1] < max(part_rows, default=0):

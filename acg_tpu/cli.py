@@ -346,6 +346,36 @@ def make_parser() -> argparse.ArgumentParser:
                         "the --history ledger.  Exit 96 if ANY "
                         "schedule converged to a wrong answer -- the "
                         "acceptance bar is zero wrong-answer-green")
+    p.add_argument("--nrhs", type=int, default=0, metavar="B",
+                   help="batched multi-RHS tier (acg_tpu.solvers."
+                        "batched): solve B right-hand sides against "
+                        "the ONE ingested matrix in a single batched "
+                        "program -- one multi-vector SpMV per "
+                        "iteration (matrix HBM traffic amortized B-"
+                        "fold), ALL per-RHS dots fused into B-wide "
+                        "reductions (on the mesh: collective count "
+                        "INVARIANT in B), per-RHS convergence masks "
+                        "(converged columns freeze, the loop runs to "
+                        "the slowest RHS).  b may be an n x B dense "
+                        "array file; without a b file, B seeded random "
+                        "unit-norm columns (--seed); with "
+                        "--manufactured-solution, B manufactured "
+                        "columns.  Per-RHS evidence lands in a "
+                        "'batch:' stats section, the per-RHS residual "
+                        "ring (--convergence-log), the status "
+                        "document (ETA keyed to the slowest "
+                        "unconverged RHS) and per-RHS soak "
+                        "percentiles.  B=1 (or flag absent) runs the "
+                        "byte-identical single-RHS programs")
+    p.add_argument("--block-cg", action="store_true",
+                   help="with --nrhs B: the TRUE block-CG recurrence "
+                        "instead of the masked batched one -- ONE "
+                        "shared Krylov block, B x B Gram solves with "
+                        "rank deflation on breakdown; converges in "
+                        "measurably fewer total iterations than B "
+                        "independent solves on ill-conditioned "
+                        "families (--aniso).  Single-device tier "
+                        "(--nparts 1 / --comm none)")
     p.add_argument("--precise-dots", action="store_true",
                    help="compensated (double-float) dot products for the "
                         "CG scalars; lets f32 storage converge past the "
@@ -704,6 +734,21 @@ def _buildinfo(out) -> int:
          f"heartbeats carry the same it/s + ETA on every tier incl. "
          f"the host oracle; 'slo' stats section, schema "
          f"{STATS_SCHEMA}"),
+        ("batched solves", f"--nrhs B (multi-RHS CG: one batched "
+         f"program solves B systems against the shared matrix -- "
+         f"multi-vector SpMV amortizes matrix HBM traffic B-fold, "
+         f"per-RHS dots fuse into B-wide reductions with the mesh "
+         f"collective count INVARIANT in B, converged columns freeze "
+         f"via per-RHS masks; B=1/flag-absent runs byte-identical "
+         f"single-RHS programs), --block-cg (true block-CG: shared "
+         f"Krylov block, B x B Gram solves, rank deflation on "
+         f"breakdown; fewer total iterations than B independent "
+         f"solves on --aniso), per-RHS residual ring in "
+         f"--convergence-log, per-RHS soak percentiles, status-doc "
+         f"ETA keyed to the slowest unconverged RHS, batched "
+         f"checkpoint carries (a batch survives preemption and "
+         f"--resume-repartition); 'batch' stats section, schema "
+         f"{STATS_SCHEMA}"),
         ("elastic recovery", "--supervise (survivor-mesh process "
          "supervisor: watches the exit-code contract, relaunches with "
          "--resume -- shrinking --nparts with --resume-repartition on "
@@ -843,6 +888,9 @@ def _solve_generated_direct(args, dim, n, N, jax, jnp, dtype,
         ("--output-comm-matrix", args.output_comm_matrix),
         (f"--spmv-format {args.spmv_format}",
          args.spmv_format not in ("auto", "dia")),
+        ("--nrhs/--block-cg (the batched tiers need the host-CSR "
+         "ingest path; lower ACG_TPU_GEN_DIRECT_MIN only for "
+         "single-RHS solves)", getattr(args, "_batched", False)),
     ] if on]
     if unsupported:
         raise SystemExit(
@@ -1239,6 +1287,13 @@ def _emit_telemetry(args, solver, *, matrix_id, nparts=1,
         # (perfmodel._doc_case): preconditioned and plain captures must
         # never silently diff against each other
         extra["precond"] = str(pc)
+    if getattr(args, "_batched", False):
+        # nrhs/block join the case key too (perfmodel._batch_keyed):
+        # a B-wide capture must never silently diff against a
+        # single-RHS one
+        extra["nrhs"] = int(args.nrhs)
+        if args.block_cg:
+            extra["block_cg"] = True
     if args.aniso is not None:
         extra["aniso"] = float(args.aniso)
     kern = getattr(inner, "kernels", None)
@@ -1801,18 +1856,22 @@ def _emit_solution(args, x, perm=None) -> None:
     first so users always see their own ordering."""
     if args.output is None and args.quiet:
         return
-    from acg_tpu.io.mtxfile import vector_mtx, write_mtx
+    from acg_tpu.io.mtxfile import multi_vector_mtx, vector_mtx, write_mtx
 
     x = np.asarray(x)
     if perm is not None:
         xo = np.empty_like(x)
         xo[perm] = x
         x = xo
+    # batched solutions are (n, B) column blocks: one dense array file
+    # with B columns (io.mtxfile.vector_columns reads it back)
+    wrap = (multi_vector_mtx if x.ndim == 2 and x.shape[1] > 1
+            else lambda v: vector_mtx(np.asarray(v).reshape(-1)))
     if args.output is not None:
-        write_mtx(args.output, vector_mtx(np.asarray(x, np.float64)),
+        write_mtx(args.output, wrap(np.asarray(x, np.float64)),
                   binary=True)
     elif not args.quiet:
-        write_mtx(sys.stdout.buffer, vector_mtx(x), numfmt=args.numfmt)
+        write_mtx(sys.stdout.buffer, wrap(x), numfmt=args.numfmt)
 
 
 def _load_perm_sidecar(matrix_path: str, n: int):
@@ -2303,6 +2362,49 @@ def _main(args) -> int:
                 repartition=args.resume_repartition)
         except ValueError as e:
             raise SystemExit(f"acg-tpu: {e}")
+    # batched multi-RHS tier (acg_tpu.solvers.batched): validate the
+    # selection BEFORE anything expensive, refuse configurations the
+    # batched programs cannot serve (the fault-injector could-never-
+    # fire discipline).  --nrhs 1 and flag-absent take the UNBATCHED
+    # path -- byte-identical programs (the disarmed-identity contract)
+    if args.nrhs < 0:
+        raise SystemExit("acg-tpu: --nrhs must be >= 0")
+    if args.block_cg and args.nrhs < 2:
+        raise SystemExit(
+            "acg-tpu: --block-cg shares one Krylov block across B "
+            "right-hand sides; add --nrhs B (B >= 2)")
+    args._batched = args.nrhs >= 2
+    if args._batched:
+        unsupported = [flag for flag, on in [
+            (f"--solver {args.solver} (use the device solvers; the "
+             f"host batched oracle is a library API)",
+             args.solver in ("host", "host-native", "petsc")),
+            ("--refine", args.refine),
+            ("--replace-every", args.replace_every > 0),
+            (f"--kernels {args.kernels} (batched runs the XLA "
+             f"multi-vector SpMV)", args.kernels in ("pallas", "fused")),
+            ("--audit-every/--stall-window (no batched audit hooks "
+             "yet)", args._health is not None),
+            ("--fault-inject/--recover (no batched breakdown "
+             "detection yet)", bool(args.fault_inject) or args.recover),
+            ("--comm dma (the batched mesh tier runs the XLA "
+             "all_to_all transport)", args.comm in ("dma", "nvshmem")),
+            ("--progress (no batched heartbeat hook yet; "
+             "--status-file/--status-port serve per-RHS progress)",
+             args.progress > 0),
+            ("--diff-atol/--diff-rtol (residual criteria only)",
+             args.diff_atol > 0 or args.diff_rtol > 0),
+            ("--multihost/--coordinator (single-controller tier)",
+             args.multihost or args.coordinator is not None),
+            ("--distributed-read", args.distributed_read),
+            ("--profile-ops", args.profile_ops is not None),
+            ("--explain", args.explain),
+            ("--output-comm-matrix", args.output_comm_matrix),
+        ] if on]
+        if unsupported:
+            raise SystemExit(
+                f"acg-tpu: --nrhs {args.nrhs} does not support: "
+                f"{', '.join(unsupported)}")
     if args.aniso is not None:
         if not 0.0 < args.aniso <= 1.0:
             raise SystemExit("acg-tpu: --aniso EPS must be in (0, 1]")
@@ -2661,7 +2763,33 @@ def _main(args) -> int:
         # stage 4: right-hand side and initial guess
         rng = np.random.default_rng(args.seed)
         xsol = None
-        if args.manufactured_solution:
+        if args._batched:
+            # batched multi-RHS block (one column per system): b may
+            # be an n x B dense array file (io.mtxfile.vector_columns),
+            # a manufactured block, or B seeded random unit columns
+            from acg_tpu.io.generators import batched_rhs
+            from acg_tpu.io.mtxfile import vector_columns
+            if args.manufactured_solution:
+                xsol = rng.standard_normal((n, args.nrhs))
+                xsol /= np.linalg.norm(xsol, axis=0, keepdims=True)
+                b = np.column_stack(
+                    [A.dsymv(xsol[:, j], epsilon=args.epsilon)
+                     for j in range(args.nrhs)])
+            elif args.b:
+                bmtx = read_mtx(args.b, binary=args.binary)
+                b = vector_columns(bmtx, n, args.nrhs)
+                if perm_sidecar is not None:
+                    b = b[perm_sidecar]
+            else:
+                b = batched_rhs(n, args.nrhs, seed=args.seed)
+            if args.x0:
+                xmtx = read_mtx(args.x0, binary=args.binary)
+                x0 = vector_columns(xmtx, n, args.nrhs)
+                if perm_sidecar is not None:
+                    x0 = x0[perm_sidecar]
+            else:
+                x0 = None
+        elif args.manufactured_solution:
             # random unit-norm solution; b = A*xsol via the independent host
             # SpMV (cuda/acg-cuda.c:1969-2140)
             xsol = rng.standard_normal(n)
@@ -2676,7 +2804,9 @@ def _main(args) -> int:
                 b = b[perm_sidecar]
         else:
             b = np.ones(n)
-        if args.x0:
+        if args._batched:
+            pass
+        elif args.x0:
             xmtx = read_mtx(args.x0, binary=args.binary)
             x0 = np.asarray(xmtx.vals, dtype=np.float64).reshape(-1)
             if x0.size != n:
@@ -2801,6 +2931,50 @@ def _main(args) -> int:
                 from acg_tpu.solvers.petsc_cg import PetscBaselineSolver
                 solver = PetscBaselineSolver(csr, pipelined=pipelined)
                 x = _run_solve(args, solver, b, x0=x0, criteria=criteria)
+            elif args._batched:
+                # batched multi-RHS tier: B columns, ONE solve (the
+                # solvers.batched / parallel.dist_batched programs)
+                mode = ("block" if args.block_cg
+                        else "pipelined" if pipelined else "batched")
+                if comm == "none" or nparts == 1:
+                    from acg_tpu.solvers.batched import BatchedCGSolver
+                    dev = device_matrix_from_csr(csr, dtype=dtype,
+                                                 format=args.spmv_format)
+                    try:
+                        solver = BatchedCGSolver(
+                            dev, mode=mode,
+                            precise_dots=args.precise_dots,
+                            vector_dtype=vec_dtype,
+                            precond=args._precond, trace=args._trace,
+                            ckpt=args._ckpt, host_matrix=csr)
+                    except ValueError as e:
+                        raise SystemExit(f"acg-tpu: {e}")
+                else:
+                    if args.block_cg:
+                        raise SystemExit(
+                            "acg-tpu: --block-cg is a single-device "
+                            "tier (its B x B Gram solves are not "
+                            "distributed); use --nparts 1/--comm none, "
+                            "or drop --block-cg for the batched mesh "
+                            "tier")
+                    from acg_tpu.parallel.dist_batched import \
+                        BatchedDistCGSolver
+                    from acg_tpu.parallel.mesh import solve_mesh
+                    mesh = solve_mesh(nparts)
+                    subs = partition_matrix(csr, part, nparts)
+                    prob = DistributedProblem.build(
+                        csr, part, nparts, dtype=dtype, subs=subs,
+                        vector_dtype=vec_dtype)
+                    try:
+                        solver = BatchedDistCGSolver(
+                            prob, pipelined=pipelined, mesh=mesh,
+                            precise_dots=args.precise_dots,
+                            precond=args._precond, trace=args._trace,
+                            ckpt=args._ckpt)
+                    except ValueError as e:
+                        raise SystemExit(f"acg-tpu: {e}")
+                x = _run_solve(args, solver, b, x0=x0,
+                               criteria=criteria, warmup=args.warmup)
             elif comm == "none" or nparts == 1:
                 dev = device_matrix_from_csr(csr, dtype=dtype,
                                              format=args.spmv_format)
@@ -2908,12 +3082,19 @@ def _main(args) -> int:
     # stage 9: statistics block (grep-compatible with the reference)
     solver.stats.fwrite(sys.stderr)
 
-    # stage 9b: manufactured-solution error norms
+    # stage 9b: manufactured-solution error norms (batched: Frobenius
+    # over the column block, plus the worst single column)
     if xsol is not None:
-        err0 = np.linalg.norm((x0 if x0 is not None else np.zeros(n)) - xsol)
-        err = np.linalg.norm(x - xsol)
+        x0ref = x0 if x0 is not None else np.zeros_like(xsol)
+        err0 = np.linalg.norm(x0ref - xsol)
+        err = np.linalg.norm(np.asarray(x) - xsol)
         sys.stderr.write(f"initial error 2-norm: {err0:.15g}\n")
         sys.stderr.write(f"error 2-norm: {err:.15g}\n")
+        if xsol.ndim == 2 and xsol.shape[1] > 1:
+            per = np.linalg.norm(np.asarray(x) - xsol, axis=0)
+            sys.stderr.write(f"worst per-RHS error 2-norm: "
+                             f"{float(per.max()):.15g} "
+                             f"(rhs {int(per.argmax())})\n")
 
     # stage 2d/10: communication matrix and solution output
     if comm_mtx_out is not None:

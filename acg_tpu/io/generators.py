@@ -175,6 +175,19 @@ def poisson_dia_device(n: int, dim: int = 2, dtype=None):
             tuple(int(offsets[i]) for i in order), N)
 
 
+def batched_rhs(n: int, nrhs: int, seed: int = 42,
+                dtype=np.float64) -> np.ndarray:
+    """Default multi-RHS block for ``--nrhs B``: B random unit-norm
+    columns (seeded).  Random, NOT replicated ones: parallel columns
+    would collapse the block Krylov space to rank 1, making every
+    batched/block measurement degenerate -- a serving fleet's requests
+    differ, and so must the default benchmark block."""
+    rng = np.random.default_rng(seed)
+    B = rng.standard_normal((n, int(nrhs))).astype(dtype)
+    B /= np.linalg.norm(B, axis=0, keepdims=True)
+    return B
+
+
 def irregular_spd_coo(n: int, avg_degree: float = 16.0, seed: int = 0,
                       dtype=np.float64):
     """Random irregular SPD matrix -> full COO.

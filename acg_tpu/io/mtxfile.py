@@ -705,3 +705,38 @@ def vector_mtx(x: np.ndarray, field: str = "real") -> MtxFile:
     return MtxFile(object="matrix", format="array", field=field,
                    symmetry="general", nrows=x.size, ncols=1,
                    nnz=x.size, vals=x)
+
+
+def multi_vector_mtx(X: np.ndarray, field: str = "real") -> MtxFile:
+    """Wrap an (n, B) COLUMN BLOCK as a dense Matrix Market array file
+    (the batched tier's multi-RHS b / solution container).  Values are
+    stored column-major, the Matrix Market array convention."""
+    X = np.asarray(X)
+    if X.ndim == 1:
+        X = X[:, None]
+    return MtxFile(object="matrix", format="array", field=field,
+                   symmetry="general", nrows=X.shape[0],
+                   ncols=X.shape[1], nnz=X.size,
+                   vals=np.asarray(X, order="F").reshape(-1, order="F"))
+
+
+def vector_columns(mtx: MtxFile, n: int, nrhs: int) -> np.ndarray:
+    """Extract an (n, nrhs) column block from a dense array MtxFile --
+    the multi-column b/x0 ingest of ``--nrhs``.  Accepts a file whose
+    header declares exactly ``n x nrhs`` (column-major data, the MTX
+    array convention); anything else refuses self-describingly rather
+    than silently reshaping someone else's vector."""
+    if mtx.format != "array":
+        raise AcgError(
+            ErrorCode.INVALID_FORMAT,
+            f"--nrhs {nrhs} needs a DENSE array file of {n} x {nrhs} "
+            f"values (one column per right-hand side); this file is "
+            f"{mtx.format} format")
+    vals = np.asarray(mtx.vals, dtype=np.float64).reshape(-1)
+    if mtx.ncols != nrhs or mtx.nrows != n or vals.size != n * nrhs:
+        raise AcgError(
+            ErrorCode.INVALID_VALUE,
+            f"--nrhs {nrhs} needs a {n} x {nrhs} array file; this "
+            f"file declares {mtx.nrows} x {mtx.ncols} "
+            f"({vals.size} values)")
+    return vals.reshape((n, nrhs), order="F")

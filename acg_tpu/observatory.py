@@ -183,6 +183,32 @@ class SolveStatus:
             if self.solve:
                 self.solve["seconds"] = float(seconds)
 
+    def note_batch(self, nrhs: int, residuals, converged) -> None:
+        """Per-RHS evidence of a batched solve (acg_tpu.solvers.
+        batched): the status document's ``solve.batch`` block names
+        the SLOWEST unconverged RHS -- the column the ETA is keyed to,
+        since the batched loop runs exactly until it converges."""
+        with self._lock:
+            if not self.solve:
+                self.solve = {"what": "batched", "maxits": 0,
+                              "rtol": 0.0, "atol": 0.0, "target": None,
+                              "started_unix": time.time()}
+            res = [_finite(r) for r in residuals]
+            conv = [bool(c) for c in converged]
+            unconv = [i for i, c in enumerate(conv) if not c]
+            pool = unconv if unconv else list(range(len(res)))
+            slowest = max(pool, key=lambda i: (res[i]
+                                               if res[i] is not None
+                                               else float("inf"))) \
+                if pool else 0
+            self.solve["batch"] = {
+                "nrhs": int(nrhs),
+                "unconverged": len(unconv),
+                "slowest_rhs": int(slowest),
+                "slowest_residual": res[slowest] if res else None,
+                "residuals": res,
+            }
+
     def note_phase(self, name: str) -> None:
         with self._lock:
             self.phase = str(name)
@@ -490,6 +516,16 @@ def note_soak_solve(i: int, nsolves: int, latency: float) -> None:
         return
     STATUS.note_soak(i + 1, nsolves)
     STATUS.note_latency(latency)
+    _maybe_flush()
+
+
+def note_batch(nrhs: int, residuals, converged) -> None:
+    """Per-RHS residual/convergence columns of a batched solve (the
+    status document's ``solve.batch`` block; the ETA keys to the
+    slowest unconverged RHS).  No-op disarmed."""
+    if not _armed:
+        return
+    STATUS.note_batch(nrhs, residuals, converged)
     _maybe_flush()
 
 

@@ -853,6 +853,7 @@ def _doc_case(doc: dict):
     if metric is None:
         metric = f"{man.get('solver', 'solve')}:{man.get('matrix', '?')}"
     metric = _precond_keyed(metric, man.get("precond"))
+    metric = _batch_keyed(metric, man.get("nrhs"), man.get("block_cg"))
     soak = st.get("soak") or {}
     if soak:
         try:
@@ -883,13 +884,32 @@ def _precond_keyed(metric, precond) -> str:
     return metric
 
 
+def _batch_keyed(metric, nrhs, block=None) -> str:
+    """Fold the batch selection into the case key (the _precond_keyed
+    pattern): a B-wide batched (or block-CG) capture measures a
+    different program than a single-RHS one and must never silently
+    diff against it."""
+    metric = str(metric)
+    try:
+        b = int(nrhs or 0)
+    except (TypeError, ValueError):
+        b = 0
+    if b > 1:
+        metric = f"{metric}|nrhs={b}"
+        if block:
+            metric = f"{metric}|block"
+    return metric
+
+
 def _row_case(row: dict):
     """``(key, value)`` for one bench summary row (the JSON lines bench
     prints / BENCH_*.json records)."""
     metric, value = row.get("metric"), row.get("value")
     if metric is None or not isinstance(value, (int, float)):
         return None
-    return _precond_keyed(metric, row.get("precond")), float(value)
+    key = _precond_keyed(metric, row.get("precond"))
+    key = _batch_keyed(key, row.get("nrhs"), row.get("block"))
+    return key, float(value)
 
 
 def rows_to_cases(rows) -> dict:

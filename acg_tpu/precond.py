@@ -357,6 +357,75 @@ def make_apply(spec: PrecondSpec, spmv_fn):
     return apply
 
 
+def make_apply_batched(spec: PrecondSpec, spmv_multi_fn=None):
+    """``apply(mstate, A, R) -> Z`` over a MULTI-COLUMN residual block
+    ``R`` of shape ``(n, B)`` -- the preconditioner apply broadcast
+    over the batch axis (the batched multi-RHS tier,
+    acg_tpu.solvers.batched).
+
+    Jacobi broadcasts the inverse diagonal across columns in one
+    elementwise multiply; block-Jacobi reuses the SAME batched
+    triangular solves with B right-hand sides per block (the blocked
+    reshape gains a trailing column axis); Chebyshev runs its K-step
+    semi-iteration on the whole block through ``spmv_multi_fn``
+    (default: the single-device multi-vector SpMV) -- K matrix passes
+    for ALL B columns, the same amortization as the solve loop's."""
+    import jax
+    import jax.numpy as jnp
+
+    from acg_tpu.ops.spmv import acc_dtype
+
+    if spec.kind == "jacobi":
+        def apply(mstate, A, R):
+            (dinv,) = mstate
+            return (R.astype(dinv.dtype) * dinv[:, None]).astype(R.dtype)
+        return apply
+
+    if spec.kind == "bjacobi":
+        bs = spec.block
+
+        def apply(mstate, A, R):
+            (chol,) = mstate
+            n, nb_cols = R.shape
+            npad = chol.shape[0] * bs
+            Rp = R.astype(chol.dtype)
+            if npad != n:
+                Rp = jnp.pad(Rp, ((0, npad - n), (0, 0)))
+            Rb = Rp.reshape(chol.shape[0], bs, nb_cols)
+            y = jax.lax.linalg.triangular_solve(
+                chol, Rb, left_side=True, lower=True)
+            z = jax.lax.linalg.triangular_solve(
+                chol, y, left_side=True, lower=True, transpose_a=True)
+            return z.reshape(npad, nb_cols)[:n].astype(R.dtype)
+        return apply
+
+    k = spec.degree
+    if spmv_multi_fn is None:
+        from acg_tpu.solvers.batched import spmv_multi as spmv_multi_fn
+
+    def apply(mstate, A, R):
+        lmin, lmax = mstate
+        adt = acc_dtype(R.dtype)
+        lmin = lmin.astype(adt)
+        lmax = lmax.astype(adt)
+        theta = (lmax + lmin) * 0.5
+        delta = (lmax - lmin) * 0.5
+        sigma = theta / delta
+        rho = 1.0 / sigma
+        Rs = R.astype(adt)
+        d = Rs / theta
+        z = d
+        rcur = Rs
+        for _ in range(k):
+            rcur = rcur - spmv_multi_fn(A, d.astype(R.dtype)).astype(adt)
+            rho_new = 1.0 / (2.0 * sigma - rho)
+            d = rho_new * rho * d + (2.0 * rho_new / delta) * rcur
+            z = z + d
+            rho = rho_new
+        return z.astype(R.dtype)
+    return apply
+
+
 # -- stacked host-side state builders (the explicit distributed tier) -----
 
 def _np_diag_blocks_from_triples(rows, cols, vals, n: int, bs: int,

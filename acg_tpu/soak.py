@@ -173,6 +173,14 @@ def run_soak(solver, b, *, nsolves: int, x0=None, criteria=None,
     # a serving fleet's accuracy can drift (accumulating operator
     # updates, thermal-driven recompiles) just like its latency
     gaps: list[float] = []
+    # batched tier (acg_tpu.solvers.batched): per-RHS iteration counts
+    # and EFFECTIVE latencies across the run -- a batch completes
+    # together, but each RHS's share of the wall clock is its frozen-at
+    # iteration over the slowest RHS's, which is what a per-request SLA
+    # on a coalescing service actually observes
+    rhs_iters: list[int] = []
+    rhs_lats: list[float] = []
+    rhs_n = 0
     x = None
     for i in range(nsolves):
         kw = dict(kwargs)
@@ -197,6 +205,13 @@ def run_soak(solver, b, *, nsolves: int, x0=None, criteria=None,
         g = (st.health or {}).get("gap_last")
         if g is not None and math.isfinite(float(g)):
             gaps.append(float(g))
+        batch = st.batch or {}
+        if batch.get("nrhs", 0) >= 1 and batch.get("iterations"):
+            rhs_n = int(batch["nrhs"])
+            its_b = [int(v) for v in batch["iterations"]]
+            kmax = max(max(its_b), 1)
+            rhs_iters.extend(its_b)
+            rhs_lats.extend(lat * it / kmax for it in its_b)
         # live-observatory tier: per-solve queue progress for the
         # status endpoint (no-op disarmed) and the SLO verdict for
         # this solve (no-op without declared objectives; breaches
@@ -225,6 +240,22 @@ def run_soak(solver, b, *, nsolves: int, x0=None, criteria=None,
         "iterations": _percentiles(it_hist),
         "drift": det.to_dict(),
     }
+    if rhs_iters:
+        # per-RHS view of a batched soak (stats schema /9): quantiles
+        # over every (solve, rhs) pair of the run
+        def _q(vals, q):
+            s = sorted(vals)
+            return s[min(int(q * len(s)), len(s) - 1)]
+
+        report["per_rhs"] = {
+            "nrhs": rhs_n,
+            "iterations": {"p50": _q(rhs_iters, 0.5),
+                           "p95": _q(rhs_iters, 0.95),
+                           "p99": _q(rhs_iters, 0.99)},
+            "latency": {"p50": _q(rhs_lats, 0.5),
+                        "p95": _q(rhs_lats, 0.95),
+                        "p99": _q(rhs_lats, 0.99)},
+        }
     if gaps:
         # accuracy-drift view of the run: how the audited true-residual
         # gap moved across repeated solves (the latency drift gate's

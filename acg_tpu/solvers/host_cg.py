@@ -847,3 +847,99 @@ class HostDistCGSolver:
             raise NotConvergedError(
                 f"{k} iterations, residual {st.rnrm2:.3e} > {res_tol:.3e}")
         return x
+
+
+# -- batched/block eager oracles (the ground-truth parity targets) --------
+
+def host_batched_cg(A, B, x0=None, criteria: StoppingCriteria | None = None
+                    ) -> tuple:
+    """Eager f64 multi-RHS twin of the batched device tier: the classic
+    recurrence run per COLUMN (a plain numpy loop -- no fusion, no
+    masks, the un-clever reference), so the device batched/block
+    results have a ground-truth parity target whose arithmetic is
+    beyond suspicion.  Returns ``(X, niterations, rnrm2)`` with
+    per-RHS arrays."""
+    crit = criteria or StoppingCriteria()
+    A = as_csr(A)
+    B = np.asarray(B, dtype=np.float64)
+    if B.ndim == 1:
+        B = B[:, None]
+    n, nrhs = B.shape
+    X = (np.zeros((n, nrhs)) if x0 is None
+         else np.array(x0, dtype=np.float64, copy=True))
+    iters = np.zeros(nrhs, dtype=np.int64)
+    rn = np.zeros(nrhs)
+    for j in range(nrhs):
+        x = X[:, j].copy()
+        r = B[:, j] - A @ x
+        p = r.copy()
+        gamma = float(r @ r)
+        res_tol = max(crit.residual_atol,
+                      crit.residual_rtol * np.sqrt(gamma))
+        k = 0
+        while (crit.unbounded or gamma >= res_tol * res_tol) \
+                and k < crit.maxits:
+            t = A @ p
+            alpha = gamma / float(p @ t)
+            x += alpha * p
+            r -= alpha * t
+            gamma_next = float(r @ r)
+            beta = gamma_next / gamma
+            gamma = gamma_next
+            p = r + beta * p
+            k += 1
+        X[:, j] = x
+        iters[j] = k
+        rn[j] = np.sqrt(gamma)
+    return X, iters, rn
+
+
+def host_block_cg(A, B, x0=None, criteria: StoppingCriteria | None = None
+                  ) -> tuple:
+    """Eager f64 TRUE block-CG oracle (O'Leary 1980): one shared Krylov
+    block, B x B Gram solves per iteration, rank deflation by relative
+    Tikhonov jitter -- the same recurrence the device block tier
+    compiles (acg_tpu.solvers.batched._block_cg_program), in plain
+    numpy so its iteration counts and solutions anchor the acceptance
+    tests.  Returns ``(X, niterations, rnrm2, block_iterations)``."""
+    crit = criteria or StoppingCriteria()
+    A = as_csr(A)
+    B = np.asarray(B, dtype=np.float64)
+    if B.ndim == 1:
+        B = B[:, None]
+    n, nrhs = B.shape
+    X = (np.zeros((n, nrhs)) if x0 is None
+         else np.array(x0, dtype=np.float64, copy=True))
+    eps = np.finfo(np.float64).eps
+
+    def deflated_solve(M, G):
+        tr = np.trace(M) / M.shape[0]
+        jitter = 64.0 * eps * max(abs(tr), eps)
+        return np.linalg.solve(M + jitter * np.eye(M.shape[0]), G)
+
+    R = B - A @ X
+    rr = np.einsum("nb,nb->b", R, R)
+    res_tol = np.maximum(crit.residual_atol,
+                         crit.residual_rtol * np.sqrt(rr))
+    done = (np.zeros(nrhs, bool) if crit.unbounded
+            else rr < res_tol * res_tol)
+    iters = np.zeros(nrhs, dtype=np.int64)
+    P = R.copy()
+    G = R.T @ R
+    k = 0
+    while k < crit.maxits and not done.all():
+        Q = A @ P
+        W = P.T @ Q
+        alpha = deflated_solve(W, G)
+        X = X + P @ alpha
+        R = R - Q @ alpha
+        rr = np.einsum("nb,nb->b", R, R)
+        iters += (~done).astype(np.int64)
+        if not crit.unbounded:
+            done = done | (~done & (rr < res_tol * res_tol))
+        G_new = R.T @ R
+        beta = deflated_solve(G, G_new)
+        P = R + P @ beta
+        G = G_new
+        k += 1
+    return X, iters, np.sqrt(rr), k

@@ -155,6 +155,10 @@ class SolverStats:
     # declared --slo objectives and their observation/breach/burn
     # verdict.  Appends strictly last
     slo: dict = dataclasses.field(default_factory=dict)
+    # batched multi-RHS tier (acg_tpu.solvers.batched, stats schema
+    # /9): nrhs, per-RHS iteration/residual/converged columns, and the
+    # block-CG iteration totals.  Appends strictly last
+    batch: dict = dataclasses.field(default_factory=dict)
 
     def to_dict(self) -> dict:
         """Machine-readable twin of :meth:`fwrite` -- the ``stats`` key
@@ -203,6 +207,7 @@ class SolverStats:
             "ckpt": dict(self.ckpt),
             "tracing": dict(self.tracing),
             "slo": dict(self.slo),
+            "batch": dict(self.batch),
         }
         if self.trace is not None:
             d["trace"] = self.trace.to_dict()
@@ -309,6 +314,9 @@ class SolverStats:
         if self.slo:
             p("slo:")
             _write_section(p, self.slo, 1)
+        if self.batch:
+            p("batch:")
+            _write_section(p, self.batch, 1)
         text = out.getvalue()
         if f is not None:
             f.write(text)

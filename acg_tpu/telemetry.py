@@ -89,7 +89,13 @@ from acg_tpu.solvers.stats import PHASE_ORDER
 # slo-breach event kind -- additive, so /1../7 consumers keep working
 # (the run-history ledger wraps whole /N documents, any N, under its
 # own acg-tpu-history/1 index lines)
-STATS_SCHEMA = "acg-tpu-stats/8"
+# /9: the batched multi-RHS tier (acg_tpu.solvers.batched) adds a
+# "batch" key inside the stats twin (nrhs, per-RHS iteration/residual/
+# converged columns, block-CG iteration totals), a "per_rhs" key inside
+# "soak" (per-RHS latency/iteration percentiles), and an "nrhs" manifest
+# key that joins the bench-diff case key -- additive, so /1../8
+# consumers keep working
+STATS_SCHEMA = "acg-tpu-stats/9"
 CONVERGENCE_SCHEMA = "acg-tpu-convergence/1"
 # default ring capacity (--telemetry-window): 512 iterations x 4 scalars
 # is 8 KiB of f32 carry -- negligible against any solve's vectors, and
@@ -136,6 +142,30 @@ def ring_record(buf, k, rnrm2sqr, alpha, beta, pAp, audit=None):
         vals = vals + (audit,)
     row = jnp.stack([jnp.asarray(v, buf.dtype).reshape(())
                      for v in vals])[None]
+    slot = jnp.asarray(k, jnp.int32) % buf.shape[0]
+    return jax.lax.dynamic_update_slice(buf, row, (slot, jnp.int32(0)))
+
+
+def ring_init_batched(capacity: int, nrhs: int, dtype):
+    """The batched tier's carried ring: ``(capacity, nrhs)`` slots of
+    per-RHS ``||r_j||^2`` columns, NaN-initialised like the classic
+    ring.  Scalars (alpha/beta/pAp) are per-RHS vectors in the batched
+    recurrences, so the ring records the one column every consumer
+    needs -- the residual fan -- instead of 4*nrhs columns nobody
+    reads."""
+    import jax.numpy as jnp
+
+    return jnp.full((max(int(capacity), 1), max(int(nrhs), 1)), jnp.nan,
+                    dtype=dtype)
+
+
+def ring_record_batched(buf, k, rnrm2sqr_cols):
+    """Write iteration ``k``'s per-RHS squared residuals into slot
+    ``k % capacity`` (the batched twin of :func:`ring_record`)."""
+    import jax
+    import jax.numpy as jnp
+
+    row = jnp.asarray(rnrm2sqr_cols, buf.dtype).reshape(1, -1)
     slot = jnp.asarray(k, jnp.int32) % buf.shape[0]
     return jax.lax.dynamic_update_slice(buf, row, (slot, jnp.int32(0)))
 
@@ -292,6 +322,101 @@ class ConvergenceTrace:
         if audited:
             line += " [audit gap column present]"
         return line
+
+
+@dataclasses.dataclass
+class BatchedConvergenceTrace:
+    """Host view of a batched solve's per-RHS residual ring.
+
+    ``records`` is ``(m, nrhs)`` float64 of per-RHS residual NORMS
+    (sqrt applied here, once); ``iterations`` the 0-based iteration of
+    each row.  The JSONL form declares ``nrhs`` in its meta line and
+    each data record carries the full residual column plus the
+    worst-RHS value, so :mod:`scripts/plot_convergence` can render the
+    residual fan and ascii consumers can fall back to the worst RHS."""
+
+    capacity: int
+    niterations: int
+    nrhs: int
+    records: np.ndarray
+    iterations: np.ndarray
+    wrapped: bool
+    solver: str = "cg-batched"
+
+    @classmethod
+    def from_ring(cls, buf, niterations: int,
+                  solver: str = "cg-batched",
+                  offset: int = 0) -> "BatchedConvergenceTrace":
+        buf = np.asarray(buf, dtype=np.float64)
+        cap, nrhs = int(buf.shape[0]), int(buf.shape[1])
+        n = int(niterations)
+        off = int(offset)
+        m = min(n, cap)
+        its = np.arange(n - m, n, dtype=np.int64)
+        rows = np.array(buf[its % cap] if m else buf[:0], copy=True)
+        if m:
+            rows = np.where(rows >= 0, np.sqrt(np.abs(rows)), rows)
+        return cls(capacity=cap, niterations=n + off, nrhs=nrhs,
+                   records=rows, iterations=its + off,
+                   wrapped=n > cap or off > 0, solver=solver)
+
+    @property
+    def first_iteration(self) -> int:
+        return int(self.iterations[0]) if self.iterations.size else 0
+
+    def worst_per_iteration(self) -> np.ndarray:
+        """(m,) worst-RHS residual per recorded iteration -- what the
+        ascii sparkline and the status-trail consumers fall back to."""
+        if not self.records.size:
+            return self.records.reshape(0)
+        return np.nanmax(self.records, axis=1)
+
+    def to_dict(self) -> dict:
+        return {
+            "schema": CONVERGENCE_SCHEMA,
+            "solver": self.solver,
+            "capacity": self.capacity,
+            "niterations": self.niterations,
+            "first_iteration": self.first_iteration,
+            "wrapped": self.wrapped,
+            "nrhs": self.nrhs,
+            "fields": ["rnrm2"],
+            "records": [self.record_dict(i)
+                        for i in range(self.iterations.size)],
+        }
+
+    def record_dict(self, i: int) -> dict:
+        cols = [_json_float(v) for v in self.records[i]]
+        finite = [v for v in self.records[i] if math.isfinite(v)]
+        return {"it": int(self.iterations[i]), "rnrm2": cols,
+                "worst": _json_float(max(finite) if finite
+                                     else float("nan"))}
+
+    def write_jsonl(self, f) -> None:
+        own = isinstance(f, (str, bytes)) or hasattr(f, "__fspath__")
+        out = open(f, "w") if own else f
+        try:
+            meta = self.to_dict()
+            records = meta.pop("records")
+            meta = {"meta": True, **meta}
+            if self.wrapped:
+                meta["truncated_before"] = self.first_iteration
+            out.write(json.dumps(meta) + "\n")
+            for rec in records:
+                out.write(json.dumps(rec) + "\n")
+        finally:
+            if own:
+                out.close()
+
+    def tail_summary(self, n: int = 5) -> str:
+        worst = self.worst_per_iteration()
+        m = min(int(n), self.iterations.size)
+        if not m:
+            return "trailing residual window: (empty)"
+        parts = [f"it {int(self.iterations[-m + i])}: "
+                 f"{worst[-m + i]:.3e} (worst of {self.nrhs})"
+                 for i in range(m)]
+        return "trailing residual window: " + ", ".join(parts)
 
 
 class EagerTraceRecorder:

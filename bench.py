@@ -838,6 +838,153 @@ def run_soak_mode(args) -> int:
     return rc or soak.gate_exit_code(report, args.fail_on_drift)
 
 
+def run_batched_mode(args) -> int:
+    """``bench.py --batched``: the batched-vs-sequential throughput
+    case (ISSUE 11 acceptance) -- solves/second at B in {1, 4, 8} for
+    one Poisson matrix, each B measured as ONE batched multi-RHS solve
+    against a sequential loop of B single-RHS solves of the SAME
+    columns (fixed-iteration protocol, so every row does identical
+    numerical work), plus the block-CG iteration-count case on the
+    --aniso family (block total iterations vs the sum of B independent
+    solves).  One JSON row per case.
+
+    Measured over the 8-part mesh (the virtual CPU mesh off-TPU, the
+    sweep_np provisioning): the per-iteration collectives are where
+    the B-invariance pays -- a sequential loop moves B x the
+    allreduces/halo exchanges of one batched solve.
+
+    Re-baseline note: the nrhs/block keys join the bench-diff case key
+    (perfmodel._batch_keyed), so the FIRST batched capture starts a
+    fresh baseline series -- r05 was bench_backend_unavailable and no
+    prior batched rows exist to diff against (ROADMAP Recent)."""
+    import numpy as np
+
+    from acg_tpu._platform import provision_host_mesh
+
+    jax = provision_host_mesh(8)
+    if len(jax.devices()) < 8:
+        env = dict(os.environ)
+        env["JAX_PLATFORMS"] = "cpu"
+        env.setdefault("XLA_FLAGS",
+                       "--xla_force_host_platform_device_count=8")
+        import subprocess
+        return subprocess.run(
+            [sys.executable, os.path.abspath(__file__), "--batched",
+             "--batched-side", str(args.batched_side),
+             "--batched-its", str(args.batched_its),
+             "--batched-aniso-side", str(args.batched_aniso_side)]
+            + (["--stats-json", args.stats_json] if args.stats_json
+               else [])
+            + (["--baseline", args.baseline] if args.baseline else []),
+            env=env).returncode
+
+    import jax.numpy as jnp
+
+    from acg_tpu._platform import device_sync
+    from acg_tpu.io.generators import batched_rhs
+    from acg_tpu.ops.spmv import device_matrix_from_csr
+    from acg_tpu.parallel.dist import DistCGSolver, DistributedProblem
+    from acg_tpu.parallel.dist_batched import BatchedDistCGSolver
+    from acg_tpu.partition import partition_rows
+    from acg_tpu.solvers.batched import BatchedCGSolver
+    from acg_tpu.solvers.jax_cg import JaxCGSolver
+    from acg_tpu.solvers.stats import StoppingCriteria
+
+    side, its = args.batched_side, args.batched_its
+    csr = _build(side, 2)
+    n = csr.shape[0]
+    nparts = 8
+    part = partition_rows(csr, nparts, seed=0, method="band")
+    prob = DistributedProblem.build(csr, part, nparts,
+                                    dtype=jnp.float32)
+    Bcols = batched_rhs(n, 8, seed=0, dtype=np.float32)
+    crit = StoppingCriteria(maxits=its)   # fixed-work protocol
+    rows = []
+
+    def best_of(fn, reps=3):
+        ts = []
+        for _ in range(reps):
+            t0 = time.perf_counter()
+            fn()
+            ts.append(time.perf_counter() - t0)
+        return min(ts)
+
+    seq = DistCGSolver(prob)
+    seq.solve(Bcols[:, 0], criteria=crit, host_result=False)  # compile
+    for nb in (1, 4, 8):
+        cols = Bcols[:, :nb]
+        bs = BatchedDistCGSolver(prob)
+        # compile outside timing (both sides)
+        device_sync(bs.solve(cols, criteria=crit, host_result=False))
+
+        def batched_once():
+            device_sync(bs.solve(cols, criteria=crit,
+                                 host_result=False))
+
+        def sequential_once():
+            for j in range(nb):
+                device_sync(seq.solve(cols[:, j], criteria=crit,
+                                      host_result=False))
+
+        t_b = best_of(batched_once)
+        t_s = best_of(sequential_once)
+        row = {
+            "metric": f"batched_cg_solves_per_sec_poisson2d_n{side}"
+                      f"_np{nparts}_f32_its{its}",
+            "nrhs": nb,
+            "value": round(nb / t_b, 3),
+            "unit": "solves/s",
+            "dtype": "f32",
+            "nparts": nparts,
+            "sequential_solves_per_sec": round(nb / t_s, 3),
+            "speedup_vs_sequential": round(t_s / t_b, 3),
+        }
+        print(f"# B={nb}: batched {t_b:.3f}s vs sequential {t_s:.3f}s "
+              f"({t_s / t_b:.2f}x)", file=sys.stderr)
+        print(json.dumps(row))
+        rows.append(row)
+        _sink_stats(row, bs)
+        sys.stdout.flush()
+
+    # block-CG iteration acceptance on the aniso family: total block
+    # iterations (trips x B) vs the summed iterations of B independent
+    # solves to the same tolerance
+    from acg_tpu.io.generators import aniso_poisson2d_coo
+    from acg_tpu.matrix import SymCsrMatrix
+    jax.config.update("jax_enable_x64", True)
+    r, c, v, N = aniso_poisson2d_coo(args.batched_aniso_side, 0.05)
+    acsr = SymCsrMatrix.from_coo(N, r, c, v).to_csr()
+    Aa = device_matrix_from_csr(acsr, dtype=jnp.float64)
+    B8 = batched_rhs(N, 8, seed=0)
+    tol = StoppingCriteria(maxits=50000, residual_rtol=1e-8)
+    blk = BatchedCGSolver(Aa, mode="block")
+    blk.solve(B8, criteria=tol)
+    trips = blk.stats.batch["block_iterations"]
+    indep = 0
+    for j in range(8):
+        s1 = JaxCGSolver(Aa, kernels="xla")
+        s1.solve(B8[:, j], criteria=tol)
+        indep += s1.stats.niterations
+    ratio = trips * 8 / indep
+    row = {
+        "metric": f"block_cg_iters_ratio_aniso_n"
+                  f"{args.batched_aniso_side}_eps0.05_rtol1e-8",
+        "nrhs": 8,
+        "block": True,
+        "value": round(ratio, 4),
+        "unit": "block_total/indep_sum",
+        "block_iterations": int(trips),
+        "block_total_iterations": int(trips * 8),
+        "independent_iterations_sum": int(indep),
+    }
+    print(f"# block-CG: {trips} trips x 8 = {trips * 8} vs "
+          f"{indep} independent ({ratio:.3f}x)", file=sys.stderr)
+    print(json.dumps(row))
+    rows.append(row)
+    _sink_stats(row, blk)
+    return _finish(args, rows, 0)
+
+
 def _finish(args, rows, rc: int) -> int:
     """Apply the --baseline regression gate to this run's emitted rows
     (the perfmodel tier's case-by-case diff -- same engine as
@@ -879,6 +1026,25 @@ def main(argv=None) -> int:
                          "out subsequent rows; round-3 verdict item 8)")
     ap.add_argument("--sweep-np", action="store_true",
                     help="multi-chip CPU-mesh correctness sweep")
+    ap.add_argument("--batched", action="store_true",
+                    help="batched multi-RHS throughput case: solves/s "
+                         "at B in {1,4,8}, one batched solve vs a "
+                         "sequential B-solve loop of the same columns, "
+                         "plus the block-CG iteration-ratio case on "
+                         "the --aniso family (ISSUE 11 acceptance).  "
+                         "nrhs/block join the bench-diff case key; the "
+                         "first batched capture starts a fresh "
+                         "baseline series")
+    ap.add_argument("--batched-side", type=int, default=128, metavar="N",
+                    help="with --batched: Poisson grid side "
+                         "(default: 128)")
+    ap.add_argument("--batched-its", type=int, default=200, metavar="K",
+                    help="with --batched: fixed iterations per solve "
+                         "(default: 200)")
+    ap.add_argument("--batched-aniso-side", type=int, default=48,
+                    metavar="N",
+                    help="with --batched: aniso grid side for the "
+                         "block-CG iteration case (default: 48)")
     ap.add_argument("--stats-json", metavar="FILE", default=None,
                     help="JSONL-append each timed case's full solver "
                          "stats document (the CLI's --stats-json "
@@ -935,6 +1101,12 @@ def main(argv=None) -> int:
 
     if args.sweep_np:
         return sweep_np()
+
+    if args.batched:
+        # like --sweep-np, provisions its own 8-part virtual CPU mesh
+        # (re-executing itself when the flags must be set before jax
+        # init), so it runs BEFORE the backend probe
+        return run_batched_mode(args)
 
     # fail FAST when the tunneled backend is dead: its init has been
     # observed to hang ~15 minutes before raising UNAVAILABLE (round 5),

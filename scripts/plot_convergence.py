@@ -46,6 +46,19 @@ def _load_conv(path):
 
     meta, records = read_convergence_log(path)
     its = [r["it"] for r in records]
+    # batched multi-RHS logs (/9): rnrm2 is a per-RHS COLUMN -- keep
+    # the full fan for the matplotlib renderer (thin line per RHS,
+    # worst highlighted) and collapse to the worst RHS for the scalar
+    # consumers (the ascii sparkline's documented fallback)
+    if int(meta.get("nrhs") or 0) > 1 or any(
+            isinstance(r.get("rnrm2"), list) for r in records):
+        fan = [[float(v) for v in r["rnrm2"]] for r in records]
+        meta["_fan"] = fan
+        rn = [float(r["worst"]) if "worst" in r
+              else max((v for v in row if math.isfinite(v)),
+                       default=math.nan)
+              for r, row in zip(records, fan)]
+        return meta, its, rn, None
     # poisoned values arrive as repr strings ("nan"/"inf"); float()
     # parses those directly, so they stay non-finite for the renderers
     rn = [float(r["rnrm2"]) for r in records]
@@ -491,6 +504,11 @@ def main(argv=None) -> int:
                          f" truncated)")
             if meta.get("truncated"):
                 head += " (trailing line truncated mid-write)"
+            if meta.get("_fan"):
+                # batched log: the ascii fallback shows the WORST RHS
+                # only (the fan needs a real plot; run without --ascii)
+                head += (f" [residual fan: {len(meta['_fan'][0])} RHS, "
+                         f"worst shown]")
             print(head)
             print("  " + _sparkline(its, rn))
             if finite:
@@ -535,6 +553,24 @@ def main(argv=None) -> int:
         label = os.path.basename(path)
         if meta.get("wrapped"):
             label += " (truncated)"
+        fan = meta.get("_fan")
+        if fan:
+            # the residual FAN of a batched log: one thin line per
+            # RHS, the worst-RHS envelope highlighted on top -- the
+            # per-request view of a coalesced batch
+            nrhs = len(fan[0])
+            for j in range(nrhs):
+                ax.semilogy(
+                    its,
+                    [row[j] if math.isfinite(row[j]) and row[j] > 0
+                     else float("nan") for row in fan],
+                    linewidth=0.6, alpha=0.45)
+            ax.semilogy(its,
+                        [v if math.isfinite(v) and v > 0
+                         else float("nan") for v in rn],
+                        label=f"{label} (worst of {nrhs} RHS)",
+                        linewidth=1.6, color="black")
+            continue
         ax.semilogy(its, [v if math.isfinite(v) and v > 0 else float("nan")
                           for v in rn], label=label, linewidth=1.2)
         if gaps is not None:

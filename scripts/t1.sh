@@ -7,7 +7,7 @@
 # T1_SOAK=1 additionally runs the service-soak smoke after the tests: a
 # tiny 3-solve --soak run whose --metrics-file must validate as
 # Prometheus exposition format and whose --stats-json must carry the
-# acg-tpu-stats/8 soak section (the CI soak-smoke step runs the same
+# acg-tpu-stats/9 soak section (the CI soak-smoke step runs the same
 # thing).  T1_HEALTH=1 runs the numerical-health smoke: an audited
 # pipelined solve on the anisotropic generator must leave a health:
 # section with a finite gap, the acg_health_* metric families, and a
@@ -30,6 +30,11 @@
 # answer must verify against the host matrix; then a small seeded
 # chaos campaign must end every schedule converged-or-agreed-abort
 # (zero wrong-answer-green) with the acg_recovery_* families present.
+# T1_BATCH=1 runs the batched multi-RHS smoke: an 8-part CPU-mesh
+# solve of B=4 right-hand sides in ONE batched program must converge
+# every column, leave a /9 stats document with the per-RHS batch:
+# section, a status document whose solve.batch block names the
+# slowest RHS, and one history ledger row carrying the batch section.
 set -o pipefail
 cd "$(dirname "$0")/.."
 rm -f /tmp/_t1.log
@@ -52,7 +57,7 @@ if [ "${T1_SOAK:-0}" = "1" ]; then
     python - <<'PY' || rc=$((rc ? rc : 1))
 import json
 doc = json.load(open("/tmp/_t1_soak.json"))
-assert doc["schema"] == "acg-tpu-stats/8", doc["schema"]
+assert doc["schema"] == "acg-tpu-stats/9", doc["schema"]
 soak = doc["stats"]["soak"]
 assert soak["nsolves"] == 3 and soak["latency"]["p50"] is not None, soak
 assert "metrics" in doc, "registry snapshot missing from /3 document"
@@ -74,7 +79,7 @@ if [ "${T1_PRECOND:-0}" = "1" ]; then
         env PC="$pc" python - <<'PY' || rc=$((rc ? rc : 1))
 import json, os
 doc = json.load(open("/tmp/_t1_precond.json"))
-assert doc["schema"] == "acg-tpu-stats/8", doc["schema"]
+assert doc["schema"] == "acg-tpu-stats/9", doc["schema"]
 st = doc["stats"]
 assert st["converged"] is True, st["rnrm2"]
 assert st["precond"]["kind"] == os.environ["PC"], st["precond"]
@@ -110,7 +115,7 @@ if [ "${T1_HEALTH:-0}" = "1" ]; then
     python - <<'PY' || rc=$((rc ? rc : 1))
 import json, math
 doc = json.load(open("/tmp/_t1_health.json"))
-assert doc["schema"] == "acg-tpu-stats/8", doc["schema"]
+assert doc["schema"] == "acg-tpu-stats/9", doc["schema"]
 h = doc["stats"]["health"]
 assert h["naudits"] > 0, h
 assert h["gap_last"] is not None and math.isfinite(h["gap_last"]), h
@@ -149,7 +154,7 @@ if [ "${T1_CKPT:-0}" = "1" ]; then
     python - <<'PY' || rc=$((rc ? rc : 1))
 import json
 doc = json.load(open("/tmp/_t1_ckpt.json"))
-assert doc["schema"] == "acg-tpu-stats/8", doc["schema"]
+assert doc["schema"] == "acg-tpu-stats/9", doc["schema"]
 st = doc["stats"]
 assert st["converged"] is True, st["rnrm2"]
 ck = st["ckpt"]
@@ -188,7 +193,7 @@ if [ "${T1_TRACE:-0}" = "1" ]; then
     python - <<'PY' || rc=$((rc ? rc : 1))
 import json
 doc = json.load(open("/tmp/_t1_trace.json"))
-assert doc["schema"] == "acg-tpu-stats/8", doc["schema"]
+assert doc["schema"] == "acg-tpu-stats/9", doc["schema"]
 tr = doc["stats"]["tracing"]
 tl = tr["timeline"]
 assert tl["nparts"] == 8 and tl["nspans"] > 0, tl
@@ -237,7 +242,7 @@ assert len(ledgers) == 1, ledgers
 row = json.loads(open(f"/tmp/_t1_history/{ledgers[0]}").readline())
 assert row["ledger"] == "acg-tpu-history/1", row["ledger"]
 assert row["nparts"] == 8 and row["converged"] is True, row
-assert row["doc"]["schema"] == "acg-tpu-stats/8", row["doc"]["schema"]
+assert row["doc"]["schema"] == "acg-tpu-stats/9", row["doc"]["schema"]
 sj = json.load(open("/tmp/_t1_status_stats.json"))
 assert sj["stats"]["slo"]["targets"]["iters"] == 280, sj["stats"]["slo"]
 print(f"T1_STATUS: OK (iteration {doc['solve']['iteration']}, "
@@ -301,6 +306,41 @@ assert "WRONG-ANSWER" not in outcomes, outcomes
 print(f"T1_CHAOS: campaign OK ({outcomes.count('converged')} "
       f"converged, {outcomes.count('agreed-abort')} agreed-abort, "
       f"0 wrong-answer)")
+PY
+fi
+if [ "${T1_BATCH:-0}" = "1" ]; then
+    # batched multi-RHS smoke (the ISSUE-11 acceptance in miniature):
+    # B=4 systems against one matrix on the 8-part CPU mesh, one
+    # batched SPMD program -- every RHS must converge, the per-RHS
+    # evidence must land in the batch: stats section, the status
+    # document and the history ledger
+    echo "T1_BATCH: 8-part B=4 batched smoke"
+    rm -rf /tmp/_t1_batch_hist
+    rm -f /tmp/_t1_batch.json /tmp/_t1_batch_status.json
+    timeout -k 10 300 env JAX_PLATFORMS=cpu \
+        XLA_FLAGS="--xla_force_host_platform_device_count=8" \
+        python -m acg_tpu.cli gen:poisson2d:24 --nparts 8 --nrhs 4 \
+        --max-iterations 400 --residual-rtol 1e-8 --warmup 0 --quiet \
+        --ckpt /tmp/_t1_batch_ck --ckpt-every 20 \
+        --status-file /tmp/_t1_batch_status.json \
+        --history /tmp/_t1_batch_hist \
+        --stats-json /tmp/_t1_batch.json || rc=$((rc ? rc : 1))
+    python - <<'PY' || rc=$((rc ? rc : 1))
+import json, os
+doc = json.load(open("/tmp/_t1_batch.json"))
+assert doc["schema"] == "acg-tpu-stats/9", doc["schema"]
+batch = doc["stats"]["batch"]
+assert batch["nrhs"] == 4 and len(batch["iterations"]) == 4, batch
+assert all(batch["converged"]) and batch["unconverged"] == 0, batch
+sd = json.load(open("/tmp/_t1_batch_status.json"))
+sb = sd["solve"]["batch"]
+assert sb["nrhs"] == 4 and len(sb["residuals"]) == 4, sb
+ledgers = [f for f in os.listdir("/tmp/_t1_batch_hist")
+           if f.endswith(".jsonl")]
+row = json.loads(open(f"/tmp/_t1_batch_hist/{ledgers[0]}").readline())
+assert row["doc"]["stats"]["batch"]["nrhs"] == 4, row["doc"]["stats"]
+print(f"T1_BATCH: OK (per-RHS iterations {batch['iterations']}, "
+      f"slowest rhs {sb['slowest_rhs']})")
 PY
 fi
 exit $rc
