@@ -52,6 +52,23 @@ def make_parser() -> argparse.ArgumentParser:
                    help="solver variant (default: acg); host = numpy "
                         "reference oracle, host-native = C++ core oracle "
                         "(native/src/cg.cpp)")
+    p.add_argument("--algorithm", default="auto", metavar="ALG",
+                   help="CG recurrence: 'classic' | 'pipelined' "
+                        "(Ghysels-Vanroose, = --solver acg-pipelined) | "
+                        "'sstep:S' (communication-avoiding s-step CG: "
+                        "ONE fused Gram allreduce per S iterations, "
+                        "monomial basis below S=4, Chebyshev at S>=4) | "
+                        "'pipelined:L' (deep-pipelined p(l)-CG: ONE "
+                        "fused allreduce per iteration consumed L "
+                        "iterations later -- L reduction latencies "
+                        "hidden behind L SpMVs; restarted on the "
+                        "method's square-root breakdown).  'auto' "
+                        "follows --solver.  The CA recurrences ride "
+                        "the single-device, sharded gen-direct and "
+                        "distributed tiers, run unpreconditioned over "
+                        "f32/f64 vectors, and compose with telemetry/"
+                        "faults/recovery (+ the health audit for "
+                        "sstep)")
     p.add_argument("--comm", default="xla",
                    choices=["none", "xla", "dma", "mpi", "nccl", "nvshmem"],
                    help="halo transport: xla collectives or pallas dma "
@@ -671,6 +688,13 @@ def _buildinfo(out) -> int:
          f"--explain measured-vs-predicted comm verdict and replaces "
          f"--profile-ops replay estimates); 'tracing' section + "
          f"acg_trace_* metrics; schema {STATS_SCHEMA}"),
+        ("communication-avoiding recurrences", "--algorithm sstep:S "
+         "(ONE Gram allreduce per S iterations -- mesh reduction count "
+         "2/iter -> 1/S-block; Chebyshev basis at S>=4) | pipelined:L "
+         "(p(l)-CG: ONE fused allreduce/iter consumed L iterations "
+         "later; restarted on sqrt breakdown); single-device, sharded "
+         "gen-direct and dist tiers; builder classic/pipelined "
+         "emission pinned byte-identical (acg_tpu.recurrence)"),
         ("perf observability", f"--explain (compiled cost_analysis/"
          f"memory_analysis introspection, comm ledger, roofline "
          f"verdict); 'costmodel'/'memory' keys in the {STATS_SCHEMA} "
@@ -933,7 +957,9 @@ def _solve_generated_direct(args, dim, n, N, jax, jnp, dtype,
                              trace=args._trace, progress=args.progress,
                              precond=getattr(args, "_precond", None),
                              health=getattr(args, "_health", None),
-                             ckpt=getattr(args, "_ckpt", None))
+                             ckpt=getattr(args, "_ckpt", None),
+                             algorithm=getattr(args, "_algorithm",
+                                               None))
     except ValueError as e:
         raise SystemExit(f"acg-tpu: {e}")
     b = jnp.ones(N, dtype=vec_dtype)
@@ -1522,7 +1548,9 @@ def _solve_distributed_read(args, jax, jnp, dtype, vec_dtype) -> int:
                               trace=args._trace, progress=args.progress,
                               precond=getattr(args, "_precond", None),
                               health=getattr(args, "_health", None),
-                              ckpt=getattr(args, "_ckpt", None))
+                              ckpt=getattr(args, "_ckpt", None),
+                              algorithm=getattr(args, "_algorithm",
+                                                None))
     except ValueError as e:
         sys.stderr.write(f"acg-tpu: {e}\n")
         _stage_sync(args, "solve", 1)
@@ -1955,7 +1983,8 @@ def _solve_generated_sharded(args, dim, n, N, jax, jnp, dtype,
             trace=args._trace, progress=args.progress,
             precond=getattr(args, "_precond", None),
             health=getattr(args, "_health", None),
-            ckpt=getattr(args, "_ckpt", None))
+            ckpt=getattr(args, "_ckpt", None),
+            algorithm=getattr(args, "_algorithm", None))
     except ValueError as e:
         raise SystemExit(f"acg-tpu: {e}")
     _log(args, f"assemble sharded DIA planes on device ({nparts} parts):",
@@ -2250,6 +2279,51 @@ def _main(args) -> int:
         if unsupported:
             raise SystemExit(
                 f"acg-tpu: --precond {args.precond} does not support: "
+                f"{', '.join(unsupported)}")
+    # communication-avoiding recurrence selection (acg_tpu.recurrence):
+    # validate BEFORE anything expensive, refuse hosts/tiers the armed
+    # recurrence could never ride (the fault-injector discipline)
+    from acg_tpu.recurrence import parse_algorithm
+    try:
+        args._algorithm = parse_algorithm(args.algorithm)
+    except ValueError as e:
+        raise SystemExit(f"acg-tpu: {e}")
+    if (args._algorithm is not None
+            and not args._algorithm.communication_avoiding):
+        # classic/pipelined resolve onto the existing solver names
+        if args._algorithm.kind == "pipelined" \
+                and args.solver == "acg":
+            args.solver = "acg-pipelined"
+        elif args._algorithm.kind == "classic" \
+                and args.solver == "acg-pipelined":
+            args.solver = "acg"
+        args._algorithm = None
+    if args._algorithm is not None:
+        ca = str(args._algorithm)
+        unsupported = [flag for flag, on in [
+            (f"--solver {args.solver} (the host/external oracles run "
+             f"the classic recurrence)",
+             args.solver in ("host", "host-native", "petsc")),
+            ("--nrhs/--block-cg (no batched CA recurrences yet)",
+             args.nrhs >= 2 or args.block_cg),
+            ("--refine", args.refine),
+            ("--replace-every", args.replace_every > 0),
+            ("--precise-dots", args.precise_dots),
+            (f"--precond {args.precond} (the CA recurrences run "
+             f"unpreconditioned)", args._precond is not None),
+            ("--kernels fused", args.kernels == "fused"),
+            ("--explain (the explain sweep drives the "
+             "classic/pipelined tiers)", args.explain),
+            ("--profile-ops (the replay census has no CA op map)",
+             args.profile_ops is not None),
+            ("--ckpt/--resume (no CA checkpoint carry yet)",
+             args.ckpt is not None or args.resume is not None),
+            ("--diff-atol/--diff-rtol (residual criteria only)",
+             args.diff_atol > 0 or args.diff_rtol > 0),
+        ] if on]
+        if unsupported:
+            raise SystemExit(
+                f"acg-tpu: --algorithm {ca} does not support: "
                 f"{', '.join(unsupported)}")
     # numerical-health tier (acg_tpu.health): validate the spec BEFORE
     # anything expensive; refuse configurations where an armed audit
@@ -2990,7 +3064,8 @@ def _main(args) -> int:
                                          progress=args.progress,
                                          precond=args._precond,
                                          health=args._health,
-                                         ckpt=args._ckpt)
+                                         ckpt=args._ckpt,
+                                         algorithm=args._algorithm)
                 except ValueError as e:
                     raise SystemExit(f"acg-tpu: {e}")
                 if args.refine:
@@ -3028,7 +3103,8 @@ def _main(args) -> int:
                                           progress=args.progress,
                                           precond=args._precond,
                                           health=args._health,
-                                          ckpt=args._ckpt)
+                                          ckpt=args._ckpt,
+                                          algorithm=args._algorithm)
                 except ValueError as e:
                     raise SystemExit(f"acg-tpu: {e}")
                 if args.refine:

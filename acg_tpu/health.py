@@ -540,6 +540,14 @@ def spectrum_estimate(trace, precond: str | None = None) -> dict | None:
     rec = np.asarray(trace.records, dtype=np.float64)
     if rec.ndim != 2 or rec.shape[0] < 2 or rec.shape[1] < 3:
         return None
+    # the CA recurrences (acg_tpu.recurrence: *-sstepS / *-plL solver
+    # names) record CLASSIC-aligned rows by construction -- s-step
+    # records each inner step's plain CG scalars, p(l) records
+    # (q^2, 1/d, l^2, d) at solution-advance time, and alpha = 1/d /
+    # beta = l^2 satisfy the classic CG<->Lanczos identity exactly --
+    # so only the Ghysels-Vanroose names carry the re-alignment marker
+    # (their spec names deliberately avoid the "pipelined" substring;
+    # pinned in tests/test_recurrence.py)
     pipelined = "pipelined" in str(getattr(trace, "solver", ""))
     d, e = lanczos_tridiagonal(rec[:, 1], rec[:, 2],
                                pipelined=pipelined,

@@ -48,12 +48,12 @@ from acg_tpu.errors import (AcgError, BreakdownError, ErrorCode,
                             NotConvergedError)
 from acg_tpu.graph import (Subdomain, partition_matrix, reorder_owned_natural,
                            scatter_vector)
-from acg_tpu.ops.precision import dot_compensated
 from acg_tpu.ops.spmv import (acc_dtype, csr_diag_offsets, dia_mv,
                               dia_planes_fixed, ell_planes_from_csr)
 from acg_tpu.parallel.halo import DeviceHaloPlan, build_device_halo, halo_exchange
 from acg_tpu.parallel.halo_dma import halo_exchange_dma
 from acg_tpu.parallel.mesh import PARTS_AXIS, solve_mesh
+from acg_tpu.parallel.reductions import make_pdot, make_pdotk
 from acg_tpu.parallel.multihost import get_global, put_global
 from acg_tpu.solvers.jax_cg import _breakdown_guard, _iterate
 from acg_tpu.solvers.stats import (SolverStats, StoppingCriteria,
@@ -777,7 +777,7 @@ class DistCGSolver:
                  precise_dots: bool = False, kernels: str = "auto",
                  replace_every: int = 0, replace_restart: bool = True,
                  recovery=None, trace: int = 0, progress: int = 0,
-                 precond=None, health=None, ckpt=None):
+                 precond=None, health=None, ckpt=None, algorithm=None):
         """``recovery`` (acg_tpu.solvers.resilience.RecoveryPolicy) arms
         in-loop breakdown detection plus the host-side restart ladder:
         bounded restarts from the recomputed true residual, the
@@ -828,6 +828,17 @@ class DistCGSolver:
         self.pipelined = pipelined
         self.precise_dots = precise_dots
         self.comm = comm
+        # recurrence selection (acg_tpu.recurrence): classic/pipelined
+        # stay on the hand-built shard_body (builder emission pinned
+        # byte-identical in tests/test_hlo_structure.py); sstep:S /
+        # pipelined:L compose the builder recurrences with this tier's
+        # halo'd SpMV + fused psum machinery (_compile_ca)
+        from acg_tpu.recurrence import parse_algorithm
+        self.algo = parse_algorithm(algorithm)
+        if self.algo is not None and not self.algo.communication_avoiding:
+            self.pipelined = pipelined = (self.algo.kind == "pipelined")
+            self.algo = None
+        self._lam = None
         self.mesh = mesh if mesh is not None else solve_mesh(problem.nparts)
         self.stats = SolverStats(unknowns=problem.n)
         self._sharding = NamedSharding(self.mesh, P(PARTS_AXIS))
@@ -907,6 +918,51 @@ class DistCGSolver:
                     "state never leaves the program (use the direct "
                     "classic/pipelined programs)")
         self.ckpt = ckpt
+        if self.algo is not None:
+            # the CA refusal set mirrors JaxCGSolver's (the
+            # could-never-fire discipline)
+            ca = str(self.algo)
+            if pipelined:
+                raise ValueError(
+                    f"--algorithm {ca} selects its own recurrence; it "
+                    f"does not compose with the pipelined flag")
+            if self.replace_every:
+                raise ValueError(
+                    f"{ca} does not compose with replace_every")
+            if self.precise_dots:
+                raise ValueError(
+                    f"{ca} accumulates its fused Gram/window reductions "
+                    f"in the scalar dtype; precise_dots composes with "
+                    f"the classic/pipelined programs")
+            if self.precond_spec is not None:
+                raise ValueError(
+                    f"{ca} runs unpreconditioned: the s-step basis and "
+                    f"the p(l) auxiliary basis have no M^-1 hook yet")
+            if np.dtype(problem.vdtype) == np.dtype(jnp.bfloat16):
+                raise ValueError(
+                    f"{ca} amplifies storage rounding through its basis "
+                    f"products; bf16 vectors need the classic/pipelined "
+                    f"tiers")
+            if ckpt is not None:
+                raise ValueError(
+                    f"{ca} does not expose its window/basis carry to "
+                    f"the checkpoint chunk driver yet; --ckpt/--resume "
+                    f"need --algorithm classic|pipelined")
+            if self.health_spec is not None:
+                if self.algo.kind == "pl":
+                    raise ValueError(
+                        f"{ca} has no in-loop audit hook; --audit-every "
+                        f"needs classic/pipelined/sstep")
+                if self.health_spec.abft:
+                    raise ValueError(
+                        f"{ca} has no checksum hook for its basis "
+                        f"products; --abft needs classic/pipelined")
+        if (self.algo is not None and self.algo.kind == "pl"
+                and recovery is None):
+            # restarted p(l)-CG (the jax_cg rationale): sqrt breakdown
+            # is algorithmic; arm the restart ladder by default
+            from acg_tpu.recurrence import pl_restart_policy
+            recovery = pl_restart_policy()
         self.recovery = recovery
         self.trace = int(trace)
         self.progress = int(progress)
@@ -951,6 +1007,11 @@ class DistCGSolver:
         checkpoint chunk driver's plumbing).  Disarmed programs never
         name any of it and lower byte-identical code (pinned in
         tests/test_checkpoint.py)."""
+        if self.algo is not None:
+            # communication-avoiding recurrences: the builder program
+            # (recurrence.run_sstep_loop / run_pl_loop) composed with
+            # this tier's machinery
+            return self._compile_ca(fault=fault)
         prob = self.problem
         pipelined = self.pipelined
         replace_every = self.replace_every
@@ -1035,49 +1096,22 @@ class DistCGSolver:
             def ldot(a, c):
                 return jnp.dot(a, c, preferred_element_type=sdt)
 
-            if precise:
-                # compensated local dot (ops.precision), hi and lo
-                # psum'd as a pair so local summation error stays out of
-                # the global scalar (cross-part addition error is
-                # O(nparts) ulps, negligible vs the 4M-element sums)
-                def pdot(a, c):
-                    hi, lo = dot_compensated(a.astype(sdt), c.astype(sdt))
-                    pair = psum(jnp.stack([hi, lo]))
-                    return pair[0] + pair[1]
+            # the fused-reduction family (parallel.reductions): ONE
+            # psum carries k scalars -- compensated mode psums hi/lo
+            # pairs so local summation error stays out of the global
+            # scalar, and the pipelined/PCG single-allreduce property
+            # (cgcuda.c:1730-1737) is the k=2/k=3 member.  The builders
+            # emit exactly the op sequence the hand-written ladders
+            # traced, so these programs lower byte-identically to the
+            # pre-refactor ones (pinned in tests/test_hlo_structure.py)
+            pdot = make_pdot(psum, ldot, sdt, precise)
+            _pdotk = make_pdotk(psum, ldot, sdt, precise)
 
-                def pdot2_fused(a1, c1, a2, c2):
-                    # both compensated dots in ONE psum of 4 scalars,
-                    # preserving the pipelined variant's single-allreduce
-                    # property (cgcuda.c:1730-1737)
-                    h1, l1 = dot_compensated(a1.astype(sdt), c1.astype(sdt))
-                    h2, l2 = dot_compensated(a2.astype(sdt), c2.astype(sdt))
-                    quad = psum(jnp.stack([h1, l1, h2, l2]))
-                    return quad[0] + quad[1], quad[2] + quad[3]
-            else:
-                def pdot(a, c):
-                    return psum(ldot(a, c))
+            def pdot2_fused(a1, c1, a2, c2):
+                return _pdotk((a1, c1), (a2, c2))
 
-                def pdot2_fused(a1, c1, a2, c2):
-                    pair = psum(jnp.stack([ldot(a1, c1),
-                                           ldot(a2, c2)]))
-                    return pair[0], pair[1]
-
-            if precise:
-                def pdot3_fused(a1, c1, a2, c2, a3, c3):
-                    # the pipelined-PCG reduction: three compensated
-                    # dots in ONE psum of 6 scalars -- the single-
-                    # allreduce property survives preconditioning
-                    h1, l1 = dot_compensated(a1.astype(sdt), c1.astype(sdt))
-                    h2, l2 = dot_compensated(a2.astype(sdt), c2.astype(sdt))
-                    h3, l3 = dot_compensated(a3.astype(sdt), c3.astype(sdt))
-                    six = psum(jnp.stack([h1, l1, h2, l2, h3, l3]))
-                    return (six[0] + six[1], six[2] + six[3],
-                            six[4] + six[5])
-            else:
-                def pdot3_fused(a1, c1, a2, c2, a3, c3):
-                    tri = psum(jnp.stack([ldot(a1, c1), ldot(a2, c2),
-                                          ldot(a3, c3)]))
-                    return tri[0], tri[1], tri[2]
+            def pdot3_fused(a1, c1, a2, c2, a3, c3):
+                return _pdotk((a1, c1), (a2, c2), (a3, c3))
 
             bnrm2 = jnp.sqrt(pdot(b, b))
             x0nrm2 = jnp.sqrt(pdot(x0, x0))
@@ -1709,6 +1743,147 @@ class DistCGSolver:
 
         return program
 
+    def _compile_ca(self, fault=None):
+        """Communication-avoiding recurrence programs: s-step CG (one
+        Gram allreduce per s-iteration block) and deep-pipelined
+        p(l)-CG (one fused 2l+2-scalar window allreduce per iteration),
+        shard_map'd over the SAME halo'd SpMV / psum plumbing as the
+        hand-built programs.  The recurrence math itself -- basis
+        construction, coefficient updates, the stream-Cholesky window
+        bookkeeping -- is the same code the single-device tier runs
+        (recurrence.run_sstep_loop / run_pl_loop): a recurrence lands
+        once in the builder and rides every tier."""
+        from acg_tpu.recurrence import (TierOps, run_pl_loop,
+                                        run_sstep_loop)
+        prob = self.problem
+        algo = self.algo
+        axis = PARTS_AXIS
+        comm = self.comm
+        interpret = self._interpret
+        trace = self.trace
+        progress = self.progress
+        health = self.health_spec
+        dist_spmv = make_dist_spmv(prob, comm, interpret,
+                                   kernels=self.kernels, fault=fault)
+        single_shard = self.mesh.devices.size == 1
+
+        def psum(v):
+            return v if single_shard else lax.psum(v, axis)
+
+        def shard_body(la, ga, sidx, gsrc, gval, scnt, rcnt, b, x0,
+                       tols, maxits, lam, unbounded=False):
+            la, ga = (jax.tree.map(lambda a: a[0], t) for t in (la, ga))
+            sidx, gsrc, gval, scnt, rcnt, b, x0 = (
+                a[0] for a in (sidx, gsrc, gval, scnt, rcnt, b, x0))
+            maxits = maxits.astype(jnp.int32)
+            dtype = b.dtype
+            sdt = acc_dtype(dtype)
+            store = ((lambda v: v.astype(dtype)) if sdt != dtype
+                     else (lambda v: v))
+            res_atol, res_rtol = tols[0], tols[1]
+            pidx = None
+            if fault is not None:
+                pidx = (jnp.int32(0) if single_shard
+                        else lax.axis_index(axis))
+
+            def spmv(x, k=None):
+                return dist_spmv(x, la, ga, sidx, gsrc, gval, scnt,
+                                 rcnt, k=k, pidx=pidx)
+
+            def ldot(a, c):
+                return jnp.dot(a, c, preferred_element_type=sdt)
+
+            pdot = make_pdot(psum, ldot, sdt, False)
+            ops = TierOps(spmv=spmv, dot=pdot, psum_stack=psum,
+                          store=store, sdt=sdt)
+            leader = None
+            if progress and not single_shard:
+                leader = lax.axis_index(axis) == jnp.int32(0)
+            bnrm2 = jnp.sqrt(pdot(b, b))
+            x0nrm2 = jnp.sqrt(pdot(x0, x0))
+            r = b - spmv(x0)
+            gamma = pdot(r, r)
+            r0nrm2 = jnp.sqrt(gamma)
+            res_tol = jnp.maximum(res_atol, res_rtol * r0nrm2)
+            inf = jnp.asarray(jnp.inf, sdt)
+            lam_t = (lam[0].astype(sdt), lam[1].astype(sdt))
+            what = algo.solver_name("dist-cg")
+            if algo.kind == "sstep":
+                x, k, gamma_f, bad, done, extras = run_sstep_loop(
+                    ops, algo.param, algo.basis, lam_t, b, x0, r,
+                    gamma, res_tol, maxits, unbounded, fault=fault,
+                    trace=trace, progress=progress, health=health,
+                    what=what, leader=leader, bnrm2=bnrm2)
+                rnrm2 = jnp.sqrt(jnp.maximum(gamma_f, 0.0))
+            else:
+                eta = r0nrm2
+                z0 = store(r / jnp.where(eta == 0, 1.0, eta))
+                x, k, q, conv, bad, extras = run_pl_loop(
+                    ops, algo.param, lam_t, x0, z0, eta, gamma,
+                    res_tol, maxits, unbounded, fault=fault,
+                    trace=trace, progress=progress, what=what,
+                    leader=leader)
+                x = store(x)
+                rnrm2 = jnp.abs(q)
+                done = (~bad) if unbounded else conv
+            breakdown = bad & ~done
+            out = (x[None], k, rnrm2, r0nrm2, bnrm2, x0nrm2, inf,
+                   done, breakdown)
+            return out + extras
+
+        pspec = P(PARTS_AXIS)
+        rspec = P()
+        in_specs = (pspec, pspec, pspec, pspec, pspec, pspec, pspec,
+                    pspec, pspec, rspec, rspec, rspec)
+        out_specs = (pspec,) + (rspec,) * (
+            8 + (1 if trace else 0)
+            + (1 if health is not None else 0))
+
+        @functools.partial(jax.jit,
+                           static_argnames=("unbounded", "needs_diff",
+                                            "detect"))
+        def program(la, ga, sidx, gsrc, gval, scnt, rcnt, b, x0, tols,
+                    maxits, lam, unbounded, needs_diff, detect=False):
+            # needs_diff / detect ride the signature for dispatch
+            # compatibility: diff criteria are refused at solve time,
+            # and the CA programs always carry their breakdown flag
+            if single_shard and not prob.halo.has_ghosts:
+                return shard_body(la, ga, sidx, gsrc, gval, scnt, rcnt,
+                                  b, x0, tols, maxits, lam,
+                                  unbounded=unbounded)
+
+            def smb(la, ga, sidx, gsrc, gval, scnt, rcnt, b, x0, tols,
+                    maxits, lam):
+                return shard_body(la, ga, sidx, gsrc, gval, scnt, rcnt,
+                                  b, x0, tols, maxits, lam,
+                                  unbounded=unbounded)
+
+            return _shard_map(
+                smb, mesh=self.mesh, in_specs=in_specs,
+                out_specs=out_specs,
+            )(la, ga, sidx, gsrc, gval, scnt, rcnt, b, x0, tols,
+              maxits, lam)
+
+        return program
+
+    def _ensure_lam(self, dev_args):
+        """Cached (lmin, lmax) interval for the CA recurrences: the
+        mesh power iteration (_power_lmax) through this tier's own
+        halo'd SpMV, with the recurrence module's spectral headroom."""
+        if self._lam is None:
+            from acg_tpu.recurrence import LAM_SAFETY
+            if self.algo is not None and self.algo.needs_lam:
+                self._lam = (0.0,
+                             self._power_lmax(dev_args) * LAM_SAFETY)
+            else:
+                self._lam = (0.0, 0.0)
+        return self._lam
+
+    def _solver_name(self) -> str:
+        if self.algo is not None:
+            return self.algo.solver_name("dist-cg")
+        return "dist-cg-pipelined" if self.pipelined else "dist-cg"
+
     # -- preconditioner state ---------------------------------------------
 
     def _power_lmax(self, dev_args, iters=None) -> float:
@@ -1846,6 +2021,9 @@ class DistCGSolver:
         if self.replace_every and crit.needs_diff:
             raise ValueError("replace_every supports residual criteria "
                              "only")
+        if self.algo is not None and crit.needs_diff:
+            raise ValueError(f"{self.algo} supports residual criteria "
+                             f"only")
         sdt = acc_dtype(np.dtype(self.problem.vdtype))
         dev = self.device_args(np.asarray(b_global), x0)
         b, x0, la, ga, sidx, gsrc, gval, scnt, rcnt = dev
@@ -1858,8 +2036,13 @@ class DistCGSolver:
         if self.precond_spec is not None:
             self._last_dev_args = dev
             kwargs["mstate"] = self._ensure_precond_state(dev)
-        return program.lower(la, ga, sidx, gsrc, gval, scnt, rcnt, b, x0,
-                             tols, jnp.int32(crit.maxits), **kwargs)
+        args = (la, ga, sidx, gsrc, gval, scnt, rcnt, b, x0,
+                tols, jnp.int32(crit.maxits))
+        if self.algo is not None:
+            lam = self._ensure_lam(dev)
+            args = args + ((jnp.asarray(lam[0], sdt),
+                            jnp.asarray(lam[1], sdt)),)
+        return program.lower(*args, **kwargs)
 
     def _detect(self, fault) -> bool:
         """Breakdown-flag arming shared by solve() and lower_solve (the
@@ -1926,6 +2109,30 @@ class DistCGSolver:
             "allreduce_bytes_per_iteration": int(nred * scal * sdl),
             "max_hops": int(max_hops),
         }
+        if self.algo is not None:
+            # communication-avoiding recurrences: the reduction
+            # schedule is the recurrence's own declaration
+            # (recurrence.reduction_schedule) -- fractional values are
+            # exact per-iteration averages of per-block events (the
+            # whole point: s-step's 1/s allreduce per iteration vs
+            # classic's 2)
+            from acg_tpu.recurrence import reduction_schedule
+            sched = reduction_schedule(self.algo, False)
+            led["algorithm"] = str(self.algo)
+            led["allreduce_per_iteration"] = \
+                sched["allreduce_per_iteration"]
+            led["allreduce_scalars"] = sched["allreduce_scalars"]
+            led["allreduce_bytes_per_iteration"] = (
+                sched["allreduce_per_iteration"]
+                * sched["allreduce_scalars"] * sdl)
+            led["halo_exchanges_per_iteration"] = \
+                sched["spmv_per_iteration"]
+            led["halo_bytes_per_iteration"] = (
+                total * sched["spmv_per_iteration"])
+            for extra_key in ("iterations_per_reduction",
+                              "reduction_latency_hidden"):
+                if extra_key in sched:
+                    led[extra_key] = sched[extra_key]
         if self.precond_spec is not None:
             # reclassify for PCG: cheby multiplies the halo pattern by
             # its degree (K extra SpMV-shaped exchanges per iteration);
@@ -2004,6 +2211,25 @@ class DistCGSolver:
                 "fault injection does not reach the replacement-segment "
                 "program (replace_every); inject into the direct "
                 "classic/pipelined programs instead")
+        if (self.algo is not None and fault is not None
+                and self.algo.kind == "sstep"
+                and fault.site in ("spmv", "sdc", "halo")
+                and fault.iteration % self.algo.param != 0):
+            # the s-step basis products carry the BLOCK-START iteration
+            # index (jax_cg rationale): mid-block arming never fires
+            raise AcgError(
+                ErrorCode.INVALID_VALUE,
+                f"sstep:{self.algo.param} applies SpMV/halo faults at "
+                f"block boundaries; arm an iteration that is a "
+                f"multiple of {self.algo.param} (got "
+                f"{fault.iteration})")
+        if (self.algo is not None and fault is not None
+                and self.algo.kind == "pl" and fault.site == "dot"):
+            raise AcgError(
+                ErrorCode.INVALID_VALUE,
+                "dot fault injection has no site in the p(l) "
+                "recurrence (its reductions are fused window matvecs); "
+                "use spmv:, or the classic/pipelined/sstep programs")
         if (fault is not None and fault.site == "precond"
                 and self.precond_spec is None):
             # no preconditioner armed: the apply the fault poisons
@@ -2042,6 +2268,14 @@ class DistCGSolver:
                 self._last_dev_args)
         args = (la, ga, sidx, gsrc, gval, scnt, rcnt, b, x0, tols,
                 jnp.int32(crit.maxits))
+        if self.algo is not None:
+            if crit.needs_diff:
+                raise ValueError(f"{self.algo} supports residual "
+                                 f"criteria only")
+            lam = self._ensure_lam((b, x0, la, ga, sidx, gsrc, gval,
+                                    scnt, rcnt))
+            args = args + ((jnp.asarray(lam[0], sdt),
+                            jnp.asarray(lam[1], sdt)),)
         # device_sync, not bare block_until_ready: see _platform (the
         # tunneled backend's block has been observed not to wait)
         from acg_tpu._platform import block_until_ready_works, device_sync
@@ -2064,8 +2298,7 @@ class DistCGSolver:
             # complete copy, np.asarray reads the local one
             return telemetry.ConvergenceTrace.from_ring(
                 np.asarray(out[9]), int(out[1]),
-                solver="dist-cg-pipelined" if self.pipelined
-                else "dist-cg")
+                solver=self._solver_name())
 
         hl = self.health_spec is not None
 
@@ -2111,7 +2344,7 @@ class DistCGSolver:
                     x_next = x0_dev
                 remaining = max(crit.maxits - niter, 1)
                 return (args[:8] + (x_next, rtols)
-                        + (jnp.int32(remaining),))
+                        + (jnp.int32(remaining),) + args[11:])
 
             while bool(out[8]):
                 k_done = int(out[1])
@@ -2170,7 +2403,24 @@ class DistCGSolver:
                 if driver.on_breakdown(k_done):
                     x_next = out[0]
                     if fault is not None:
-                        fault = fault.shift(k_done)
+                        if (self.algo is not None
+                                and self.algo.kind == "sstep"
+                                and fault.device_site
+                                and fault.iteration <= k_done):
+                            # fired inside a frozen basis block: vanish,
+                            # never rebase (jax_cg rationale)
+                            fault = None
+                        elif (self.algo is not None
+                              and self.algo.kind == "pl"
+                              and fault.device_site):
+                            # shift in the z-counter frame (j = adv + l
+                            # at breakdown -- jax_cg rationale): a
+                            # fired fault vanishes instead of
+                            # re-triggering forever
+                            fault = fault.shift(
+                                k_done + self.algo.param + 1)
+                        else:
+                            fault = fault.shift(k_done)
                         program = self._program_for(fault)
                     if self.precond_spec is not None:
                         # preserve finite preconditioner state across
@@ -2234,8 +2484,7 @@ class DistCGSolver:
         # comm ledger (comm_profile, the perfmodel tier's hook)
         from acg_tpu import metrics
         metrics.record_solve(t_solve, niter, st.converged,
-                             solver="dist-cg-pipelined" if self.pipelined
-                             else "dist-cg")
+                             solver=self._solver_name())
         metrics.observe_solver_comm(self, niter)
         self._account_ops(st, niter)
 
@@ -2278,16 +2527,28 @@ class DistCGSolver:
         prob = self.problem
         dtype = np.dtype(prob.vdtype)
         n = prob.n
+        # CA recurrences run spmv_per_iteration SpMV-equivalents (the
+        # s-step matrix-powers basis: (2s-1)/s), declared once by
+        # recurrence.reduction_schedule -- the same number the jax_cg
+        # tier's census and the comm ledger report, so the two tiers'
+        # stats for the identical algorithm cannot drift apart
+        spmv_eq = 1.0
+        if self.algo is not None:
+            from acg_tpu.recurrence import reduction_schedule
+            spmv_eq = reduction_schedule(
+                self.algo, False)["spmv_per_iteration"]
         st.nflops += (cg_flops_per_iteration(prob.nnz_total, n, self.pipelined)
-                      * niter + 3.0 * prob.nnz_total + 2.0 * n)
+                      * niter + 3.0 * prob.nnz_total + 2.0 * n
+                      + 3.0 * prob.nnz_total * (spmv_eq - 1.0) * niter)
         dbl = dtype.itemsize
         # matrix bytes in the matrix dtype (differs from vectors under
         # mixed); DIA local blocks read no index arrays, ELL reads 4 B
         mat_dbl = np.dtype(prob.dtype).itemsize
         idx_b = 0 if prob.local.format == "dia" else 4
-        st.ops["gemv"].add(niter + 1, 0.0,
+        ngemv = int(niter * spmv_eq) + 1
+        st.ops["gemv"].add(ngemv, 0.0,
                            (prob.nnz_total * (mat_dbl + idx_b)
-                            + 2 * n * dbl) * (niter + 1))
+                            + 2 * n * dbl) * ngemv)
         # op census matching the single-device/eager accounting
         # (jax_cg.solve / host_cg.solve): the convergence test's (r, r)
         # is the nrm2 class, classic CG's p = r setup the one copy --
@@ -2298,8 +2559,20 @@ class DistCGSolver:
         st.ops["axpy"].add(3 * niter, 0.0, 3 * n * dbl * 3 * niter)
         if not self.pipelined:
             st.ops["copy"].add(1, 0.0, 2 * n * dbl)
-        st.ops["allreduce"].add((1 if self.pipelined else 2) * niter, 0.0,
-                                8 * (1 if self.pipelined else 2) * niter)
+        if self.algo is not None:
+            # CA recurrences: the schedule is the single source
+            # (recurrence.reduction_schedule) -- fractional per-
+            # iteration averages rounded to whole events
+            from acg_tpu.recurrence import reduction_schedule
+            sched = reduction_schedule(self.algo, False)
+            nred = max(int(round(sched["allreduce_per_iteration"]
+                                 * niter)), 1)
+            st.ops["allreduce"].add(
+                nred, 0.0, 8 * sched["allreduce_scalars"] * nred)
+        else:
+            st.ops["allreduce"].add(
+                (1 if self.pipelined else 2) * niter, 0.0,
+                8 * (1 if self.pipelined else 2) * niter)
         # local-read problems carry the allgathered total (summing subs
         # here would count only this controller's parts)
         halo_total = getattr(prob, "halo_send_total", None)
@@ -2307,7 +2580,8 @@ class DistCGSolver:
             halo_total = sum(int(s.halo.total_send) for s in prob.subs
                              if s.halo is not None)
         halo_bytes = halo_total * dbl
-        st.ops["halo"].add(niter + 1, 0.0, halo_bytes * (niter + 1))
+        nhalo = int(niter * spmv_eq) + 1
+        st.ops["halo"].add(nhalo, 0.0, halo_bytes * nhalo)
         if self.precond_spec is not None:
             # the precond_apply census (jax_cg._account_precond's dist
             # twin): one apply per iteration + setup, cheby billing its

@@ -36,10 +36,10 @@ from jax.sharding import NamedSharding, PartitionSpec as P
 
 from acg_tpu._platform import shard_map as _shard_map
 from acg_tpu.errors import AcgError, ErrorCode, NotConvergedError
-from acg_tpu.ops.precision import dot_compensated
 from acg_tpu.ops.spmv import acc_dtype
 from acg_tpu.parallel.dist import DistributedProblem
 from acg_tpu.parallel.mesh import PARTS_AXIS, solve_mesh
+from acg_tpu.parallel.reductions import make_pdot_cols, make_pdotk_cols
 from acg_tpu.parallel.multihost import get_global, put_global
 from acg_tpu.solvers.stats import (SolverStats, StoppingCriteria,
                                    cg_flops_per_iteration)
@@ -224,37 +224,17 @@ class BatchedDistCGSolver:
                 return jnp.einsum("nb,nb->b", a, c,
                                   preferred_element_type=sdt)
 
-            if precise:
-                def _comp_cols(a, c):
-                    def one(u, v):
-                        return dot_compensated(u.astype(sdt),
-                                               v.astype(sdt))
-                    hi, lo = jax.vmap(one, in_axes=1)(a, c)
-                    return hi, lo
+            # the fused-reduction family (parallel.reductions), B-wide:
+            # ONE psum carries k B-column payloads (the mesh collective
+            # count stays invariant in B; compensated mode interleaves
+            # hi/lo column pairs) -- byte-identical emission to the
+            # hand-written ladders this replaced (tests/test_batched.py
+            # pins the counts)
+            pdot_cols = make_pdot_cols(psum, lcoldot, sdt, precise)
+            _pdotk_cols = make_pdotk_cols(psum, lcoldot, sdt, precise)
 
-                def pdot_cols(a, c):
-                    hi, lo = _comp_cols(a, c)
-                    pair = psum(jnp.stack([hi, lo]))
-                    return pair[0] + pair[1]
-
-                def pdot2_fused_cols(a1, c1, a2, c2):
-                    # BOTH per-RHS dot families (4B scalars with their
-                    # compensation terms) in ONE psum -- the B-column
-                    # pdot2_fused
-                    h1, l1 = _comp_cols(a1, c1)
-                    h2, l2 = _comp_cols(a2, c2)
-                    quad = psum(jnp.stack([h1, l1, h2, l2]))
-                    return quad[0] + quad[1], quad[2] + quad[3]
-            else:
-                def pdot_cols(a, c):
-                    return psum(lcoldot(a, c))
-
-                def pdot2_fused_cols(a1, c1, a2, c2):
-                    # the pipelined tier's single fused allreduce,
-                    # widened to 2B scalars (count invariant in B)
-                    pair = psum(jnp.stack([lcoldot(a1, c1),
-                                           lcoldot(a2, c2)]))
-                    return pair[0], pair[1]
+            def pdot2_fused_cols(a1, c1, a2, c2):
+                return _pdotk_cols((a1, c1), (a2, c2))
 
             bnrm2 = jnp.sqrt(pdot_cols(b, b))
             x0nrm2 = jnp.sqrt(pdot_cols(x0, x0))

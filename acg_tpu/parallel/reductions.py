@@ -1,0 +1,104 @@
+"""Fused mesh reductions shared by every distributed recurrence.
+
+The communication-avoiding property of the pipelined/batched/s-step
+tiers is carried by ONE idiom: stack k locally-computed scalars (or
+B-wide scalar columns), psum the stack once, unpack.  Before this
+module the idiom was hand-copied as ``pdot2_fused``/``pdot3_fused``
+(parallel/dist.py) and ``pdot2_fused_cols`` (parallel/dist_batched.py),
+and every new recurrence re-derived it; now :func:`make_pdot` /
+:func:`make_pdotk` / :func:`make_pdotk_cols` build the whole family
+from the tier's own ``psum`` + local-dot, and the s-step Gram / p(l)
+z-window reductions (``TierOps.psum_stack`` in acg_tpu.recurrence) are
+the same idiom with a matrix payload.
+
+Byte-compatibility contract: the builders emit EXACTLY the op sequence
+the hand-written ladders traced (stack order, compensated hi/lo
+interleave), so the refactored dist/dist_batched programs lower
+byte-identically to the pre-refactor ones (the HLO pins in
+tests/test_hlo_structure.py and tests/test_batched.py did not move).
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from acg_tpu.ops.precision import dot_compensated
+
+
+def make_pdot(psum, ldot, sdt, precise: bool):
+    """The single global dot product: ``pdot(a, c)`` = one psum of one
+    scalar (plain) or of the compensated hi/lo pair (``precise``)."""
+    if precise:
+        def pdot(a, c):
+            hi, lo = dot_compensated(a.astype(sdt), c.astype(sdt))
+            pair = psum(jnp.stack([hi, lo]))
+            return pair[0] + pair[1]
+    else:
+        def pdot(a, c):
+            return psum(ldot(a, c))
+    return pdot
+
+
+def make_pdotk(psum, ldot, sdt, precise: bool):
+    """``pdotk((a1, c1), ..., (ak, ck))`` -> k global scalars in ONE
+    psum -- the fused-reduction ladder every communication-avoiding
+    recurrence rides (classic PCG's (r,z)+(r,r) pair, the pipelined
+    tier's 2- and 3-scalar fusions, the ABFT 3-dot, the s-step Gram's
+    scalar tail).  Compensated mode interleaves hi/lo pairs exactly
+    like the hand-written ``pdot2_fused``/``pdot3_fused`` did."""
+    if precise:
+        def pdotk(*pairs):
+            hls = [dot_compensated(a.astype(sdt), c.astype(sdt))
+                   for a, c in pairs]
+            flat = psum(jnp.stack([v for hl in hls for v in hl]))
+            return tuple(flat[2 * i] + flat[2 * i + 1]
+                         for i in range(len(pairs)))
+    else:
+        def pdotk(*pairs):
+            red = psum(jnp.stack([ldot(a, c) for a, c in pairs]))
+            return tuple(red[i] for i in range(len(pairs)))
+    return pdotk
+
+
+def make_pdot_cols(psum, lcoldot, sdt, precise: bool):
+    """The single B-column global dot (batched tier): one psum of a
+    (B,) column (plain) or of the stacked compensated hi/lo columns."""
+    if precise:
+        import jax
+
+        def pdot_cols(a, c):
+            def one(u, v):
+                return dot_compensated(u.astype(sdt), v.astype(sdt))
+            hi, lo = jax.vmap(one, in_axes=1)(a, c)
+            pair = psum(jnp.stack([hi, lo]))
+            return pair[0] + pair[1]
+    else:
+        def pdot_cols(a, c):
+            return psum(lcoldot(a, c))
+    return pdot_cols
+
+
+def make_pdotk_cols(psum, lcoldot, sdt, precise: bool):
+    """The B-column twin of :func:`make_pdotk` (the batched tier):
+    ``pdotk_cols((A1, C1), ..., (Ak, Ck))`` -> k length-B scalar
+    columns in ONE psum of a (k, B) (or (2k, B) compensated) stack --
+    the mesh collective count stays invariant in B."""
+    if precise:
+        import jax
+
+        def _comp_cols(a, c):
+            def one(u, v):
+                return dot_compensated(u.astype(sdt), v.astype(sdt))
+            hi, lo = jax.vmap(one, in_axes=1)(a, c)
+            return hi, lo
+
+        def pdotk_cols(*pairs):
+            hls = [_comp_cols(a, c) for a, c in pairs]
+            flat = psum(jnp.stack([v for hl in hls for v in hl]))
+            return tuple(flat[2 * i] + flat[2 * i + 1]
+                         for i in range(len(pairs)))
+    else:
+        def pdotk_cols(*pairs):
+            red = psum(jnp.stack([lcoldot(a, c) for a, c in pairs]))
+            return tuple(red[i] for i in range(len(pairs)))
+    return pdotk_cols

@@ -232,7 +232,7 @@ class ShardedDiaCGSolver(JaxCGSolver):
                  vector_dtype=None, stencil: tuple[int, int] | None = None,
                  replace_every: int = 0, replace_restart: bool = True,
                  recovery=None, trace: int = 0, progress: int = 0,
-                 precond=None, health=None, ckpt=None):
+                 precond=None, health=None, ckpt=None, algorithm=None):
         if A.ncols_padded != A.nrows:
             raise ValueError("sharded DIA solve needs a square matrix")
         # replace_every (the sound bf16 tier, _cg_replaced_program)
@@ -258,12 +258,18 @@ class ShardedDiaCGSolver(JaxCGSolver):
         # the roll programs' state_io carry shards into the same
         # boundary collective-permutes as every other output, and the
         # snapshot stores the gathered global vectors
+        # the CA recurrences (acg_tpu.recurrence: sstep:S / p(l)-CG)
+        # likewise ride the inherited builder programs: the basis
+        # products and window SpMVs are this tier's roll SpMV, the
+        # Gram/window matmuls psum through sharding propagation like
+        # the CG scalars
         super().__init__(A, pipelined=pipelined, precise_dots=precise_dots,
                          kernels="xla-roll", vector_dtype=vector_dtype,
                          replace_every=replace_every,
                          replace_restart=replace_restart,
                          recovery=recovery, trace=trace, progress=progress,
-                         precond=precond, health=health, ckpt=ckpt)
+                         precond=precond, health=health, ckpt=ckpt,
+                         algorithm=algorithm)
         self.mesh = mesh if mesh is not None else solve_mesh()
         # fault-injection diagnosis hook (JaxCGSolver.solve): this tier
         # is multi-part but still cannot honour part= targeting
@@ -350,6 +356,21 @@ class ShardedDiaCGSolver(JaxCGSolver):
         nred = 1 if self.pipelined else 2
         scal = ((2 if self.pipelined else 1)
                 * (2 if self.precise_dots else 1))
+        algo_led = {}
+        if self.algo is not None:
+            # CA reclassification (the explicit dist tier's rule): the
+            # reduction schedule is the recurrence's own declaration
+            from acg_tpu.recurrence import reduction_schedule
+            sched = reduction_schedule(self.algo, False)
+            nred = sched["allreduce_per_iteration"]
+            scal = sched["allreduce_scalars"]
+            nexch = nexch * sched["spmv_per_iteration"]
+            per_shard = per_shard * sched["spmv_per_iteration"]
+            algo_led = {"algorithm": str(self.algo)}
+            for extra_key in ("iterations_per_reduction",
+                              "reduction_latency_hidden"):
+                if extra_key in sched:
+                    algo_led[extra_key] = sched[extra_key]
         precond_led = {}
         ar_bytes = None
         if self.precond_spec is not None:
@@ -371,13 +392,15 @@ class ShardedDiaCGSolver(JaxCGSolver):
         return {
             "transport": ("pallas-roll/ppermute" if pallas
                           else "xla-roll/collective-permute"),
+            **algo_led,
             "nparts": P,
             "mesh_shape": {str(k): int(v)
                            for k, v in dict(self.mesh.shape).items()},
             "halo_exchanges_per_iteration": nexch,
             "halo_bytes_per_iteration": int(per_shard * P * dbl),
             "halo_bytes_per_shard": int(per_shard * dbl),
-            "allreduce_per_iteration": int(nred),
+            "allreduce_per_iteration": (nred if self.algo is not None
+                                        else int(nred)),
             "allreduce_scalars": int(scal),
             "allreduce_bytes_per_iteration": int(
                 nred * scal * sdl if ar_bytes is None else ar_bytes),
@@ -638,7 +661,7 @@ def build_sharded_poisson_solver(n: int, dim: int, nparts: int | None = None,
                                  kernels: str = "xla-roll",
                                  recovery=None, trace: int = 0,
                                  progress: int = 0, precond=None,
-                                 health=None, ckpt=None):
+                                 health=None, ckpt=None, algorithm=None):
     """Assemble a sharded Poisson problem and its solver in one call
     (the gen-direct CLI path under ``--nparts``/``--multihost``).
 
@@ -672,7 +695,8 @@ def build_sharded_poisson_solver(n: int, dim: int, nparts: int | None = None,
                                 replace_restart=replace_restart,
                                 recovery=recovery, trace=trace,
                                 progress=progress, precond=precond,
-                                health=health, ckpt=ckpt)
+                                health=health, ckpt=ckpt,
+                                algorithm=algorithm)
     if kernels == "pallas-roll":
         solver.use_pallas_roll(n, dim)
     return solver

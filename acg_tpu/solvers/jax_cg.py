@@ -1091,7 +1091,7 @@ class JaxCGSolver:
                  vector_dtype=None, replace_every: int = 0,
                  replace_restart: bool = True, recovery=None,
                  host_matrix=None, trace: int = 0, progress: int = 0,
-                 precond=None, health=None, ckpt=None):
+                 precond=None, health=None, ckpt=None, algorithm=None):
         """``recovery`` (a :class:`acg_tpu.solvers.resilience.
         RecoveryPolicy`) arms breakdown detection in the compiled loop
         plus the host-side restart policy; ``host_matrix`` (scipy CSR)
@@ -1138,6 +1138,17 @@ class JaxCGSolver:
         self.vector_dtype = vector_dtype
         self.pipelined = pipelined
         self.precise_dots = precise_dots
+        # recurrence selection (acg_tpu.recurrence): classic/pipelined
+        # resolve onto the existing hand-built programs (byte-identical
+        # dispatch -- the builder emission is pinned equal in
+        # tests/test_hlo_structure.py); sstep:S / pipelined:L dispatch
+        # the communication-avoiding builder programs
+        from acg_tpu.recurrence import parse_algorithm
+        self.algo = parse_algorithm(algorithm)
+        if self.algo is not None and not self.algo.communication_avoiding:
+            self.pipelined = pipelined = (self.algo.kind == "pipelined")
+            self.algo = None
+        self._lam = None  # cached (lmin, lmax) spectral estimate
         if kernels == "auto":
             # the Pallas kernels win on TPU hardware (BASELINE.md); off
             # TPU they would run interpreted (slow), the measured win
@@ -1284,8 +1295,69 @@ class JaxCGSolver:
                     "two streamed kernels and exposes no loop carry; "
                     "checkpointing needs kernels='xla'/'pallas'")
         self.ckpt = ckpt
+        if self.algo is not None:
+            # the communication-avoiding recurrences run unpreconditioned
+            # over f32/f64 vectors and compose with telemetry, faults,
+            # recovery and (sstep) the health audit; everything they do
+            # NOT reach refuses here rather than silently dropping (the
+            # could-never-fire discipline)
+            ca = str(self.algo)
+            if pipelined:
+                raise ValueError(
+                    f"--algorithm {ca} selects its own recurrence; it "
+                    f"does not compose with the pipelined flag (use "
+                    f"--algorithm pipelined for Ghysels-Vanroose)")
+            if self.replace_every:
+                raise ValueError(
+                    f"{ca} does not compose with replace_every (the "
+                    f"replacement segments restructure the recurrence)")
+            if self.precise_dots:
+                raise ValueError(
+                    f"{ca} accumulates its fused Gram/window reductions "
+                    f"in the scalar dtype; precise_dots composes with "
+                    f"the classic/pipelined programs")
+            if self.precond_spec is not None:
+                raise ValueError(
+                    f"{ca} runs unpreconditioned: the s-step basis and "
+                    f"the p(l) auxiliary basis have no M^-1 hook yet "
+                    f"(use --algorithm classic|pipelined with --precond)")
+            if isinstance(kernels, str) and kernels.startswith("fused"):
+                raise ValueError(
+                    f"{ca} needs kernels='xla'/'pallas' (the fused "
+                    f"two-phase iteration folds the classic recurrence)")
+            vdt = (jnp.dtype(vector_dtype) if vector_dtype is not None
+                   else jnp.dtype(matrix_dtype(A)))
+            if vdt == jnp.bfloat16:
+                raise ValueError(
+                    f"{ca} amplifies storage rounding through its basis "
+                    f"products; bf16 vectors need the classic/pipelined "
+                    f"tiers (replace_every is the bf16 contract)")
+            if ckpt is not None:
+                raise ValueError(
+                    f"{ca} does not expose its window/basis carry to "
+                    f"the checkpoint chunk driver yet; --ckpt/--resume "
+                    f"need --algorithm classic|pipelined")
+            if self.health_spec is not None:
+                if self.algo.kind == "pl":
+                    raise ValueError(
+                        f"{ca} has no in-loop audit hook (the basis "
+                        f"recovery already detects its own breakdown); "
+                        f"--audit-every needs classic/pipelined/sstep")
+                if self.health_spec.abft:
+                    raise ValueError(
+                        f"{ca} has no checksum hook for its basis "
+                        f"products; --abft needs classic/pipelined")
         self.kernels = kernels
         self.recovery = recovery
+        if (self.algo is not None and self.algo.kind == "pl"
+                and recovery is None):
+            # restarted p(l)-CG: the square-root breakdown of the deep
+            # pipeline is an EXPECTED algorithmic event; arm the
+            # standard restart ladder with the algorithm's own budget
+            # (recurrence.pl_restart_policy) so a breakdown restarts
+            # from the current iterate instead of raising
+            from acg_tpu.recurrence import pl_restart_policy
+            self.recovery = pl_restart_policy()
         self.host_matrix = host_matrix
         self.trace = int(trace)
         self.progress = int(progress)
@@ -1341,6 +1413,22 @@ class JaxCGSolver:
                                     A_program=self._A_program)
         return self._mstate
 
+    def _ensure_lam(self):
+        """Cached (lmin, lmax) spectral interval for the
+        communication-avoiding recurrences (Chebyshev s-step basis,
+        p(l) shifts): one power iteration through THIS tier's own SpMV
+        selection at first dispatch; (0, 0) when the armed recurrence
+        never reads it (monomial basis)."""
+        if self._lam is None:
+            from acg_tpu.recurrence import estimate_lam
+            if self.algo is not None and self.algo.needs_lam:
+                self._lam = estimate_lam(
+                    self._A_program, self.A.nrows,
+                    acc_dtype(self._solve_dtype()), kernels=self.kernels)
+            else:
+                self._lam = (0.0, 0.0)
+        return self._lam
+
     def _select_program(self, b, x0, crit: StoppingCriteria,
                         detect: bool = False, fault=None):
         """``(program, args, kwargs, traced)``: this configuration's
@@ -1354,6 +1442,46 @@ class JaxCGSolver:
         # 1e-9 rtol is not pre-rounded to 8 mantissa bits
         sdt = acc_dtype(b.dtype)
         telem = self.trace or self.progress
+        if self.algo is not None:
+            # communication-avoiding recurrences (acg_tpu.recurrence):
+            # the builder programs composed with this tier's SpMV
+            # selection.  ``lam`` rides between the tolerances and
+            # maxits so the recovery ladder's generic restart arg
+            # surgery (args[5:-1]) carries it through restarts
+            from acg_tpu import recurrence as rec
+            if crit.needs_diff:
+                raise ValueError(
+                    f"{self.algo} supports residual criteria only (the "
+                    f"coefficient-space/pipelined updates carry no "
+                    f"||dx|| scalar)")
+            lam = self._ensure_lam()
+            if self.algo.kind == "sstep":
+                program = rec._cg_sstep_program
+                args = (self._A_program, b, x0,
+                        jnp.asarray(crit.residual_atol, sdt),
+                        jnp.asarray(crit.residual_rtol, sdt),
+                        (jnp.asarray(lam[0], sdt),
+                         jnp.asarray(lam[1], sdt)),
+                        jnp.int32(crit.maxits))
+                kwargs = dict(s=self.algo.param, basis=self.algo.basis,
+                              unbounded=crit.unbounded,
+                              kernels=self.kernels, fault=fault,
+                              trace=self.trace, progress=self.progress)
+                if self.health_spec is not None:
+                    kwargs["health"] = self.health_spec
+            else:
+                program = rec._cg_pl_program
+                args = (self._A_program, b, x0,
+                        jnp.asarray(crit.residual_atol, sdt),
+                        jnp.asarray(crit.residual_rtol, sdt),
+                        (jnp.asarray(lam[0], sdt),
+                         jnp.asarray(lam[1], sdt)),
+                        jnp.int32(crit.maxits))
+                kwargs = dict(l=self.algo.param,
+                              unbounded=crit.unbounded,
+                              kernels=self.kernels, fault=fault,
+                              trace=self.trace, progress=self.progress)
+            return program, args, kwargs, bool(self.trace)
         if self.replace_every:
             if crit.needs_diff:
                 raise ValueError("replace_every supports residual "
@@ -1507,6 +1635,27 @@ class JaxCGSolver:
                 "precond fault injection needs an armed preconditioner "
                 "(--precond jacobi|bjacobi|cheby:K); this solve runs "
                 "unpreconditioned CG")
+        if (self.algo is not None and fault is not None
+                and self.algo.kind == "sstep"
+                and fault.site in ("spmv", "sdc", "halo")
+                and fault.iteration % self.algo.param != 0):
+            # the s-step basis products carry the BLOCK-START iteration
+            # index: a vector fault armed mid-block could never fire
+            raise AcgError(
+                ErrorCode.INVALID_VALUE,
+                f"sstep:{self.algo.param} applies SpMV/halo faults at "
+                f"block boundaries; arm an iteration that is a "
+                f"multiple of {self.algo.param} (got "
+                f"{fault.iteration})")
+        if (self.algo is not None and fault is not None
+                and self.algo.kind == "pl" and fault.site == "dot"):
+            # p(l) has no scalar dot in its loop (the window reduction
+            # is a fused matvec): the armed injector could never fire
+            raise AcgError(
+                ErrorCode.INVALID_VALUE,
+                "dot fault injection has no site in the p(l) "
+                "recurrence (its reductions are fused window matvecs); "
+                "use spmv:, or the classic/pipelined/sstep programs")
         if fault is not None and fault.part > 0:
             # _fault_nparts distinguishes the true single-device solver
             # from multi-part subclasses that reuse this solve (the
@@ -1599,7 +1748,7 @@ class JaxCGSolver:
                 return None
             return telemetry.ConvergenceTrace.from_ring(
                 np.asarray(tbuf), int(res.niterations),
-                solver="cg-pipelined" if self.pipelined else "cg")
+                solver=self._solver_name())
 
         # warmup solves outside the timed region (the reference warms up
         # each op class before timing, cgcuda.c:612-710).  device_sync,
@@ -1688,8 +1837,34 @@ class JaxCGSolver:
                                       "from the initial guess")
                         x_next = x0_dev
                     if fault is not None and "fault" in kwargs:
-                        fault = fault.shift(k_done)
-                        kwargs["fault"] = fault
+                        if (self.algo is not None
+                                and self.algo.kind == "sstep"
+                                and fault.device_site
+                                and fault.iteration <= k_done):
+                            # the poisoned basis block froze BEFORE
+                            # executing its steps, so niterations never
+                            # passes the fault's index: the fault FIRED
+                            # -- vanish it (the chunk drivers'
+                            # vanish-not-rebase rationale) instead of
+                            # rebasing it into the restart's first block
+                            fault = None
+                            kwargs["fault"] = None
+                        elif (self.algo is not None
+                              and self.algo.kind == "pl"):
+                            # p(l) faults key on the AUXILIARY-basis
+                            # counter j, which runs l ahead of the
+                            # trajectory count (j = adv + l exactly at
+                            # a breakdown, since advances only freeze
+                            # on exit conditions): shift in the
+                            # z-counter frame so a fired fault vanishes
+                            # (shift -> None) instead of re-triggering
+                            # the same breakdown across every restart
+                            fault = fault.shift(
+                                k_done + self.algo.param + 1)
+                            kwargs["fault"] = fault
+                        else:
+                            fault = fault.shift(k_done)
+                            kwargs["fault"] = fault
                     if self.precond_spec is not None:
                         # preserve finite preconditioner state across
                         # the restart, rebuild it when poisoned
@@ -1754,8 +1929,7 @@ class JaxCGSolver:
         # rides through the same hook)
         from acg_tpu import metrics
         metrics.record_solve(t_solve, niter, st.converged,
-                             solver="cg-pipelined" if self.pipelined
-                             else "cg")
+                             solver=self._solver_name())
         metrics.observe_solver_comm(self, niter)
         self._account_ops(st, niter, dtype)
         if host_result:
@@ -1774,6 +1948,14 @@ class JaxCGSolver:
             raise NotConvergedError(
                 f"{niter} iterations, residual {st.rnrm2:.3e}")
         return x
+
+    def _solver_name(self) -> str:
+        """Telemetry/metrics label: the recurrence decides (the CA
+        names deliberately avoid the 'pipelined' substring -- see
+        recurrence.RecurrenceSpec.solver_name)."""
+        if self.algo is not None:
+            return self.algo.solver_name("cg")
+        return "cg-pipelined" if self.pipelined else "cg"
 
     def _account_ops(self, st, niter: int, dtype) -> None:
         """Analytic flop/byte census of ``niter`` iterations on this
@@ -1813,6 +1995,27 @@ class JaxCGSolver:
             st.ops["gemv"].add(niter + 1, 0.0,
                                (mat_bytes + 4 * n * dbl) * (niter + 1))
             st.ops["axpy"].add(niter, 0.0, 6 * n * dbl * niter)
+        elif self.algo is not None:
+            # communication-avoiding recurrences: s-step runs (2s-1)/s
+            # SpMV-equivalents per iteration (the matrix-powers basis)
+            # plus the Gram matmul and three map-back GEMVs per block;
+            # p(l) runs 1 SpMV + the fused (2l+2)-window matvec per
+            # iteration plus the v-recovery combination.  Billed as the
+            # dominant op classes; flops fold the basis overhead
+            from acg_tpu.recurrence import reduction_schedule
+            sched = reduction_schedule(self.algo, False)
+            spmv_eq = sched["spmv_per_iteration"]
+            st.nflops += self._spmv_flops * (spmv_eq - 1.0) * niter
+            st.ops["gemv"].add(int(niter * spmv_eq) + 1, 0.0,
+                               int((mat_bytes + 2 * n * dbl)
+                                   * (niter * spmv_eq + 1)))
+            wred = sched["allreduce_scalars"]
+            ndot = int(niter * sched["allreduce_per_iteration"])
+            st.ops["dot"].add(max(ndot, 1), 0.0,
+                              int(2 * n * dbl * wred ** 0.5
+                                  * max(ndot, 1)))
+            st.ops["nrm2"].add(niter + 1, 0.0, n * dbl * (niter + 1))
+            st.ops["axpy"].add(3 * niter, 0.0, 3 * n * dbl * 3 * niter)
         else:
             # per-iteration op census matching the eager host solver's
             # (host_cg.solve): the convergence test's (r, r) is the nrm2

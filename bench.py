@@ -985,6 +985,109 @@ def run_batched_mode(args) -> int:
     return _finish(args, rows, 0)
 
 
+def run_algorithms_mode(args) -> int:
+    """``bench.py --algorithms``: the communication-avoiding recurrence
+    sweep (ISSUE 12 acceptance) -- s/iteration and the static comm
+    ledger for classic, GV-pipelined, sstep:{2,4,8} and p(l):{2,3} over
+    ONE Poisson matrix on the 8-part mesh (the virtual CPU mesh
+    off-TPU, the sweep_np provisioning), fixed-iteration protocol so
+    every row does comparable numerical work.  One JSON row per
+    algorithm; the ledger columns show the reduction-count drop
+    (classic 2 allreduce/iter -> sstep 1 per S iterations, p(l) 1
+    fused) that is the whole point of the tier."""
+    import numpy as np
+
+    from acg_tpu._platform import provision_host_mesh
+
+    jax = provision_host_mesh(8)
+    if len(jax.devices()) < 8:
+        env = dict(os.environ)
+        env["JAX_PLATFORMS"] = "cpu"
+        env.setdefault("XLA_FLAGS",
+                       "--xla_force_host_platform_device_count=8")
+        import subprocess
+        return subprocess.run(
+            [sys.executable, os.path.abspath(__file__), "--algorithms",
+             "--algorithms-side", str(args.algorithms_side),
+             "--algorithms-its", str(args.algorithms_its)]
+            + (["--stats-json", args.stats_json] if args.stats_json
+               else [])
+            + (["--baseline", args.baseline] if args.baseline else []),
+            env=env).returncode
+
+    import jax.numpy as jnp
+
+    from acg_tpu._platform import device_sync
+    from acg_tpu.parallel.dist import DistCGSolver, DistributedProblem
+    from acg_tpu.partition import partition_rows
+    from acg_tpu.solvers.stats import StoppingCriteria
+
+    side, its = args.algorithms_side, args.algorithms_its
+    csr = _build(side, 2)
+    n = csr.shape[0]
+    nparts = 8
+    part = partition_rows(csr, nparts, seed=0, method="band")
+    prob = DistributedProblem.build(csr, part, nparts,
+                                    dtype=jnp.float32)
+    rng = np.random.default_rng(0)
+    b = rng.standard_normal(n).astype(np.float32)
+    crit = StoppingCriteria(maxits=its)   # fixed-work protocol
+    rows = []
+
+    def best_of(fn, reps=3):
+        ts = []
+        for _ in range(reps):
+            t0 = time.perf_counter()
+            fn()
+            ts.append(time.perf_counter() - t0)
+        return min(ts)
+
+    algs = [("classic", dict()),
+            ("pipelined", dict(pipelined=True)),
+            ("sstep:2", dict(algorithm="sstep:2")),
+            ("sstep:4", dict(algorithm="sstep:4")),
+            ("sstep:8", dict(algorithm="sstep:8")),
+            ("pipelined:2", dict(algorithm="pipelined:2")),
+            ("pipelined:3", dict(algorithm="pipelined:3"))]
+    for name, kw in algs:
+        s = DistCGSolver(prob, **kw)
+        device_sync(s.solve(b, criteria=crit, host_result=False,
+                            raise_on_divergence=False))  # compile
+
+        def once():
+            device_sync(s.solve(b, criteria=crit, host_result=False,
+                                raise_on_divergence=False))
+
+        t = best_of(once)
+        led = s.comm_profile()
+        tag = name.replace(":", "")
+        row = {
+            "metric": f"ca_cg_iters_per_sec_poisson2d_n{side}"
+                      f"_np{nparts}_f32_its{its}_{tag}",
+            "algorithm": name,
+            "value": round(its / t, 2),
+            "unit": "iters/s",
+            "s_per_iter": round(t / its, 6),
+            "dtype": "f32",
+            "nparts": nparts,
+            "iterations": int(s.stats.niterations),
+            "allreduce_per_iteration":
+                led["allreduce_per_iteration"],
+            "allreduce_scalars": led["allreduce_scalars"],
+            "halo_exchanges_per_iteration":
+                led["halo_exchanges_per_iteration"],
+        }
+        print(f"# {name}: {t:.3f}s for {its} its "
+              f"({its / t:.1f} iters/s, "
+              f"{led['allreduce_per_iteration']:g} allreduce/iter)",
+              file=sys.stderr)
+        print(json.dumps(row))
+        rows.append(row)
+        _sink_stats(row, s)
+        sys.stdout.flush()
+    return _finish(args, rows, 0)
+
+
 def _finish(args, rows, rc: int) -> int:
     """Apply the --baseline regression gate to this run's emitted rows
     (the perfmodel tier's case-by-case diff -- same engine as
@@ -1026,6 +1129,20 @@ def main(argv=None) -> int:
                          "out subsequent rows; round-3 verdict item 8)")
     ap.add_argument("--sweep-np", action="store_true",
                     help="multi-chip CPU-mesh correctness sweep")
+    ap.add_argument("--algorithms", action="store_true",
+                    help="run the communication-avoiding recurrence "
+                         "sweep (classic/pipelined/sstep:S/p(l)) on "
+                         "the 8-part CPU mesh: s/iter + comm ledger "
+                         "per algorithm")
+    ap.add_argument("--algorithms-side", type=int, default=128,
+                    metavar="N",
+                    help="with --algorithms: Poisson grid side "
+                         "(default 128 -> n=16384: small n/P, the "
+                         "latency-dominated regime)")
+    ap.add_argument("--algorithms-its", type=int, default=200,
+                    metavar="K",
+                    help="with --algorithms: fixed iterations per "
+                         "solve (default 200)")
     ap.add_argument("--batched", action="store_true",
                     help="batched multi-RHS throughput case: solves/s "
                          "at B in {1,4,8}, one batched solve vs a "
@@ -1101,6 +1218,12 @@ def main(argv=None) -> int:
 
     if args.sweep_np:
         return sweep_np()
+
+    if args.algorithms:
+        # like --sweep-np/--batched, provisions its own 8-part virtual
+        # CPU mesh (re-executing itself when the flags must be set
+        # before jax init), so it runs BEFORE the backend probe
+        return run_algorithms_mode(args)
 
     if args.batched:
         # like --sweep-np, provisions its own 8-part virtual CPU mesh

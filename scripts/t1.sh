@@ -343,4 +343,43 @@ print(f"T1_BATCH: OK (per-RHS iterations {batch['iterations']}, "
       f"slowest rhs {sb['slowest_rhs']})")
 PY
 fi
+if [ "${T1_SSTEP:-0}" = "1" ]; then
+    # communication-avoiding recurrence smoke (the ISSUE-12 acceptance
+    # in miniature): s-step and p(l) solves on the aniso generator over
+    # the 8-part CPU mesh -- both must converge to rtol, and the comm
+    # ledger in the stats twin must show the reduction-count drop
+    # (sstep 1 allreduce per S iterations, p(l) 1 fused per iteration)
+    echo "T1_SSTEP: 8-part s-step + p(l) smoke"
+    rm -f /tmp/_t1_sstep.json /tmp/_t1_pl.json
+    timeout -k 10 300 env JAX_PLATFORMS=cpu \
+        XLA_FLAGS="--xla_force_host_platform_device_count=8" \
+        python -m acg_tpu.cli gen:poisson2d:24 --nparts 8 \
+        --algorithm sstep:4 \
+        --max-iterations 2000 --residual-rtol 1e-6 --warmup 0 --quiet \
+        --stats-json /tmp/_t1_sstep.json || rc=$((rc ? rc : 1))
+    timeout -k 10 300 env JAX_PLATFORMS=cpu \
+        XLA_FLAGS="--xla_force_host_platform_device_count=8" \
+        python -m acg_tpu.cli gen:poisson2d:24 --nparts 8 \
+        --algorithm pipelined:2 \
+        --max-iterations 2000 --residual-rtol 1e-6 --warmup 0 --quiet \
+        --stats-json /tmp/_t1_pl.json || rc=$((rc ? rc : 1))
+    python - <<'PY' || rc=$((rc ? rc : 1))
+import json
+ss = json.load(open("/tmp/_t1_sstep.json"))
+pl = json.load(open("/tmp/_t1_pl.json"))
+assert ss["stats"]["converged"] is True, ss["stats"]
+assert pl["stats"]["converged"] is True, pl["stats"]
+# the comm-ledger reduction-count invariant, through the library's
+# own ledger (recurrence.reduction_schedule feeds comm_profile)
+from acg_tpu.recurrence import parse_algorithm, reduction_schedule
+s4 = reduction_schedule(parse_algorithm("sstep:4"), False)
+p2 = reduction_schedule(parse_algorithm("pipelined:2"), False)
+assert s4["allreduce_per_iteration"] == 0.25, s4
+assert p2["allreduce_per_iteration"] == 1.0, p2
+assert p2["reduction_latency_hidden"] == 2, p2
+print(f"T1_SSTEP: OK (sstep {ss['stats']['niterations']} its, "
+      f"p(2) {pl['stats']['niterations']} its, both converged; "
+      f"sstep:4 {s4['allreduce_per_iteration']} allreduce/iter)")
+PY
+fi
 exit $rc
