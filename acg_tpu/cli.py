@@ -149,11 +149,14 @@ def make_parser() -> argparse.ArgumentParser:
                         "pallas = hand-written single-x-pass DIA SpMV "
                         "(the reference's cg-kernels-cuda.cu tier; vector "
                         "updates stay in XLA -- see BASELINE.md); fused = "
-                        "the two-phase whole-iteration kernel pair (the "
-                        "monolithic device-kernel analog; classic CG on "
-                        "single-window DIA shapes only); auto picks "
-                        "pallas on TPU hardware for DIA matrices and DIA "
-                        "local blocks of the multi-part path")
+                        "single-device: the two-phase whole-iteration "
+                        "kernel pair (classic CG on single-window DIA "
+                        "shapes); with --nparts: the interior/border "
+                        "OVERLAPPED iteration (halo exchange in flight "
+                        "behind the interior SpMV; classic + pipelined); "
+                        "auto picks pallas on TPU hardware for DIA "
+                        "matrices and DIA local blocks of the multi-part "
+                        "path")
     p.add_argument("--spmv-format", default="auto",
                    choices=["auto", "dia", "ell", "coo"],
                    help="force the device sparse format for the "
@@ -695,6 +698,14 @@ def _buildinfo(out) -> int:
          "later; restarted on sqrt breakdown); single-device, sharded "
          "gen-direct and dist tiers; builder classic/pipelined "
          "emission pinned byte-identical (acg_tpu.recurrence)"),
+        ("persistent fused iteration", "--kernels fused on the mesh "
+         "(--nparts): interior/border OVERLAPPED SpMV -- one-sided "
+         "halo DMA (--comm dma) or all_to_all issued first, interior "
+         "rows computed in flight, border rows finished after the "
+         "recv wait; builder-emitted classic + pipelined, bitwise "
+         "equal to the unsplit tier; comm ledger declares the overlap "
+         "model the --explain verdict prices (exposed halo = max(0, "
+         "halo - interior SpMV)); bench.py --overlap measures it"),
         ("perf observability", f"--explain (compiled cost_analysis/"
          f"memory_analysis introspection, comm ledger, roofline "
          f"verdict); 'costmodel'/'memory' keys in the {STATS_SCHEMA} "
@@ -1395,7 +1406,9 @@ def _solve_distributed_read(args, jax, jnp, dtype, vec_dtype) -> int:
         ("b/x0 files with --manufactured-solution",
          args.manufactured_solution and bool(args.b or args.x0)),
         ("--profile-ops", args.profile_ops is not None),
-        ("--kernels fused (single-device only)", args.kernels == "fused"),
+        ("--kernels fused (needs the full-information build; the "
+         "local-read flow holds other controllers' coupled-row lists "
+         "as stubs)", args.kernels == "fused"),
         ("--diff-* criteria with --replace-every or --refine",
          (args.replace_every > 0 or args.refine)
          and (args.diff_atol > 0 or args.diff_rtol > 0)),
@@ -1961,8 +1974,8 @@ def _solve_generated_sharded(args, dim, n, N, jax, jnp, dtype,
         raise SystemExit(
             "acg-tpu: the sharded direct-assembly path supports "
             "--kernels auto/xla (roll formulation) or pallas (per-shard "
-            "clustered kernel + ppermute halo); 'fused' is single-device "
-            "only")
+            "clustered kernel + ppermute halo); 'fused' rides the "
+            "single-device and explicit-mesh (--nparts) tiers")
     sharded_kernels = ("pallas-roll" if args.kernels == "pallas"
                        else "xla-roll")
     if args.replace_every and (args.diff_atol > 0 or args.diff_rtol > 0):

@@ -22,6 +22,15 @@ Both run in interpret mode on CPU (tests) and compiled on TPU.  Whether
 they actually beat XLA fusion is *measured* (``scripts/bench_pallas.py``,
 BASELINE.md) -- the solvers select per measurement via
 ``kernels="pallas"``.
+
+The DISTRIBUTED fused tier (``kernels='fused'`` with ``--nparts``) does
+not use these single-device kernels: it is the recurrence builder's
+emission over the interior|border OVERLAPPED SpMV
+(``parallel.dist.make_dist_spmv_overlapped`` -- one-sided halo DMA in
+flight behind the interior rows' work).  Folding that tier's axpy/dot
+updates into true per-iteration Pallas mega-kernels on the split row
+sets is the remaining rung of ROADMAP item 4; the ``_window_copies``
+machinery here is the intended substrate.
 """
 
 from __future__ import annotations

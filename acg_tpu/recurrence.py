@@ -919,12 +919,22 @@ def build_dist_program(solver):
     (base configuration), composed with the solver's OWN machinery
     (halo'd SpMV, psum, fused reductions, mesh specs): byte-identity
     with DistCGSolver._compile()'s hand-built program is pinned in
-    tests/test_hlo_structure.py."""
+    tests/test_hlo_structure.py.
+
+    ``kernels='fused'`` swaps in the interior|border OVERLAPPED SpMV
+    (``make_dist_spmv_overlapped``: halo exchange issued first,
+    interior rows computed while it is in flight, border rows finished
+    after) -- this IS the dispatched program of the distributed fused
+    tier, so the overlapped SpMV lands once here and every recurrence
+    the builder emits inherits it.  Everything else (carry layout,
+    reduction ladder, shard specs) is identical, keeping the non-fused
+    emission byte-stable."""
     import jax.numpy as _jnp
     from jax import lax
     from jax.sharding import PartitionSpec as P
     from acg_tpu._platform import shard_map as _shard_map
-    from acg_tpu.parallel.dist import make_dist_spmv
+    from acg_tpu.parallel.dist import (make_dist_spmv,
+                                       make_dist_spmv_overlapped)
     from acg_tpu.parallel.mesh import PARTS_AXIS
     from acg_tpu.parallel.reductions import make_pdot, make_pdotk
     from acg_tpu.solvers.jax_cg import _iterate
@@ -932,8 +942,13 @@ def build_dist_program(solver):
     prob = solver.problem
     pipelined = solver.pipelined
     axis = PARTS_AXIS
-    dist_spmv = make_dist_spmv(prob, solver.comm, solver._interpret,
-                               kernels=solver.kernels, fault=None)
+    if isinstance(solver.kernels, str) and \
+            solver.kernels.startswith("fused"):
+        dist_spmv = make_dist_spmv_overlapped(prob, solver.comm,
+                                              solver._interpret)
+    else:
+        dist_spmv = make_dist_spmv(prob, solver.comm, solver._interpret,
+                                   kernels=solver.kernels, fault=None)
     single_shard = solver.mesh.devices.size == 1
 
     def psum(v):

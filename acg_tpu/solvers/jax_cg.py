@@ -1173,8 +1173,10 @@ class JaxCGSolver:
 
             if pipelined:
                 raise ValueError("kernels='fused' implements classic CG "
-                                 "(use the pipelined variant with "
-                                 "kernels='pallas'/'xla')")
+                                 "on the single-device tier (use the "
+                                 "pipelined variant with kernels="
+                                 "'pallas'/'xla'; the DIST mesh fused "
+                                 "tier supports pipelined)")
             if precise_dots:
                 raise ValueError("kernels='fused' accumulates its dots "
                                  "in plain f32 SMEM; compensated dots "

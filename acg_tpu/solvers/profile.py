@@ -236,8 +236,15 @@ def _profile_dist(solver, b, reps: int) -> dict[str, float]:
     axis = PARTS_AXIS
     pspec = P(PARTS_AXIS)
     bd, x0, la, ga, sidx, gsrc, gval, scnt, rcnt = solver.device_args(b)
-    spmv_shard = make_dist_spmv(prob, solver.comm, solver._interpret,
-                                kernels=solver.kernels)
+    if str(solver.kernels).startswith("fused"):
+        # the fused tier's device_args extends ga with the interior
+        # row lists; replay the SAME overlapped SpMV the solve runs
+        from acg_tpu.parallel.dist import make_dist_spmv_overlapped
+        spmv_shard = make_dist_spmv_overlapped(prob, solver.comm,
+                                               solver._interpret)
+    else:
+        spmv_shard = make_dist_spmv(prob, solver.comm, solver._interpret,
+                                    kernels=solver.kernels)
 
     tiny = jnp.asarray(1e-30, prob.vdtype)
 

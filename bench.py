@@ -1009,7 +1009,8 @@ def run_algorithms_mode(args) -> int:
         return subprocess.run(
             [sys.executable, os.path.abspath(__file__), "--algorithms",
              "--algorithms-side", str(args.algorithms_side),
-             "--algorithms-its", str(args.algorithms_its)]
+             "--algorithms-its", str(args.algorithms_its),
+             "--fail-on-regress", str(args.fail_on_regress)]
             + (["--stats-json", args.stats_json] if args.stats_json
                else [])
             + (["--baseline", args.baseline] if args.baseline else []),
@@ -1088,6 +1089,121 @@ def run_algorithms_mode(args) -> int:
     return _finish(args, rows, 0)
 
 
+def run_overlap_mode(args) -> int:
+    """``bench.py --overlap``: the fused-iteration overlap sweep (ISSUE
+    13 acceptance) -- comm={xla,dma} x kernels={auto,fused} at small
+    n/P on the 8-part mesh (the regime where BENCH_r03/r04 showed
+    collective latency dominating), fixed-iteration protocol.  Each
+    case is timed AND captured under the jax profiler so the row
+    carries the measured solve-windowed overlap-efficiency score
+    (acg_tpu.tracing -- the PR 8 protocol the ISSUE 13 acceptance
+    gates on) next to s/iter and the ledger's interior/border split."""
+    import shutil
+    import tempfile
+
+    import numpy as np
+
+    from acg_tpu._platform import provision_host_mesh
+
+    jax = provision_host_mesh(8)
+    if len(jax.devices()) < 8:
+        env = dict(os.environ)
+        env["JAX_PLATFORMS"] = "cpu"
+        env.setdefault("XLA_FLAGS",
+                       "--xla_force_host_platform_device_count=8")
+        import subprocess
+        return subprocess.run(
+            [sys.executable, os.path.abspath(__file__), "--overlap",
+             "--overlap-side", str(args.overlap_side),
+             "--overlap-its", str(args.overlap_its),
+             "--fail-on-regress", str(args.fail_on_regress)]
+            + (["--stats-json", args.stats_json] if args.stats_json
+               else [])
+            + (["--baseline", args.baseline] if args.baseline else []),
+            env=env).returncode
+
+    import jax.numpy as jnp
+
+    from acg_tpu import tracing
+    from acg_tpu._platform import device_sync
+    from acg_tpu.parallel.dist import DistCGSolver, DistributedProblem
+    from acg_tpu.partition import partition_rows
+    from acg_tpu.solvers.stats import StoppingCriteria
+
+    side, its = args.overlap_side, args.overlap_its
+    csr = _build(side, 2)
+    n = csr.shape[0]
+    nparts = 8
+    part = partition_rows(csr, nparts, seed=0, method="band")
+    prob = DistributedProblem.build(csr, part, nparts,
+                                    dtype=jnp.float32)
+    rng = np.random.default_rng(0)
+    b = rng.standard_normal(n).astype(np.float32)
+    crit = StoppingCriteria(maxits=its)   # fixed-work protocol
+    rows = []
+
+    def best_of(fn, reps=3):
+        ts = []
+        for _ in range(reps):
+            t0 = time.perf_counter()
+            fn()
+            ts.append(time.perf_counter() - t0)
+        return min(ts)
+
+    for comm in ("xla", "dma"):
+        for kern in ("auto", "fused"):
+            s = DistCGSolver(prob, comm=comm, kernels=kern)
+            device_sync(s.solve(b, criteria=crit, host_result=False,
+                                raise_on_divergence=False))  # compile
+
+            def once():
+                device_sync(s.solve(b, criteria=crit,
+                                    host_result=False,
+                                    raise_on_divergence=False))
+
+            t = best_of(once)
+            # per-case profiler capture -> measured solve-windowed
+            # overlap-efficiency (degrades to null where the capture
+            # is unusable; the timing row stands either way)
+            cap = tempfile.mkdtemp(prefix="acg_overlap_")
+            try:
+                with tracing.profiler_trace(cap):
+                    once()
+                analysis = tracing.analyze_trace(cap)
+            finally:
+                shutil.rmtree(cap, ignore_errors=True)
+            eff = (analysis.get("overlap_efficiency")
+                   if analysis.get("available") else None)
+            led = s.comm_profile()
+            row = {
+                "metric": f"overlap_cg_iters_per_sec_poisson2d_n{side}"
+                          f"_np{nparts}_f32_its{its}_{comm}_{kern}",
+                "comm": comm,
+                "kernels": s.kernels,
+                "value": round(its / t, 2),
+                "unit": "iters/s",
+                "s_per_iter": round(t / its, 6),
+                "dtype": "f32",
+                "nparts": nparts,
+                "iterations": int(s.stats.niterations),
+                "overlap_efficiency": eff,
+                "halo_bytes_per_iteration":
+                    led["halo_bytes_per_iteration"],
+            }
+            if led.get("overlap"):
+                row["interior_rows"] = led["overlap"]["interior_rows"]
+                row["border_rows"] = led["overlap"]["border_rows"]
+            print(f"# {comm}/{kern}: {t:.3f}s for {its} its "
+                  f"({its / t:.1f} iters/s, overlap-efficiency "
+                  f"{eff if eff is not None else 'n/a'})",
+                  file=sys.stderr)
+            print(json.dumps(row))
+            rows.append(row)
+            _sink_stats(row, s)
+            sys.stdout.flush()
+    return _finish(args, rows, 0)
+
+
 def _finish(args, rows, rc: int) -> int:
     """Apply the --baseline regression gate to this run's emitted rows
     (the perfmodel tier's case-by-case diff -- same engine as
@@ -1143,6 +1259,21 @@ def main(argv=None) -> int:
                     metavar="K",
                     help="with --algorithms: fixed iterations per "
                          "solve (default 200)")
+    ap.add_argument("--overlap", action="store_true",
+                    help="run the fused-iteration overlap sweep "
+                         "(comm={xla,dma} x kernels={auto,fused} on "
+                         "the 8-part mesh at small n/P, each case "
+                         "profiler-captured for its measured "
+                         "overlap-efficiency; one JSON line per case)")
+    ap.add_argument("--overlap-side", type=int, default=64,
+                    metavar="N",
+                    help="with --overlap: Poisson grid side (default "
+                         "64 -- small n/P, the collective-latency-"
+                         "dominated regime)")
+    ap.add_argument("--overlap-its", type=int, default=200,
+                    metavar="K",
+                    help="with --overlap: fixed iterations per case "
+                         "(default 200)")
     ap.add_argument("--batched", action="store_true",
                     help="batched multi-RHS throughput case: solves/s "
                          "at B in {1,4,8}, one batched solve vs a "
@@ -1224,6 +1355,11 @@ def main(argv=None) -> int:
         # CPU mesh (re-executing itself when the flags must be set
         # before jax init), so it runs BEFORE the backend probe
         return run_algorithms_mode(args)
+
+    if args.overlap:
+        # like --algorithms: provisions its own 8-part virtual CPU
+        # mesh, so it runs BEFORE the backend probe
+        return run_overlap_mode(args)
 
     if args.batched:
         # like --sweep-np, provisions its own 8-part virtual CPU mesh

@@ -382,4 +382,57 @@ print(f"T1_SSTEP: OK (sstep {ss['stats']['niterations']} its, "
       f"sstep:4 {s4['allreduce_per_iteration']} allreduce/iter)")
 PY
 fi
+if [ "${T1_FUSED:-0}" = "1" ]; then
+    # fused-overlap smoke (the ISSUE-13 acceptance in miniature): an
+    # 8-part interpret-mode fused solve (interior/border overlapped
+    # SpMV, --kernels fused) must converge; then the armed-pin +
+    # overlap-section asserts -- the fused program keeps the unsplit
+    # tier's collective inventory (5 all_reduces / 2 all_to_alls,
+    # comm=dma drops the all_to_alls), kernels=auto stays
+    # byte-identical to xla, and the comm ledger declares the
+    # interior|border overlap model
+    echo "T1_FUSED: 8-part fused overlap smoke"
+    rm -f /tmp/_t1_fused.json
+    timeout -k 10 300 env JAX_PLATFORMS=cpu \
+        XLA_FLAGS="--xla_force_host_platform_device_count=8" \
+        python -m acg_tpu.cli gen:poisson2d:24 --nparts 8 \
+        --kernels fused --max-iterations 400 --residual-rtol 1e-8 \
+        --warmup 0 --quiet --stats-json /tmp/_t1_fused.json \
+        || rc=$((rc ? rc : 1))
+    timeout -k 10 300 env JAX_PLATFORMS=cpu \
+        XLA_FLAGS="--xla_force_host_platform_device_count=8" \
+        python - <<'PY' || rc=$((rc ? rc : 1))
+import json, re
+import numpy as np
+import jax.numpy as jnp
+doc = json.load(open("/tmp/_t1_fused.json"))
+assert doc["stats"]["converged"] is True, doc["stats"]["rnrm2"]
+from acg_tpu.io.generators import poisson2d_coo
+from acg_tpu.matrix import SymCsrMatrix
+from acg_tpu.parallel.dist import DistCGSolver, DistributedProblem
+from acg_tpu.partition import partition_rows
+r, c, v, N = poisson2d_coo(16)
+csr = SymCsrMatrix.from_coo(N, r, c, v).to_csr()
+part = partition_rows(csr, 8, seed=0, method="band")
+prob = DistributedProblem.build(csr, part, 8, dtype=jnp.float32)
+b = np.ones(N)
+fused = DistCGSolver(prob, kernels="fused")
+txt = fused.lower_solve(b).as_text()
+ar = len(re.findall(r"all_reduce", txt))
+ata = len(re.findall(r"all_to_all", txt))
+assert (ar, ata) == (5, 2), (ar, ata)
+dtxt = DistCGSolver(prob, kernels="fused",
+                    comm="dma").lower_solve(b).as_text()
+assert len(re.findall(r"all_to_all", dtxt)) == 0
+auto = DistCGSolver(prob, kernels="auto").lower_solve(b).as_text()
+xla = DistCGSolver(prob, kernels="xla").lower_solve(b).as_text()
+assert auto == xla, "kernels=auto no longer byte-identical to xla"
+ov = fused.comm_profile()["overlap"]
+assert ov["split"] == "interior|border", ov
+assert ov["interior_rows"] > 0 and ov["border_rows"] > 0, ov
+print(f"T1_FUSED: OK (converged, pins (5,2)/dma-0-a2a hold, "
+      f"{ov['interior_rows']} interior / {ov['border_rows']} border "
+      f"rows)")
+PY
+fi
 exit $rc

@@ -187,6 +187,87 @@ def _topology_partition(csr, kind, nparts, side):
     raise ValueError(kind)
 
 
+def test_halo_exchange_dma_parity_8part(small_problem):
+    """Interpret-mode parity of the TRANSPORT itself (not a whole
+    solve): the ghost vector halo_exchange_dma delivers on the 8-part
+    CPU mesh equals the xla all_to_all transport's, slot for slot
+    (scripts/dma_probe.py promoted from a dated one-off note into CI).
+    The dma unpack masks padding ghost slots (ghost_valid); the xla
+    unpack reads zero-filled receive rows there, so both sides are
+    comparable everywhere."""
+    from acg_tpu.parallel.dist import DistCGSolver
+    from acg_tpu.parallel.halo import halo_exchange
+    from acg_tpu.parallel.halo_dma import halo_exchange_dma
+
+    csr, prob = small_problem
+    s = DistCGSolver(prob, comm="xla")
+    b, x0, la, ga, sidx, gsrc, gval, scnt, rcnt = s.device_args(
+        np.ones(prob.n))
+    rng = np.random.default_rng(11)
+    x = jax.device_put(
+        prob.scatter(rng.standard_normal(prob.n).astype(np.float32)),
+        jax.sharding.NamedSharding(s.mesh, P(PARTS_AXIS)))
+    pspec = P(PARTS_AXIS)
+
+    def body(sidx, gsrc, gval, scnt, rcnt, x):
+        sidx, gsrc, gval, scnt, rcnt, x = (
+            a[0] for a in (sidx, gsrc, gval, scnt, rcnt, x))
+        g_dma = halo_exchange_dma(x, sidx, gsrc, gval, scnt, rcnt,
+                                  PARTS_AXIS, interpret=True)
+        g_xla = halo_exchange(x, sidx, gsrc, PARTS_AXIS)
+        # mask the xla side like the dma unpack: padding slots beyond a
+        # part's real ghost count are never consumed by the SpMV
+        g_xla = jnp.where(gval, g_xla, 0)
+        return g_dma[None], g_xla[None]
+
+    f = jax.jit(_shard_map(body, mesh=s.mesh, in_specs=(pspec,) * 6,
+                           out_specs=(pspec, pspec)))
+    g_dma, g_xla = f(sidx, gsrc, gval, scnt, rcnt, x)
+    np.testing.assert_array_equal(np.asarray(g_dma), np.asarray(g_xla))
+
+
+def test_dma_to_xla_fallback_under_halo_fault(small_problem, monkeypatch):
+    """The recovery ladder's transport rung under ``halo:`` fault
+    injection: a breakdown that RECURS on the dma transport (a faulty
+    one-sided link keeps corrupting payloads, so the first restart does
+    not cure it) makes the driver retire dma for the xla collectives --
+    its own rung, not billed to the restart budget -- and the solve
+    converges there.  The injector's one-shot ``shift`` is patched to
+    keep the fault armed exactly while the solver is still on dma: the
+    persistent-transport-fault scenario the rung exists for."""
+    from acg_tpu import faults
+    from acg_tpu.parallel.dist import DistCGSolver
+    from acg_tpu.solvers.resilience import RecoveryPolicy
+
+    csr, prob = small_problem
+    N = csr.shape[0]
+    b = np.ones(N, np.float32)
+    pol = RecoveryPolicy(max_restarts=3, fallback_comm=True,
+                         fallback_host=False)
+    solver = DistCGSolver(prob, comm="dma", recovery=pol)
+    orig_shift = faults.FaultSpec.shift
+
+    def shift_persistent_while_dma(spec, consumed):
+        if solver.comm == "dma":
+            return spec           # the faulty link keeps corrupting
+        return orig_shift(spec, consumed)
+
+    monkeypatch.setattr(faults.FaultSpec, "shift",
+                        shift_persistent_while_dma)
+    faults.install(faults.parse_fault_spec("halo:nan@5"))
+    try:
+        x = solver.solve(b, criteria=StoppingCriteria(
+            maxits=200, residual_rtol=1e-4))
+    finally:
+        faults.install(None)
+    st = solver.stats
+    assert st.converged
+    assert solver.comm == "xla", "dma transport was not retired"
+    assert st.nfallbacks >= 1
+    assert "dma -> xla" in st.fwrite()
+    assert np.isfinite(x).all()
+
+
 @pytest.mark.parametrize("kind", ["line", "star", "clustered"])
 def test_dma_matches_xla_topologies(kind):
     """xla-vs-dma agreement across qualitatively different partition
