@@ -597,6 +597,34 @@ def make_parser() -> argparse.ArgumentParser:
                         "classification (compute/HBM/comm/dispatch).  "
                         "Degrades gracefully where the analysis is "
                         "unsupported on the running jax version/backend")
+    p.add_argument("--commbench", nargs="?", const="-", default=None,
+                   metavar="FILE",
+                   help="communication observatory: run the collective "
+                        "microbenchmark suite over this run's mesh "
+                        "(psum/all_reduce scalar latency, all_to_all + "
+                        "collective_permute bandwidth sweeps, per-edge "
+                        "one-sided halo_dma put/wait timing) plus a "
+                        "measured SpMV/halo/reduction segment "
+                        "decomposition of this case, fit an alpha-beta "
+                        "model per collective kind, and write the "
+                        "acg-tpu-commbench/1 calibration document to "
+                        "FILE ('-' or omitted = stdout).  Standalone "
+                        "mode, or combined with --explain to calibrate "
+                        "the roofline verdict live")
+    p.add_argument("--calibration", metavar="FILE", default=None,
+                   help="a saved --commbench document: --explain prices "
+                        "comm from its fitted alpha-beta model instead "
+                        "of ring-hop estimates and reports predicted-vs-"
+                        "measured with calibration provenance; on a "
+                        "normal solve the calibration id is recorded in "
+                        "the --stats-json manifest and convergence-log "
+                        "meta line (bench_diff keys differently-"
+                        "calibrated captures apart)")
+    p.add_argument("--no-probe-cache", action="store_true",
+                   help="ignore the on-disk backend-keyed triad-probe "
+                        "sidecar (ACG_TPU_PROBE_CACHE / "
+                        "~/.cache/acg-tpu/probe_cache.json) and "
+                        "re-measure HBM bandwidth")
     p.add_argument("--profile-ops", nargs="?", const=10, type=int,
                    default=None, metavar="REPS",
                    help="fill the stats block's per-op seconds/GB/s by "
@@ -710,6 +738,19 @@ def _buildinfo(out) -> int:
          f"memory_analysis introspection, comm ledger, roofline "
          f"verdict); 'costmodel'/'memory' keys in the {STATS_SCHEMA} "
          f"stats twin"),
+        ("communication observatory", "--commbench FILE (mesh "
+         "collective microbenchmarks: psum/all_reduce latency, "
+         "all_to_all + collective_permute sweeps, per-edge one-sided "
+         "halo_dma put/wait timing by ring distance, fitted t = alpha "
+         "+ beta*bytes per kind; measured SpMV/halo/reduction segment "
+         "decomposition from the recurrence builder's own emission; "
+         "acg-tpu-commbench/1 document with a content-hashed "
+         "calibration id), --explain --calibration FILE (comm priced "
+         "from the fitted alpha-beta, predicted-vs-measured with "
+         "provenance; calibration ids ride the stats manifest, "
+         "convergence-log meta line and bench_diff case keys), "
+         "--no-probe-cache (bypass the backend-keyed on-disk triad-"
+         "probe sidecar); acg_commbench_* metric families"),
         ("bench gating", "bench.py --baseline FILE --fail-on-regress "
          "PCT; scripts/bench_diff.py (diffs --stats-json or bench-row "
          "captures case-by-case, nonzero exit on regression)"),
@@ -1262,7 +1303,14 @@ def _emit_telemetry(args, solver, *, matrix_id, nparts=1,
             or getattr(args, "history", None)):
         return
     from acg_tpu import telemetry
+    from acg_tpu.commbench import UNCALIBRATED
     from acg_tpu.parallel.multihost import is_primary
+
+    # the active commbench calibration id, stamped on BOTH provenance
+    # surfaces below (convergence-log meta line + stats manifest) from
+    # one lookup so they can never drift
+    _cal = getattr(args, "_calibration", None)
+    cal_id = _cal["calibration_id"] if _cal is not None else UNCALIBRATED
 
     _fold_phases(args, solver)
     # the span timeline rides the same call points (success AND error
@@ -1274,6 +1322,11 @@ def _emit_telemetry(args, solver, *, matrix_id, nparts=1,
     if args.convergence_log and is_primary():
         try:
             if trace is not None:
+                # calibration provenance on the meta line (the
+                # stats-manifest twin below records the same id): a
+                # log produced under a commbench calibration names it,
+                # every other log says "uncalibrated"
+                trace.meta_extra["calibration"] = cal_id
                 trace.write_jsonl(args.convergence_log)
             else:
                 sys.stderr.write(
@@ -1317,6 +1370,10 @@ def _emit_telemetry(args, solver, *, matrix_id, nparts=1,
         return
     extra = {"matrix": str(matrix_id), "solver": args.solver,
              "comm": comm, "nparts": int(nparts), "dtype": args.dtype,
+             # the active commbench calibration id; joins the bench-diff
+             # CASE KEY (perfmodel._calibration_keyed) so differently-
+             # calibrated captures never diff silently
+             "calibration": cal_id,
              "argv": list(sys.argv[1:])}
     pc = getattr(args, "_precond", None)
     if pc is not None:
@@ -2265,6 +2322,57 @@ def _main(args) -> int:
                 f"acg-tpu: --explain is an analysis pass and produces "
                 f"none of: {', '.join(ignored)} -- run a normal solve "
                 f"for those (--stats-json works with --explain)")
+    # communication observatory (acg_tpu.commbench): validate the
+    # calibration source BEFORE anything expensive (the explain/fault
+    # discipline), refuse configurations the observatory could never
+    # honestly measure
+    if args.commbench is not None and args.calibration is not None:
+        raise SystemExit(
+            "acg-tpu: --commbench and --calibration are two calibration "
+            "sources; run --commbench to produce a document, then "
+            "--explain --calibration FILE to consume it")
+    if args.commbench is not None:
+        if (args.multihost or args.coordinator is not None
+                or args.distributed_read):
+            raise SystemExit(
+                "acg-tpu: --commbench is a single-controller "
+                "measurement pass (drop --multihost/--coordinator/"
+                "--distributed-read)")
+        if args.fault_inject or os.environ.get("ACG_TPU_FAULT_INJECT"):
+            raise SystemExit(
+                "acg-tpu: --commbench measures the PRISTINE mesh "
+                "collectives; drop --fault-inject")
+        if not args.explain:
+            ignored = [flag for flag, on in [
+                ("--convergence-log", bool(args.convergence_log)),
+                ("-o/--output", args.output is not None),
+                ("--profile-ops", args.profile_ops is not None),
+                ("--timeline", args.timeline is not None),
+                ("--stats-json (the commbench document IS the "
+                 "structured output; --stats-json works with "
+                 "--explain --commbench)", args.stats_json is not None),
+                ("--soak", args.soak > 0),
+                ("--history (the ledger records solves, not "
+                 "microbenchmarks)", args.history is not None),
+                ("--slo (objectives judge real solves)",
+                 args.slo is not None),
+            ] if on]
+            if ignored:
+                raise SystemExit(
+                    f"acg-tpu: --commbench is a measurement pass and "
+                    f"produces none of: {', '.join(ignored)} -- run a "
+                    f"normal solve for those")
+    if args.calibration is not None:
+        from acg_tpu.commbench import load_calibration
+        try:
+            args._calibration = load_calibration(args.calibration)
+        except OSError as e:
+            raise SystemExit(f"acg-tpu: --calibration "
+                             f"{args.calibration}: {e}")
+        except ValueError as e:
+            raise SystemExit(f"acg-tpu: --calibration "
+                             f"{args.calibration}: {e}")
+        args._calibration_source = f"--calibration {args.calibration}"
     if args.telemetry_window <= 0:
         raise SystemExit("acg-tpu: --telemetry-window must be positive")
     if args.progress < 0:
@@ -2744,11 +2852,37 @@ def _main(args) -> int:
         vec_dtype = dtype
     comm = {"mpi": "xla", "nccl": "xla", "nvshmem": "dma"}.get(args.comm, args.comm)
 
+    if args.commbench is not None and not args.explain:
+        # the communication observatory's standalone mode: run the
+        # microbenchmark suite over this run's mesh and emit the
+        # calibration document (incompatible modes refused at the top
+        # of _main, the explain discipline)
+        from acg_tpu.commbench import run_commbench
+        return run_commbench(args, dtype, vec_dtype)
+
     if args.explain:
         # the perfmodel tier's analysis pass: per-tier compiled-program
         # introspection + roofline verdict in place of a normal solve
         # (incompatible modes were refused at the top of _main, before
         # the backend probe and multihost init could block)
+        if args.commbench is not None:
+            # live calibration: collect the commbench document first,
+            # then run the explain pass against it (and still write
+            # the document when a FILE was named)
+            from acg_tpu import commbench
+            doc = commbench.collect_document(args, dtype, vec_dtype,
+                                             sys.stderr)
+            # the document is always emitted (stdout when FILE is
+            # omitted/'-' -- the explain verdict goes to stderr, so
+            # stdout is free): an unsaveable live calibration would
+            # force the user to re-run the whole sweep
+            try:
+                commbench.write_document(doc, args.commbench)
+            except OSError as e:
+                sys.stderr.write(f"acg-tpu: --commbench "
+                                 f"{args.commbench}: {e}\n")
+            args._calibration = doc
+            args._calibration_source = "live --commbench run"
         from acg_tpu.perfmodel import run_explain
         return run_explain(args, dtype=dtype, vec_dtype=vec_dtype)
 

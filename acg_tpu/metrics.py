@@ -528,6 +528,23 @@ TRACE_OVERLAP = REGISTRY.gauge(
 TRACE_EXPOSED_SECONDS = REGISTRY.gauge(
     "acg_trace_exposed_collective_seconds", "Collective device time "
     "NOT overlapped by compute in the last analyzed capture.")
+# communication observatory (acg_tpu.commbench, --commbench): fitted
+# alpha-beta per collective kind and the measured segment split
+COMMBENCH_RUNS = REGISTRY.counter(
+    "acg_commbench_runs_total", "Completed --commbench microbenchmark "
+    "suites (collective sweeps + segment decomposition).")
+COMMBENCH_ALPHA = REGISTRY.gauge(
+    "acg_commbench_alpha_seconds", "Fitted per-collective latency "
+    "alpha from the last commbench run (t = alpha + beta * bytes).",
+    labelnames=("kind",))
+COMMBENCH_BETA = REGISTRY.gauge(
+    "acg_commbench_beta_seconds_per_byte", "Fitted per-collective "
+    "inverse bandwidth beta from the last commbench run.",
+    labelnames=("kind",))
+COMMBENCH_SEGMENT = REGISTRY.gauge(
+    "acg_commbench_segment_seconds", "Measured per-iteration segment "
+    "seconds (spmv / halo / reduction) from the last commbench "
+    "segment decomposition.", labelnames=("segment",))
 # live-observatory tier (acg_tpu.observatory, --slo): declared
 # service-level objectives and their error-budget burn
 SLO_TARGET = REGISTRY.gauge(
@@ -756,6 +773,27 @@ def observe_solver_comm(solver, iterations: int) -> None:
         record_comm(prof(), iterations)
     except Exception:  # noqa: BLE001 -- metrics must never sink a solve
         pass
+
+
+def record_commbench(doc: dict) -> None:
+    """Fold one commbench document into the registry: alpha/beta per
+    fitted collective kind plus the measured segment split (no-op
+    disarmed, like every recorder here)."""
+    if not _armed or not isinstance(doc, dict):
+        return
+    COMMBENCH_RUNS.inc()
+    for kind, fit in (doc.get("collectives") or {}).items():
+        if isinstance(fit, dict) and "alpha_s" in fit:
+            COMMBENCH_ALPHA.labels(str(kind)).set(float(fit["alpha_s"]))
+            COMMBENCH_BETA.labels(str(kind)).set(
+                float(fit.get("beta_s_per_byte", 0.0)))
+    segs = (doc.get("segments") or {})
+    for name, seg in (segs.get("segments") or {}).items():
+        try:
+            COMMBENCH_SEGMENT.labels(str(name)).set(
+                float(seg["s_per_iteration"]))
+        except (KeyError, TypeError, ValueError):
+            continue
 
 
 def update_resource_gauges() -> None:

@@ -59,6 +59,19 @@ from acg_tpu.solvers.jax_cg import _breakdown_guard, _iterate
 from acg_tpu.solvers.stats import (SolverStats, StoppingCriteria,
                                    cg_flops_per_iteration)
 
+# the reference's --comm spellings mapped onto our two transports
+# (cuda/acg-cuda.c:321-377): ONE copy, shared by the CLI, the explain
+# tier and the commbench observatory
+COMM_ALIASES = {"mpi": "xla", "nccl": "xla", "nvshmem": "dma"}
+
+
+def resolve_comm(name: str) -> str:
+    """Transport for a dist solver from a --comm spelling; ``none``
+    (the CLI's single-device selector) resolves to the xla transport
+    for analysis passes that build a mesh tier regardless."""
+    c = COMM_ALIASES.get(str(name), str(name))
+    return "xla" if c == "none" else c
+
 
 def _ell_mv(data: jax.Array, cols: jax.Array, x: jax.Array) -> jax.Array:
     return jnp.einsum("nk,nk->n", data, x[cols],
@@ -2320,6 +2333,21 @@ class DistCGSolver:
             "allreduce_scalars": int(scal),
             "allreduce_bytes_per_iteration": int(nred * scal * sdl),
             "max_hops": int(max_hops),
+            # what the transport ACTUALLY moves per exchange and shard:
+            # windows are padded to the mesh-wide maximum count (the
+            # NVSHMEM symmetric-buffer trick), so the wire sees the
+            # padded plane -- (P-1) windows for the dma rotation
+            # schedule, P for the all_to_all plane.  The commbench
+            # calibration prices halo time over these bytes (its
+            # sweeps use the same convention); the unpadded neighbour
+            # totals above stay the VOLUME accounting
+            "halo_plane_bytes_per_exchange": int(
+                ((P - 1) if self.comm == "dma" else P)
+                * int(getattr(prob.halo, "maxcnt", 0)) * dbl),
+            # the ring distances this partition's edges span -- the key
+            # that matches a commbench per-edge put/wait row to an
+            # actual edge of this halo plan
+            "ring_distances": sorted({n["hops"] for n in neighbors}),
         }
         if self.kernels == "fused":
             # the overlap declaration of the fused tier: how much

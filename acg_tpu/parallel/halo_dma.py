@@ -264,6 +264,23 @@ def _exchange(sendbuf, send_counts, recv_counts, axis: str, interpret: bool,
     )(send_counts, recv_counts, sendbuf)
 
 
+def dma_exchange(sendbuf: jax.Array, send_counts: jax.Array,
+                 recv_counts: jax.Array, axis: str = PARTS_AXIS,
+                 interpret: bool | None = None,
+                 gate_by_counts: bool | None = None) -> jax.Array:
+    """The raw systolic put-with-signal exchange without pack/unpack --
+    the communication observatory's probe entry (acg_tpu.commbench:
+    dense window sweeps and the per-edge put/wait timing rows, whose
+    distance gates are globally uniform per rotation round and so are
+    safe under the interpret emulation's op pairing).  Same contract as
+    the :func:`_exchange` kernel the solve-path transport rides, same
+    interpret default as :func:`halo_exchange_dma`."""
+    if interpret is None:
+        interpret = jax.devices()[0].platform != "tpu"
+    return _exchange(sendbuf, send_counts, recv_counts, axis, interpret,
+                     gate_by_counts)
+
+
 def halo_exchange_dma(x_loc: jax.Array, send_idx: jax.Array,
                       ghost_src: jax.Array, ghost_valid: jax.Array,
                       send_counts: jax.Array, recv_counts: jax.Array,

@@ -349,6 +349,61 @@ def triad_probe_gbs(nelems: int = 1 << 26, reps: int = 3,
                        f"implausible after {attempts} attempts)")
 
 
+def _probe_cache_path() -> str:
+    """The on-disk triad-probe sidecar (``ACG_TPU_PROBE_CACHE``
+    overrides; default under the XDG cache dir)."""
+    p = os.environ.get("ACG_TPU_PROBE_CACHE")
+    if p:
+        return p
+    base = (os.environ.get("XDG_CACHE_HOME")
+            or os.path.join(os.path.expanduser("~"), ".cache"))
+    return os.path.join(base, "acg-tpu", "probe_cache.json")
+
+
+def cached_triad_probe_gbs(nelems: int = 1 << 26, use_cache: bool = True,
+                           refresh: bool = False, **kw) -> float:
+    """:func:`triad_probe_gbs` behind an on-disk, backend-keyed sidecar
+    so repeated ``--explain``/bench runs skip the ~1 s re-probe
+    (``--no-probe-cache`` forces a fresh measurement).  Keyed by
+    ``platform:device_kind:nelems`` -- a CPU figure can never stand in
+    for a TPU one, and the small --explain host probe never collides
+    with the full-size bench probe.  ``refresh`` re-measures but still
+    updates the sidecar (a fresh probe is the best cache entry); cache
+    I/O failures degrade to a plain probe."""
+    import jax
+
+    dev = jax.devices()[0]
+    key = f"{dev.platform}:{dev.device_kind}:n{int(nelems)}"
+    path = _probe_cache_path()
+    if use_cache and not refresh:
+        try:
+            with open(path) as f:
+                entry = (json.load(f) or {}).get(key)
+            if isinstance(entry, dict) and float(entry.get("gbs", 0)) > 0:
+                return float(entry["gbs"])
+        except (OSError, ValueError, TypeError):
+            pass
+    bw = triad_probe_gbs(nelems, **kw)
+    if use_cache:
+        try:
+            os.makedirs(os.path.dirname(path), exist_ok=True)
+            try:
+                with open(path) as f:
+                    cache = json.load(f)
+            except (OSError, ValueError):
+                cache = {}
+            if not isinstance(cache, dict):
+                cache = {}
+            cache[key] = {"gbs": float(bw), "unix_time": time.time()}
+            tmp = f"{path}.tmp.{os.getpid()}"
+            with open(tmp, "w") as f:
+                json.dump(cache, f, indent=2)
+            os.replace(tmp, path)
+        except OSError:
+            pass
+    return bw
+
+
 def _dispatch_seconds(reps: int = 5, dtype=None) -> float:
     """Per-program dispatch latency (a synced noop): the fixed cost a
     whole-solve program pays ONCE, amortised over its iterations in the
@@ -376,7 +431,8 @@ def _dispatch_seconds(reps: int = 5, dtype=None) -> float:
 
 
 def predicted_overlap_seconds(led: dict, bw_gbs: float | None,
-                              ici_gbs: float | None) -> dict | None:
+                              ici_gbs: float | None,
+                              halo_s: float | None = None) -> dict | None:
     """The fused tier's overlap verdict from its static ledger: price
     the halo payload against the interconnect and the interior-SpMV
     traffic against HBM, then ``exposed = max(0, halo - interior)`` --
@@ -384,17 +440,23 @@ def predicted_overlap_seconds(led: dict, bw_gbs: float | None,
     before the puts land (the reference's stream-overlap argument,
     restated in ledger terms).  ``hidden_frac`` is directly comparable
     to the measured solve-windowed overlap-efficiency score a --trace
-    capture yields.  None when either bandwidth is unknown."""
+    capture yields.  ``halo_s`` (the commbench calibration's measured
+    per-exchange halo seconds) replaces the bytes-over-ici guess when
+    given.  None when a needed bandwidth is unknown."""
     ov = led.get("overlap") or {}
-    if not bw_gbs or not ici_gbs:
+    if not bw_gbs or (halo_s is None and not ici_gbs):
         return None
-    t_halo = led.get("halo_bytes_per_iteration", 0) / (ici_gbs * 1e9)
+    t_halo = (halo_s if halo_s is not None else
+              led.get("halo_bytes_per_iteration", 0) / (ici_gbs * 1e9))
     t_int = ov.get("interior_matrix_bytes", 0) / (bw_gbs * 1e9)
     exposed = max(0.0, t_halo - t_int)
-    return {"halo_s": t_halo, "interior_spmv_s": t_int,
-            "exposed_halo_s": exposed,
-            "hidden_frac": (1.0 - exposed / t_halo) if t_halo > 0
-            else None}
+    out = {"halo_s": t_halo, "interior_spmv_s": t_int,
+           "exposed_halo_s": exposed,
+           "hidden_frac": (1.0 - exposed / t_halo) if t_halo > 0
+           else None}
+    if halo_s is not None:
+        out["halo_source"] = "commbench calibration"
+    return out
 
 
 def classify_bound(measured_s: float, hbm_s: float, comm_s: float,
@@ -457,10 +519,20 @@ def _fmt_bytes(n: float) -> str:
 
 
 def _explain_tier(name, solver, b, csr, K, bw_gbs, dispatch_s, on_tpu,
-                  err) -> dict | None:
+                  err, cal: dict | None = None) -> dict | None:
     """Analyze + time one tier and print its explain block.  Returns the
     verdict row (for the optional --stats-json sink), or None when the
-    tier failed entirely."""
+    tier failed entirely.
+
+    With a commbench calibration (``cal``, --calibration FILE or a live
+    --commbench run) the comm component is priced from the fitted
+    alpha-beta model instead of the ring-hop/ICI_GBS guess, the fused
+    overlap verdict prices the MEASURED per-exchange halo seconds, and
+    the tier's own measured segment decomposition (SpMV-only /
+    reduction-only probes from the dispatched TierOps composition)
+    replaces the analytic-bytes prediction -- both the calibrated and
+    the uncalibrated predicted s/iter are reported so the calibration's
+    effect is auditable."""
     from acg_tpu.ops.spmv import matrix_index_bytes, matrix_dtype
     from acg_tpu.solvers.stats import (StoppingCriteria,
                                        cg_flops_per_iteration)
@@ -528,8 +600,45 @@ def _explain_tier(name, solver, b, csr, K, bw_gbs, dispatch_s, on_tpu,
             t_comm = (overlap["exposed_halo_s"]
                       + led.get("allreduce_bytes_per_iteration", 0)
                       / (ici * 1e9))
+    t_comm_uncal = t_comm
+    predicted_uncal = t_hbm + t_comm_uncal + t_disp
+
+    # -- the calibrated verdict (acg_tpu.commbench) ---------------------
+    cal_comm = segs = None
+    cal_id = None
+    if cal is not None:
+        from acg_tpu import commbench
+        cal_id = str(cal.get("calibration_id", ""))
+        if led and "error" not in led:
+            cal_comm = commbench.comm_seconds(cal, led)
+            if led.get("overlap"):
+                halo_meas = commbench.halo_exchange_seconds(cal, led)
+                ov_cal = predicted_overlap_seconds(led, bw_gbs, ici,
+                                                   halo_s=halo_meas)
+                if ov_cal is not None:
+                    overlap = ov_cal
+        segs = commbench.segment_decomposition(solver, b)
+        if cal_comm is not None:
+            # fitted alpha-beta replaces the ring-hop/ICI_GBS guess;
+            # the fused ledger still discounts the hidden halo share
+            t_comm = (cal_comm["allreduce_s"]
+                      + (overlap["exposed_halo_s"]
+                         if overlap is not None
+                         else cal_comm["halo_s"]))
     verdict, comp = classify_bound(t_iter, t_hbm, t_comm, t_disp)
     predicted = t_hbm + t_comm + t_disp
+    if cal is not None and segs and segs.get("available"):
+        # measured segments replace the analytic-HBM stand-in: the
+        # SpMV segment (exchange included, as dispatched) plus the
+        # reduction component (fitted alpha-beta where a mesh ledger
+        # exists, the measured psum-ladder probe otherwise) plus the
+        # amortised dispatch
+        sseg = segs["segments"]
+        spmv_seg = sseg.get("spmv", {}).get("s_per_iteration", 0.0)
+        red_seg = (cal_comm["allreduce_s"] if cal_comm is not None
+                   else sseg.get("reduction", {})
+                   .get("s_per_iteration", 0.0))
+        predicted = spmv_seg + red_seg + t_disp
     attained = (t_hbm / t_iter) if t_iter > 0 else 0.0
 
     err.write(f"== explain: {name} ==\n")
@@ -578,15 +687,50 @@ def _explain_tier(name, solver, b, csr, K, bw_gbs, dispatch_s, on_tpu,
                   f"exposed {overlap['exposed_halo_s']:.3e} s/iter"
                   + (f" ({hid:.0%} hidden)" if hid is not None else "")
                   + "\n")
+    if segs is not None:
+        if segs.get("available"):
+            sseg = segs["segments"]
+            parts_txt = " + ".join(
+                f"{k} {v['s_per_iteration']:.3e}"
+                for k, v in sseg.items() if k != "halo")
+            halo_txt = (f" (halo {sseg['halo']['s_per_iteration']:.3e}"
+                        f" inside spmv)" if "halo" in sseg else "")
+            err.write(f"  segments (measured, {segs['reps']} chained "
+                      f"reps/probe): {parts_txt} ="
+                      f" {segs['explained_s_per_iteration']:.3e} "
+                      f"s/iter explained{halo_txt}\n")
+        else:
+            err.write(f"  segments: unavailable "
+                      f"({segs.get('why', '?')})\n")
+    if cal is not None:
+        if cal_comm is not None:
+            err.write(f"  calibrated comm (alpha-beta, {cal_id}): "
+                      f"allreduce {cal_comm['allreduce_s']:.3e} + "
+                      f"halo[{cal_comm['halo_kind']}] "
+                      f"{cal_comm['halo_s']:.3e} s/iter (replaces the "
+                      f"ring-hop/ICI stand-in)\n")
+        elif led is None:
+            err.write(f"  calibrated comm ({cal_id}): no comm ledger "
+                      f"on this tier (single device) -- segments "
+                      f"carry the calibration\n")
     bw_txt = f"{bw_gbs:,.1f} GB/s" if bw_gbs else "unavailable"
     err.write(f"  roofline: probe {bw_txt}"
               + (f", ici {ici:,.0f} GB/s (stand-in)" if comm_bytes and
-                 on_tpu else "")
-              + f"; predicted {predicted:.3e} s/iter (hbm {t_hbm:.3e} + "
-              f"comm {t_comm:.3e} + dispatch {t_disp:.3e})\n")
+                 on_tpu and cal_comm is None else "")
+              + f"; predicted {predicted:.3e} s/iter"
+              + (f" (measured segments + fitted comm + dispatch; "
+                 f"uncalibrated model {predicted_uncal:.3e})"
+                 if cal is not None and predicted != predicted_uncal
+                 else f" (hbm {t_hbm:.3e} + comm {t_comm:.3e} + "
+                      f"dispatch {t_disp:.3e})") + "\n")
+    ratio = (predicted / t_iter) if t_iter > 0 else 0.0
+    ratio_uncal = (predicted_uncal / t_iter) if t_iter > 0 else 0.0
     err.write(f"  measured {t_iter:.3e} s/iter over {K} iterations; "
               f"attained {attained:.2f}x of HBM roofline; "
-              f"verdict: {verdict}\n\n")
+              f"predicted/measured {ratio:.2f}x"
+              + (f" (uncalibrated {ratio_uncal:.2f}x; calibration "
+                 f"{cal_id})" if cal is not None else "")
+              + f"; verdict: {verdict}\n\n")
 
     row = {"tier": name, "measured_s_per_iter": t_iter,
            "predicted_s_per_iter": predicted,
@@ -594,7 +738,38 @@ def _explain_tier(name, solver, b, csr, K, bw_gbs, dispatch_s, on_tpu,
            "components_s": comp}
     if overlap is not None:
         row["overlap_model"] = overlap
+    if cal is not None:
+        row["calibration"] = cal_id
+        row["uncalibrated_predicted_s_per_iter"] = predicted_uncal
+        solver.stats.costmodel["calibration"] = cal_id
+        if cal_comm is not None:
+            row["calibrated_comm_s"] = cal_comm
+    if segs is not None and segs.get("available"):
+        row["segments"] = segs
+        solver.stats.costmodel["segments"] = segs
     return row
+
+
+def build_explain_dist_solver(args, csr, nparts, dtype, vec_dtype,
+                              **solver_kw):
+    """The dist analysis tier's construction, shared by
+    :func:`run_explain` and the commbench observatory (ONE copy: same
+    partition method/seed, same transport resolution -- a commbench
+    calibration must describe the very mesh the explain verdict
+    prices)."""
+    from acg_tpu.ops.spmv import prefers_dia
+    from acg_tpu.parallel.dist import (DistCGSolver, DistributedProblem,
+                                       resolve_comm)
+    from acg_tpu.partition import partition_rows
+
+    method = "band" if prefers_dia(csr) else "graph"
+    part = partition_rows(csr, nparts, seed=args.seed, method=method)
+    prob = DistributedProblem.build(csr, part, nparts, dtype=dtype,
+                                    vector_dtype=vec_dtype)
+    return DistCGSolver(prob, pipelined=False,
+                        comm=resolve_comm(args.comm),
+                        precise_dots=args.precise_dots,
+                        kernels=args.kernels, **solver_kw)
 
 
 def run_explain(args, dtype, vec_dtype) -> int:
@@ -612,13 +787,40 @@ def run_explain(args, dtype, vec_dtype) -> int:
     b = np.ones(n)
     K = max(8, min(args.max_iterations, 60))
     on_tpu = jax.default_backend() == "tpu"
+    nparts = args.nparts or min(len(jax.devices()), 4)
+    # the communication observatory's calibration (a saved --calibration
+    # doc or a live --commbench run, loaded/collected by the CLI): the
+    # comm components below are then priced from its fitted alpha-beta
+    # model and the tiers run measured segment decompositions
+    cal = getattr(args, "_calibration", None)
+    if cal is not None:
+        from acg_tpu.commbench import KINDS
+        src = getattr(args, "_calibration_source", None) \
+            or "live --commbench run"
+        fitted = [k for k in KINDS
+                  if isinstance(cal.get("collectives", {}).get(k), dict)
+                  and "alpha_s" in cal["collectives"][k]]
+        err.write(f"== explain: calibration ==\n"
+                  f"  id {cal.get('calibration_id')} ({src}); fitted "
+                  f"kinds: {', '.join(fitted) or 'none'}; benchmarked "
+                  f"on a {cal.get('nparts')}-part mesh\n")
+        if int(cal.get("nparts", 0)) != int(nparts):
+            err.write(f"  WARNING: calibration mesh "
+                      f"({cal.get('nparts')} parts) differs from this "
+                      f"run's ({nparts} parts) -- fitted latencies may "
+                      f"not transfer\n")
+        err.write("\n")
     bw = None
+    use_cache = not getattr(args, "no_probe_cache", False)
     try:
         # full-size probe on real HBM; a small (16 MiB/vector) variant
         # elsewhere -- host CPUs move the small triad fast enough, and
-        # --explain must stay cheap in CPU test runs
-        bw = (triad_probe_gbs() if on_tpu
-              else triad_probe_gbs(1 << 22, lo=0.5))
+        # --explain must stay cheap in CPU test runs.  Behind the
+        # backend-keyed sidecar so repeated explain runs skip the
+        # re-probe (--no-probe-cache forces one)
+        bw = (cached_triad_probe_gbs(use_cache=use_cache) if on_tpu
+              else cached_triad_probe_gbs(1 << 22, use_cache=use_cache,
+                                          lo=0.5))
     except Exception as e:  # noqa: BLE001
         err.write(f"acg-tpu: bandwidth probe failed ({e}); roofline "
                   f"fractions unavailable\n")
@@ -626,7 +828,7 @@ def run_explain(args, dtype, vec_dtype) -> int:
 
     import jax.numpy as jnp
 
-    from acg_tpu.ops.spmv import device_matrix_from_csr, prefers_dia
+    from acg_tpu.ops.spmv import device_matrix_from_csr
     from acg_tpu.solvers.jax_cg import JaxCGSolver
 
     rows = []
@@ -656,7 +858,7 @@ def run_explain(args, dtype, vec_dtype) -> int:
                     f"{name} ({solver.kernels} kernels, {args.dtype}"
                     + (f", precond {pc}" if pc is not None else "") + ")",
                     solver, jnp.asarray(b, solver._solve_dtype()), csr, K, bw,
-                    disp, on_tpu, err)
+                    disp, on_tpu, err, cal=cal)
                 if row:
                     rows.append((row, solver))
             except Exception as e:  # noqa: BLE001 -- one tier must not sink the rest
@@ -666,29 +868,17 @@ def run_explain(args, dtype, vec_dtype) -> int:
         # one distributed tier: the halo'd multi-part program over however
         # many devices this host exposes (capped -- the ledger and verdict,
         # not scaling, are the point here)
-        nparts = args.nparts or min(len(jax.devices()), 4)
         try:
-            from acg_tpu.parallel.dist import DistCGSolver, DistributedProblem
-            from acg_tpu.partition import partition_rows
-
-            method = "band" if prefers_dia(csr) else "graph"
-            part = partition_rows(csr, nparts, seed=args.seed, method=method)
-            prob = DistributedProblem.build(csr, part, nparts, dtype=dtype,
-                                            vector_dtype=vec_dtype)
-            comm = {"mpi": "xla", "nccl": "xla",
-                    "nvshmem": "dma"}.get(args.comm, args.comm)
-            solver = DistCGSolver(prob, pipelined=False,
-                                  comm=comm if comm != "none" else "xla",
-                                  precise_dots=args.precise_dots,
-                                  kernels=args.kernels,
-                                  recovery=getattr(args, "_recovery", None),
-                                  precond=getattr(args, "_precond", None))
+            solver = build_explain_dist_solver(
+                args, csr, nparts, dtype, vec_dtype,
+                recovery=getattr(args, "_recovery", None),
+                precond=getattr(args, "_precond", None))
             pc = getattr(args, "_precond", None)
             row = _explain_tier(f"dist-cg (nparts={nparts}, {solver.kernels} "
                                 f"kernels, {args.dtype}"
                                 + (f", precond {pc}" if pc is not None
                                    else "") + ")", solver, b, csr, K,
-                                bw, disp, on_tpu, err)
+                                bw, disp, on_tpu, err, cal=cal)
             if row:
                 rows.append((row, solver))
         except Exception as e:  # noqa: BLE001
@@ -715,10 +905,13 @@ def run_explain(args, dtype, vec_dtype) -> int:
         from acg_tpu import telemetry
 
         try:
+            from acg_tpu.commbench import UNCALIBRATED
             for row, solver in rows:
                 man = telemetry.run_manifest(
                     metric=f"explain:{row['tier']}", matrix=str(args.A),
-                    dtype=args.dtype, explain=row)
+                    dtype=args.dtype, explain=row,
+                    calibration=(cal.get("calibration_id")
+                                 if cal is not None else UNCALIBRATED))
                 telemetry.write_stats_json(args.stats_json, solver.stats,
                                            manifest=man, append=True)
         except OSError as e:
@@ -747,6 +940,26 @@ def _explain_measured(args, rows, K: int, err) -> dict | None:
         err.write(tracing.measured_comm_line(
             analysis, predicted,
             label=f"comm ledger x {K} iters/tier") + "\n")
+        # per-KIND confrontation: the commbench alpha-beta fit priced
+        # allreduce and halo separately, and the capture now breaks
+        # collective seconds out by kind -- confront them kind by kind
+        kinds = (analysis.get("collective_kind_seconds_in_solve")
+                 or analysis.get("collective_kind_seconds") or {})
+        cal_rows = [row for row, _ in rows
+                    if row.get("calibrated_comm_s")]
+        if kinds and cal_rows:
+            pred_ar = sum(r["calibrated_comm_s"]["allreduce_s"] * K
+                          for r in cal_rows)
+            pred_halo = sum(r["calibrated_comm_s"]["halo_s"] * K
+                            for r in cal_rows)
+            meas_ar = kinds.get("all_reduce", 0.0)
+            meas_halo = sum(v for k, v in kinds.items()
+                            if k != "all_reduce")
+            err.write(f"  per-kind (commbench fit x {K} iters/"
+                      f"calibrated tier): allreduce predicted "
+                      f"{pred_ar:.3e} s vs measured {meas_ar:.3e} s; "
+                      f"halo predicted {pred_halo:.3e} s vs measured "
+                      f"{meas_halo:.3e} s\n")
         # the fused tier's overlap verdict, confronted: the static
         # ledger's predicted hidden fraction vs the capture's measured
         # solve-windowed overlap-efficiency score (same quantity, one
@@ -771,7 +984,8 @@ def _explain_measured(args, rows, K: int, err) -> dict | None:
         # way tracing.attach builds the section
         compact = {k: analysis[k] for k in
                    ("available", "nfiles", "op_seconds",
-                    "collective_seconds", "exposed_collective_seconds",
+                    "collective_seconds", "collective_kind_seconds",
+                    "exposed_collective_seconds",
                     "overlap_efficiency", "straggler")
                    if analysis.get(k) is not None}
         for _, solver in rows:
@@ -918,6 +1132,7 @@ def _doc_case(doc: dict):
         metric = f"{man.get('solver', 'solve')}:{man.get('matrix', '?')}"
     metric = _precond_keyed(metric, man.get("precond"))
     metric = _batch_keyed(metric, man.get("nrhs"), man.get("block_cg"))
+    metric = _calibration_keyed(metric, man.get("calibration"))
     soak = st.get("soak") or {}
     if soak:
         try:
@@ -965,6 +1180,20 @@ def _batch_keyed(metric, nrhs, block=None) -> str:
     return metric
 
 
+def _calibration_keyed(metric, calibration) -> str:
+    """Fold a commbench calibration id into the case key (the
+    _precond_keyed pattern): two captures explained/priced under
+    DIFFERENT calibrations measure against different models and must
+    never diff silently -- they become distinct, reported-not-gated
+    cases.  The ``"uncalibrated"`` sentinel (and absent keys, every
+    pre-/10 capture) adds nothing, so old baselines keep comparing."""
+    metric = str(metric)
+    cal = str(calibration or "")
+    if cal and cal != "uncalibrated":
+        return f"{metric}|cal={cal}"
+    return metric
+
+
 def _row_case(row: dict):
     """``(key, value)`` for one bench summary row (the JSON lines bench
     prints / BENCH_*.json records)."""
@@ -973,6 +1202,7 @@ def _row_case(row: dict):
         return None
     key = _precond_keyed(metric, row.get("precond"))
     key = _batch_keyed(key, row.get("nrhs"), row.get("block"))
+    key = _calibration_keyed(key, row.get("calibration"))
     return key, float(value)
 
 

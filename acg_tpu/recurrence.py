@@ -1072,6 +1072,179 @@ def build_dist_program(solver):
     return program
 
 
+# -- measured segment probes (the communication observatory) ---------------
+#
+# SpMV-only / halo-only / reduction-only probe programs for
+# acg_tpu.commbench.segment_decomposition, built from the SAME machinery
+# the builder's dispatched emission composes -- the overlapped-or-not
+# dist SpMV selection of build_dist_program, the make_pdot/make_pdotk
+# reduction ladders, _spmv_fn on the single-device tier -- so a measured
+# segment times the ops a real iteration runs, not a replay stand-in.
+# Each probe chains `reps` rounds inside ONE dispatched fori_loop (data
+# dependence between rounds, so XLA can neither elide nor batch them)
+# and clamps the SpMV chain (repeated A.v grows as lambda_max^reps;
+# the clamp keeps values finite and out of denormal range without a
+# norm, which would smuggle a reduction into the SpMV segment).
+# Building or running probes never mutates solver state: the dispatched
+# solve programs stay byte-identical (pinned in tests/test_commbench.py).
+
+def _probe_reduction_calls(pipelined: bool) -> tuple[str, float]:
+    """(probe flavour, calls/iteration) of the reduction segment:
+    classic runs TWO single-scalar pdots per iteration, the pipelined
+    recurrence ONE fused 2-scalar pdotk -- the probe reproduces the
+    exact ladder so the segment prices what the mesh actually moves."""
+    return ("pdotk2", 1.0) if pipelined else ("pdot", 2.0)
+
+
+def build_single_segment_probes(solver, b, reps: int) -> list[tuple]:
+    """``[(name, runner, calls_per_iteration), ...]`` for the
+    single-device tier: SpMV-only and reduction-only (no halo on one
+    chip)."""
+    from acg_tpu.solvers.jax_cg import _scalar_setup, _spmv_fn
+
+    if getattr(solver, "algo", None) is not None:
+        raise ValueError("segment probes cover the classic/pipelined "
+                         "recurrences")
+    spmv_ = _spmv_fn(solver.kernels)
+    dtype = solver._solve_dtype()
+    v0 = jnp.asarray(np.ones(int(np.asarray(b).shape[0])), dtype)
+    dot, sdt = _scalar_setup(dtype, solver.precise_dots)
+    A = solver._A_program
+    flavour, red_calls = _probe_reduction_calls(solver.pipelined)
+
+    @functools.partial(jax.jit, static_argnames="reps")
+    def spmv_prog(A, v, reps):
+        def rnd(_, v):
+            return jnp.clip(spmv_(A, v), -1e3, 1e3)
+        return jax.lax.fori_loop(0, reps, rnd, v)
+
+    @functools.partial(jax.jit, static_argnames="reps")
+    def red_prog(v, reps):
+        tiny = jnp.asarray(1e-30, sdt)
+
+        def rnd(_, v):
+            if flavour == "pdotk2":
+                g = dot(v, v) + dot(v, v)
+            else:
+                g = dot(v, v)
+            return v + (g * tiny).astype(v.dtype)
+        return jax.lax.fori_loop(0, reps, rnd, v)
+
+    r = int(reps)
+    return [("spmv", lambda: spmv_prog(A, v0, r), 1.0),
+            ("reduction", lambda: red_prog(v0, r), red_calls)]
+
+
+def build_dist_segment_probes(solver, b_global, reps: int) -> list[tuple]:
+    """``[(name, runner, calls_per_iteration), ...]`` for the dist
+    tier: the halo'd SpMV (overlapped when ``kernels='fused'`` -- the
+    same selection :func:`build_dist_program` dispatches), the halo
+    exchange alone (xla all_to_all or one-sided DMA, per the solver's
+    armed transport), and the psum reduction ladder."""
+    from jax import lax
+    from jax.sharding import PartitionSpec as P
+    from acg_tpu._platform import shard_map as _shard_map
+    from acg_tpu.parallel.dist import (make_dist_spmv,
+                                       make_dist_spmv_overlapped)
+    from acg_tpu.parallel.halo import halo_exchange
+    from acg_tpu.parallel.halo_dma import halo_exchange_dma
+    from acg_tpu.parallel.mesh import PARTS_AXIS
+    from acg_tpu.parallel.reductions import make_pdot, make_pdotk
+
+    if getattr(solver, "algo", None) is not None:
+        raise ValueError("segment probes cover the classic/pipelined "
+                         "recurrences")
+    prob = solver.problem
+    axis = PARTS_AXIS
+    if isinstance(solver.kernels, str) and \
+            solver.kernels.startswith("fused"):
+        dist_spmv = make_dist_spmv_overlapped(prob, solver.comm,
+                                              solver._interpret)
+    else:
+        dist_spmv = make_dist_spmv(prob, solver.comm, solver._interpret,
+                                   kernels=solver.kernels, fault=None)
+    single_shard = solver.mesh.devices.size == 1
+    comm = solver.comm
+    interpret = solver._interpret
+    precise = solver.precise_dots
+    flavour, red_calls = _probe_reduction_calls(solver.pipelined)
+
+    def psum(v):
+        return v if single_shard else lax.psum(v, axis)
+
+    dev = solver.device_args(np.asarray(b_global), None)
+    b, _x0, la, ga, sidx, gsrc, gval, scnt, rcnt = dev
+
+    def make_probe(round_of):
+        """One probe program over the solver's own stacked device args:
+        shard body unstacks exactly like the emission's shard_body,
+        builds the round from the tier ops, and chains ``reps``
+        rounds."""
+        def shard(la, ga, sidx, gsrc, gval, scnt, rcnt, b):
+            la, ga = (jax.tree.map(lambda a: a[0], t)
+                      for t in (la, ga))
+            sidx, gsrc, gval, scnt, rcnt, b = (
+                a[0] for a in (sidx, gsrc, gval, scnt, rcnt, b))
+            sdt = acc_dtype(b.dtype)
+
+            def spmv(x):
+                return dist_spmv(x, la, ga, sidx, gsrc, gval, scnt,
+                                 rcnt, k=None, pidx=None)
+
+            def halo(x):
+                if comm == "dma":
+                    return halo_exchange_dma(x, sidx, gsrc, gval,
+                                             scnt, rcnt, axis,
+                                             interpret=interpret)
+                return halo_exchange(x, sidx, gsrc, axis)
+
+            def ldot(a, c):
+                return jnp.dot(a, c, preferred_element_type=sdt)
+
+            pdot = make_pdot(psum, ldot, sdt, precise)
+            pdotk = make_pdotk(psum, ldot, sdt, precise)
+            rnd = round_of(spmv, halo, pdot, pdotk, sdt)
+            v = jax.lax.fori_loop(0, reps, lambda _, v: rnd(v), b)
+            return v[None]
+
+        if single_shard and not prob.halo.has_ghosts:
+            prog = jax.jit(lambda *a: shard(*a))
+        else:
+            pspec = P(axis)
+            prog = jax.jit(_shard_map(
+                shard, mesh=solver.mesh, in_specs=(pspec,) * 8,
+                out_specs=pspec))
+        return lambda: prog(la, ga, sidx, gsrc, gval, scnt, rcnt, b)
+
+    def spmv_round(spmv, halo, pdot, pdotk, sdt):
+        return lambda v: jnp.clip(spmv(v), -1e3, 1e3)
+
+    def halo_round(spmv, halo, pdot, pdotk, sdt):
+        def rnd(v):
+            g = halo(v)
+            return v.at[0].add((g[0]
+                                * jnp.asarray(1e-30, g.dtype)))
+        return rnd
+
+    def red_round(spmv, halo, pdot, pdotk, sdt):
+        tiny = jnp.asarray(1e-30, sdt)
+
+        def rnd(v):
+            if flavour == "pdotk2":
+                g1, g2 = pdotk((v, v), (v, v))
+                g = g1 + g2
+            else:
+                g = pdot(v, v)
+            return v + (g * tiny).astype(v.dtype)
+        return rnd
+
+    probes = [("spmv", make_probe(spmv_round), 1.0)]
+    if prob.halo.has_ghosts:
+        probes.append(("halo", make_probe(halo_round), 1.0))
+    probes.append(("reduction", make_probe(red_round), red_calls))
+    return probes
+
+
 # -- the p(l) restart driver (shared by every tier) ------------------------
 
 def pl_restart_policy():

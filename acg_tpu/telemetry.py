@@ -95,7 +95,14 @@ from acg_tpu.solvers.stats import PHASE_ORDER
 # "soak" (per-RHS latency/iteration percentiles), and an "nrhs" manifest
 # key that joins the bench-diff case key -- additive, so /1../8
 # consumers keep working
-STATS_SCHEMA = "acg-tpu-stats/9"
+# /10: the communication observatory (acg_tpu.commbench) adds a
+# "calibration" manifest key (the active acg-tpu-commbench/1
+# calibration id, or "uncalibrated") that joins the bench-diff case
+# key, "segments"/"calibration" keys inside the costmodel: stats
+# section (measured SpMV/halo/reduction decomposition), and a
+# "calibration" key on the convergence-log meta line -- additive, so
+# /1../9 consumers keep working
+STATS_SCHEMA = "acg-tpu-stats/10"
 CONVERGENCE_SCHEMA = "acg-tpu-convergence/1"
 # default ring capacity (--telemetry-window): 512 iterations x 4 scalars
 # is 8 KiB of f32 carry -- negligible against any solve's vectors, and
@@ -223,6 +230,9 @@ class ConvergenceTrace:
     wrapped: bool
     solver: str = "cg"
     fields: tuple = TRACE_FIELDS
+    # extra meta-line keys (additive; e.g. the active commbench
+    # calibration id the CLI stamps on the JSONL meta record)
+    meta_extra: dict = dataclasses.field(default_factory=dict)
 
     @classmethod
     def from_ring(cls, buf, niterations: int, solver: str = "cg",
@@ -272,6 +282,7 @@ class ConvergenceTrace:
             "first_iteration": self.first_iteration,
             "wrapped": self.wrapped,
             "fields": list(self.fields),
+            **dict(self.meta_extra),
             "records": [self.record_dict(i)
                         for i in range(self.iterations.size)],
         }
@@ -342,6 +353,8 @@ class BatchedConvergenceTrace:
     iterations: np.ndarray
     wrapped: bool
     solver: str = "cg-batched"
+    # extra meta-line keys (the ConvergenceTrace convention)
+    meta_extra: dict = dataclasses.field(default_factory=dict)
 
     @classmethod
     def from_ring(cls, buf, niterations: int,
@@ -381,6 +394,7 @@ class BatchedConvergenceTrace:
             "wrapped": self.wrapped,
             "nrhs": self.nrhs,
             "fields": ["rnrm2"],
+            **dict(self.meta_extra),
             "records": [self.record_dict(i)
                         for i in range(self.iterations.size)],
         }

@@ -433,6 +433,30 @@ _KEYWORD_PATTERNS: tuple[tuple[str, re.Pattern], ...] = (
     ("allreduce", re.compile(r"\bpsum\b", re.I)),
     ("halo", re.compile(r"ppermute|halo_exchange", re.I)),
 )
+# collective KIND sub-classification (the commbench observatory's
+# per-kind confrontation: acg_tpu.commbench fits one alpha-beta model
+# per kind, so the capture must report measured seconds per kind too,
+# not one pooled "collective" figure).  First match wins; the fallback
+# maps the coarse class (allreduce -> all_reduce, halo -> all_to_all)
+_COLLECTIVE_KIND_PATTERNS: tuple[tuple[str, re.Pattern], ...] = (
+    # "dma" must match halo_exchange_dma / pallas put kernels but NOT
+    # the plain halo_exchange all_to_all transport program name
+    ("dma", re.compile(r"dma|pallas", re.I)),
+    ("all_to_all", re.compile(r"all[-_.]?to[-_.]?all", re.I)),
+    ("collective_permute", re.compile(
+        r"collective[-_.]?permute|ppermute", re.I)),
+    ("all_reduce", re.compile(
+        r"all[-_.]?reduce|reduce[-_.]?scatter|psum", re.I)),
+)
+
+
+def _collective_kind(name: str, cls: str) -> str:
+    for kind, pat in _COLLECTIVE_KIND_PATTERNS:
+        if pat.search(name):
+            return kind
+    return "all_reduce" if cls == "allreduce" else "all_to_all"
+
+
 _PJIT_RE = re.compile(r"^(?:PjitFunction|jit_?)\(?([^)]*)\)?$")
 _PHASES = ("ingest", "partition", "transfer", "compile", "solve",
            "ckpt", "writeback")
@@ -548,6 +572,8 @@ def analyze_trace(trace_dir) -> dict:
 
     op_s: dict[str, float] = {}
     op_solve_s: dict[str, float] = {}
+    kind_s: dict[str, float] = {}
+    kind_solve_s: dict[str, float] = {}
     phase_s: dict[str, float] = {}
     per_rank: list[dict] = []
     exposed = 0.0
@@ -607,12 +633,23 @@ def analyze_trace(trace_dir) -> dict:
             ts = float(e.get("ts", 0.0)) * 1e-6
             op_s[cls] = op_s.get(cls, 0.0) + dur
             mid = ts + dur / 2.0
-            if any(a <= mid <= b for a, b in solve_iv):
+            in_solve = any(a <= mid <= b for a, b in solve_iv)
+            if in_solve:
                 op_solve_s[cls] = op_solve_s.get(cls, 0.0) + dur
             iv = (ts, ts + dur)
             rank_busy.append(iv)
-            (coll_iv if cls in ("allreduce", "halo")
-             else comp_iv).append(iv)
+            if cls in ("allreduce", "halo"):
+                # per-KIND breakdown (all_reduce / all_to_all /
+                # collective_permute / dma): the row the commbench
+                # alpha-beta fits are confronted with, kind by kind
+                kind = _collective_kind(name, cls)
+                kind_s[kind] = kind_s.get(kind, 0.0) + dur
+                if in_solve:
+                    kind_solve_s[kind] = (kind_solve_s.get(kind, 0.0)
+                                          + dur)
+                coll_iv.append(iv)
+            else:
+                comp_iv.append(iv)
         if coll_iv:
             exposed += _subtract_seconds(coll_iv, comp_iv)
         rank = os.path.basename(path).split(".")[0]
@@ -637,6 +674,12 @@ def analyze_trace(trace_dir) -> dict:
             "collective_seconds_in_solve": round(
                 op_solve_s.get("allreduce", 0.0)
                 + op_solve_s.get("halo", 0.0), 9),
+            "collective_kind_seconds": {k: round(v, 9)
+                                        for k, v in sorted(
+                                            kind_s.items())},
+            "collective_kind_seconds_in_solve": {
+                k: round(v, 9)
+                for k, v in sorted(kind_solve_s.items())},
             "exposed_collective_seconds": round(exposed, 9),
             "overlap_efficiency": (round(overlap_eff, 6)
                                    if overlap_eff is not None else None),
@@ -697,6 +740,9 @@ def attach(stats, analysis: dict | None,
                 "exposed_collective_seconds":
                     analysis.get("exposed_collective_seconds", 0.0),
             })
+            if analysis.get("collective_kind_seconds"):
+                sec["collective_kind_seconds"] = dict(
+                    analysis["collective_kind_seconds"])
             if analysis.get("overlap_efficiency") is not None:
                 sec["overlap_efficiency"] = \
                     analysis["overlap_efficiency"]
@@ -766,6 +812,11 @@ def format_analysis(analysis: dict) -> list[str]:
     else:
         lines.append("  (no per-op device events in this capture -- "
                      "CPU backends emit whole-program dispatches only)")
+    kinds = analysis.get("collective_kind_seconds") or {}
+    if kinds:
+        lines.append("  collectives by kind: "
+                     + ", ".join(f"{k} {v:.6f}s"
+                                 for k, v in kinds.items()))
     coll = analysis.get("collective_seconds", 0.0)
     eff = analysis.get("overlap_efficiency")
     if eff is not None:

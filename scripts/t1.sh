@@ -7,7 +7,7 @@
 # T1_SOAK=1 additionally runs the service-soak smoke after the tests: a
 # tiny 3-solve --soak run whose --metrics-file must validate as
 # Prometheus exposition format and whose --stats-json must carry the
-# acg-tpu-stats/9 soak section (the CI soak-smoke step runs the same
+# acg-tpu-stats/10 soak section (the CI soak-smoke step runs the same
 # thing).  T1_HEALTH=1 runs the numerical-health smoke: an audited
 # pipelined solve on the anisotropic generator must leave a health:
 # section with a finite gap, the acg_health_* metric families, and a
@@ -35,6 +35,12 @@
 # every column, leave a /9 stats document with the per-RHS batch:
 # section, a status document whose solve.batch block names the
 # slowest RHS, and one history ledger row carrying the batch section.
+# T1_COMMBENCH=1 runs the communication-observatory smoke: an 8-part
+# --commbench sweep must emit a valid acg-tpu-commbench/1 document
+# (fitted alpha-beta per collective kind, per-edge DMA rows, measured
+# segments) and a calibrated --explain must print provenance with a
+# predicted-vs-measured ratio strictly closer to 1.0 than the
+# uncalibrated model's.
 set -o pipefail
 cd "$(dirname "$0")/.."
 rm -f /tmp/_t1.log
@@ -57,7 +63,7 @@ if [ "${T1_SOAK:-0}" = "1" ]; then
     python - <<'PY' || rc=$((rc ? rc : 1))
 import json
 doc = json.load(open("/tmp/_t1_soak.json"))
-assert doc["schema"] == "acg-tpu-stats/9", doc["schema"]
+assert doc["schema"] == "acg-tpu-stats/10", doc["schema"]
 soak = doc["stats"]["soak"]
 assert soak["nsolves"] == 3 and soak["latency"]["p50"] is not None, soak
 assert "metrics" in doc, "registry snapshot missing from /3 document"
@@ -79,7 +85,7 @@ if [ "${T1_PRECOND:-0}" = "1" ]; then
         env PC="$pc" python - <<'PY' || rc=$((rc ? rc : 1))
 import json, os
 doc = json.load(open("/tmp/_t1_precond.json"))
-assert doc["schema"] == "acg-tpu-stats/9", doc["schema"]
+assert doc["schema"] == "acg-tpu-stats/10", doc["schema"]
 st = doc["stats"]
 assert st["converged"] is True, st["rnrm2"]
 assert st["precond"]["kind"] == os.environ["PC"], st["precond"]
@@ -115,7 +121,7 @@ if [ "${T1_HEALTH:-0}" = "1" ]; then
     python - <<'PY' || rc=$((rc ? rc : 1))
 import json, math
 doc = json.load(open("/tmp/_t1_health.json"))
-assert doc["schema"] == "acg-tpu-stats/9", doc["schema"]
+assert doc["schema"] == "acg-tpu-stats/10", doc["schema"]
 h = doc["stats"]["health"]
 assert h["naudits"] > 0, h
 assert h["gap_last"] is not None and math.isfinite(h["gap_last"]), h
@@ -154,7 +160,7 @@ if [ "${T1_CKPT:-0}" = "1" ]; then
     python - <<'PY' || rc=$((rc ? rc : 1))
 import json
 doc = json.load(open("/tmp/_t1_ckpt.json"))
-assert doc["schema"] == "acg-tpu-stats/9", doc["schema"]
+assert doc["schema"] == "acg-tpu-stats/10", doc["schema"]
 st = doc["stats"]
 assert st["converged"] is True, st["rnrm2"]
 ck = st["ckpt"]
@@ -193,7 +199,7 @@ if [ "${T1_TRACE:-0}" = "1" ]; then
     python - <<'PY' || rc=$((rc ? rc : 1))
 import json
 doc = json.load(open("/tmp/_t1_trace.json"))
-assert doc["schema"] == "acg-tpu-stats/9", doc["schema"]
+assert doc["schema"] == "acg-tpu-stats/10", doc["schema"]
 tr = doc["stats"]["tracing"]
 tl = tr["timeline"]
 assert tl["nparts"] == 8 and tl["nspans"] > 0, tl
@@ -242,7 +248,7 @@ assert len(ledgers) == 1, ledgers
 row = json.loads(open(f"/tmp/_t1_history/{ledgers[0]}").readline())
 assert row["ledger"] == "acg-tpu-history/1", row["ledger"]
 assert row["nparts"] == 8 and row["converged"] is True, row
-assert row["doc"]["schema"] == "acg-tpu-stats/9", row["doc"]["schema"]
+assert row["doc"]["schema"] == "acg-tpu-stats/10", row["doc"]["schema"]
 sj = json.load(open("/tmp/_t1_status_stats.json"))
 assert sj["stats"]["slo"]["targets"]["iters"] == 280, sj["stats"]["slo"]
 print(f"T1_STATUS: OK (iteration {doc['solve']['iteration']}, "
@@ -328,7 +334,7 @@ if [ "${T1_BATCH:-0}" = "1" ]; then
     python - <<'PY' || rc=$((rc ? rc : 1))
 import json, os
 doc = json.load(open("/tmp/_t1_batch.json"))
-assert doc["schema"] == "acg-tpu-stats/9", doc["schema"]
+assert doc["schema"] == "acg-tpu-stats/10", doc["schema"]
 batch = doc["stats"]["batch"]
 assert batch["nrhs"] == 4 and len(batch["iterations"]) == 4, batch
 assert all(batch["converged"]) and batch["unconverged"] == 0, batch
@@ -433,6 +439,55 @@ assert ov["interior_rows"] > 0 and ov["border_rows"] > 0, ov
 print(f"T1_FUSED: OK (converged, pins (5,2)/dma-0-a2a hold, "
       f"{ov['interior_rows']} interior / {ov['border_rows']} border "
       f"rows)")
+PY
+fi
+if [ "${T1_COMMBENCH:-0}" = "1" ]; then
+    # communication-observatory smoke (the ISSUE-14 acceptance in
+    # miniature): an 8-part --commbench sweep must emit an
+    # acg-tpu-commbench/1 document that round-trips the validator
+    # (fitted alpha-beta per collective kind, per-edge DMA rows,
+    # measured segment decomposition), and a calibrated --explain on
+    # the same case must print calibration provenance and land its
+    # predicted-vs-measured ratio strictly closer to 1.0 than the
+    # uncalibrated model's
+    echo "T1_COMMBENCH: 8-part commbench + calibrated explain smoke"
+    rm -f /tmp/_t1_cb.json /tmp/_t1_cb_explain.jsonl
+    timeout -k 10 300 env JAX_PLATFORMS=cpu \
+        XLA_FLAGS="--xla_force_host_platform_device_count=8" \
+        python -m acg_tpu.cli gen:poisson2d:16 --nparts 8 \
+        --dtype f32 --max-iterations 20 --warmup 0 --quiet \
+        --commbench /tmp/_t1_cb.json || rc=$((rc ? rc : 1))
+    timeout -k 10 300 env JAX_PLATFORMS=cpu \
+        XLA_FLAGS="--xla_force_host_platform_device_count=8" \
+        python -m acg_tpu.cli gen:poisson2d:16 --nparts 8 \
+        --dtype f32 --max-iterations 20 --warmup 0 --quiet \
+        --explain --calibration /tmp/_t1_cb.json \
+        --stats-json /tmp/_t1_cb_explain.jsonl \
+        2> /tmp/_t1_cb_explain.err || rc=$((rc ? rc : 1))
+    python - <<'PY' || rc=$((rc ? rc : 1))
+import json, math
+from acg_tpu.commbench import validate_commbench
+doc = json.load(open("/tmp/_t1_cb.json"))
+assert validate_commbench(doc) == [], validate_commbench(doc)
+for kind in ("all_reduce", "all_to_all", "collective_permute", "dma"):
+    assert "alpha_s" in doc["collectives"][kind], kind
+assert [e["distance"] for e in doc["edges"]] == [1, 2, 3, 4]
+assert doc["segments"]["available"] is True, doc["segments"]
+err = open("/tmp/_t1_cb_explain.err").read()
+assert "== explain: calibration ==" in err
+assert doc["calibration_id"] in err
+docs = [json.loads(ln) for ln in
+        open("/tmp/_t1_cb_explain.jsonl") if ln.strip()]
+dist = [d for d in docs if "dist-cg" in d["manifest"]["metric"]]
+assert dist and dist[0]["manifest"]["calibration"] \
+    == doc["calibration_id"]
+row = dist[0]["manifest"]["explain"]
+ratio = row["predicted_s_per_iter"] / row["measured_s_per_iter"]
+uncal = (row["uncalibrated_predicted_s_per_iter"]
+         / row["measured_s_per_iter"])
+assert abs(math.log(ratio)) < abs(math.log(uncal)), (ratio, uncal)
+print(f"T1_COMMBENCH: OK (id {doc['calibration_id']}, calibrated "
+      f"ratio {ratio:.2f}x vs uncalibrated {uncal:.2f}x)")
 PY
 fi
 exit $rc

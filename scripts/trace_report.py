@@ -41,6 +41,10 @@ def report_capture(path: str) -> int:
         print(line)
     if not an.get("available"):
         return 1
+    kinds = an.get("collective_kind_seconds_in_solve") or {}
+    if kinds:
+        print("  collectives by kind (solve windows): "
+              + ", ".join(f"{k} {v:.6f}s" for k, v in kinds.items()))
     per_rank = an.get("per_rank", [])
     if len(per_rank) > 1:
         print("  per-rank phase seconds:")

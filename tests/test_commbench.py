@@ -1,0 +1,505 @@
+"""Communication observatory (acg_tpu.commbench): the alpha-beta fit,
+the 8-part mesh collective sweeps, per-edge one-sided DMA timing in
+interpret mode, measured segment decomposition, document validation +
+bench_diff keying, disarmed byte-identity pins, and the CLI
+``--commbench`` / ``--calibration`` acceptance path."""
+
+import gzip
+import json
+import math
+import os
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+from acg_tpu import commbench as cb
+
+_ENV = {"JAX_PLATFORMS": "cpu",
+        "XLA_FLAGS": "--xla_force_host_platform_device_count=8"}
+
+
+def _mesh(nparts=8):
+    from acg_tpu.parallel.mesh import solve_mesh
+    return solve_mesh(nparts)
+
+
+def _dist_solver(side=16, nparts=8, pipelined=False, comm="xla"):
+    from acg_tpu.io.generators import poisson2d_coo
+    from acg_tpu.matrix import SymCsrMatrix
+    from acg_tpu.parallel.dist import DistCGSolver, DistributedProblem
+    from acg_tpu.partition import partition_rows
+
+    r, c, v, n = poisson2d_coo(side)
+    csr = SymCsrMatrix.from_coo(n, r, c, v).to_csr()
+    part = partition_rows(csr, nparts, seed=42, method="band")
+    prob = DistributedProblem.build(csr, part, nparts)
+    return DistCGSolver(prob, pipelined=pipelined, comm=comm), csr
+
+
+# -- the alpha-beta fit --------------------------------------------------
+
+def test_fit_recovers_known_alpha_beta():
+    """Synthetic timings t = alpha + beta*bytes (+ 2% noise) recover
+    alpha and beta within a band."""
+    alpha, beta = 5e-5, 2e-9
+    rng = np.random.default_rng(7)
+    pts = []
+    for b in (64, 1024, 16384, 262144, 4194304):
+        t = (alpha + beta * b) * (1.0 + 0.02 * rng.standard_normal())
+        pts.append((b, t))
+    fit = cb.fit_alpha_beta(pts)
+    assert fit["npoints"] == 5
+    assert abs(fit["alpha_s"] - alpha) / alpha < 0.25
+    assert abs(fit["beta_s_per_byte"] - beta) / beta < 0.25
+    assert fit["r2"] > 0.99
+    assert cb.predict_seconds(fit, 0) == pytest.approx(fit["alpha_s"])
+
+
+def test_fit_clamps_nonnegative_and_degrades():
+    # decreasing times (noise): beta clamps to 0, alpha = mean
+    fit = cb.fit_alpha_beta([(64, 3e-5), (65536, 1e-5)])
+    assert fit["beta_s_per_byte"] == 0.0
+    assert fit["alpha_s"] == pytest.approx(2e-5)
+    # nothing usable
+    assert cb.fit_alpha_beta([]) is None
+    assert cb.fit_alpha_beta([(64, -1.0)]) is None
+    # one point: pure-bandwidth attribution
+    one = cb.fit_alpha_beta([(1024, 1e-6)])
+    assert one["alpha_s"] == 0.0
+    assert one["beta_s_per_byte"] == pytest.approx(1e-6 / 1024)
+    assert cb.predict_seconds(None, 10) is None
+
+
+# -- mesh microbenchmarks ------------------------------------------------
+
+def test_collective_sweep_on_8part_mesh():
+    """The message-size sweep runs every XLA collective kind over the
+    8-part CPU mesh and yields usable nonnegative fits with per-point
+    provenance."""
+    colls = cb.bench_collectives(_mesh(), (256, 8192), reps=6,
+                                 repeats=2)
+    for kind in ("all_reduce", "all_to_all", "collective_permute"):
+        entry = colls[kind]
+        assert entry["alpha_s"] >= 0.0, kind
+        assert entry["beta_s_per_byte"] >= 0.0, kind
+        assert len(entry["points"]) == 2
+        for p in entry["points"]:
+            assert p["seconds"] > 0 and p["bytes"] > 0
+    # the all_to_all plane realises the requested per-shard payload
+    assert colls["all_to_all"]["points"][1]["bytes"] == 8192
+
+
+def test_dma_per_edge_timing_interpret_mode():
+    """Per-edge put/wait rows by ring distance on the 8-part interpret
+    mesh: one row per distance 1..4, positive seconds, and the
+    antipodal distance has a single peer per shard."""
+    rows = cb.bench_dma_edges(_mesh(), 2048, reps=6, repeats=2)
+    assert [r["distance"] for r in rows] == [1, 2, 3, 4]
+    for r in rows:
+        assert r["put_wait_seconds"] > 0
+        assert r["window_bytes"] == 2048
+    assert rows[-1]["peers_per_shard"] == 1
+    assert all(r["peers_per_shard"] == 2 for r in rows[:-1])
+    # the dense sweep fits too
+    dense = cb.bench_dma(_mesh(), (512, 4096), reps=6, repeats=2)
+    assert dense["alpha_s"] >= 0 and len(dense["points"]) == 2
+
+
+# -- segment decomposition ----------------------------------------------
+
+def test_segment_decomposition_sums_to_measured_band():
+    """The measured SpMV/halo/reduction split approximates the measured
+    s/iter of the same dist solve: every segment positive, halo
+    strictly inside the SpMV segment's scope, and explained/measured
+    within a CI-noise-tolerant band."""
+    from acg_tpu.solvers.stats import StoppingCriteria
+
+    solver, _csr = _dist_solver()
+    b = np.ones(solver.problem.n)
+    segs = cb.segment_decomposition(solver, b, reps=12, repeats=3)
+    assert segs["available"], segs
+    names = set(segs["segments"])
+    assert names == {"spmv", "halo", "reduction"}
+    for seg in segs["segments"].values():
+        assert seg["s_per_iteration"] > 0
+    # classic CG: two reductions, one halo'd SpMV per iteration
+    assert segs["segments"]["reduction"]["calls_per_iteration"] == 2.0
+    assert segs["segments"]["spmv"]["calls_per_iteration"] == 1.0
+    K = 25
+    best = math.inf
+    for _ in range(3):
+        solver.stats.tsolve = 0.0
+        solver.solve(b, criteria=StoppingCriteria(maxits=K), warmup=1,
+                     host_result=False, raise_on_divergence=False)
+        best = min(best, solver.stats.tsolve / K)
+    ratio = segs["explained_s_per_iteration"] / best
+    assert 0.15 <= ratio <= 3.5, (segs, best)
+
+
+def test_pipelined_reduction_probe_is_fused():
+    """The pipelined tier's reduction probe reproduces the ONE fused
+    2-scalar ladder (calls/iter = 1), not two classic pdots."""
+    solver, _ = _dist_solver(pipelined=True)
+    segs = cb.segment_decomposition(solver, np.ones(solver.problem.n),
+                                    reps=6, repeats=2)
+    assert segs["available"], segs
+    assert segs["segments"]["reduction"]["calls_per_iteration"] == 1.0
+
+
+def test_probes_leave_solve_programs_byte_identical():
+    """The disarmed pin: building + running segment probes and the
+    collective microbenchmarks must leave every dispatched solve
+    program byte-identical (StableHLO), dist AND single-chip."""
+    import jax.numpy as jnp
+    from acg_tpu.ops.spmv import device_matrix_from_csr
+    from acg_tpu.solvers.jax_cg import JaxCGSolver
+
+    solver, csr = _dist_solver()
+    b = np.ones(solver.problem.n)
+    before = solver.lower_solve(b).as_text()
+    A = device_matrix_from_csr(csr, dtype=jnp.float64, format="auto")
+    s1 = JaxCGSolver(A, kernels="xla")
+    b1 = jnp.asarray(b, s1._solve_dtype())
+    before1 = s1.lower_solve(b1).as_text()
+    assert cb.segment_decomposition(solver, b, reps=4,
+                                    repeats=1)["available"]
+    assert cb.segment_decomposition(s1, b1, reps=4,
+                                    repeats=1)["available"]
+    cb.bench_collectives(_mesh(), (256,), reps=2, repeats=1)
+    cb.bench_dma_edges(_mesh(), 256, reps=2, repeats=1)
+    assert solver.lower_solve(b).as_text() == before
+    assert s1.lower_solve(b1).as_text() == before1
+
+
+# -- document validation + calibrated pricing ----------------------------
+
+def _minimal_doc(**over):
+    doc = {"schema": cb.COMMBENCH_SCHEMA, "nparts": 8,
+           "collectives": {
+               "all_reduce": {"alpha_s": 1e-5, "beta_s_per_byte": 0.0,
+                              "npoints": 1, "r2": None,
+                              "points": [{"bytes": 8,
+                                          "seconds": 1e-5}]},
+               "all_to_all": {"alpha_s": 2e-5,
+                              "beta_s_per_byte": 1e-9,
+                              "npoints": 1, "r2": None,
+                              "points": [{"bytes": 1024,
+                                          "seconds": 2.1e-5}]}}}
+    doc.update(over)
+    doc["calibration_id"] = cb.calibration_id(doc)
+    return doc
+
+
+def test_validator_roundtrip_and_tamper_detection(tmp_path):
+    doc = _minimal_doc()
+    assert cb.validate_commbench(doc) == []
+    p = tmp_path / "cal.json"
+    p.write_text(json.dumps(doc))
+    assert cb.load_calibration(p)["calibration_id"] == \
+        doc["calibration_id"]
+    # tamper: content no longer matches the id
+    tampered = dict(doc, nparts=4)
+    assert any("calibration_id" in w
+               for w in cb.validate_commbench(tampered))
+    # wrong schema / not json
+    assert cb.validate_commbench({"schema": "nope"})
+    (tmp_path / "garbage.json").write_text("{torn")
+    with pytest.raises(ValueError):
+        cb.load_calibration(tmp_path / "garbage.json")
+    # malformed VALUES become named problems, never exceptions --
+    # rejecting such docs gracefully is the validator's whole job
+    mangled = _minimal_doc()
+    mangled["collectives"]["all_reduce"]["points"] = [
+        {"bytes": "oops", "seconds": 1}]
+    mangled["calibration_id"] = cb.calibration_id(mangled)
+    assert any("bad point" in w for w in cb.validate_commbench(mangled))
+    for bad_alpha in ("abc", None, True):
+        m2 = _minimal_doc()
+        m2["collectives"]["all_reduce"]["alpha_s"] = bad_alpha
+        m2["calibration_id"] = cb.calibration_id(m2)
+        assert any("alpha/beta" in w
+                   for w in cb.validate_commbench(m2)), bad_alpha
+    m3 = _minimal_doc(edges=[{"distance": "x"}])
+    assert any("edge" in w for w in cb.validate_commbench(m3))
+
+
+def test_calibrated_comm_pricing_math():
+    cal = _minimal_doc()
+    led = {"transport": "xla", "nparts": 8,
+           "allreduce_per_iteration": 2, "allreduce_scalars": 1,
+           "allreduce_bytes_per_iteration": 16,
+           "halo_bytes_per_iteration": 800,
+           "halo_exchanges_per_iteration": 1,
+           "halo_plane_bytes_per_exchange": 1000}
+    cs = cb.comm_seconds(cal, led)
+    assert cs["allreduce_s"] == pytest.approx(2 * 1e-5)
+    assert cs["halo_s"] == pytest.approx(2e-5 + 1e-9 * 1000)
+    assert cs["halo_kind"] == "all_to_all"
+    assert cs["calibration_id"] == cal["calibration_id"]
+    # the dma transport falls back to the all_to_all fit when no dma
+    # kind was benchmarked -- and the reported kind names the fit
+    # actually used, not the transport's wish
+    led_dma = dict(led, transport="dma")
+    assert cb.comm_seconds(cal, led_dma)["halo_kind"] == "all_to_all"
+    with_dma = _minimal_doc(collectives={
+        **cal["collectives"],
+        "dma": {"alpha_s": 4e-5, "beta_s_per_byte": 2e-9,
+                "npoints": 1, "r2": None,
+                "points": [{"bytes": 512, "seconds": 4.1e-5}]}})
+    cs_dma = cb.comm_seconds(with_dma, led_dma)
+    assert cs_dma["halo_kind"] == "dma"
+    assert cs_dma["halo_s"] == pytest.approx(4e-5 + 2e-9 * 1000)
+    # errored/absent ledgers refuse
+    assert cb.comm_seconds(cal, {"error": "x"}) is None
+
+
+def test_ledger_carries_plane_bytes_and_ring_distances():
+    """The dist comm ledger declares the padded plane bytes the
+    transport actually moves and the ring distances its edges span --
+    the keys calibrated pricing and the per-edge rows match on."""
+    solver, _ = _dist_solver()
+    led = solver.comm_profile()
+    maxcnt = solver.problem.halo.maxcnt
+    dbl = np.dtype(solver.problem.vdtype).itemsize
+    assert led["halo_plane_bytes_per_exchange"] == 8 * maxcnt * dbl
+    assert led["ring_distances"] == [1]
+    sd = cb.halo_exchange_seconds(_minimal_doc(), led)
+    assert sd == pytest.approx(
+        2e-5 + 1e-9 * led["halo_plane_bytes_per_exchange"])
+
+
+def test_bench_diff_keys_calibrations_apart(tmp_path):
+    """Differently-calibrated captures become distinct, not-silently-
+    comparable cases; uncalibrated captures keep their old keys."""
+    from acg_tpu import perfmodel
+
+    def doc(cal, val):
+        return {"schema": "acg-tpu-stats/10",
+                "manifest": {"metric": "m1", "calibration": cal},
+                "stats": {"tsolve": 1.0, "niterations": val}}
+
+    a = tmp_path / "a.jsonl"
+    a.write_text(json.dumps(doc("cb-cpu-8p-aaaa", 100)) + "\n")
+    b = tmp_path / "b.jsonl"
+    b.write_text(json.dumps(doc("cb-cpu-8p-bbbb", 50)) + "\n")
+    u = tmp_path / "u.jsonl"
+    u.write_text(json.dumps(doc("uncalibrated", 75)) + "\n")
+    ca, cbb, cu = (perfmodel.load_cases(p) for p in (a, b, u))
+    assert list(ca) == ["m1|cal=cb-cpu-8p-aaaa"]
+    assert list(cbb) == ["m1|cal=cb-cpu-8p-bbbb"]
+    assert list(cu) == ["m1"]  # the sentinel adds nothing
+    lines, nreg, ncmp = perfmodel.compare_cases(ca, cbb, 10.0)
+    assert ncmp == 0 and nreg == 0  # keyed apart, never gated
+    # bench rows key the same way
+    key, _ = perfmodel._row_case({"metric": "m1", "value": 1.0,
+                                  "calibration": "cb-x-2p-cc"})
+    assert key == "m1|cal=cb-x-2p-cc"
+
+
+# -- the probe-cache sidecar ---------------------------------------------
+
+def test_triad_probe_cache_sidecar(tmp_path, monkeypatch):
+    """Backend-keyed on-disk cache: the second call reads the sidecar,
+    use_cache=False and refresh=True re-measure (refresh still updates
+    the sidecar)."""
+    from acg_tpu import perfmodel
+
+    calls = {"n": 0}
+
+    def fake_probe(nelems, **kw):
+        calls["n"] += 1
+        return 123.0 + calls["n"]
+
+    monkeypatch.setattr(perfmodel, "triad_probe_gbs", fake_probe)
+    monkeypatch.setenv("ACG_TPU_PROBE_CACHE",
+                       str(tmp_path / "probe.json"))
+    bw1 = perfmodel.cached_triad_probe_gbs(999)
+    assert bw1 == 124.0 and calls["n"] == 1
+    assert perfmodel.cached_triad_probe_gbs(999) == 124.0
+    assert calls["n"] == 1  # sidecar hit, no re-probe
+    cache = json.loads((tmp_path / "probe.json").read_text())
+    (key,) = cache.keys()
+    assert key.endswith(":n999") and cache[key]["gbs"] == 124.0
+    # a different size is a different key
+    perfmodel.cached_triad_probe_gbs(1000)
+    assert calls["n"] == 2
+    # --no-probe-cache: re-measure (3rd probe call), sidecar untouched
+    assert perfmodel.cached_triad_probe_gbs(999,
+                                            use_cache=False) == 126.0
+    assert json.loads((tmp_path
+                       / "probe.json").read_text())[key]["gbs"] == 124.0
+    # refresh: re-measure (4th call) AND update the sidecar
+    assert perfmodel.cached_triad_probe_gbs(999, refresh=True) == 127.0
+    assert json.loads((tmp_path
+                       / "probe.json").read_text())[key]["gbs"] == 127.0
+
+
+# -- tracing per-kind breakdown ------------------------------------------
+
+def test_trace_analysis_breaks_collectives_out_by_kind(tmp_path):
+    """analyze_trace reports per-kind collective seconds (all_reduce /
+    all_to_all / collective_permute) instead of one pooled figure --
+    the row the commbench fit is confronted with."""
+    from acg_tpu import tracing
+
+    us = 1e6
+    events = [
+        {"ph": "X", "pid": 1, "tid": 1, "name": "solve",
+         "ts": 0.0, "dur": 10.0 * us},
+        {"ph": "X", "pid": 1, "tid": 3, "name": "all-reduce.1",
+         "ts": 1.0 * us, "dur": 2.0 * us},
+        {"ph": "X", "pid": 1, "tid": 3, "name": "all-to-all.4",
+         "ts": 4.0 * us, "dur": 1.0 * us},
+        {"ph": "X", "pid": 1, "tid": 3, "name": "collective-permute.2",
+         "ts": 6.0 * us, "dur": 0.5 * us},
+    ]
+    d = tmp_path / "plugins" / "profile" / "r"
+    d.mkdir(parents=True)
+    with gzip.open(d / "vm.trace.json.gz", "wt") as f:
+        json.dump({"displayTimeUnit": "ns", "metadata": {},
+                   "traceEvents": events}, f)
+    an = tracing.analyze_trace(tmp_path)
+    assert an["available"]
+    kinds = an["collective_kind_seconds"]
+    assert kinds["all_reduce"] == pytest.approx(2.0)
+    assert kinds["all_to_all"] == pytest.approx(1.0)
+    assert kinds["collective_permute"] == pytest.approx(0.5)
+    assert an["collective_kind_seconds_in_solve"]["all_reduce"] == \
+        pytest.approx(2.0)
+    assert sum(kinds.values()) == pytest.approx(
+        an["collective_seconds"])
+    assert any("collectives by kind" in ln
+               for ln in tracing.format_analysis(an))
+
+
+# -- CLI: --commbench / --calibration ------------------------------------
+
+def _run_cli(argv, timeout=600):
+    env = dict(os.environ)
+    env.update(_ENV)
+    return subprocess.run([sys.executable, "-m", "acg_tpu.cli"] + argv,
+                          capture_output=True, text=True, env=env,
+                          timeout=timeout)
+
+
+@pytest.fixture(scope="module")
+def commbench_doc(tmp_path_factory):
+    """One subprocess --commbench run shared by the CLI tests."""
+    out = tmp_path_factory.mktemp("cb") / "cal.json"
+    r = _run_cli(["gen:poisson2d:16", "--commbench", str(out),
+                  "--nparts", "8", "--dtype", "f32",
+                  "--max-iterations", "20", "--warmup", "0", "-q"])
+    assert r.returncode == 0, r.stderr
+    assert "calibration id: cb-cpu-8p-" in r.stderr
+    return out
+
+
+def test_cli_commbench_document_validates(commbench_doc):
+    doc = json.loads(commbench_doc.read_text())
+    assert cb.validate_commbench(doc) == []
+    assert doc["schema"] == cb.COMMBENCH_SCHEMA
+    for kind in ("all_reduce", "all_to_all", "collective_permute",
+                 "dma"):
+        assert "alpha_s" in doc["collectives"][kind], kind
+    assert [e["distance"] for e in doc["edges"]] == [1, 2, 3, 4]
+    assert doc["segments"]["available"] is True
+    assert doc["case"]["measured_s_per_iteration"] > 0
+
+
+def test_cli_calibrated_explain_beats_uncalibrated(commbench_doc,
+                                                   tmp_path):
+    """THE acceptance criterion: on the 8-part CPU mesh,
+    ``--explain --calibration <doc>`` reports a predicted-vs-measured
+    s/iter ratio strictly closer to 1.0 than the uncalibrated verdict
+    on the same case, with calibration provenance printed and recorded
+    in the stats manifest."""
+    sj = tmp_path / "explain.jsonl"
+    r = _run_cli(["gen:poisson2d:16", "--explain", "--calibration",
+                  str(commbench_doc), "--nparts", "8", "--dtype",
+                  "f32", "--max-iterations", "20", "--warmup", "0",
+                  "--stats-json", str(sj), "-q"])
+    assert r.returncode == 0, r.stderr
+    cal_id = json.loads(commbench_doc.read_text())["calibration_id"]
+    assert "== explain: calibration ==" in r.stderr
+    assert cal_id in r.stderr
+    docs = [json.loads(ln) for ln in sj.read_text().splitlines()
+            if ln.strip()]
+    dist = [d for d in docs
+            if "dist-cg" in d["manifest"]["metric"]]
+    assert dist, [d["manifest"]["metric"] for d in docs]
+    row = dist[0]["manifest"]["explain"]
+    meas = row["measured_s_per_iter"]
+    ratio = row["predicted_s_per_iter"] / meas
+    ratio_uncal = row["uncalibrated_predicted_s_per_iter"] / meas
+    assert abs(math.log(ratio)) < abs(math.log(ratio_uncal)), row
+    assert row["calibration"] == cal_id
+    assert dist[0]["manifest"]["calibration"] == cal_id
+    assert row["segments"]["available"] is True
+    assert "segments" in dist[0]["stats"]["costmodel"]
+    assert dist[0]["stats"]["costmodel"]["calibration"] == cal_id
+
+
+def test_cli_solve_records_calibration_provenance(commbench_doc,
+                                                  tmp_path):
+    """A NORMAL solve under --calibration stamps the id on the stats
+    manifest and the convergence-log meta line; without one both say
+    'uncalibrated'."""
+    sj = tmp_path / "solve.jsonl"
+    cl = tmp_path / "conv.jsonl"
+    r = _run_cli(["gen:poisson2d:16", "--comm", "none",
+                  "--max-iterations", "100", "--residual-rtol", "1e-8",
+                  "--warmup", "0", "-q", "--calibration",
+                  str(commbench_doc), "--stats-json", str(sj),
+                  "--convergence-log", str(cl)])
+    assert r.returncode == 0, r.stderr
+    cal_id = json.loads(commbench_doc.read_text())["calibration_id"]
+    doc = json.loads(sj.read_text())
+    assert doc["schema"] == "acg-tpu-stats/10"
+    assert doc["manifest"]["calibration"] == cal_id
+    meta = json.loads(cl.read_text().splitlines()[0])
+    assert meta["meta"] is True and meta["calibration"] == cal_id
+    # uncalibrated twin
+    r2 = _run_cli(["gen:poisson2d:16", "--comm", "none",
+                   "--max-iterations", "100", "--residual-rtol",
+                   "1e-8", "--warmup", "0", "-q", "--stats-json",
+                   str(tmp_path / "u.jsonl"), "--convergence-log",
+                   str(tmp_path / "uc.jsonl")])
+    assert r2.returncode == 0, r2.stderr
+    u = json.loads((tmp_path / "u.jsonl").read_text())
+    assert u["manifest"]["calibration"] == "uncalibrated"
+    umeta = json.loads((tmp_path
+                        / "uc.jsonl").read_text().splitlines()[0])
+    assert umeta["calibration"] == "uncalibrated"
+
+
+def test_cli_commbench_and_calibration_refusals(tmp_path):
+    """Validation: two calibration sources refuse, a garbage/missing
+    --calibration file refuses self-describingly, --commbench refuses
+    fault injection and solve-output flags."""
+    r = _run_cli(["gen:poisson2d:8", "--commbench", "--calibration",
+                  "x.json"], timeout=120)
+    assert r.returncode != 0
+    assert "two calibration sources" in r.stderr
+    r = _run_cli(["gen:poisson2d:8", "--explain", "--calibration",
+                  str(tmp_path / "missing.json")], timeout=120)
+    assert r.returncode != 0 and "--calibration" in r.stderr
+    bad = tmp_path / "bad.json"
+    bad.write_text('{"schema": "nope"}')
+    r = _run_cli(["gen:poisson2d:8", "--explain", "--calibration",
+                  str(bad)], timeout=120)
+    assert r.returncode != 0
+    assert "not a valid acg-tpu-commbench/1" in r.stderr
+    r = _run_cli(["gen:poisson2d:8", "--commbench", "--fault-inject",
+                  "spmv:nan@3"], timeout=120)
+    assert r.returncode != 0 and "PRISTINE" in r.stderr
+    r = _run_cli(["gen:poisson2d:8", "--commbench", "--soak", "3"],
+                 timeout=120)
+    assert r.returncode != 0 and "measurement pass" in r.stderr
+    r = _run_cli(["gen:poisson2d:8", "--commbench", "/tmp/x.json",
+                  "--stats-json", "/tmp/s.jsonl"], timeout=120)
+    assert r.returncode != 0 and "--stats-json" in r.stderr
+    r = _run_cli(["gen:poisson2d:8", "--commbench", "--multihost"],
+                 timeout=120)
+    assert r.returncode != 0 and "single-controller" in r.stderr
