@@ -184,6 +184,32 @@ def make_parser() -> argparse.ArgumentParser:
                         "pipelined-PCG on every device tier; 'none' "
                         "compiles byte-identical unpreconditioned "
                         "programs (default)")
+    p.add_argument("--operator", default="none", metavar="SPEC",
+                   help="matrix-free operator tier (acg_tpu.ops."
+                        "operator): solve with A as a jitted APPLY "
+                        "instead of stored planes -- zero matrix HBM "
+                        "traffic per iteration, trajectories bitwise-"
+                        "equal to the assembled-DIA tier on the "
+                        "classic/sstep/jacobi/batched/dist tiers "
+                        "(FMA-reassociation-level on the apply-"
+                        "chaining pipelined/cheby/ABFT setups).  'stencil' "
+                        "derives the built-in stencil from the gen: "
+                        "matrix spec (+ --aniso for the variable-"
+                        "coefficient family); "
+                        "stencil:poisson1d|poisson2d|poisson3d:N and "
+                        "stencil:aniso2d:N:EPS name it explicitly "
+                        "(validated against the matrix being solved); "
+                        "user:NAME runs an operator registered via "
+                        "register_operator (in-process callers).  "
+                        "Rides every device tier -- classic/pipelined, "
+                        "sstep:S / pipelined:L, --nrhs (single device), "
+                        "--precond jacobi (analytic diagonal) / "
+                        "cheby:K, --abft (checksum through the apply), "
+                        "and the --nparts mesh incl. --kernels fused "
+                        "(interior/border split applied to the stencil "
+                        "apply) and --comm dma.  'none' (default) "
+                        "leaves every dispatched program byte-"
+                        "identical to the assembled build")
     p.add_argument("--aniso", type=float, default=None, metavar="EPS",
                    help="with gen:poisson2d:N: generate the ANISOTROPIC "
                         "(stretched-grid) Poisson family instead -- "
@@ -734,6 +760,23 @@ def _buildinfo(out) -> int:
          "equal to the unsplit tier; comm ledger declares the overlap "
          "model the --explain verdict prices (exposed halo = max(0, "
          "halo - interior SpMV)); bench.py --overlap measures it"),
+        ("matrix-free operators", "--operator stencil | "
+         "stencil:poisson1d|2d|3d:N | stencil:aniso2d:N:EPS | "
+         "user:NAME (acg_tpu.ops.operator): A as a jitted apply -- "
+         "plane values GENERATED inside the SpMV, zero matrix HBM "
+         "traffic, trajectories bitwise-equal to the assembled DIA "
+         "tier on classic/sstep/jacobi/batched/dist (FMA-level on "
+         "the apply-chaining pipelined/cheby/ABFT setups); rides classic/pipelined, sstep:S / pipelined:L, "
+         "--nrhs (single device), --precond jacobi (analytic "
+         "diagonal)/cheby:K, --abft (checksum c = A^T 1 through the "
+         "apply), and the --nparts mesh (generated local planes "
+         "behind the existing halo/ghost machinery; --kernels fused "
+         "splits the stencil apply interior|border, --comm dma "
+         "rides unchanged); register_operator hooks user-supplied "
+         "jitted operators (diagonal_fn arms jacobi); in-kernel "
+         "Pallas stencil path under --kernels pallas; operator "
+         "identity rides the stats manifest + bench_diff case key; "
+         "bench.py --matfree measures matrix-free vs assembled"),
         ("perf observability", f"--explain (compiled cost_analysis/"
          f"memory_analysis introspection, comm ledger, roofline "
          f"verdict); 'costmodel'/'memory' keys in the {STATS_SCHEMA} "
@@ -930,6 +973,22 @@ def synthesize_host_matrix(spec_str: str, aniso=None, seed: int = 42):
     return SymCsrMatrix.from_coo(N, r, c, v)
 
 
+def _build_cli_operator(args, n: int, dtype):
+    """Instantiate the armed ``--operator`` for this solve (device
+    dtype resolved), validated against the matrix being solved; records
+    the identity string for the stats manifest / bench case key."""
+    from acg_tpu.ops.operator import build_operator
+
+    gen = _parse_gen_spec(args.A) if args.A.startswith("gen:") else None
+    try:
+        op = build_operator(args._operator_spec, dtype, gen=gen,
+                            aniso=args.aniso, nrows=n)
+    except ValueError as e:
+        raise SystemExit(f"acg-tpu: {e}")
+    args._operator_id = op.identity()
+    return op
+
+
 def _gen_direct_min() -> int:
     """Row threshold above which gen:poisson specs skip host CSR
     assembly and build DIA planes on device (env-overridable so tests
@@ -986,17 +1045,31 @@ def _solve_generated_direct(args, dim, n, N, jax, jnp, dtype,
     # chip and O(1) host memory per controller.
     if (args.nparts > 1 or args.multihost or args.coordinator is not None
             or args.manufactured_solution or args.refine):
+        if getattr(args, "_operator_spec", None) is not None:
+            raise SystemExit(
+                "acg-tpu: --operator does not reach the sharded "
+                "gen-direct tier (parallel/sharded_dia derives its "
+                "halo from the SPMD partitioner over stored planes); "
+                "use the host-ingest mesh path (raise "
+                "ACG_TPU_GEN_DIRECT_MIN above N) or a single-chip "
+                "solve")
         return _solve_generated_sharded(args, dim, n, N, jax, jnp, dtype,
                                         vec_dtype)
 
     t0 = time.perf_counter()
-    planes, offsets, _ = poisson_dia_device(n, dim, dtype=dtype)
-    if args.epsilon:
-        planes = list(planes)
-        d = offsets.index(0)
-        planes[d] = planes[d] + jnp.asarray(args.epsilon, dtype)
-    A = DiaMatrix(data=tuple(planes), offsets=offsets,
-                  nrows=N, ncols_padded=N)
+    if getattr(args, "_operator_spec", None) is not None:
+        # matrix-free at gen-direct sizes: NOTHING is assembled, on
+        # device or off -- the operator replaces even the on-device
+        # plane build (--epsilon already refused at validation)
+        A = _build_cli_operator(args, N, dtype)
+    else:
+        planes, offsets, _ = poisson_dia_device(n, dim, dtype=dtype)
+        if args.epsilon:
+            planes = list(planes)
+            d = offsets.index(0)
+            planes[d] = planes[d] + jnp.asarray(args.epsilon, dtype)
+        A = DiaMatrix(data=tuple(planes), offsets=offsets,
+                      nrows=N, ncols_padded=N)
     _log(args, "assemble DIA planes on device:", t0)
     args._phases.add("ingest", time.perf_counter() - t0)
 
@@ -1388,6 +1461,11 @@ def _emit_telemetry(args, solver, *, matrix_id, nparts=1,
         extra["nrhs"] = int(args.nrhs)
         if args.block_cg:
             extra["block_cg"] = True
+    if getattr(args, "_operator_id", None):
+        # the operator identity joins the case key
+        # (perfmodel._operator_keyed): a matrix-free capture must never
+        # silently diff against an assembled one of the same system
+        extra["operator"] = args._operator_id
     if args.aniso is not None:
         extra["aniso"] = float(args.aniso)
     kern = getattr(inner, "kernels", None)
@@ -2600,6 +2678,57 @@ def _main(args) -> int:
             raise SystemExit(
                 f"acg-tpu: --nrhs {args.nrhs} does not support: "
                 f"{', '.join(unsupported)}")
+    # matrix-free operator tier (acg_tpu.ops.operator): validate the
+    # spec BEFORE anything expensive, refuse configurations the armed
+    # operator could never serve (the fault-injector could-never-fire
+    # discipline).  'none' takes the assembled path -- byte-identical
+    # dispatched programs (the disarmed-identity contract)
+    from acg_tpu.ops.operator import parse_operator_spec
+    try:
+        args._operator_spec = parse_operator_spec(args.operator)
+    except ValueError as e:
+        raise SystemExit(f"acg-tpu: {e}")
+    args._operator_id = None
+    if args._operator_spec is not None:
+        unsupported = [flag for flag, on in [
+            (f"--solver {args.solver} (the host/external oracles run "
+             f"assembled matrices)",
+             args.solver in ("host", "host-native", "petsc")),
+            (f"--dtype {args.dtype} (operators generate plane values "
+             f"in the storage dtype; bf16 has no matrix traffic left "
+             f"to halve)", args.dtype in ("bf16", "mixed")),
+            (f"--spmv-format {args.spmv_format} (forcing an assembled "
+             f"device format contradicts matrix-free)",
+             args.spmv_format != "auto"),
+            ("--replace-every (the bf16 tier's contract; operators "
+             "run f32/f64)", args.replace_every > 0),
+            ("--refine", args.refine),
+            ("--block-cg (the block-Gram tier keeps assembled "
+             "matrices)", args.block_cg),
+            ("--nrhs on the mesh (the batched dist tier keeps "
+             "assembled local blocks; --nrhs rides matrix-free on the "
+             "single-device tier: --comm none / --nparts 1)",
+             args._batched and not (args.comm == "none"
+                                    or args.nparts == 1)),
+            ("--epsilon (the stencil computes the UNshifted system; a "
+             "shifted solve needs the assembled path)",
+             bool(args.epsilon)),
+            ("--multihost/--coordinator (single-controller tier)",
+             args.multihost or args.coordinator is not None),
+            ("--distributed-read", args.distributed_read),
+        ] if on]
+        if unsupported:
+            raise SystemExit(
+                f"acg-tpu: --operator {args.operator} does not "
+                f"support: {', '.join(unsupported)}")
+        if (args._operator_spec[0] in ("auto", "poisson", "aniso2d")
+                and not args.A.startswith("gen:")):
+            raise SystemExit(
+                "acg-tpu: --operator stencil* pairs with a gen: matrix "
+                "spec (a file matrix is assembled by definition and "
+                "the stencil could silently compute a different "
+                "system); register a user:NAME operator for "
+                "file-backed systems")
     if args.aniso is not None:
         if not 0.0 < args.aniso <= 1.0:
             raise SystemExit("acg-tpu: --aniso EPS must be in (0, 1]")
@@ -3159,8 +3288,13 @@ def _main(args) -> int:
                         else "pipelined" if pipelined else "batched")
                 if comm == "none" or nparts == 1:
                     from acg_tpu.solvers.batched import BatchedCGSolver
-                    dev = device_matrix_from_csr(csr, dtype=dtype,
-                                                 format=args.spmv_format)
+                    if args._operator_spec is not None:
+                        # matrix-free batched: spmv_multi dispatches on
+                        # the operator's multi-column apply
+                        dev = _build_cli_operator(args, n, dtype)
+                    else:
+                        dev = device_matrix_from_csr(
+                            csr, dtype=dtype, format=args.spmv_format)
                     try:
                         solver = BatchedCGSolver(
                             dev, mode=mode,
@@ -3197,8 +3331,13 @@ def _main(args) -> int:
                 x = _run_solve(args, solver, b, x0=x0,
                                criteria=criteria, warmup=args.warmup)
             elif comm == "none" or nparts == 1:
-                dev = device_matrix_from_csr(csr, dtype=dtype,
-                                             format=args.spmv_format)
+                if args._operator_spec is not None:
+                    # matrix-free: the operator IS the device matrix
+                    # (ops.spmv dispatches on the matfree protocol)
+                    dev = _build_cli_operator(args, n, dtype)
+                else:
+                    dev = device_matrix_from_csr(csr, dtype=dtype,
+                                                 format=args.spmv_format)
                 try:
                     solver = JaxCGSolver(dev, pipelined=pipelined,
                                          precise_dots=args.precise_dots,
@@ -3240,6 +3379,12 @@ def _main(args) -> int:
                                                 subs=subs,
                                                 vector_dtype=vec_dtype,
                                                 owned_parts=owned)
+                if args._operator_spec is not None:
+                    # matrix-free on the mesh: generated local planes
+                    # behind the SAME halo plan and ghost block
+                    from acg_tpu.parallel.dist import arm_matfree
+                    arm_matfree(prob, _build_cli_operator(args, n,
+                                                          dtype))
                 try:
                     solver = DistCGSolver(prob, pipelined=pipelined, comm=comm,
                                           precise_dots=args.precise_dots,

@@ -47,6 +47,16 @@ bumped additively to ``acg-tpu-stats/5``), ``acg_health_*`` Prometheus
 gauges/counters (:mod:`acg_tpu.metrics`), the ``--explain``
 convergence verdict, and gap drift tracked by ``--soak`` alongside
 latency drift.
+
+Matrix-free generalization (ROADMAP item 5, acg_tpu.ops.operator):
+every mechanism here consumes the operator ONLY through applies -- the
+audit recomputes ``b - A x`` through the tier's SpMV selection, and the
+ABFT column checksum ``c = A^T 1`` is computed *through the apply* at
+setup (``spmv_(A, ones)`` in the solve programs) -- so arming
+``--audit-every``/``--abft`` over a matrix-free operator needs no code
+here at all: the dispatch in :mod:`acg_tpu.ops.spmv` routes the applies
+and the audited trajectories stay bitwise-equal to the assembled
+tier's (tests/test_matfree.py).
 """
 
 from __future__ import annotations

@@ -1,0 +1,604 @@
+"""Matrix-free operator tier: ``A`` as an apply, not a stored matrix.
+
+ROADMAP item 5 (arXiv:2205.08909, PAPERS.md): matrix-free high-order
+operator application beats assembled SpMV precisely by deleting the
+per-iteration A-read from HBM.  The ``gen:`` path already assembles DIA
+planes on device (``io.generators.poisson_dia_device``) -- one step
+short of never materializing A at all.  This module takes that step:
+
+* :class:`StencilOperator` -- a jit-traversable pytree standing in for
+  a :class:`~acg_tpu.ops.spmv.DeviceMatrix` whose SpMV expresses the
+  stencil as shifted VIEWS of the reshaped grid (pad + slice + the
+  O(grid-side) coefficient tables, fused by XLA into the
+  multiply-accumulate) instead of reading O(ndiags * N) planes from
+  HBM.  Per-element products are BITWISE IDENTICAL to the assembled
+  planes' (constants are exactly representable; variable coefficients
+  are pre-rounded host-side in f64 exactly like the assembled ingest)
+  and accumulate in the same offset order, so iteration trajectories
+  match the assembled-DIA tier bit for bit on the tiers whose applies
+  consume loop-carried state -- classic CG (the headline bench
+  protocol), s-step, jacobi PCG, batched, and the whole dist tier;
+  tiers that CHAIN applies inside one fused region (the pipelined
+  setup, cheby's polynomial, the ABFT setup checksum) agree to FMA
+  reassociation instead (see ``StencilOperator.matfree_apply``;
+  tests/test_matfree.py pins both halves of the contract).
+* :class:`UserOperator` + :func:`register_operator` -- the registration
+  hook for user-supplied jitted operators: ``apply_fn(captures, x)``
+  (and optionally ``diagonal_fn``) registered under a name; the
+  operator object itself stays a hashable-meta pytree so it rides the
+  solve programs' jit arguments like any device matrix.
+
+Integration is by dispatch, not by new loops: ``ops.spmv.spmv`` (and
+``matrix_diagonal`` / ``spmv_flops`` / ``matrix_index_bytes``) recognise
+the ``matfree_*`` protocol, so every solver tier -- classic, pipelined,
+the PR 12 CA recurrences (``sstep:S`` / ``pipelined:L`` ride
+:func:`acg_tpu.recurrence.single_ops`, whose SpMV source this is),
+batched multi-RHS, precond (jacobi reads :func:`matrix_diagonal`
+through the diagonal hook, cheby needs only applies) and the ABFT
+checksum (``c = A^T 1`` computed through the apply at setup) -- inherits
+matrix-free operation with zero new recurrence code.  The distributed
+restatement (band-partitioned local planes generated per shard, halo
+riding the existing exchange machinery) lives in
+``parallel.dist.arm_matfree``.
+
+Built-in stencils: constant-coefficient Poisson 1D/2D/3D (the ``gen:``
+family) and the variable-coefficient anisotropic 2D family
+(``io.generators.aniso_poisson2d_coo`` -- whose coefficients depend
+only on the grid row, so three O(n) tables replace O(n^2) planes).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from acg_tpu.errors import AcgError, ErrorCode
+from acg_tpu.ops.spmv import acc_dtype
+
+
+def is_matrix_free(A) -> bool:
+    """True for any operator speaking the ``matfree_*`` protocol (the
+    dispatch predicate ops.spmv / the solvers / perfmodel share)."""
+    return hasattr(A, "matfree_apply")
+
+
+# -- plane generation ------------------------------------------------------
+
+def stencil_planes(kind: str, grid: tuple, offsets: tuple, tables,
+                   nrows: int, dtype, row0=0, nowned=None):
+    """The lazily-generated DIA planes of a built-in stencil: one traced
+    (nrows,) array per static offset, for global rows ``[row0, row0 +
+    nrows)``.  XLA fuses the iota/compare/select chains into the SpMV's
+    multiply-accumulate, so no plane ever materialises in HBM.
+
+    Values are bitwise-equal to the assembled ingest's planes: constants
+    (Poisson -1 / 2*dim) are exactly representable in every supported
+    dtype, and the anisotropic tables arrive pre-rounded from f64
+    exactly like ``dia_from_csr``'s ``astype`` (one rounding, host-side,
+    in :func:`aniso2d_stencil`).
+
+    ``nowned`` (the distributed local-block mask) zeroes entries whose
+    row or column index falls outside ``[0, nowned)`` LOCALLY -- exactly
+    the owned x owned split the assembled ``dia_planes_fixed`` stacking
+    encodes (out-of-part couplings live in the ghost block, padding rows
+    are zero).  ``row0`` may be a traced scalar (per-shard)."""
+    n = grid[0]
+    glob = (nowned is None and isinstance(row0, int) and row0 == 0)
+
+    def axis_coord(stride: int):
+        """The grid coordinate ``(idx // stride) % n`` per row.  The
+        global full-grid case builds it as a BROADCAST of a 1-D arange
+        over the reshaped ``(-1, n, stride)`` view -- no per-element
+        integer division anywhere, which is what makes generating the
+        planes cheaper than reading them.  Shard windows (traced row0 /
+        owned masks, not grid-aligned) take the iota arithmetic."""
+        if glob and nrows % (stride * n) == 0:
+            reps = nrows // (stride * n)
+            c = jnp.broadcast_to(
+                jnp.arange(n, dtype=jnp.int32)[None, :, None],
+                (reps, n, stride))
+            return c.reshape(nrows)
+        idx = jnp.asarray(row0, jnp.int32) + jax.lax.iota(jnp.int32,
+                                                          nrows)
+        return (idx // stride) % n
+
+    if nowned is not None:
+        i_loc = jax.lax.iota(jnp.int32, nrows)
+        nown = jnp.asarray(nowned, jnp.int32)
+
+    def local_mask(plane, off):
+        if nowned is None:
+            return plane
+        ok = ((i_loc < nown) & (i_loc + off >= 0) & (i_loc + off < nown))
+        return jnp.where(ok, plane, jnp.zeros((), dtype))
+
+    planes = []
+    if kind == "poisson":
+        _n, dim = grid
+        for off in offsets:
+            if off == 0:
+                plane = jnp.full((nrows,), float(2 * dim), dtype)
+            else:
+                stride = abs(int(off))
+                coord = axis_coord(stride)
+                if off < 0:
+                    plane = jnp.where(coord > 0, -1.0, 0.0).astype(dtype)
+                else:
+                    plane = jnp.where(coord < n - 1, -1.0,
+                                      0.0).astype(dtype)
+            planes.append(local_mask(plane, off))
+        return planes
+    if kind == "aniso2d":
+        wx, wy, dtab = tables
+
+        def row_table(t):
+            """``t[j]`` per row: a broadcast over the (n, n) view in
+            the global case, a gather on shard windows."""
+            if glob and nrows == n * n:
+                return jnp.broadcast_to(t[:n, None],
+                                        (n, n)).reshape(nrows)
+            idx = jnp.asarray(row0, jnp.int32) + jax.lax.iota(
+                jnp.int32, nrows)
+            return t[idx // n]
+
+        i = axis_coord(1)
+        j = axis_coord(n)
+        for off in offsets:
+            if off == 0:
+                plane = row_table(dtab)
+            elif off == -1:
+                plane = jnp.where(i > 0, -row_table(wx),
+                                  jnp.zeros((), dtype))
+            elif off == 1:
+                plane = jnp.where(i < n - 1, -row_table(wx),
+                                  jnp.zeros((), dtype))
+            elif off == -n:
+                plane = jnp.where(j > 0, -row_table(wy),
+                                  jnp.zeros((), dtype))
+            elif off == n:
+                plane = jnp.where(j < n - 1, -row_table(wy[1:]),
+                                  jnp.zeros((), dtype))
+            else:
+                raise ValueError(f"aniso2d stencil has no offset {off}")
+            planes.append(local_mask(plane, off))
+        return planes
+    raise ValueError(f"unknown stencil kind {kind!r}")
+
+
+@functools.partial(jax.tree_util.register_dataclass,
+                   data_fields=["tables"],
+                   meta_fields=["kind", "grid", "param", "offsets",
+                                "nrows", "ncols_padded", "dtype_name"])
+@dataclasses.dataclass
+class StencilOperator:
+    """A built-in matrix-free stencil, pytree-registered so it rides the
+    solve programs' jit arguments exactly like a DeviceMatrix: the O(n)
+    coefficient ``tables`` are the only data leaves (empty for
+    constant-coefficient stencils), everything else is hashable static
+    metadata keying the jit cache."""
+
+    tables: tuple       # () or small rounded coefficient arrays
+    kind: str           # "poisson" | "aniso2d"
+    grid: tuple         # (n, dim)
+    param: float        # aniso stretch eps; 0.0 for constant stencils
+    offsets: tuple      # static diagonal offsets, ascending
+    nrows: int
+    ncols_padded: int
+    dtype_name: str     # storage dtype the generated values take
+
+    @property
+    def dtype(self):
+        return jnp.dtype(self.dtype_name)
+
+    def planes(self, row0=0, nrows: int | None = None, nowned=None):
+        return stencil_planes(self.kind, self.grid, self.offsets,
+                              self.tables,
+                              self.nrows if nrows is None else nrows,
+                              self.dtype, row0=row0, nowned=nowned)
+
+    # -- the DeviceMatrix protocol (ops.spmv dispatch) -----------------
+
+    def _shifted(self, x, stride: int, sign: int):
+        """``out[idx] = x[idx + sign*stride]`` where the grid neighbour
+        exists, else 0 -- a PAD + SLICE on the reshaped
+        ``(-1, n, stride)`` view of x: the boundary structure is
+        expressed by the array geometry, so no per-element index
+        arithmetic exists anywhere in the apply.  This is what makes
+        the generated apply CHEAPER than reading planes (the
+        plane-generation path must still manufacture an O(N) mask the
+        compiler may materialise), not merely traffic-equivalent."""
+        n = self.grid[0]
+        x3 = x.reshape(-1, n, stride)
+        z = jnp.zeros_like(x3[:, :1, :])
+        if sign < 0:
+            sh = jnp.concatenate([z, x3[:, :-1, :]], axis=1)
+        else:
+            sh = jnp.concatenate([x3[:, 1:, :], z], axis=1)
+        return sh.reshape(x.shape)
+
+    def matfree_apply(self, x):
+        """y = A @ x with the stencil structure expressed as shifted
+        VIEWS of the reshaped grid: per ascending offset, one
+        pad-and-slice neighbour image times its coefficient,
+        accumulated in the assembled ``dia_mv``'s offset order with the
+        identical per-element products.
+
+        Bitwise contract (tests/test_matfree.py): iteration
+        trajectories equal the assembled-DIA tier's bit for bit on the
+        tiers whose applies consume/produce loop-carried state --
+        classic CG (the headline bench protocol), s-step CG, Jacobi
+        PCG, the batched tier, and the whole dist tier (which runs the
+        generated-plane form).  Programs that CHAIN applies inside one
+        fused region (the pipelined setup's w = A(b - A x0), cheby's
+        K-apply polynomial, the ABFT setup) let XLA contract the fused
+        multiply-adds differently than the assembled build -- per
+        apply the results are still bitwise-equal (verified un-fused),
+        in-program they agree to FMA reassociation (~1 ulp/apply) and
+        convergence behaviour is identical."""
+        adt = acc_dtype(x.dtype)
+        n, dim = self.grid
+        y = jnp.zeros(x.shape, adt)
+        if self.kind == "poisson":
+            mone = jnp.asarray(-1.0, adt)
+            for off in self.offsets:
+                if off == 0:
+                    y = y + (jnp.asarray(float(2 * dim), adt)
+                             * x.astype(adt))
+                else:
+                    sh = self._shifted(x, abs(int(off)),
+                                       1 if off > 0 else -1)
+                    y = y + mone * sh.astype(adt)
+            return y.astype(x.dtype)
+        # aniso2d: coefficients depend only on the grid row j, so each
+        # offset is one broadcast of an O(n) table over the (n, n) view
+        wx, wy, dtab = self.tables
+        x2 = x.reshape(n, n)
+        y2 = y.reshape(n, n)
+        for off in self.offsets:
+            if off == 0:
+                y2 = y2 + dtab[:, None].astype(adt) * x2.astype(adt)
+                continue
+            stride = abs(int(off))
+            sh = self._shifted(x, stride,
+                               1 if off > 0 else -1).reshape(n, n)
+            if stride == 1:
+                coeff = -wx[:, None].astype(adt)
+            elif off < 0:
+                coeff = -wy[:-1, None].astype(adt)     # -wy[j]
+            else:
+                coeff = -wy[1:, None].astype(adt)      # -wy[j+1]
+            y2 = y2 + coeff * sh.astype(adt)
+        return y2.reshape(x.shape).astype(x.dtype)
+
+    def matfree_apply_multi(self, X):
+        """Multi-column twin (the batched tier): the shifted-view apply
+        vmapped over the batch axis -- same per-column accumulation as
+        the assembled multi-vector SpMV."""
+        return jax.vmap(self.matfree_apply, in_axes=1, out_axes=1)(X)
+
+    def matfree_diagonal(self):
+        """Analytic ``diag(A)`` (the ``--precond jacobi`` twin of
+        ``ops.spmv.matrix_diagonal``), in the accumulation dtype like
+        the assembled extraction."""
+        d = self.planes()[self.offsets.index(0)]
+        return d.astype(acc_dtype(self.dtype))
+
+    def matfree_nnz(self) -> float:
+        """Analytic stored-nonzero count (the assembled twin's nnz):
+        each off-diagonal plane is zero on one boundary slice of
+        N/n entries."""
+        n, dim = self.grid
+        N = self.nrows
+        return float((2 * dim + 1) * N - 2 * dim * (N // n))
+
+    def table_bytes(self) -> int:
+        """HBM bytes the generated planes actually read per apply (the
+        O(n) coefficient tables; 0 for constant stencils) -- the
+        matrix-bytes term the --explain roofline prices instead of
+        nnz * itemsize."""
+        return sum(int(np.prod(np.shape(t))) * self.dtype.itemsize
+                   for t in self.tables)
+
+    # -- host twins (dist setup / oracles) -----------------------------
+
+    def host_diagonal(self) -> np.ndarray:
+        """diag(A) as host numpy f64 OF THE ROUNDED stored values --
+        what the stacked Jacobi builder inverts (matching the device
+        extraction exactly)."""
+        n, dim = self.grid
+        if self.kind == "poisson":
+            return np.full(self.nrows, float(2 * dim))
+        dtab = np.asarray(self.tables[2], np.float64)
+        return np.repeat(dtab, n)
+
+    def identity(self) -> str:
+        """The operator's provenance string (stats manifest, bench case
+        keys: perfmodel._operator_keyed)."""
+        n, dim = self.grid
+        if self.kind == "poisson":
+            return f"stencil:poisson{dim}d:{n}"
+        return f"stencil:aniso2d:{n}:{self.param:g}"
+
+
+def poisson_stencil(n: int, dim: int, dtype=jnp.float32) -> StencilOperator:
+    """Constant-coefficient Poisson stencil operator (1D/2D/3D), the
+    matrix-free twin of ``io.generators.poisson_dia`` /
+    ``poisson_dia_device`` (same offsets, same values -- bitwise)."""
+    if dim not in (1, 2, 3):
+        raise ValueError(f"poisson stencil dim must be 1, 2 or 3 "
+                         f"(got {dim})")
+    if n < 2:
+        raise ValueError(f"poisson stencil needs n >= 2 (got {n})")
+    N = n ** dim
+    offsets = sorted([s for a in range(dim)
+                      for s in (-(n ** a), n ** a)] + [0])
+    return StencilOperator(tables=(), kind="poisson", grid=(n, dim),
+                           param=0.0,
+                           offsets=tuple(int(o) for o in offsets),
+                           nrows=N, ncols_padded=N,
+                           dtype_name=str(jnp.dtype(dtype)))
+
+
+def aniso2d_stencil(n: int, eps: float,
+                    dtype=jnp.float32) -> StencilOperator:
+    """The variable-coefficient anisotropic 2D family
+    (``io.generators.aniso_poisson2d_coo``) as a matrix-free operator:
+    the edge weights depend only on the grid row, so THREE O(n) tables
+    (x-edge weights, y-edge weights, and the PRE-SUMMED diagonal)
+    replace the O(n^2) planes.  Tables are computed in f64 and rounded
+    ONCE to the storage dtype -- the same single rounding the assembled
+    ingest applies (f64 COO -> ``astype(dtype)`` planes), which is what
+    makes the generated values bitwise-equal to the assembled ones
+    (summing pre-rounded weights on device would round differently)."""
+    if not 0.0 < eps <= 1.0:
+        raise ValueError(f"aniso stretch factor must be in (0, 1], "
+                         f"got {eps}")
+    j = np.arange(n)
+    wx = eps ** ((j + 0.5) / n)                    # f64, like the gen
+    e = np.arange(n + 1)
+    wy = eps ** (-(e / n))
+    dtab = 2 * wx + wy[:-1] + wy[1:]               # f64 sum, THEN round
+    npdt = np.dtype(str(jnp.dtype(dtype)))
+    tables = (jnp.asarray(wx.astype(npdt)), jnp.asarray(wy.astype(npdt)),
+              jnp.asarray(dtab.astype(npdt)))
+    N = n * n
+    return StencilOperator(tables=tables, kind="aniso2d", grid=(n, 2),
+                           param=float(eps),
+                           offsets=(-n, -1, 0, 1, n),
+                           nrows=N, ncols_padded=N,
+                           dtype_name=str(jnp.dtype(dtype)))
+
+
+# -- user-supplied operators (the registration hook) ----------------------
+
+_USER_OPS: dict = {}
+
+
+def register_operator(name: str, apply_fn, diagonal_fn=None,
+                      nnz: float | None = None) -> None:
+    """Register a user-supplied jitted operator under ``name``:
+    ``apply_fn(captures, x) -> y`` is traced into every solve program
+    exactly where the assembled SpMV would run (``captures`` is the
+    operator instance's pytree-leaf tuple); ``diagonal_fn(captures) ->
+    diag`` arms ``--precond jacobi`` (absent: jacobi refuses
+    self-describingly); ``nnz`` feeds the flop statistic (default: 0,
+    reported as unknown work)."""
+    if not callable(apply_fn):
+        raise ValueError(f"operator {name!r}: apply_fn must be callable")
+    _USER_OPS[str(name)] = {"apply": apply_fn, "diagonal": diagonal_fn,
+                            "nnz": nnz}
+
+
+def registered_operators() -> tuple:
+    return tuple(sorted(_USER_OPS))
+
+
+@functools.partial(jax.tree_util.register_dataclass,
+                   data_fields=["captures"],
+                   meta_fields=["name", "nrows", "ncols_padded",
+                                "dtype_name"])
+@dataclasses.dataclass
+class UserOperator:
+    """A registered user operator as a solve-program argument: the
+    closed-over arrays ride ``captures`` (data leaves), the registry
+    ``name`` selects the apply at trace time."""
+
+    captures: tuple
+    name: str
+    nrows: int
+    ncols_padded: int
+    dtype_name: str
+
+    @property
+    def dtype(self):
+        return jnp.dtype(self.dtype_name)
+
+    def _entry(self):
+        try:
+            return _USER_OPS[self.name]
+        except KeyError:
+            raise AcgError(
+                ErrorCode.INVALID_VALUE,
+                f"operator {self.name!r} is not registered in this "
+                f"process (register_operator must run before the solve)")
+
+    def matfree_apply(self, x):
+        return self._entry()["apply"](self.captures, x)
+
+    def matfree_diagonal(self):
+        dfn = self._entry()["diagonal"]
+        if dfn is None:
+            raise AcgError(
+                ErrorCode.NOT_SUPPORTED,
+                f"operator {self.name!r} was registered without a "
+                f"diagonal_fn: --precond jacobi needs the analytic "
+                f"diagonal (register_operator(..., diagonal_fn=...), "
+                f"or use --precond cheby:K, which needs only applies)")
+        return dfn(self.captures)
+
+    def matfree_nnz(self) -> float:
+        return float(self._entry()["nnz"] or 0.0)
+
+    def table_bytes(self) -> int:
+        return sum(int(np.prod(np.shape(t))) * np.dtype(
+            getattr(t, "dtype", np.float64)).itemsize
+            for t in jax.tree_util.tree_leaves(self.captures))
+
+    def identity(self) -> str:
+        return f"user:{self.name}"
+
+
+def user_operator(name: str, nrows: int, dtype=jnp.float32,
+                  captures: tuple = ()) -> UserOperator:
+    """Instantiate a registered operator for an ``nrows``-row system."""
+    if str(name) not in _USER_OPS:
+        raise AcgError(
+            ErrorCode.INVALID_VALUE,
+            f"operator {name!r} is not registered "
+            f"(known: {', '.join(registered_operators()) or 'none'}); "
+            f"call acg_tpu.ops.operator.register_operator first")
+    return UserOperator(captures=tuple(captures), name=str(name),
+                        nrows=int(nrows), ncols_padded=int(nrows),
+                        dtype_name=str(jnp.dtype(dtype)))
+
+
+# -- CLI spec parsing ------------------------------------------------------
+
+def _gen_desc(gen) -> str:
+    """Human spelling of a parsed gen: matrix spec for refusals."""
+    kind, dim, n = gen[0], gen[1], gen[2]
+    if kind == "poisson":
+        return f"gen:poisson{dim}d:{n}"
+    return f"gen:{kind}:{n}"
+
+
+def parse_operator_spec(text):
+    """``--operator`` grammar -> spec tuple (None = disarmed):
+
+    * ``none``/empty             -> None (byte-identical assembled path)
+    * ``stencil``                -> ("auto",): derive the stencil from
+                                   the ``gen:`` matrix spec (+ --aniso)
+    * ``stencil:poisson1d:N`` (2d/3d) -> ("poisson", dim, N)
+    * ``stencil:aniso2d:N:EPS``  -> ("aniso2d", N, EPS)
+    * ``user:NAME``              -> ("user", NAME)
+    """
+    if text is None:
+        return None
+    t = str(text).strip()
+    if t in ("", "none"):
+        return None
+    if t == "stencil":
+        return ("auto",)
+    fields = t.split(":")
+    if fields[0] == "user":
+        if len(fields) != 2 or not fields[1]:
+            raise ValueError(f"operator spec {text!r}: expected "
+                             f"user:NAME")
+        return ("user", fields[1])
+    if fields[0] != "stencil":
+        raise ValueError(
+            f"operator spec {text!r}: expected none, stencil, "
+            f"stencil:poisson1d|poisson2d|poisson3d:N, "
+            f"stencil:aniso2d:N:EPS, or user:NAME")
+    kind = fields[1] if len(fields) > 1 else ""
+    try:
+        if kind in ("poisson1d", "poisson2d", "poisson3d"):
+            if len(fields) != 3:
+                raise ValueError
+            dim = int(kind[7])
+            n = int(fields[2])
+            if n < 2:
+                raise ValueError
+            return ("poisson", dim, n)
+        if kind == "aniso2d":
+            if len(fields) != 4:
+                raise ValueError
+            n = int(fields[2])
+            eps = float(fields[3])
+            if n < 2 or not 0.0 < eps <= 1.0:
+                raise ValueError
+            return ("aniso2d", n, eps)
+    except ValueError:
+        pass
+    raise ValueError(
+        f"operator spec {text!r}: expected none, stencil, "
+        f"stencil:poisson1d|poisson2d|poisson3d:N, "
+        f"stencil:aniso2d:N:EPS, or user:NAME")
+
+
+def build_operator(spec, dtype, gen=None, aniso=None, nrows=None):
+    """Spec tuple -> operator instance.  ``gen`` is the parsed ``gen:``
+    matrix spec tuple (kind, dim, n, N, avg) when the matrix came from a
+    generator -- the ``("auto",)`` spelling derives the stencil from it,
+    and explicit spellings are validated against it (an operator that
+    does not compute the matrix being solved would silently answer a
+    different system)."""
+    if spec is None:
+        return None
+    if spec[0] == "auto":
+        if gen is None or gen[0] != "poisson":
+            raise ValueError(
+                "--operator stencil derives the stencil from a "
+                "gen:poisson* matrix spec (files and gen:irregular are "
+                "assembled by definition); name the stencil explicitly "
+                "(stencil:poisson2d:N, stencil:aniso2d:N:EPS) or use a "
+                "registered user:NAME operator")
+        _, dim, n, _N, _ = gen
+        if aniso is not None:
+            return aniso2d_stencil(n, float(aniso), dtype=dtype)
+        return poisson_stencil(n, dim, dtype=dtype)
+    if spec[0] == "poisson":
+        _, dim, n = spec
+        # the gen: matrix must AFFIRMATIVELY match: a non-matching kind
+        # (irregular, wrong dim/n) or an --aniso selection means the
+        # stencil would silently compute a different system than the
+        # matrix being solved
+        if gen is not None and (gen[0] != "poisson"
+                                or (gen[1], gen[2]) != (dim, n)):
+            raise ValueError(
+                f"--operator stencil:poisson{dim}d:{n} does not compute "
+                f"the gen: matrix being solved ({_gen_desc(gen)})")
+        if aniso is not None:
+            raise ValueError(
+                "--aniso selects the variable-coefficient family; use "
+                "--operator stencil (auto) or stencil:aniso2d:N:EPS")
+        return poisson_stencil(n, dim, dtype=dtype)
+    if spec[0] == "aniso2d":
+        _, n, eps = spec
+        if gen is not None and (gen[0] != "poisson" or gen[1] != 2
+                                or gen[2] != n):
+            raise ValueError(
+                f"--operator stencil:aniso2d:{n}:{eps:g} does not "
+                f"compute the gen: matrix being solved "
+                f"({_gen_desc(gen)})")
+        if gen is not None and aniso is None:
+            # without --aniso the gen matrix IS the constant-coefficient
+            # family -- the aniso stencil would silently solve the
+            # stretched-grid system instead
+            raise ValueError(
+                f"--operator stencil:aniso2d:{n}:{eps:g} computes the "
+                f"anisotropic family, but the matrix being solved is "
+                f"the constant-coefficient gen:poisson2d:{n} (add "
+                f"--aniso {eps:g} to solve the anisotropic system)")
+        if aniso is not None and float(aniso) != float(eps):
+            raise ValueError(
+                f"--operator stencil:aniso2d:{n}:{eps:g} disagrees "
+                f"with --aniso {aniso:g}")
+        return aniso2d_stencil(n, eps, dtype=dtype)
+    if spec[0] == "user":
+        if nrows is None:
+            raise ValueError("user operators need the system size")
+        return user_operator(spec[1], nrows, dtype=dtype)
+    raise ValueError(f"unknown operator spec {spec!r}")
+
+
+def operator_identity(A) -> str | None:
+    """Provenance string of a matrix-free operator (None for assembled
+    matrices) -- joins the stats manifest and the bench case key."""
+    if is_matrix_free(A) and hasattr(A, "identity"):
+        return A.identity()
+    return None

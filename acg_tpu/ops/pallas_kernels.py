@@ -684,3 +684,121 @@ def fused_pipelined_update(x, r, w, p, t, z, q, alpha, beta,
         interpret=interpret,
     )(ab, *vecs)
     return tuple(o[:n] for o in outs)
+
+
+# -- matrix-free stencil SpMV (the operator tier's Pallas path) -----------
+
+def stencil_spmv_route(op, n_total: int, dtype):
+    """``(Lpad, Rpad, tile, align)`` when the in-kernel-generated
+    stencil SpMV supports this operator/shape, else None.  Constant-
+    coefficient Poisson on the single-window band (the ``dia_spmv``
+    "fast" shape): the whole point of the kernel is that NO plane
+    inputs exist -- x streams through VMEM once and the coefficient
+    masks are computed from iotas in-register -- so the VMEM budget is
+    looser than the assembled kernel's, but the band/divisibility
+    constraints are the same."""
+    if getattr(op, "kind", None) != "poisson":
+        return None
+    route = dia_spmv_route(op.offsets, n_total, dtype,
+                           ndiags=len(op.offsets))
+    if route[0] != "fast":
+        return None
+    Lpad, Rpad, tile, align = route[1:]
+    if tile % LANE:
+        return None
+    return Lpad, Rpad, tile, align
+
+
+@functools.partial(jax.jit,
+                   static_argnames=("n", "dim", "offsets", "Lpad",
+                                    "Rpad", "tile", "align", "interpret"))
+def _stencil_poisson_call(x, n: int, dim: int, offsets: tuple,
+                          Lpad: int, Rpad: int, tile: int, align: int,
+                          interpret: bool):
+    N = x.shape[0]
+    grid = N // tile
+    win = tile + Lpad + Rpad
+    sub = tile // LANE
+
+    def kernel(x_hbm, y_ref):
+        i = pl.program_id(0)
+
+        def body(xwin, sems):
+            start, wait = _window_copies(x_hbm, xwin, sems, 0, i, grid,
+                                         tile, Lpad, Rpad, align,
+                                         x.dtype)
+            start()
+            wait()
+            kadt = acc_dtype(x.dtype)
+            # global row indices of this tile, as a native 2-D tile
+            # (TPU iotas want >= 2 dims); masks derive from the grid
+            # coordinate exactly like ops.operator.stencil_planes
+            r2 = jax.lax.broadcasted_iota(jnp.int32, (sub, LANE), 0)
+            c2 = jax.lax.broadcasted_iota(jnp.int32, (sub, LANE), 1)
+            gidx = i * tile + r2 * LANE + c2
+            acc = jnp.zeros((sub, LANE), kadt)
+            for off in offsets:
+                xs = xwin[pl.ds(Lpad + off, tile)].reshape(
+                    sub, LANE).astype(kadt)
+                # the generated plane VALUE, in exactly dia_mv's
+                # ``y + plane * x`` expression shape so XLA forms the
+                # same multiply-add chain as the assembled/XLA path
+                if off == 0:
+                    plane = jnp.full((sub, LANE), float(2 * dim), kadt)
+                else:
+                    stride = abs(int(off))
+                    coord = (gidx // stride) % n
+                    mask = coord > 0 if off < 0 else coord < n - 1
+                    plane = jnp.where(mask, -1.0, 0.0).astype(kadt)
+                acc = acc + plane * xs
+            y_ref[:] = acc.reshape(tile).astype(x.dtype)
+
+        pl.run_scoped(body, pltpu.VMEM((win,), x.dtype),
+                      pltpu.SemaphoreType.DMA((3,)))
+
+    return pl.pallas_call(
+        kernel,
+        grid=(grid,),
+        in_specs=[pl.BlockSpec(memory_space=pl.ANY)],
+        out_specs=pl.BlockSpec((tile,), lambda i: (i,),
+                               memory_space=pltpu.VMEM),
+        out_shape=jax.ShapeDtypeStruct((N,), x.dtype),
+        interpret=interpret,
+    )(x)
+
+
+def stencil_spmv(op, x, interpret: bool = False, tile: int | None = None,
+                 align: int | None = None):
+    """y = A @ x for a matrix-free :class:`~acg_tpu.ops.operator.
+    StencilOperator` with the coefficient masks generated IN-KERNEL:
+    x streams through VMEM once per row tile (``_window_copies``, the
+    single-x-pass machinery the assembled DIA kernel uses) and the
+    plane values never exist anywhere -- not in HBM, not in VMEM.
+    This is the matrix-free restatement of :func:`dia_spmv`'s traffic
+    argument: the assembled kernel still reads D planes per tile; this
+    one reads x and writes y, full stop.
+
+    Values are bitwise-equal to the XLA matfree apply (-1 * x == -x;
+    masked positions add a zero, exactly like the structural-zero
+    plane entries).  Shapes outside the single-window route -- or
+    non-Poisson kinds -- fall back to the operator's own XLA apply
+    (``op.matfree_apply``), the same degrade discipline as
+    ``dia_spmv``'s "xla" route.  ``tile``/``align`` override the route
+    for interpret-mode tests at small sizes."""
+    n_total = x.shape[0]
+    if tile is not None:
+        n, dim = op.grid
+        band = n ** (dim - 1)
+        Lpad = Rpad = band + (-band) % (align or 1)
+        if (tile % LANE or n_total % tile or band > tile
+                or op.kind != "poisson"):
+            return op.matfree_apply(x)
+        return _stencil_poisson_call(x, n, dim, op.offsets, Lpad, Rpad,
+                                     tile, align or 1, interpret)
+    route = stencil_spmv_route(op, n_total, x.dtype)
+    if route is None:
+        return op.matfree_apply(x)
+    Lpad, Rpad, rtile, ralign = route
+    n, dim = op.grid
+    return _stencil_poisson_call(x, n, dim, op.offsets, Lpad, Rpad,
+                                 rtile, ralign, interpret)

@@ -142,6 +142,16 @@ class BinnedEllMatrix:
 
 DeviceMatrix = Union[EllMatrix, CooMatrix, DiaMatrix, BinnedEllMatrix]
 
+# A fifth member by protocol rather than by type: matrix-free operators
+# (acg_tpu.ops.operator) expose ``matfree_apply``/``matfree_diagonal``/
+# ``matfree_nnz`` and are accepted everywhere a DeviceMatrix is -- the
+# dispatchers below check the protocol FIRST, so an operator never
+# falls through to a stored-plane path that does not exist for it.
+
+
+def _is_matfree(A) -> bool:
+    return hasattr(A, "matfree_apply")
+
 # geometric (x1.5) bin widths: padding bounded at ~1.33x, ~18 bins max
 BELL_WIDTHS = (1, 2, 3, 4, 6, 8, 12, 16, 24, 32, 48, 64, 96, 128, 192,
                256, 384, 512)
@@ -393,8 +403,9 @@ def matrix_dtype(A: DeviceMatrix):
 def matrix_index_bytes(A: DeviceMatrix) -> float:
     """Index bytes read per stored nonzero during SpMV (DIA: none;
     ELL-family: one int32 column; COO: row + column; binned ELL: the
-    nnz-weighted mix of its 4 B bins and 8 B hub tail)."""
-    if isinstance(A, DiaMatrix):
+    nnz-weighted mix of its 4 B bins and 8 B hub tail; matrix-free
+    operators: none -- no stored nonzeros exist)."""
+    if _is_matfree(A) or isinstance(A, DiaMatrix):
         return 0.0
     if isinstance(A, CooMatrix):
         return 8.0
@@ -434,6 +445,10 @@ def _binned_ell_mv(A: BinnedEllMatrix, x: jax.Array) -> jax.Array:
 
 def _spmv(A: DeviceMatrix, x: jax.Array) -> jax.Array:
     adt = acc_dtype(x.dtype)
+    if _is_matfree(A):
+        # matrix-free operator tier (ops.operator): plane values are
+        # GENERATED inside the apply -- zero matrix HBM traffic
+        return A.matfree_apply(x)
     if isinstance(A, BinnedEllMatrix):
         return _binned_ell_mv(A, x)
     if isinstance(A, DiaMatrix):
@@ -458,6 +473,12 @@ def matrix_diagonal(A: DeviceMatrix) -> jax.Array:
     back exactly 0, which the Jacobi state builder turns into a 0
     inverse (padded residual entries are exactly 0 by construction)."""
     adt = acc_dtype(matrix_dtype(A))
+    if _is_matfree(A):
+        # the operator-path twin: analytic diagonal through the
+        # operator's own hook (typed refusal for user operators
+        # registered without one) -- what makes --precond jacobi work
+        # matrix-free
+        return A.matfree_diagonal().astype(adt)
     if isinstance(A, DiaMatrix):
         if 0 in A.offsets:
             return A.data[A.offsets.index(0)][: A.nrows].astype(adt)
@@ -501,6 +522,9 @@ def spmv_flops(A: DeviceMatrix) -> float:
     count would be an O(matrix) device->host copy -- ~3.8 GB for the
     512^3 DIA planes, i.e. minutes over a tunneled chip, for a flop
     statistic.  Only one scalar crosses the wire here."""
+    if _is_matfree(A):
+        # analytic count: no planes exist to scan, on device or off
+        return 3.0 * float(A.matfree_nnz())
     if isinstance(A, DiaMatrix):
         nnz = float(_count_nonzero_on_device(tuple(A.data)))
     elif isinstance(A, EllMatrix):

@@ -99,16 +99,44 @@ class StackedLocalBlock:
     goal, ``cg-kernels-cuda.cu:340-441``, round-4 verdict item 3).
     """
 
-    format: str      # "dia" | "ell" | "binnedell"
+    format: str      # "dia" | "ell" | "binnedell" | "matfree"
     arrays: tuple    # dia: ndiags x (P, nrows); ell: (data (P,nrows,K), cols)
     #                  binnedell: (bin_rows, bin_data, bin_cols tuples,
     #                              tail_rows, tail_cols, tail_vals)
-    offsets: tuple   # dia only: static diagonal offsets, ascending
+    #                  matfree: (row0 (P,1), nowned (P,1), *tables (P,L))
+    offsets: tuple   # dia/matfree: static diagonal offsets, ascending
     nrows: int
     bin_ks: tuple = ()   # binnedell only: static K_b per bin
+    # matfree only (acg_tpu.ops.operator / arm_matfree): the stencil
+    # operator TEMPLATE -- static metadata (kind, grid, dtype) keying
+    # the in-shard plane generation; its coefficient tables ride
+    # ``arrays`` stacked per part so they shard like every other block
+    operator: object = None
+
+    def gen_planes(self, arrays):
+        """Matfree: this shard's LOCAL-block DIA planes, generated from
+        the stacked (row0, nowned, *tables) arrays -- global stencil
+        values at rows [row0, row0 + nrows) masked to the owned x owned
+        window, bitwise-equal to what ``dia_planes_fixed`` would have
+        assembled (out-of-part couplings live in the ghost block,
+        padding rows are zero)."""
+        from acg_tpu.ops.operator import stencil_planes
+        op = self.operator
+        row0 = arrays[0].reshape(-1)[0]
+        nown = arrays[1].reshape(-1)[0]
+        return stencil_planes(op.kind, op.grid, self.offsets,
+                              tuple(arrays[2:]), self.nrows, op.dtype,
+                              row0=row0, nowned=nown)
 
     def shard_mv(self, arrays, x):
         """y = A_local @ x for one shard (arrays = leading axis stripped)."""
+        if self.format == "matfree":
+            # the matrix-free stencil tier: plane values generated in
+            # the shard (fused by XLA into the accumulate), then the
+            # SAME dia_mv accumulation as the assembled DIA path --
+            # zero matrix HBM traffic, bitwise-equal trajectories
+            return dia_mv(self.gen_planes(arrays), self.offsets,
+                          self.nrows, x)
         if self.format == "dia":
             return dia_mv(arrays, self.offsets, self.nrows, x)
         if self.format == "binnedell":
@@ -507,6 +535,12 @@ class DistributedProblem:
     def vdtype(self):
         return self.dtype if self.vector_dtype is None else self.vector_dtype
 
+    # the matrix-free stencil operator armed over this problem
+    # (arm_matfree; None = assembled local blocks).  The halo plan and
+    # ghost block stay assembled either way -- the operator replaces
+    # only the O(ndiags * N) local-plane HBM traffic
+    operator: object = None
+
     # parts whose matrix blocks this controller built (None = all);
     # scatter() only fills these, matching the device shards this
     # process can address
@@ -840,9 +874,10 @@ def make_dist_spmv_overlapped(prob: "DistributedProblem", comm: str,
     halo = prob.halo
     local_block = prob.local
     ghost_block = prob.ghost
-    if local_block.format not in ("dia", "ell"):
-        raise ValueError(f"overlapped SpMV needs DIA or ELL local "
-                         f"blocks (got {local_block.format!r})")
+    if local_block.format not in ("dia", "ell", "matfree"):
+        raise ValueError(f"overlapped SpMV needs DIA, ELL or matrix-"
+                         f"free local blocks (got "
+                         f"{local_block.format!r})")
     nrows = local_block.nrows
     offs = local_block.offsets
 
@@ -852,14 +887,19 @@ def make_dist_spmv_overlapped(prob: "DistributedProblem", comm: str,
         drops).  Bit-identical per row to ``shard_mv``: the DIA form
         accumulates plane products in the same plane order over the
         same padded-x values (:func:`acg_tpu.ops.spmv.dia_mv`), the ELL
-        form is the same row-independent einsum reduction."""
+        form is the same row-independent einsum reduction, and the
+        matrix-free form runs the DIA accumulation over GENERATED
+        plane values (the interior/border split applied to the stencil
+        apply -- the same split PR 13 gave the assembled SpMV)."""
         adt = acc_dtype(x.dtype)
-        if local_block.format == "dia":
+        if local_block.format in ("dia", "matfree"):
+            planes = (local_block.gen_planes(la)
+                      if local_block.format == "matfree" else la)
             L = max(0, -min(offs))
             R = max(0, max(offs))
             xp = jnp.pad(x, (L, R))
             acc = jnp.zeros(rows.shape, adt)
-            for plane, off in zip(la, offs):
+            for plane, off in zip(planes, offs):
                 acc = acc + (plane[rows].astype(adt)
                              * xp[rows + (L + off)].astype(adt))
             return acc.astype(x.dtype)
@@ -902,6 +942,72 @@ def make_dist_spmv_overlapped(prob: "DistributedProblem", comm: str,
         return y
 
     return dist_spmv
+
+
+def arm_matfree(prob: "DistributedProblem", op) -> "DistributedProblem":
+    """Arm the matrix-free operator tier over a built distributed
+    problem: replace the assembled LOCAL planes with a ``matfree``
+    stacked block whose shard-level SpMV GENERATES the stencil values
+    (ops.operator.stencil_planes over per-part ``(row0, nowned)`` and
+    the operator's O(grid-side) tables), while the halo plan and the
+    ghost block -- the O(border) boundary-strip coupling -- stay
+    assembled and ride the existing exchange machinery (all_to_all or
+    one-sided DMA) unchanged.  In-place on ``prob``; returns it.
+
+    Needs the full-information build over a CONTIGUOUS natural-order
+    band partition (each part's local rows are then a global row range,
+    so the generated global planes masked to the owned window equal the
+    assembled ``dia_planes_fixed`` stacking bitwise); anything else
+    refuses self-describingly rather than silently answering a
+    different system."""
+    from acg_tpu.ops.operator import StencilOperator
+
+    if not isinstance(op, StencilOperator):
+        raise AcgError(
+            ErrorCode.NOT_SUPPORTED,
+            "the distributed matrix-free tier runs the built-in "
+            "stencil operators (their local structure is derivable per "
+            "part); user-registered operators ride the single-device "
+            "tiers")
+    if prob.owned_parts is not None:
+        raise AcgError(
+            ErrorCode.NOT_SUPPORTED,
+            "matrix-free arming needs the full-information build "
+            "(restricted multi-controller builds hold other "
+            "controllers' subdomains as stubs)")
+    if int(op.nrows) != int(prob.n):
+        raise AcgError(
+            ErrorCode.INVALID_VALUE,
+            f"operator computes a {op.nrows}-row system; this problem "
+            f"has {prob.n} rows")
+    if np.dtype(str(op.dtype)) != np.dtype(prob.dtype):
+        raise AcgError(
+            ErrorCode.INVALID_VALUE,
+            f"operator dtype {op.dtype} != problem dtype "
+            f"{np.dtype(prob.dtype)}")
+    rows0, nowns = [], []
+    for s in prob.subs:
+        gids = np.asarray(s.global_ids[: s.nowned], dtype=np.int64)
+        if s.nowned and (s.owned_order != "natural"
+                         or int(gids[-1]) - int(gids[0]) + 1 != s.nowned):
+            raise AcgError(
+                ErrorCode.NOT_SUPPORTED,
+                f"matrix-free stencils need a contiguous natural-order "
+                f"band partition (part {s.part} owns a scattered row "
+                f"set); use --partition-method band")
+        rows0.append(int(gids[0]) if s.nowned else 0)
+        nowns.append(int(s.nowned))
+    P = prob.nparts
+    arrays = (np.asarray(rows0, np.int32).reshape(P, 1),
+              np.asarray(nowns, np.int32).reshape(P, 1))
+    for t in op.tables:
+        arrays = arrays + (np.broadcast_to(
+            np.asarray(t), (P,) + np.shape(t)).copy(),)
+    prob.local = StackedLocalBlock(format="matfree", arrays=arrays,
+                                   offsets=op.offsets,
+                                   nrows=prob.nmax_owned, operator=op)
+    prob.operator = op
+    return prob
 
 
 class DistCGSolver:
@@ -1008,12 +1114,12 @@ class DistCGSolver:
             # gather form of the local block and the full-information
             # build (the split derives from every part's coupled-row
             # list)
-            if problem.local.format not in ("dia", "ell"):
+            if problem.local.format not in ("dia", "ell", "matfree"):
                 raise ValueError(
-                    "kernels='fused' needs DIA or ELL local blocks "
-                    f"(this problem stacked {problem.local.format!r}, "
-                    f"which has no per-row gather form); use "
-                    f"kernels='auto'")
+                    "kernels='fused' needs DIA, ELL or matrix-free "
+                    f"local blocks (this problem stacked "
+                    f"{problem.local.format!r}, which has no per-row "
+                    f"gather form); use kernels='auto'")
             if problem.owned_parts is not None:
                 raise ValueError(
                     "kernels='fused' needs the full-information build: "
@@ -2349,6 +2455,16 @@ class DistCGSolver:
             # actual edge of this halo plan
             "ring_distances": sorted({n["hops"] for n in neighbors}),
         }
+        if prob.operator is not None:
+            # the matrix-free stencil ledger: who the operator is, and
+            # what the "matrix read" actually costs per apply -- the
+            # O(grid-side) coefficient tables (0 for constant
+            # stencils), NOT nnz * itemsize.  --explain prices the
+            # roofline's matrix-bytes term from this
+            led["operator"] = prob.operator.identity()
+            led["matrix_free"] = True
+            led["matrix_bytes_per_spmv"] = int(
+                prob.operator.table_bytes())
         if self.kernels == "fused":
             # the overlap declaration of the fused tier: how much
             # interior-SpMV work is available to hide the halo latency
@@ -2362,7 +2478,8 @@ class DistCGSolver:
             nbor = int((np.asarray(prob.ghost.rows)
                         < prob.nmax_owned).sum())
             mat_b = int(np.dtype(prob.dtype).itemsize)
-            idx_b = 0 if prob.local.format == "dia" else 4
+            matfree = prob.local.format == "matfree"
+            idx_b = 0 if prob.local.format in ("dia", "matfree") else 4
             nnz_int = 0
             for p, s in enumerate(prob.subs):
                 if s.A_local is None:
@@ -2378,8 +2495,11 @@ class DistCGSolver:
                 "interior_nnz": nnz_int,
                 # HBM traffic of the interior SpMV phase: matrix reads
                 # plus the x gather + y write over the interior rows
-                "interior_matrix_bytes": (nnz_int * (mat_b + idx_b)
-                                          + 2 * nint * dbl),
+                # (matrix-free: the planes are generated, not read --
+                # only the vector traffic remains)
+                "interior_matrix_bytes": (
+                    (0 if matfree else nnz_int * (mat_b + idx_b))
+                    + 2 * nint * dbl),
             }
         if self.algo is not None:
             # communication-avoiding recurrences: the reduction
@@ -2836,11 +2956,16 @@ class DistCGSolver:
         # matrix bytes in the matrix dtype (differs from vectors under
         # mixed); DIA local blocks read no index arrays, ELL reads 4 B
         mat_dbl = np.dtype(prob.dtype).itemsize
-        idx_b = 0 if prob.local.format == "dia" else 4
+        idx_b = 0 if prob.local.format in ("dia", "matfree") else 4
+        # matrix-free local blocks read no planes at all -- the gemv
+        # row's bytes are the generated-operand vector traffic plus
+        # the O(grid-side) coefficient tables
+        mat_read = (prob.operator.table_bytes()
+                    if prob.operator is not None
+                    else prob.nnz_total * (mat_dbl + idx_b))
         ngemv = int(niter * spmv_eq) + 1
         st.ops["gemv"].add(ngemv, 0.0,
-                           (prob.nnz_total * (mat_dbl + idx_b)
-                            + 2 * n * dbl) * ngemv)
+                           (mat_read + 2 * n * dbl) * ngemv)
         # op census matching the single-device/eager accounting
         # (jax_cg.solve / host_cg.solve): the convergence test's (r, r)
         # is the nrm2 class, classic CG's p = r setup the one copy --

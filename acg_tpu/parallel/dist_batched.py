@@ -133,6 +133,13 @@ class BatchedDistCGSolver:
                     "CG (preconditioned batching lives on the "
                     "single-device tier, acg_tpu.solvers.batched); "
                     "drop precond or use nparts=1")
+        if problem.local.format == "matfree":
+            raise ValueError(
+                "the batched distributed tier runs assembled local "
+                "blocks (its multi-vector shard SpMV has no generated-"
+                "plane form yet); matrix-free batching lives on the "
+                "single-device tier (acg_tpu.solvers.batched), or drop "
+                "--nrhs for the matrix-free mesh solve")
         self.problem = problem
         self.pipelined = bool(pipelined)
         self.precise_dots = bool(precise_dots)

@@ -552,20 +552,33 @@ def _explain_tier(name, solver, b, csr, K, bw_gbs, dispatch_s, on_tpu,
 
     # analytic fallbacks when the compiler analysis is unavailable
     prob = getattr(solver, "problem", None)
+    matfree_tables = None
     if prob is not None:
         nnz, n = int(prob.nnz_total), int(prob.n)
         mat_b = int(np.dtype(prob.dtype).itemsize)
         vec_b = int(np.dtype(prob.vdtype).itemsize)
-        idx_b = 0.0 if prob.local.format == "dia" else 4.0
+        idx_b = 0.0 if prob.local.format in ("dia", "matfree") else 4.0
+        if getattr(prob, "operator", None) is not None:
+            matfree_tables = int(prob.operator.table_bytes())
     else:
         A = solver.A
         nnz, n = int(csr.nnz), int(csr.shape[0])
         mat_b = int(np.dtype(matrix_dtype(A)).itemsize)
         vec_b = int(np.dtype(solver._solve_dtype()).itemsize)
         idx_b = matrix_index_bytes(A)
+        if hasattr(A, "matfree_apply"):
+            matfree_tables = int(A.table_bytes())
     flops_it_analytic = cg_flops_per_iteration(nnz, n, solver.pipelined)
-    bytes_it_analytic = analytic_bytes_per_iteration(
-        nnz, n, idx_b, mat_b, vec_b, solver.pipelined)
+    if matfree_tables is not None:
+        # matrix-free operator tier: the roofline's matrix-bytes term
+        # goes to (nearly) zero -- the apply reads the O(grid-side)
+        # coefficient tables, not nnz * itemsize of planes.  Flops are
+        # unchanged (the multiply-adds still happen)
+        bytes_it_analytic = (analytic_bytes_per_iteration(
+            0, n, 0.0, 0, vec_b, solver.pipelined) + matfree_tables)
+    else:
+        bytes_it_analytic = analytic_bytes_per_iteration(
+            nnz, n, idx_b, mat_b, vec_b, solver.pipelined)
     spec = getattr(solver, "precond_spec", None)
     if spec is not None:
         # reclassify the roofline for PCG: one M^-1 apply per iteration
@@ -661,6 +674,11 @@ def _explain_tier(name, solver, b, csr, K, bw_gbs, dispatch_s, on_tpu,
         err.write(f"  per-iteration (analytic): flops "
                   f"{flops_it_analytic:,.4g}, bytes "
                   f"{bytes_it_analytic:,.4g}\n")
+    if matfree_tables is not None:
+        err.write(f"  matrix-free operator: matrix bytes/SpMV "
+                  f"{matfree_tables:,} (generated planes read only the "
+                  f"coefficient tables; the assembled twin reads "
+                  f"{nnz * (mat_b + idx_b):,.0f})\n")
     mem = an.get("memory") if an.get("available") else None
     if mem:
         err.write(f"  memory (HBM footprint): arguments "
@@ -736,6 +754,9 @@ def _explain_tier(name, solver, b, csr, K, bw_gbs, dispatch_s, on_tpu,
            "predicted_s_per_iter": predicted,
            "attained_roofline_frac": attained, "bound": verdict,
            "components_s": comp}
+    if matfree_tables is not None:
+        row["matrix_free"] = True
+        row["matrix_bytes_per_spmv"] = matfree_tables
     if overlap is not None:
         row["overlap_model"] = overlap
     if cal is not None:
@@ -751,21 +772,25 @@ def _explain_tier(name, solver, b, csr, K, bw_gbs, dispatch_s, on_tpu,
 
 
 def build_explain_dist_solver(args, csr, nparts, dtype, vec_dtype,
-                              **solver_kw):
+                              operator=None, **solver_kw):
     """The dist analysis tier's construction, shared by
     :func:`run_explain` and the commbench observatory (ONE copy: same
     partition method/seed, same transport resolution -- a commbench
     calibration must describe the very mesh the explain verdict
-    prices)."""
+    prices).  ``operator`` (a matrix-free stencil) forces the band
+    partition it requires and arms the matfree local block."""
     from acg_tpu.ops.spmv import prefers_dia
     from acg_tpu.parallel.dist import (DistCGSolver, DistributedProblem,
-                                       resolve_comm)
+                                       arm_matfree, resolve_comm)
     from acg_tpu.partition import partition_rows
 
-    method = "band" if prefers_dia(csr) else "graph"
+    method = "band" if operator is not None or prefers_dia(csr) \
+        else "graph"
     part = partition_rows(csr, nparts, seed=args.seed, method=method)
     prob = DistributedProblem.build(csr, part, nparts, dtype=dtype,
                                     vector_dtype=vec_dtype)
+    if operator is not None:
+        arm_matfree(prob, operator)
     return DistCGSolver(prob, pipelined=False,
                         comm=resolve_comm(args.comm),
                         precise_dots=args.precise_dots,
@@ -831,6 +856,19 @@ def run_explain(args, dtype, vec_dtype) -> int:
     from acg_tpu.ops.spmv import device_matrix_from_csr
     from acg_tpu.solvers.jax_cg import JaxCGSolver
 
+    # the matrix-free operator tier (--operator): the single tiers run
+    # over the operator itself, the dist tier arms the matfree local
+    # block -- the roofline's matrix-bytes term then goes to ~0
+    op = None
+    if getattr(args, "_operator_spec", None) is not None:
+        # ONE construction path with the CLI solve (validation against
+        # the gen: matrix, SystemExit wrapping, manifest identity
+        # recording) -- a duplicate here would let the two drift
+        from acg_tpu.cli import _build_cli_operator
+        op = _build_cli_operator(args, n, dtype)
+    op_id = op.identity() if op is not None else None
+    op_tag = f", operator {op_id}" if op_id else ""
+
     rows = []
     # under --trace the WHOLE tier sweep runs inside one profiler
     # capture (acg_tpu.tracing): the measured section below then
@@ -840,7 +878,8 @@ def run_explain(args, dtype, vec_dtype) -> int:
     with profiler_trace(args.trace):
         # ONE device assembly serves both single-chip tiers (A is immutable;
         # rebuilding it per tier would re-upload every plane)
-        A = device_matrix_from_csr(csr, dtype=dtype, format=args.spmv_format)
+        A = op if op is not None else device_matrix_from_csr(
+            csr, dtype=dtype, format=args.spmv_format)
         for name, pipelined in (("cg", False), ("cg-pipelined", True)):
             try:
                 # the session's recovery policy rides along (--recover):
@@ -856,7 +895,8 @@ def run_explain(args, dtype, vec_dtype) -> int:
                 pc = getattr(args, "_precond", None)
                 row = _explain_tier(
                     f"{name} ({solver.kernels} kernels, {args.dtype}"
-                    + (f", precond {pc}" if pc is not None else "") + ")",
+                    + (f", precond {pc}" if pc is not None else "")
+                    + op_tag + ")",
                     solver, jnp.asarray(b, solver._solve_dtype()), csr, K, bw,
                     disp, on_tpu, err, cal=cal)
                 if row:
@@ -870,15 +910,15 @@ def run_explain(args, dtype, vec_dtype) -> int:
         # not scaling, are the point here)
         try:
             solver = build_explain_dist_solver(
-                args, csr, nparts, dtype, vec_dtype,
+                args, csr, nparts, dtype, vec_dtype, operator=op,
                 recovery=getattr(args, "_recovery", None),
                 precond=getattr(args, "_precond", None))
             pc = getattr(args, "_precond", None)
             row = _explain_tier(f"dist-cg (nparts={nparts}, {solver.kernels} "
                                 f"kernels, {args.dtype}"
                                 + (f", precond {pc}" if pc is not None
-                                   else "") + ")", solver, b, csr, K,
-                                bw, disp, on_tpu, err, cal=cal)
+                                   else "") + op_tag + ")", solver, b,
+                                csr, K, bw, disp, on_tpu, err, cal=cal)
             if row:
                 rows.append((row, solver))
         except Exception as e:  # noqa: BLE001
@@ -909,7 +949,7 @@ def run_explain(args, dtype, vec_dtype) -> int:
             for row, solver in rows:
                 man = telemetry.run_manifest(
                     metric=f"explain:{row['tier']}", matrix=str(args.A),
-                    dtype=args.dtype, explain=row,
+                    dtype=args.dtype, explain=row, operator=op_id,
                     calibration=(cal.get("calibration_id")
                                  if cal is not None else UNCALIBRATED))
                 telemetry.write_stats_json(args.stats_json, solver.stats,
@@ -1132,6 +1172,7 @@ def _doc_case(doc: dict):
         metric = f"{man.get('solver', 'solve')}:{man.get('matrix', '?')}"
     metric = _precond_keyed(metric, man.get("precond"))
     metric = _batch_keyed(metric, man.get("nrhs"), man.get("block_cg"))
+    metric = _operator_keyed(metric, man.get("operator"))
     metric = _calibration_keyed(metric, man.get("calibration"))
     soak = st.get("soak") or {}
     if soak:
@@ -1180,6 +1221,20 @@ def _batch_keyed(metric, nrhs, block=None) -> str:
     return metric
 
 
+def _operator_keyed(metric, operator) -> str:
+    """Fold the operator selection into the case key (the
+    _precond_keyed pattern): a matrix-free capture runs a different
+    program -- zero matrix HBM traffic -- than an assembled one of the
+    same system and must never silently diff against it.  Absent keys
+    (every assembled capture, and all pre-/11 captures) add nothing, so
+    old baselines keep comparing."""
+    metric = str(metric)
+    op = str(operator or "")
+    if op and op != "none":
+        return f"{metric}|operator={op}"
+    return metric
+
+
 def _calibration_keyed(metric, calibration) -> str:
     """Fold a commbench calibration id into the case key (the
     _precond_keyed pattern): two captures explained/priced under
@@ -1202,6 +1257,7 @@ def _row_case(row: dict):
         return None
     key = _precond_keyed(metric, row.get("precond"))
     key = _batch_keyed(key, row.get("nrhs"), row.get("block"))
+    key = _operator_keyed(key, row.get("operator"))
     key = _calibration_keyed(key, row.get("calibration"))
     return key, float(value)
 

@@ -287,8 +287,19 @@ def setup_single(spec: PrecondSpec, A, spmv_fn, sdt, A_program=None):
     always reads the clean ``A``, the power iteration runs over the
     program's operator."""
     if spec.kind == "jacobi":
+        # matrix-free operators reach this through the matrix_diagonal
+        # operator hook (analytic stencil diagonal; typed refusal for
+        # user operators registered without a diagonal_fn)
         return jacobi_state(A, sdt)
     if spec.kind == "bjacobi":
+        from acg_tpu.ops.operator import is_matrix_free
+        if is_matrix_free(A):
+            from acg_tpu.errors import AcgError, ErrorCode
+            raise AcgError(
+                ErrorCode.NOT_SUPPORTED,
+                "bjacobi factors stored diagonal blocks, which a "
+                "matrix-free operator does not have; use --precond "
+                "jacobi (analytic diagonal) or cheby:K (applies only)")
         return bjacobi_state(A, spec.block, sdt)
     Ap = A if A_program is None else A_program
     return cheby_state(estimate_lmax(spmv_fn, Ap, A.nrows, sdt), sdt)
@@ -489,6 +500,18 @@ def stacked_jacobi_state(prob, sdt) -> tuple:
     dinv = np.zeros((prob.nparts, n), dtype=np.dtype(sdt))
     owned = (range(prob.nparts) if prob.owned_parts is None
              else prob.owned_parts)
+    if local.format == "matfree":
+        # the operator-path twin: the ANALYTIC stencil diagonal (host
+        # numpy of the same rounded values the device generates),
+        # sliced per part -- no stored planes exist to scan
+        dglob = prob.operator.host_diagonal()
+        for p in owned:
+            s = prob.subs[p]
+            gids = np.asarray(s.global_ids[: s.nowned], np.int64)
+            d = dglob[gids]
+            nz = d != 0
+            dinv[p, : s.nowned][nz] = 1.0 / d[nz]
+        return (dinv,)
     for p in owned:
         rows, cols, vals = _np_local_block_triples(local, p)
         d = np.zeros(n, np.float64)
@@ -508,6 +531,12 @@ def stacked_bjacobi_state(prob, bs: int, sdt) -> tuple:
     from acg_tpu.errors import AcgError, ErrorCode
 
     local = prob.local
+    if local.format == "matfree":
+        raise AcgError(
+            ErrorCode.NOT_SUPPORTED,
+            "bjacobi factors stored local diagonal blocks, which the "
+            "matrix-free tier does not have; use --precond jacobi "
+            "(analytic diagonal) or cheby:K (applies only)")
     n = local.nrows
     nb = -(-n // bs)
     chol = np.zeros((prob.nparts, nb, bs, bs), dtype=np.dtype(sdt))

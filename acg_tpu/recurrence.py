@@ -238,7 +238,15 @@ class TierOps:
 
 def single_ops(A, kernels, dot, sdt, store, fault=None):
     """TierOps for the single-device tier (and the sharded-DIA tier,
-    whose mesh-aware SpMV arrives as a callable ``kernels``)."""
+    whose mesh-aware SpMV arrives as a callable ``kernels``).
+
+    ``A`` may be any DeviceMatrix OR a matrix-free operator
+    (acg_tpu.ops.operator): ``_spmv_fn`` routes through the ops.spmv
+    protocol dispatch, so this is the ONE SpMV source through which
+    every builder recurrence -- classic, pipelined, sstep:S,
+    pipelined:L -- inherits matrix-free operation (the s-step basis
+    products and the p(l) auxiliary-basis SpMVs are all ``ops.spmv``
+    calls; nothing below ever touches stored planes)."""
     from acg_tpu.solvers.jax_cg import _spmv_fn
     spmv_ = _spmv_fn(kernels)
 

@@ -69,6 +69,16 @@ def spmv_multi(A: DeviceMatrix, X: jax.Array) -> jax.Array:
     DIA path stays gather-free (statically-sliced 2-D views)."""
     adt = acc_dtype(X.dtype)
     with jax.named_scope(f"spmv_multi_{type(A).__name__}"):
+        if hasattr(A, "matfree_apply_multi"):
+            # matrix-free operator tier (ops.operator): the batched
+            # twin of the generated-plane apply -- the amortization is
+            # total (there was no matrix traffic to amortize)
+            return A.matfree_apply_multi(X)
+        if hasattr(A, "matfree_apply"):
+            # user operators registered without a multi-column form:
+            # vmap the single-column apply over the batch axis
+            return jax.vmap(lambda col: A.matfree_apply(col),
+                            in_axes=1, out_axes=1)(X)
         if isinstance(A, DiaMatrix):
             L = max(0, -min(A.offsets))
             R = max(0, max(A.offsets) + A.nrows - X.shape[0])

@@ -68,13 +68,20 @@ def _spmv_fn(kernels):
 
         return f
     if kernels.startswith("pallas"):
-        from acg_tpu.ops.pallas_kernels import dia_spmv
+        from acg_tpu.ops.pallas_kernels import dia_spmv, stencil_spmv
 
         interp = kernels.endswith("interpret")
 
         def f(A, x):
             if isinstance(A, DiaMatrix) and A.ncols_padded == A.nrows:
                 return dia_spmv(A.data, A.offsets, x, interpret=interp)
+            if getattr(A, "kind", None) == "poisson" \
+                    and hasattr(A, "matfree_apply"):
+                # the matrix-free stencil's Pallas path: coefficient
+                # masks generated IN-KERNEL while x streams through
+                # VMEM once (falls back to the operator's XLA apply
+                # off the single-window route)
+                return stencil_spmv(A, x, interpret=interp)
             return spmv(A, x)
 
         return f
@@ -1231,6 +1238,22 @@ class JaxCGSolver:
                                  "kernels='xla'/'pallas' (the fused "
                                  "two-phase iteration has no replacement "
                                  "hook)")
+        # matrix-free operator tier (acg_tpu.ops.operator): the apply
+        # rides every program through the ops.spmv dispatch, so no
+        # per-program changes exist -- but bf16 vector storage has no
+        # matrix traffic to halve here (the planes are generated) and
+        # its kappa cap buys nothing: refuse rather than run a
+        # pointless degraded tier
+        self._matfree = hasattr(A, "matfree_apply")
+        if self._matfree:
+            vdt = (jnp.dtype(vector_dtype) if vector_dtype is not None
+                   else jnp.dtype(matrix_dtype(A)))
+            if vdt == jnp.bfloat16:
+                raise ValueError(
+                    "matrix-free operators generate their plane values "
+                    "in the storage dtype and have no matrix HBM "
+                    "traffic for bf16 to halve; use f32/f64 vectors "
+                    "(the assembled tiers keep the bf16 contract)")
         from acg_tpu.precond import parse_precond
         self.precond_spec = parse_precond(precond)
         if self.precond_spec is not None:

@@ -102,7 +102,14 @@ from acg_tpu.solvers.stats import PHASE_ORDER
 # section (measured SpMV/halo/reduction decomposition), and a
 # "calibration" key on the convergence-log meta line -- additive, so
 # /1../9 consumers keep working
-STATS_SCHEMA = "acg-tpu-stats/10"
+# /11: the matrix-free operator tier (acg_tpu.ops.operator) adds an
+# "operator" manifest key (the operator identity string, e.g.
+# "stencil:poisson2d:2048", present only when --operator is armed) that
+# joins the bench-diff case key (perfmodel._operator_keyed), and
+# "operator"/"matrix_free"/"matrix_bytes_per_spmv" keys inside the comm
+# ledger of matrix-free dist solves -- additive, so /1../10 consumers
+# keep working
+STATS_SCHEMA = "acg-tpu-stats/11"
 CONVERGENCE_SCHEMA = "acg-tpu-convergence/1"
 # default ring capacity (--telemetry-window): 512 iterations x 4 scalars
 # is 8 KiB of f32 carry -- negligible against any solve's vectors, and

@@ -1216,6 +1216,130 @@ def run_overlap_mode(args) -> int:
     return _finish(args, rows, 0)
 
 
+def run_matfree_mode(args) -> int:
+    """``bench.py --matfree``: the matrix-free operator sweep (ISSUE 15
+    acceptance) -- s/iteration of the matrix-free stencil apply vs the
+    assembled ``gen:`` DIA planes vs the general assembled gather
+    format (ELL, the CSR-class fallback) at 2-3 sizes on the single
+    device AND the assembled-vs-matfree pair on the 8-part mesh,
+    fixed-iteration protocol.  Matrix-free rows carry the ``operator``
+    identity so bench_diff keys them apart from assembled captures
+    (perfmodel._operator_keyed), and the headline comparison is the
+    matfree-vs-DIA s/iter at the largest (most HBM-bound) size."""
+    import numpy as np
+
+    from acg_tpu._platform import provision_host_mesh
+
+    jax = provision_host_mesh(8)
+    if len(jax.devices()) < 8:
+        env = dict(os.environ)
+        env["JAX_PLATFORMS"] = "cpu"
+        env.setdefault("XLA_FLAGS",
+                       "--xla_force_host_platform_device_count=8")
+        import subprocess
+        return subprocess.run(
+            [sys.executable, os.path.abspath(__file__), "--matfree",
+             "--matfree-sides", args.matfree_sides,
+             "--matfree-its", str(args.matfree_its),
+             "--fail-on-regress", str(args.fail_on_regress)]
+            + (["--stats-json", args.stats_json] if args.stats_json
+               else [])
+            + (["--baseline", args.baseline] if args.baseline else []),
+            env=env).returncode
+
+    import jax.numpy as jnp
+
+    from acg_tpu._platform import device_sync
+    from acg_tpu.ops.operator import poisson_stencil
+    from acg_tpu.ops.spmv import device_matrix_from_csr, dia_from_csr
+    from acg_tpu.parallel.dist import (DistCGSolver, DistributedProblem,
+                                       arm_matfree)
+    from acg_tpu.partition import partition_rows
+    from acg_tpu.solvers.jax_cg import JaxCGSolver
+    from acg_tpu.solvers.stats import StoppingCriteria
+
+    sides = [int(s) for s in args.matfree_sides.split(",") if s]
+    its = args.matfree_its
+    crit = StoppingCriteria(maxits=its)   # fixed-work protocol
+    rows = []
+
+    def best_of(fn, reps=3):
+        ts = []
+        for _ in range(reps):
+            t0 = time.perf_counter()
+            fn()
+            ts.append(time.perf_counter() - t0)
+        return min(ts)
+
+    def emit(name, side, nparts, t, solver, op=None, extra=None):
+        row = {
+            "metric": f"matfree_cg_iters_per_sec_poisson2d_n{side}"
+                      f"_np{nparts}_f32_its{its}_{name}",
+            "case": name,
+            "value": round(its / t, 2),
+            "unit": "iters/s",
+            "s_per_iter": round(t / its, 8),
+            "dtype": "f32",
+            "nparts": nparts,
+            "iterations": int(solver.stats.niterations),
+        }
+        if op is not None:
+            row["operator"] = op.identity()
+        if extra:
+            row.update(extra)
+        print(f"# n={side} np={nparts} {name}: {t:.3f}s for {its} its "
+              f"({its / t:.1f} iters/s)", file=sys.stderr)
+        print(json.dumps(row))
+        rows.append(row)
+        _sink_stats(row, solver)
+        sys.stdout.flush()
+
+    for side in sides:
+        csr = _build(side, 2)
+        n = csr.shape[0]
+        rng = np.random.default_rng(0)
+        b = rng.standard_normal(n).astype(np.float32)
+        op = poisson_stencil(side, 2, dtype=jnp.float32)
+        single = [
+            ("matfree", op, op),
+            ("dia", dia_from_csr(csr, dtype=jnp.float32), None),
+            ("ell", device_matrix_from_csr(csr, dtype=jnp.float32,
+                                           format="ell"), None),
+        ]
+        for name, A, op_row in single:
+            s = JaxCGSolver(A, kernels="xla")
+            device_sync(s.solve(b, criteria=crit, host_result=False,
+                                raise_on_divergence=False))  # compile
+
+            def once(s=s):
+                device_sync(s.solve(b, criteria=crit, host_result=False,
+                                    raise_on_divergence=False))
+
+            emit(name, side, 1, best_of(once), s, op=op_row)
+
+        # 8-part mesh pair: assembled DIA vs armed matfree over the
+        # SAME band partition / halo plan
+        part = partition_rows(csr, 8, seed=0, method="band")
+        for name, armed in (("dist_dia", False), ("dist_matfree", True)):
+            prob = DistributedProblem.build(csr, part, 8,
+                                            dtype=jnp.float32)
+            if armed:
+                arm_matfree(prob, op)
+            s = DistCGSolver(prob)
+            device_sync(s.solve(b, criteria=crit, host_result=False,
+                                raise_on_divergence=False))  # compile
+
+            def once(s=s):
+                device_sync(s.solve(b, criteria=crit, host_result=False,
+                                    raise_on_divergence=False))
+
+            led = s.comm_profile()
+            emit(name, side, 8, best_of(once), s,
+                 op=op if armed else None,
+                 extra={"matrix_free": bool(led.get("matrix_free"))})
+    return _finish(args, rows, 0)
+
+
 def _finish(args, rows, rc: int) -> int:
     """Apply the --baseline regression gate to this run's emitted rows
     (the perfmodel tier's case-by-case diff -- same engine as
@@ -1285,6 +1409,23 @@ def main(argv=None) -> int:
     ap.add_argument("--overlap-its", type=int, default=200,
                     metavar="K",
                     help="with --overlap: fixed iterations per case "
+                         "(default 200)")
+    ap.add_argument("--matfree", action="store_true",
+                    help="run the matrix-free operator sweep (matfree "
+                         "vs assembled DIA vs assembled ELL on the "
+                         "single device, assembled-vs-matfree on the "
+                         "8-part mesh; fixed-iteration protocol, one "
+                         "JSON line per case; matfree rows carry the "
+                         "operator identity for bench_diff keying)")
+    ap.add_argument("--matfree-sides", default="256,512,1024",
+                    metavar="N,N",
+                    help="with --matfree: comma-separated Poisson grid "
+                         "sides (default 256,512,1024 -- the largest "
+                         "is bandwidth-bound on every backend "
+                         "measured, where deleting the plane reads "
+                         "shows up)")
+    ap.add_argument("--matfree-its", type=int, default=200, metavar="K",
+                    help="with --matfree: fixed iterations per case "
                          "(default 200)")
     ap.add_argument("--batched", action="store_true",
                     help="batched multi-RHS throughput case: solves/s "
@@ -1390,6 +1531,11 @@ def main(argv=None) -> int:
         # like --algorithms: provisions its own 8-part virtual CPU
         # mesh, so it runs BEFORE the backend probe
         return run_overlap_mode(args)
+
+    if args.matfree:
+        # like --overlap: provisions its own 8-part virtual CPU mesh,
+        # so it runs BEFORE the backend probe
+        return run_matfree_mode(args)
 
     if args.batched:
         # like --sweep-np, provisions its own 8-part virtual CPU mesh

@@ -7,7 +7,7 @@
 # T1_SOAK=1 additionally runs the service-soak smoke after the tests: a
 # tiny 3-solve --soak run whose --metrics-file must validate as
 # Prometheus exposition format and whose --stats-json must carry the
-# acg-tpu-stats/10 soak section (the CI soak-smoke step runs the same
+# acg-tpu-stats/11 soak section (the CI soak-smoke step runs the same
 # thing).  T1_HEALTH=1 runs the numerical-health smoke: an audited
 # pipelined solve on the anisotropic generator must leave a health:
 # section with a finite gap, the acg_health_* metric families, and a
@@ -35,6 +35,11 @@
 # every column, leave a /9 stats document with the per-RHS batch:
 # section, a status document whose solve.batch block names the
 # slowest RHS, and one history ledger row carrying the batch section.
+# T1_MATFREE=1 runs the matrix-free operator smoke: an 8-part mesh
+# stencil solve under --operator stencil must converge with a printed
+# solution BYTE-IDENTICAL to the assembled run's, carry the operator
+# identity in the stats manifest, and declare matrix_free with a zero
+# matrix-bytes term in the comm ledger.
 # T1_COMMBENCH=1 runs the communication-observatory smoke: an 8-part
 # --commbench sweep must emit a valid acg-tpu-commbench/1 document
 # (fitted alpha-beta per collective kind, per-edge DMA rows, measured
@@ -63,7 +68,7 @@ if [ "${T1_SOAK:-0}" = "1" ]; then
     python - <<'PY' || rc=$((rc ? rc : 1))
 import json
 doc = json.load(open("/tmp/_t1_soak.json"))
-assert doc["schema"] == "acg-tpu-stats/10", doc["schema"]
+assert doc["schema"] == "acg-tpu-stats/11", doc["schema"]
 soak = doc["stats"]["soak"]
 assert soak["nsolves"] == 3 and soak["latency"]["p50"] is not None, soak
 assert "metrics" in doc, "registry snapshot missing from /3 document"
@@ -85,7 +90,7 @@ if [ "${T1_PRECOND:-0}" = "1" ]; then
         env PC="$pc" python - <<'PY' || rc=$((rc ? rc : 1))
 import json, os
 doc = json.load(open("/tmp/_t1_precond.json"))
-assert doc["schema"] == "acg-tpu-stats/10", doc["schema"]
+assert doc["schema"] == "acg-tpu-stats/11", doc["schema"]
 st = doc["stats"]
 assert st["converged"] is True, st["rnrm2"]
 assert st["precond"]["kind"] == os.environ["PC"], st["precond"]
@@ -121,7 +126,7 @@ if [ "${T1_HEALTH:-0}" = "1" ]; then
     python - <<'PY' || rc=$((rc ? rc : 1))
 import json, math
 doc = json.load(open("/tmp/_t1_health.json"))
-assert doc["schema"] == "acg-tpu-stats/10", doc["schema"]
+assert doc["schema"] == "acg-tpu-stats/11", doc["schema"]
 h = doc["stats"]["health"]
 assert h["naudits"] > 0, h
 assert h["gap_last"] is not None and math.isfinite(h["gap_last"]), h
@@ -160,7 +165,7 @@ if [ "${T1_CKPT:-0}" = "1" ]; then
     python - <<'PY' || rc=$((rc ? rc : 1))
 import json
 doc = json.load(open("/tmp/_t1_ckpt.json"))
-assert doc["schema"] == "acg-tpu-stats/10", doc["schema"]
+assert doc["schema"] == "acg-tpu-stats/11", doc["schema"]
 st = doc["stats"]
 assert st["converged"] is True, st["rnrm2"]
 ck = st["ckpt"]
@@ -199,7 +204,7 @@ if [ "${T1_TRACE:-0}" = "1" ]; then
     python - <<'PY' || rc=$((rc ? rc : 1))
 import json
 doc = json.load(open("/tmp/_t1_trace.json"))
-assert doc["schema"] == "acg-tpu-stats/10", doc["schema"]
+assert doc["schema"] == "acg-tpu-stats/11", doc["schema"]
 tr = doc["stats"]["tracing"]
 tl = tr["timeline"]
 assert tl["nparts"] == 8 and tl["nspans"] > 0, tl
@@ -248,7 +253,7 @@ assert len(ledgers) == 1, ledgers
 row = json.loads(open(f"/tmp/_t1_history/{ledgers[0]}").readline())
 assert row["ledger"] == "acg-tpu-history/1", row["ledger"]
 assert row["nparts"] == 8 and row["converged"] is True, row
-assert row["doc"]["schema"] == "acg-tpu-stats/10", row["doc"]["schema"]
+assert row["doc"]["schema"] == "acg-tpu-stats/11", row["doc"]["schema"]
 sj = json.load(open("/tmp/_t1_status_stats.json"))
 assert sj["stats"]["slo"]["targets"]["iters"] == 280, sj["stats"]["slo"]
 print(f"T1_STATUS: OK (iteration {doc['solve']['iteration']}, "
@@ -334,7 +339,7 @@ if [ "${T1_BATCH:-0}" = "1" ]; then
     python - <<'PY' || rc=$((rc ? rc : 1))
 import json, os
 doc = json.load(open("/tmp/_t1_batch.json"))
-assert doc["schema"] == "acg-tpu-stats/10", doc["schema"]
+assert doc["schema"] == "acg-tpu-stats/11", doc["schema"]
 batch = doc["stats"]["batch"]
 assert batch["nrhs"] == 4 and len(batch["iterations"]) == 4, batch
 assert all(batch["converged"]) and batch["unconverged"] == 0, batch
@@ -488,6 +493,64 @@ uncal = (row["uncalibrated_predicted_s_per_iter"]
 assert abs(math.log(ratio)) < abs(math.log(uncal)), (ratio, uncal)
 print(f"T1_COMMBENCH: OK (id {doc['calibration_id']}, calibrated "
       f"ratio {ratio:.2f}x vs uncalibrated {uncal:.2f}x)")
+PY
+fi
+if [ "${T1_MATFREE:-0}" = "1" ]; then
+    # matrix-free operator smoke (the ISSUE-15 acceptance in
+    # miniature): an 8-part mesh stencil solve with --operator stencil
+    # must converge, its printed solution must be BYTE-IDENTICAL to
+    # the assembled run's (the bitwise-trajectory contract observed
+    # end to end), the stats manifest must carry the operator
+    # identity, and the comm ledger must declare matrix_free with a
+    # zero matrix-bytes term
+    echo "T1_MATFREE: 8-part matrix-free stencil smoke"
+    rm -f /tmp/_t1_mf_a.mtx /tmp/_t1_mf_m.mtx /tmp/_t1_mf.json
+    timeout -k 10 300 env JAX_PLATFORMS=cpu \
+        XLA_FLAGS="--xla_force_host_platform_device_count=8" \
+        python -m acg_tpu.cli gen:poisson2d:24 --nparts 8 \
+        --max-iterations 300 --residual-rtol 1e-8 --warmup 0 --quiet \
+        -o /tmp/_t1_mf_a.mtx || rc=$((rc ? rc : 1))
+    timeout -k 10 300 env JAX_PLATFORMS=cpu \
+        XLA_FLAGS="--xla_force_host_platform_device_count=8" \
+        python -m acg_tpu.cli gen:poisson2d:24 --nparts 8 \
+        --operator stencil \
+        --max-iterations 300 --residual-rtol 1e-8 --warmup 0 --quiet \
+        -o /tmp/_t1_mf_m.mtx \
+        --stats-json /tmp/_t1_mf.json || rc=$((rc ? rc : 1))
+    cmp -s /tmp/_t1_mf_a.mtx /tmp/_t1_mf_m.mtx || {
+        echo "T1_MATFREE: matrix-free solution differs from assembled"
+        rc=$((rc ? rc : 1)); }
+    timeout -k 10 300 env JAX_PLATFORMS=cpu \
+        XLA_FLAGS="--xla_force_host_platform_device_count=8" \
+        python - <<'PY' || rc=$((rc ? rc : 1))
+import json
+import numpy as np
+import jax.numpy as jnp
+doc = json.load(open("/tmp/_t1_mf.json"))
+assert doc["schema"] == "acg-tpu-stats/11", doc["schema"]
+st = doc["stats"]
+assert st["converged"] is True, st["rnrm2"]
+assert doc["manifest"]["operator"] == "stencil:poisson2d:24", \
+    doc["manifest"]
+assert doc["manifest"]["partition"]["local_format"] == "matfree", \
+    doc["manifest"]["partition"]
+from acg_tpu.io.generators import poisson2d_coo
+from acg_tpu.matrix import SymCsrMatrix
+from acg_tpu.ops.operator import poisson_stencil
+from acg_tpu.parallel.dist import (DistCGSolver, DistributedProblem,
+                                   arm_matfree)
+from acg_tpu.partition import partition_rows
+r, c, v, N = poisson2d_coo(24)
+csr = SymCsrMatrix.from_coo(N, r, c, v).to_csr()
+part = partition_rows(csr, 8, seed=0, method="band")
+prob = DistributedProblem.build(csr, part, 8, dtype=jnp.float64)
+arm_matfree(prob, poisson_stencil(24, 2, dtype=jnp.float64))
+led = DistCGSolver(prob).comm_profile()
+assert led["matrix_free"] is True, led
+assert led["operator"] == "stencil:poisson2d:24", led
+assert led["matrix_bytes_per_spmv"] == 0, led
+print(f"T1_MATFREE: OK (converged in {st['niterations']} iterations, "
+      f"byte-identical to assembled, ledger matrix-bytes 0)")
 PY
 fi
 exit $rc

@@ -483,3 +483,69 @@ def test_pl_collective_counts(prob):
                          f"expected 4")
         assert ata == 2, (f"pipelined:{L} lowered {ata} all_to_alls, "
                           f"expected 2")
+
+
+# -- matrix-free operator tier (acg_tpu.ops.operator): disarmed =
+# byte-identical; armed keeps the assembled collective pins ---------------
+
+def _armed_matfree_prob():
+    from acg_tpu.ops.operator import poisson_stencil
+    from acg_tpu.parallel.dist import arm_matfree
+
+    r, c, v, N = poisson2d_coo(16)
+    csr = SymCsrMatrix.from_coo(N, r, c, v).to_csr()
+    part = partition_rows(csr, 4, seed=0, method="band")
+    p = DistributedProblem.build(csr, part, 4, dtype=jnp.float64)
+    arm_matfree(p, poisson_stencil(16, 2, dtype=jnp.float64))
+    return p
+
+
+def test_matfree_dist_collective_counts(prob):
+    """Matrix-free dist programs keep the assembled collective pins
+    EXACTLY -- classic 5 AR / 2 A2A, pipelined 5/3: only the local
+    plane reads vanished, the halo/reduction machinery is untouched --
+    and comm='dma' drops the all_to_alls entirely (the one-sided
+    transport, unchanged under the operator)."""
+    for pipelined, want in ((False, (5, 2)), (True, (5, 3))):
+        ar, ata, wl = _counts(_lowered_text(_armed_matfree_prob(),
+                                            pipelined))
+        assert wl >= 1
+        assert (ar, ata) == want, \
+            f"matfree pipelined={pipelined}: {(ar, ata)} != {want}"
+    s = DistCGSolver(_armed_matfree_prob(), comm="dma")
+    ar, ata, _ = _counts(s.lower_solve(np.ones(16 * 16)).as_text())
+    assert ata == 0, f"comm='dma' matfree kept {ata} all_to_alls"
+    assert ar == 5
+
+
+def test_operator_disarmed_is_byte_identical(prob):
+    """--operator absent lowers byte-identical programs on every tier
+    (the precond/health/telemetry disarmament contract, extended to
+    the operator): arming a matfree TWIN problem leaves the plain
+    build's lowered text unchanged, and the armed program itself
+    differs (its local planes are gone)."""
+    from acg_tpu.io.generators import poisson2d_coo as _p2
+    from acg_tpu.ops.operator import poisson_stencil
+    from acg_tpu.ops.spmv import device_matrix_from_csr
+    from acg_tpu.solvers.jax_cg import JaxCGSolver
+
+    b = np.ones(prob.n)
+    plain_before = DistCGSolver(prob).lower_solve(b).as_text()
+    armed_txt = DistCGSolver(_armed_matfree_prob()).lower_solve(
+        b).as_text()
+    plain_after = DistCGSolver(prob).lower_solve(b).as_text()
+    assert plain_after == plain_before
+    assert armed_txt != plain_before
+
+    r, c, v, N = _p2(12)
+    csr = SymCsrMatrix.from_coo(N, r, c, v).to_csr()
+    b1 = np.ones(N)
+    A = device_matrix_from_csr(csr, dtype=jnp.float64)
+    assembled_before = JaxCGSolver(A, kernels="xla").lower_solve(
+        b1).as_text()
+    op_txt = JaxCGSolver(poisson_stencil(12, 2, dtype=jnp.float64),
+                         kernels="xla").lower_solve(b1).as_text()
+    assembled_after = JaxCGSolver(A, kernels="xla").lower_solve(
+        b1).as_text()
+    assert assembled_after == assembled_before
+    assert op_txt != assembled_before
