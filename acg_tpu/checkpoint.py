@@ -162,6 +162,24 @@ def carry_names(pipelined: bool, precond: bool) -> tuple:
     return ("x", "r", "w", "p", "t", "z", "gamma", "alpha")
 
 
+def ca_carry_names(kind: str) -> tuple:
+    """Loop-carry leaves of the COMMUNICATION-AVOIDING recurrences
+    (ROADMAP item 4c).  ``sstep``: at a block boundary the s-step
+    state is exactly classic-shaped -- the basis and Gram products
+    are rebuilt from ``(r, p)`` at every block start, so nothing else
+    survives the boundary and the snapshot layout matches classic CG's
+    (block-boundary-aligned cadence is the solver's job).  ``pl``: the
+    deep pipeline has no classic-shaped boundary, so the snapshot
+    carries its WHOLE working set -- the z-window ``Z``/``V``, the
+    Gram column ``zzq``, the pending products ``gb``, the scalar
+    histories ``gammas``/``deltas``, and the ABSOLUTE pipeline
+    counters ``j``/``adv``."""
+    if kind == "sstep":
+        return ("x", "r", "p", "gamma")
+    return ("x", "q", "dprev", "ptilde", "Z", "V", "zzq", "gb",
+            "gammas", "deltas", "j", "adv")
+
+
 # the batched tier's per-RHS carry leaves that are (B,)-shaped column
 # vectors rather than per-row vectors: replicated on the mesh tiers
 # (like the psum'd scalars), passed through untouched by repartition
@@ -370,7 +388,8 @@ def validate_resume(snap: SolverSnapshot, *, tier: str, pipelined: bool,
                     b_crc: int | None = None,
                     nparts: int | None = None,
                     repartition: bool = False,
-                    nrhs: int | None = None) -> None:
+                    nrhs: int | None = None,
+                    algorithm: str | None = None) -> None:
     """Refuse a snapshot that does not describe THIS solve: wrong tier,
     algorithm, preconditioner, size, dtype, partition count, or
     right-hand side.  A mismatch here means the operator pointed
@@ -414,6 +433,11 @@ def validate_resume(snap: SolverSnapshot, *, tier: str, pipelined: bool,
         if nparts is not None:
             need("nparts", int(nparts), "partition count")
     need("pipelined", bool(pipelined), "algorithm (pipelined)")
+    if algorithm is not None or m.get("algorithm") is not None:
+        # communication-avoiding recurrences snapshot a DIFFERENT carry
+        # layout per recurrence (ca_carry_names): an sstep:4 snapshot
+        # resumed as pipelined:3 (or classic) would scramble the state
+        need("algorithm", algorithm, "recurrence")
     need("precond", precond, "preconditioner")
     need("n", int(n), "unknowns")
     need("dtype", str(np.dtype(dtype)), "vector dtype")

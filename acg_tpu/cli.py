@@ -377,6 +377,61 @@ def make_parser() -> argparse.ArgumentParser:
     p.add_argument("--min-parts", type=int, default=1, metavar="M",
                    help="with --supervise: never shrink below M parts "
                         "(default: 1)")
+    p.add_argument("--grow-after", type=int, default=0, metavar="N",
+                   help="with --serve --supervise: grow-on-recovery -- "
+                        "a SHRUNKEN daemon (crash relaunch halved "
+                        "--nparts) that stays healthy for N served "
+                        "requests is relaunched back toward the "
+                        "original mesh width (doubling --nparts, with "
+                        "--resume-repartition), counted by "
+                        "acg_recovery_regrows_total (default: 0 = "
+                        "never grow back)")
+    p.add_argument("--serve", action="store_true",
+                   help="solver-service tier (acg_tpu.serve): run a "
+                        "LONG-LIVED daemon that owns the mesh and "
+                        "answers POST /solve over HTTP (JSON in/out; "
+                        "GET /status, /metrics, /healthz; POST "
+                        "/shutdown).  The positional matrix is "
+                        "preloaded into the OPERATOR CACHE; each "
+                        "request names its own gen: operator, b, and "
+                        "solver knobs.  Repeated request shapes hit "
+                        "the operator + compiled-program caches (zero "
+                        "ingest, zero compile -- acg_serve_cache_*), "
+                        "compatible queued requests coalesce into one "
+                        "batched multi-RHS solve (bitwise-equal to "
+                        "single service), admission control sheds with "
+                        "typed 429/503 responses and DOWNGRADES before "
+                        "refusing as the --slo error budget burns, and "
+                        "a failed request is answered with a typed "
+                        "error -- never a dead daemon.  --supervise "
+                        "wraps it in the relaunch/shrink/grow ladder "
+                        "(warm cache restore from --ckpt serve state); "
+                        "--chaos SEED[:N] fires seeded fault schedules "
+                        "at the LIVE daemon with per-request answer "
+                        "verification (exit 96 on wrong-answer-green)")
+    p.add_argument("--serve-port", type=int, default=0, metavar="PORT",
+                   help="with --serve: bind PORT (default 0 = "
+                        "OS-assigned, printed to stderr)")
+    p.add_argument("--serve-queue-depth", type=int, default=16,
+                   metavar="N",
+                   help="with --serve: bounded request queue depth; "
+                        "an arrival past it is shed with a typed 429 "
+                        "(default: 16)")
+    p.add_argument("--serve-coalesce", type=int, default=8, metavar="B",
+                   help="with --serve: coalesce up to B compatible "
+                        "queued requests into one batched multi-RHS "
+                        "solve (1 disables; default: 8)")
+    p.add_argument("--serve-deadline", type=float, default=60.0,
+                   metavar="SECONDS",
+                   help="with --serve: default per-request deadline "
+                        "(a request may set its own 'timeout'); an "
+                        "expired request is answered with a typed 504 "
+                        "(default: 60)")
+    p.add_argument("--serve-faults", action="store_true",
+                   help="with --serve: honour per-request 'fault' "
+                        "fields (crash / slow:S / device fault specs) "
+                        "-- the chaos campaign's hook; NEVER arm on a "
+                        "production service")
     p.add_argument("--chaos", metavar="SEED[:N]", default=None,
                    help="chaos campaign (acg_tpu.supervisor): generate "
                         "N (default 20) seeded randomized fault "
@@ -880,6 +935,27 @@ def _buildinfo(out) -> int:
          "fault campaign through the supervisor; per-schedule "
          "converged/agreed-abort/WRONG-ANSWER verdicts into the "
          "--history ledger, exit 96 on any wrong-answer-green)"),
+        ("solver service", "--serve (long-lived daemon: POST /solve "
+         "JSON requests against the owned mesh; GET /status /metrics "
+         "/healthz, POST /shutdown; operator + compiled-program "
+         "caches make repeated request shapes ZERO-ingest/ZERO-"
+         "compile -- acg_serve_cache_* families), --serve-port/"
+         "--serve-queue-depth/--serve-deadline (bounded queue + "
+         "per-request deadlines; typed 429/503/504 sheds, "
+         "acg_serve_shed_total), --slo burn drives the DEGRADE-"
+         "BEFORE-REFUSE ladder (acg_serve_degraded_total), "
+         "--serve-coalesce B (compatible queued requests merge into "
+         "one batched multi-RHS solve, bitwise-equal to single "
+         "service; acg_serve_coalesced_total), request isolation "
+         "(typed error answers, poisoned cache invalidation, bounded "
+         "retries -- the daemon never dies to a request), --serve "
+         "--supervise (relaunch with WARM operator-cache restore "
+         "from --ckpt serve state; --grow-after N regrows a shrunken "
+         "mesh, acg_recovery_regrows_total), --serve --chaos SEED[:N] "
+         "(seeded faults against the LIVE daemon, per-request answer "
+         "verification, exit 96 on wrong-answer-green), "
+         "--serve-faults (honour per-request fault fields -- chaos "
+         "hook only); acg_serve_* metric families"),
     ]
     for k, v in rows:
         out.write(f"{k}: {v}\n")
@@ -2268,6 +2344,13 @@ def main(argv=None) -> int:
             os.dup2(os.open(os.devnull, os.O_WRONLY), sys.stdout.fileno())
             return 0
     args = make_parser().parse_args(argv)
+    if args.serve:
+        # solver-service mode (acg_tpu.serve): the daemon owns its own
+        # lifecycle (metrics/observatory arming, signal-driven
+        # teardown); --supervise/--chaos wrap the LIVE daemon instead
+        # of batch children
+        from acg_tpu.serve import run_serve
+        return run_serve(args, list(argv))
     if args.chaos is not None or args.supervise:
         # elastic-recovery driver modes (acg_tpu.supervisor): the
         # supervisor owns the child solve processes' lifecycle; none of
@@ -2515,8 +2598,6 @@ def _main(args) -> int:
              "classic/pipelined tiers)", args.explain),
             ("--profile-ops (the replay census has no CA op map)",
              args.profile_ops is not None),
-            ("--ckpt/--resume (no CA checkpoint carry yet)",
-             args.ckpt is not None or args.resume is not None),
             ("--diff-atol/--diff-rtol (residual criteria only)",
              args.diff_atol > 0 or args.diff_rtol > 0),
         ] if on]

@@ -499,6 +499,48 @@ RECOVERY_MTTR = REGISTRY.histogram(
     "acg_recovery_mttr_seconds", "Seconds from the first failing "
     "child exit to the eventual converged run (--supervise; observed "
     "once per recovered incident).", buckets=SOLVE_SECONDS_BUCKETS)
+RECOVERY_REGROWS = REGISTRY.counter(
+    "acg_recovery_regrows_total", "Grow-on-recovery relaunches: a "
+    "shrunken child healthy long enough was relaunched back toward "
+    "the original mesh width (--grow-after).")
+# solver-service tier (acg_tpu.serve, --serve): request accounting,
+# the operator/program caches, and the admission-control ladder
+SERVE_REQUESTS = REGISTRY.counter(
+    "acg_serve_requests_total", "Requests answered by the solver "
+    "service, by outcome (ok/error/shed/expired/invalid).",
+    labelnames=("outcome",))
+SERVE_CACHE_HITS = REGISTRY.counter(
+    "acg_serve_cache_hits_total", "Serve cache hits (operator = "
+    "ingested matrix + device planes; program = constructed solver "
+    "whose jitted programs are compile-warm).", labelnames=("cache",))
+SERVE_CACHE_MISSES = REGISTRY.counter(
+    "acg_serve_cache_misses_total", "Serve cache misses (each one "
+    "paid an ingest or a program construction + compile).",
+    labelnames=("cache",))
+SERVE_CACHE_EVICTIONS = REGISTRY.counter(
+    "acg_serve_cache_evictions_total", "Serve cache LRU evictions.",
+    labelnames=("cache",))
+SERVE_CACHE_INVALIDATIONS = REGISTRY.counter(
+    "acg_serve_cache_invalidations_total", "Serve cache entries "
+    "dropped because a request poisoned them (request isolation).",
+    labelnames=("cache",))
+SERVE_SHED = REGISTRY.counter(
+    "acg_serve_shed_total", "Requests refused by admission control, "
+    "by reason (queue-full/slo-burn/deadline/shutdown).",
+    labelnames=("reason",))
+SERVE_COALESCED = REGISTRY.counter(
+    "acg_serve_coalesced_total", "Requests served through a coalesced "
+    "multi-RHS batched solve instead of singly.")
+SERVE_DEGRADED = REGISTRY.counter(
+    "acg_serve_degraded_total", "Requests served in degraded mode "
+    "(the SLO-burn ladder downgraded the solve configuration).")
+SERVE_WARM_RESTORES = REGISTRY.counter(
+    "acg_serve_warm_restores_total", "Operator-cache entries "
+    "re-ingested at daemon start from the persisted serve state "
+    "(self-healing warm restore).")
+SERVE_QUEUE_DEPTH = REGISTRY.gauge(
+    "acg_serve_queue_depth", "Requests currently queued in the "
+    "solver service.")
 # ABFT checksum-protected SpMV (acg_tpu.health, --abft)
 ABFT_CHECKS = REGISTRY.counter(
     "acg_abft_checks_total", "In-loop Huang-Abraham checksum "
@@ -685,6 +727,54 @@ def record_recovery_mttr(seconds: float) -> None:
     first failing child exit -> eventual converged run."""
     if _armed:
         RECOVERY_MTTR.observe(max(float(seconds), 0.0))
+
+
+def record_regrow() -> None:
+    """One grow-on-recovery relaunch (--supervise --grow-after): a
+    shrunken-but-healthy child relaunched toward the original width."""
+    if _armed:
+        RECOVERY_REGROWS.inc()
+
+
+def record_serve_request(outcome: str) -> None:
+    if _armed:
+        SERVE_REQUESTS.labels(outcome=str(outcome)).inc()
+
+
+def record_serve_cache(event: str, cache: str) -> None:
+    """One serve-cache event: ``event`` in hit/miss/evict/invalidate,
+    ``cache`` in operator/program."""
+    if not _armed:
+        return
+    fam = {"hit": SERVE_CACHE_HITS, "miss": SERVE_CACHE_MISSES,
+           "evict": SERVE_CACHE_EVICTIONS,
+           "invalidate": SERVE_CACHE_INVALIDATIONS}[event]
+    fam.labels(cache=str(cache)).inc()
+
+
+def record_serve_shed(reason: str) -> None:
+    if _armed:
+        SERVE_SHED.labels(reason=str(reason)).inc()
+
+
+def record_serve_coalesced(nrequests: int) -> None:
+    if _armed:
+        SERVE_COALESCED.inc(max(int(nrequests), 0))
+
+
+def record_serve_degraded() -> None:
+    if _armed:
+        SERVE_DEGRADED.inc()
+
+
+def record_serve_warm_restore(nentries: int) -> None:
+    if _armed:
+        SERVE_WARM_RESTORES.inc(max(int(nentries), 0))
+
+
+def record_serve_queue_depth(depth: int) -> None:
+    if _armed:
+        SERVE_QUEUE_DEPTH.set(max(int(depth), 0))
 
 
 def record_abft(nchecks: int, rel_last, ntrips: int) -> None:

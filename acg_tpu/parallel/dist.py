@@ -1216,9 +1216,10 @@ class DistCGSolver:
                     f"tiers")
             if ckpt is not None:
                 raise ValueError(
-                    f"{ca} does not expose its window/basis carry to "
-                    f"the checkpoint chunk driver yet; --ckpt/--resume "
-                    f"need --algorithm classic|pipelined")
+                    f"{ca} checkpoints on the single-device tier only "
+                    f"(checkpoint.ca_carry_names); on the mesh, "
+                    f"--ckpt/--resume need --algorithm "
+                    f"classic|pipelined")
             if self.health_spec is not None:
                 if self.algo.kind == "pl":
                     raise ValueError(

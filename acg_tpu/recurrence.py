@@ -628,12 +628,20 @@ def run_sstep_loop(ops: TierOps, s: int, basis: str, lam, b, x0, r,
                    gamma, res_tol, maxits, unbounded: bool, fault=None,
                    trace: int = 0, progress: int = 0, health=None,
                    what: str = "cg-sstep", leader=None, bnrm2=None,
-                   k_offset=None):
+                   k_offset=None, p=None, state_io: bool = False):
     """The s-step outer loop, shared verbatim by every tier: the tier
     contributes ``ops`` (its SpMV/halo machinery, its global dot, its
     ONE stacked reduction); the recurrence contributes everything else.
     Returns ``(x, k, gamma_f, bad, done, extras)`` with extras =
-    (ring?, audit?) in the jax_cg tail order."""
+    (ring?, audit?) in the jax_cg tail order.
+
+    ``p``/``state_io`` (the survivability tier): at a BLOCK BOUNDARY
+    the s-step state is exactly classic-shaped -- the basis and Gram
+    products are rebuilt from (r, p) at every block start -- so a
+    checkpoint carry is just ``(r, p, gamma)``.  A non-None ``p``
+    re-enters mid-trajectory (``r``/``gamma`` then come from the
+    snapshot too; ``p = r`` is the fresh-start value), and
+    ``state_io`` appends the final ``(r, p, gamma)`` to the return."""
     sdt = ops.sdt
     tol2 = res_tol * res_tol
     if health is not None:
@@ -643,7 +651,8 @@ def run_sstep_loop(ops: TierOps, s: int, basis: str, lam, b, x0, r,
         progress=progress, health=health, what=what, leader=leader,
         k_offset=k_offset)
     body.health_ctx.update({"b": b, "bnrm2": bnrm2})
-    init = (x0, r, r, gamma, jnp.int32(0), jnp.asarray(False))
+    init = (x0, r, r if p is None else p, gamma, jnp.int32(0),
+            jnp.asarray(False))
     if health is not None:
         init = init + (_health.audit_init(sdt, health),)
     if trace:
@@ -665,14 +674,26 @@ def run_sstep_loop(ops: TierOps, s: int, basis: str, lam, b, x0, r,
         extras = extras + (state[-1],)
     if health is not None:
         extras = extras + (state[-2] if trace else state[-1],)
+    if state_io:
+        return state[0], k, gamma_f, bad, done, extras, \
+            (state[1], state[2], gamma_f)
     return state[0], k, gamma_f, bad, done, extras
 
 
 def run_pl_loop(ops: TierOps, l: int, lam, x0, z0, eta, eta2, res_tol,
                 maxits, unbounded: bool, fault=None, trace: int = 0,
-                progress: int = 0, what: str = "cg-pl", leader=None):
+                progress: int = 0, what: str = "cg-pl", leader=None,
+                carry=None, state_io: bool = False):
     """The p(l) iteration loop, shared verbatim by every tier.  Returns
-    ``(x, adv, q, conv, bad, extras)``."""
+    ``(x, adv, q, conv, bad, extras)``.
+
+    ``carry``/``state_io`` (the survivability tier): the deep-pipeline
+    recurrence has no classic-shaped boundary -- its whole working set
+    (z-window ``Z``/``V``, Gram column ``zzq``/``gb``, scalar histories
+    ``gammas``/``deltas``, pipeline counters ``j``/``adv``) must
+    round-trip through a snapshot.  ``carry`` re-enters from the full
+    11-leaf state with ABSOLUTE ``j``/``adv`` (``maxits`` must then be
+    absolute too), ``state_io`` appends that state to the return."""
     sdt = ops.sdt
     tol2 = res_tol * res_tol
     n = x0.shape[0]
@@ -680,8 +701,14 @@ def run_pl_loop(ops: TierOps, l: int, lam, x0, z0, eta, eta2, res_tol,
     body = make_pl_step(ops, l, sigma, res_tol, maxits, fault=fault,
                         trace=trace, progress=progress, what=what,
                         leader=leader)
-    init = pl_init(l, n, x0, eta, x0.dtype, sdt, z0)
-    init = init + (eta2 < tol2, jnp.asarray(False))
+    if carry is None:
+        init = pl_init(l, n, x0, eta, x0.dtype, sdt, z0)
+        init = init + (eta2 < tol2, jnp.asarray(False))
+    else:
+        (q, dprev, ptilde, Z, V, zzq, gb, gammas, deltas, j, adv) = carry
+        init = (j.astype(jnp.int32), adv.astype(jnp.int32),
+                x0.astype(sdt), q, dprev, ptilde, Z, V, zzq, gb,
+                gammas, deltas, jnp.asarray(False), jnp.asarray(False))
     if trace:
         from acg_tpu import telemetry
         init = init + (telemetry.ring_init(trace, sdt),)
@@ -696,7 +723,12 @@ def run_pl_loop(ops: TierOps, l: int, lam, x0, z0, eta, eta2, res_tol,
 
     state = jax.lax.while_loop(cond, lambda st: body(st), init)
     extras = (state[-1],) if trace else ()
-    return (state[2], state[1], state[3], state[12], state[13], extras)
+    out = (state[2], state[1], state[3], state[12], state[13], extras)
+    if state_io:
+        out = out + ((state[3], state[4], state[5], state[6], state[7],
+                      state[8], state[9], state[10], state[11],
+                      state[0], state[1]),)
+    return out
 
 
 # -- single-device programs ------------------------------------------------
@@ -704,14 +736,22 @@ def run_pl_loop(ops: TierOps, l: int, lam, x0, z0, eta, eta2, res_tol,
 @functools.partial(jax.jit,
                    static_argnames=("s", "basis", "unbounded", "kernels",
                                     "fault", "trace", "progress",
-                                    "health"))
+                                    "health", "state_io"))
 def _cg_sstep_program(A, b, x0, res_atol, res_rtol, lam, maxits,
                       s: int, basis: str, unbounded: bool,
                       kernels: str = "xla", fault=None, trace: int = 0,
-                      progress: int = 0, health=None):
+                      progress: int = 0, health=None,
+                      state_io: bool = False, carry=None, k_offset=None):
     """Whole s-step-CG solve as one XLA program (single-device tier;
     the sharded-DIA tier rides through the callable ``kernels`` SpMV
-    exactly like _cg_program)."""
+    exactly like _cg_program).
+
+    ``carry``/``state_io``/``k_offset`` are the checkpoint hooks: a
+    carry re-enters from a block-boundary ``(r, p, gamma)`` snapshot
+    (the setup SpMV is skipped; ``r0nrm2`` from the carried gamma is
+    only meaningful on the first chunk, which never carries), state_io
+    appends the final ``(r, p, gamma)`` to the return, and k_offset
+    keeps the health audit cadence in the ABSOLUTE iteration frame."""
     from acg_tpu.solvers.jax_cg import CGResult, _scalar_setup
     dtype = b.dtype
     dot, sdt = _scalar_setup(dtype, False)
@@ -719,31 +759,47 @@ def _cg_sstep_program(A, b, x0, res_atol, res_rtol, lam, maxits,
     ops = single_ops(A, kernels, dot, sdt, store, fault=fault)
     bnrm2 = jnp.sqrt(dot(b, b))
     x0nrm2 = jnp.sqrt(dot(x0, x0))
-    r = b - ops.spmv(x0, None)
-    gamma = dot(r, r)
+    if carry is not None:
+        r, p, gamma = carry
+    else:
+        r = b - ops.spmv(x0, None)
+        p = None
+        gamma = dot(r, r)
     r0nrm2 = jnp.sqrt(gamma)
     res_tol = jnp.maximum(res_atol, res_rtol * r0nrm2)
     inf = jnp.asarray(jnp.inf, sdt)
     lam = (jnp.asarray(lam[0], sdt), jnp.asarray(lam[1], sdt))
-    x, k, gamma_f, bad, done, extras = run_sstep_loop(
+    out = run_sstep_loop(
         ops, s, basis, lam, b, x0, r, gamma, res_tol, maxits,
         unbounded, fault=fault, trace=trace, progress=progress,
-        health=health, bnrm2=bnrm2)
+        health=health, bnrm2=bnrm2, k_offset=k_offset, p=p,
+        state_io=state_io)
+    x, k, gamma_f, bad, done, extras = out[:6]
     breakdown = bad & ~done
     res = CGResult(x=x, niterations=k,
                    rnrm2=jnp.sqrt(jnp.maximum(gamma_f, 0.0)),
                    r0nrm2=r0nrm2, bnrm2=bnrm2, x0nrm2=x0nrm2,
                    dxnrm2=inf, converged=done, breakdown=breakdown)
-    return (res,) + extras if extras else res
+    tail = extras + (out[6],) if state_io else extras
+    return (res,) + tail if tail else res
 
 
 @functools.partial(jax.jit,
                    static_argnames=("l", "unbounded", "kernels", "fault",
-                                    "trace", "progress"))
+                                    "trace", "progress", "state_io"))
 def _cg_pl_program(A, b, x0, res_atol, res_rtol, lam, maxits, l: int,
                    unbounded: bool, kernels: str = "xla", fault=None,
-                   trace: int = 0, progress: int = 0):
-    """Whole p(l)-CG solve as one XLA program (single-device tier)."""
+                   trace: int = 0, progress: int = 0,
+                   state_io: bool = False, carry=None, k_offset=None):
+    """Whole p(l)-CG solve as one XLA program (single-device tier).
+
+    The checkpoint hooks carry the FULL deep-pipeline working set (see
+    run_pl_loop): ``carry`` re-enters from a snapshot whose ``j``/
+    ``adv`` counters are ABSOLUTE -- the caller must then pass an
+    absolute ``maxits`` (consumed + chunk) and read ``niterations`` as
+    an absolute count.  ``k_offset`` is accepted for signature parity
+    with the s-step program and ignored (the pipeline's own ``j``
+    counter is already absolute)."""
     from acg_tpu.solvers.jax_cg import CGResult, _scalar_setup
     dtype = b.dtype
     dot, sdt = _scalar_setup(dtype, False)
@@ -751,24 +807,36 @@ def _cg_pl_program(A, b, x0, res_atol, res_rtol, lam, maxits, l: int,
     ops = single_ops(A, kernels, dot, sdt, store, fault=fault)
     bnrm2 = jnp.sqrt(dot(b, b))
     x0nrm2 = jnp.sqrt(dot(x0, x0))
-    r0 = b - ops.spmv(x0, None)
-    eta2 = dot(r0, r0)
-    eta = jnp.sqrt(eta2)
-    r0nrm2 = eta
+    if carry is not None:
+        # mid-pipeline re-entry: the recurrence residual lives in the
+        # carried q; no setup SpMV, and r0nrm2 is only cosmetic here
+        # (later chunks run with rtol=0 against the first chunk's
+        # absolute target)
+        eta = eta2 = z0 = None
+        r0nrm2 = jnp.abs(carry[0])
+    else:
+        r0 = b - ops.spmv(x0, None)
+        eta2 = dot(r0, r0)
+        eta = jnp.sqrt(eta2)
+        r0nrm2 = eta
     res_tol = jnp.maximum(res_atol, res_rtol * r0nrm2)
     inf = jnp.asarray(jnp.inf, sdt)
     lam = (jnp.asarray(lam[0], sdt), jnp.asarray(lam[1], sdt))
-    z0 = store(r0 / jnp.where(eta == 0, 1.0, eta))
-    x, adv, q, conv, bad, extras = run_pl_loop(
+    if carry is None:
+        z0 = store(r0 / jnp.where(eta == 0, 1.0, eta))
+    out = run_pl_loop(
         ops, l, lam, x0, z0, eta, eta2, res_tol, maxits, unbounded,
-        fault=fault, trace=trace, progress=progress)
+        fault=fault, trace=trace, progress=progress, carry=carry,
+        state_io=state_io)
+    x, adv, q, conv, bad, extras = out[:6]
     done = (~bad) if unbounded else conv
     breakdown = bad & ~done
     res = CGResult(x=x.astype(dtype), niterations=adv,
                    rnrm2=jnp.abs(q), r0nrm2=r0nrm2, bnrm2=bnrm2,
                    x0nrm2=x0nrm2, dxnrm2=inf, converged=done,
                    breakdown=breakdown)
-    return (res,) + extras if extras else res
+    tail = extras + (out[6],) if state_io else extras
+    return (res,) + tail if tail else res
 
 
 @functools.partial(jax.jit, static_argnames=("kernels", "iters"))

@@ -1357,11 +1357,20 @@ class JaxCGSolver:
                     f"{ca} amplifies storage rounding through its basis "
                     f"products; bf16 vectors need the classic/pipelined "
                     f"tiers (replace_every is the bf16 contract)")
-            if ckpt is not None:
+            if ckpt is not None and ckpt.repartition:
                 raise ValueError(
-                    f"{ca} does not expose its window/basis carry to "
-                    f"the checkpoint chunk driver yet; --ckpt/--resume "
-                    f"need --algorithm classic|pipelined")
+                    f"{ca} snapshots its own carry layout "
+                    f"(checkpoint.ca_carry_names) which is not in the "
+                    f"field-compatible repartition set; "
+                    f"--resume-repartition needs --algorithm "
+                    f"classic|pipelined")
+            if (ckpt is not None and self.algo.kind == "pl"
+                    and int(trace) > 0):
+                raise ValueError(
+                    f"{ca} checkpoints its pipeline counters in the "
+                    f"ABSOLUTE iteration frame, but the trace ring is "
+                    f"reconstructed chunk-relative; --ckpt with --trace "
+                    f"needs --algorithm classic|pipelined|sstep")
             if self.health_spec is not None:
                 if self.algo.kind == "pl":
                     raise ValueError(
@@ -2162,14 +2171,35 @@ class JaxCGSolver:
         hl = "health" in kwargs
         pc_kind = (str(self.precond_spec)
                    if self.precond_spec is not None else None)
-        names = ckpt_mod.carry_names(self.pipelined,
-                                     self.precond_spec is not None)
-        solver_name = ("cg-pipelined" if self.pipelined else "cg")
+        algo_name = str(self.algo) if self.algo is not None else None
+        is_pl = self.algo is not None and self.algo.kind == "pl"
+        if self.algo is not None:
+            names = ckpt_mod.ca_carry_names(self.algo.kind)
+            solver_name = f"cg-{self.algo.kind}"
+        else:
+            names = ckpt_mod.carry_names(self.pipelined,
+                                         self.precond_spec is not None)
+            solver_name = ("cg-pipelined" if self.pipelined else "cg")
 
-        def chunk_args(x_dev, atol, rtol, m):
-            return (base[0], base[1], x_dev,
-                    jnp.asarray(atol, sdt), jnp.asarray(rtol, sdt),
-                    base[5], base[6], jnp.int32(m))
+        if self.algo is not None:
+            # CA base is the 7-tuple (A, b, x0, atol, rtol, lam,
+            # maxits): lam rides at base[5], there are no diff tols
+            def chunk_args(x_dev, atol, rtol, m):
+                return (base[0], base[1], x_dev,
+                        jnp.asarray(atol, sdt), jnp.asarray(rtol, sdt),
+                        base[5], jnp.int32(m))
+        else:
+            def chunk_args(x_dev, atol, rtol, m):
+                return (base[0], base[1], x_dev,
+                        jnp.asarray(atol, sdt), jnp.asarray(rtol, sdt),
+                        base[5], base[6], jnp.int32(m))
+
+        def pl_adv(carry):
+            # the deep pipeline's advance counter rides IN the carry
+            # (frame-absolute since the last restart): the chunk cap
+            # and the per-chunk iteration count are both relative to it
+            return int(jnp.asarray(carry[-1])) if carry is not None \
+                else 0
 
         def run(a, carry, k0):
             # the chunk's starting trajectory iteration keeps the
@@ -2198,7 +2228,8 @@ class JaxCGSolver:
             ckpt_mod.validate_resume(
                 snap, tier=self._ckpt_tier, pipelined=self.pipelined,
                 precond=pc_kind, n=int(self.A.nrows), dtype=dtype,
-                b_crc=b_crc, repartition=cfg.repartition)
+                b_crc=b_crc, repartition=cfg.repartition,
+                algorithm=algo_name)
             ckpt_mod.check_resume_env(snap, st)
             if cfg.repartition:
                 # shape-portable resume: reassemble the carry into
@@ -2261,23 +2292,44 @@ class JaxCGSolver:
                 if remaining <= 0:
                     break
                 m = min(cfg.chunk_for(rate), remaining)
+                if (self.algo is not None
+                        and self.algo.kind == "sstep" and m < remaining):
+                    # block-boundary-aligned cadence: a non-final chunk
+                    # must end where a block ends -- the carried
+                    # (r, p, gamma) only EQUALS the monolithic
+                    # trajectory there (mid-block, the basis/Gram
+                    # state is live and classic-shaped state is stale)
+                    s_ = int(self.algo.param)
+                    m = min(remaining, max(s_, (m // s_) * s_))
+                if is_pl:
+                    # the pipeline's cap/advance counters are frame-
+                    # absolute (they ride in the carry): cap this chunk
+                    # at carry-advance + m
+                    m_cap = pl_adv(carry) + m
+                else:
+                    m_cap = m
                 if abs_tol is None:
                     a = chunk_args(x_cur, crit.residual_atol,
-                                   crit.residual_rtol, m)
+                                   crit.residual_rtol, m_cap)
                 else:
                     # later chunks keep the FIRST attempt's absolute
                     # target (the recovery-restart convention: never
                     # re-baseline rtol against an already-small
                     # residual)
-                    a = chunk_args(x_cur, abs_tol, 0.0, m)
+                    a = chunk_args(x_cur, abs_tol, 0.0, m_cap)
                 if "fault" in kwargs:
-                    kwargs["fault"] = (fault.shift(executed)
+                    # the pl counters never reset across chunks, so its
+                    # injector already fires in the right frame --
+                    # shifting would double-subtract
+                    kwargs["fault"] = (fault if is_pl
+                                       else fault.shift(executed)
                                        if fault is not None else None)
                 t_chunk = time.time()
+                adv_in = pl_adv(carry) if is_pl else 0
                 res, tbuf, aud, core = run(a, carry, consumed)
                 device_sync(res.x)
                 t_end = time.time()
-                k_chunk = int(res.niterations)
+                k_chunk = int(res.niterations) - adv_in
                 if k_chunk > 0:
                     # measured s/iteration sizes the next chunk under
                     # the wall-clock cadence (cfg.chunk_for)
@@ -2338,7 +2390,12 @@ class JaxCGSolver:
                     # rebasing here would make the dispatch shift
                     # double-subtract a still-pending fault
                     if (fault is not None and fault.device_site
-                            and fault.iteration <= executed):
+                            and (is_pl
+                                 or fault.iteration <= executed)):
+                        # pl: the injector frame is the pipeline's own
+                        # counter, which a rollback/restart rewinds --
+                        # a deterministic re-fire would livelock the
+                        # ladder, so vanish it outright
                         fault = None
                     # FIRST RUNG: roll the carry back to the last
                     # committed snapshot -- exact pre-corruption Krylov
@@ -2395,6 +2452,7 @@ class JaxCGSolver:
                     meta = {
                         "tier": self._ckpt_tier,
                         "pipelined": bool(self.pipelined),
+                        "algorithm": algo_name,
                         "precond": pc_kind,
                         "n": int(self.A.nrows),
                         "dtype": str(np.dtype(dtype)),

@@ -5,26 +5,46 @@
 # agreed-abort; a single wrong-answer-green run fails the campaign
 # (exit 96, errors.ExitCode.WRONG_ANSWER).
 #
-# Usage: scripts/chaos.sh [SEED[:N]] [extra acg-tpu flags...]
+# Usage: scripts/chaos.sh [--serve] [SEED[:N]] [extra acg-tpu flags...]
+#   --serve    run the campaign against a LIVE --serve daemon instead
+#              of one solve per schedule: a supervised solver service
+#              is launched, N seeded request-level schedules (crashes
+#              mid-request, slow-solve stalls, device fault injections)
+#              are fired at it over HTTP, and every response is
+#              verified against a host-side oracle.  A wrong answer
+#              under a green status exits 96; a hung request (neither
+#              answer nor typed refusal) exits 1.
 #   SEED[:N]   campaign seed and schedule count (default 1234:20)
 #
 # Environment:
 #   CHAOS_MATRIX   matrix spec (default gen:poisson2d:20)
-#   CHAOS_NPARTS   mesh size (default 8; 0 = single device)
+#   CHAOS_NPARTS   mesh size (default 8; 0 = single device;
+#                  --serve mode defaults to 0)
 #   CHAOS_DIR      scratch/ledger directory (default a mktemp dir)
 #
-# The campaign arms --abft --audit-every (so sdc:flip schedules are
-# detectable), snapshots every 8 iterations (so crash:exit schedules
-# are resumable), and records per-schedule verdicts into the
-# $CHAOS_DIR/history ledger plus the acg_recovery_* metric families in
-# $CHAOS_DIR/chaos.prom.
+# The solve-per-schedule campaign arms --abft --audit-every (so
+# sdc:flip schedules are detectable), snapshots every 8 iterations (so
+# crash:exit schedules are resumable), and records per-schedule
+# verdicts into the $CHAOS_DIR/history ledger plus the acg_recovery_*
+# metric families in $CHAOS_DIR/chaos.prom.  The --serve campaign
+# records one acg-tpu-chaos-serve/1 verdict row per request instead.
 set -o pipefail
 cd "$(dirname "$0")/.."
+
+SERVE=0
+if [ "${1:-}" = "--serve" ]; then
+    SERVE=1
+    shift
+fi
 
 SPEC="${1:-1234:20}"
 shift 2>/dev/null || true
 MATRIX="${CHAOS_MATRIX:-gen:poisson2d:20}"
-NPARTS="${CHAOS_NPARTS:-8}"
+if [ "$SERVE" = "1" ]; then
+    NPARTS="${CHAOS_NPARTS:-0}"
+else
+    NPARTS="${CHAOS_NPARTS:-8}"
+fi
 DIR="${CHAOS_DIR:-$(mktemp -d /tmp/acg-chaos.XXXXXX)}"
 mkdir -p "$DIR"
 
@@ -35,6 +55,28 @@ if [ "$NPARTS" -gt 1 ]; then
     ENV_FLAGS+=("XLA_FLAGS=--xla_force_host_platform_device_count=$NPARTS")
 else
     PARTS_FLAGS=(--comm none)
+fi
+
+if [ "$SERVE" = "1" ]; then
+    echo "chaos.sh: SERVE campaign $SPEC on $MATRIX ($NPARTS parts) -> $DIR"
+    env "${ENV_FLAGS[@]}" python -m acg_tpu.cli "$MATRIX" \
+        "${PARTS_FLAGS[@]}" \
+        --serve --serve-faults \
+        --max-iterations 400 --residual-rtol 1e-8 --quiet \
+        --ckpt "$DIR/ck" \
+        --chaos "$SPEC" --relaunch-backoff 0 \
+        --history "$DIR/history" \
+        --metrics-file "$DIR/chaos.prom" \
+        "$@"
+    rc=$?
+    if [ $rc -eq 96 ]; then
+        echo "chaos.sh: WRONG-ANSWER-GREEN detected (exit 96) -- see $DIR"
+    elif [ $rc -ne 0 ]; then
+        echo "chaos.sh: serve campaign failed (exit $rc) -- see $DIR"
+    else
+        echo "chaos.sh: serve campaign clean (ledger: $DIR/history)"
+    fi
+    exit $rc
 fi
 
 echo "chaos.sh: campaign $SPEC on $MATRIX ($NPARTS parts) -> $DIR"

@@ -46,6 +46,13 @@
 # segments) and a calibrated --explain must print provenance with a
 # predicted-vs-measured ratio strictly closer to 1.0 than the
 # uncalibrated model's.
+# T1_SERVE=1 runs the solver-service smoke: a supervised 8-part
+# --serve daemon answers two identical requests (the second must hit
+# BOTH caches with acg_compiles_total unchanged -- zero ingest, zero
+# compile), coalesces one compatible pair into a single batched solve,
+# survives a crash-mid-request (supervisor relaunch + operator-cache
+# warm restore on the same port), and shuts down clean on
+# POST /shutdown (supervisor exit 0).
 set -o pipefail
 cd "$(dirname "$0")/.."
 rm -f /tmp/_t1.log
@@ -552,5 +559,122 @@ assert led["matrix_bytes_per_spmv"] == 0, led
 print(f"T1_MATFREE: OK (converged in {st['niterations']} iterations, "
       f"byte-identical to assembled, ledger matrix-bytes 0)")
 PY
+fi
+if [ "${T1_SERVE:-0}" = "1" ]; then
+    # solver-service smoke (the ISSUE-16 acceptance in miniature): a
+    # supervised 8-part --serve daemon -- two identical requests (the
+    # second must hit BOTH caches and leave acg_compiles_total
+    # untouched), one coalesced pair, a crash-mid-request relaunch
+    # with warm operator-cache restore, and a clean shutdown
+    echo "T1_SERVE: supervised 8-part solver-service smoke"
+    rm -f /tmp/_t1_serve_ck /tmp/_t1_serve_ck.serve.json
+    SERVE_PORT=$((20000 + RANDOM % 20000))
+    timeout -k 10 600 env JAX_PLATFORMS=cpu \
+        XLA_FLAGS="--xla_force_host_platform_device_count=8" \
+        python -m acg_tpu.cli gen:poisson2d:20 --nparts 8 \
+        --serve --serve-port "$SERVE_PORT" --serve-faults \
+        --supervise --relaunch-backoff 0 --quiet \
+        --ckpt /tmp/_t1_serve_ck &
+    SERVE_PID=$!
+    env SERVE_PORT="$SERVE_PORT" python - <<'PY' || rc=$((rc ? rc : 1))
+import json, os, threading, time, urllib.request
+
+base = f"http://127.0.0.1:{os.environ['SERVE_PORT']}"
+
+
+def req(method, path, doc=None, timeout=180.0):
+    r = urllib.request.Request(
+        base + path, method=method,
+        data=None if doc is None else json.dumps(doc).encode(),
+        headers={"Content-Type": "application/json"})
+    with urllib.request.urlopen(r, timeout=timeout) as resp:
+        return resp.status, json.loads(resp.read().decode())
+
+
+def counter(name):
+    with urllib.request.urlopen(base + "/metrics",
+                                timeout=30.0) as resp:
+        text = resp.read().decode()
+    total = 0.0
+    for line in text.splitlines():
+        head, _, val = line.rpartition(" ")
+        if not line.startswith("#") and (
+                head == name or head.startswith(name + "{")):
+            total += float(val)
+    return total
+
+
+def wait_up(budget=240.0):
+    t0 = time.monotonic()
+    while time.monotonic() - t0 < budget:
+        try:
+            s, d = req("GET", "/healthz", timeout=5.0)
+            if s == 200 and d.get("ok"):
+                return True
+        except Exception:
+            pass
+        time.sleep(0.25)
+    return False
+
+
+assert wait_up(), "T1_SERVE: the daemon never came up"
+doc = {"b_seed": 1, "rtol": 1e-8, "maxits": 500}
+s, b1 = req("POST", "/solve", doc)
+assert s == 200 and b1["ok"] and b1["converged"], b1
+# the daemon preloads its boot operator; only the program is cold
+assert b1["cache"] == {"operator": "hit", "program": "miss"}, b1
+c1 = counter("acg_compiles_total")
+s, b2 = req("POST", "/solve", dict(doc, b_seed=2))
+assert s == 200 and b2["ok"], b2
+assert b2["cache"] == {"operator": "hit", "program": "hit"}, b2
+c2 = counter("acg_compiles_total")
+assert c2 == c1, f"repeat request recompiled ({c1} -> {c2})"
+
+# one coalesced pair: hold the worker with a slow (uncoalescible)
+# request, race two compatible followers into the queue
+results = {}
+
+
+def fire(key, body):
+    results[key] = req("POST", "/solve", body)
+
+
+ts = [threading.Thread(target=fire, args=(
+    "slow", dict(doc, b_seed=9, fault="slow:0.8")))]
+ts[0].start()
+time.sleep(0.4)
+for seed in (11, 12):
+    t = threading.Thread(target=fire, args=(seed, dict(doc,
+                                                       b_seed=seed)))
+    ts.append(t)
+    t.start()
+for t in ts:
+    t.join(timeout=240.0)
+for seed in (11, 12):
+    s, body = results[seed]
+    assert s == 200 and body["coalesced"] == 2, (seed, body)
+
+# crash mid-request -> supervisor relaunch -> warm restore
+try:
+    req("POST", "/solve", dict(doc, fault="crash"), timeout=30.0)
+except Exception:
+    pass  # the connection dies with the daemon
+assert wait_up(), "T1_SERVE: the daemon did not relaunch"
+s, st = req("GET", "/status")
+assert st["warm_restored"] >= 1, st
+s, b3 = req("POST", "/solve", dict(doc, b_seed=3))
+assert s == 200 and b3["ok"], b3
+assert b3["cache"]["operator"] == "hit", b3
+
+req("POST", "/shutdown", {}, timeout=10.0)
+print("T1_SERVE: OK (zero-recompile repeat, coalesced pair of 2, "
+      "crash relaunch + warm restore, clean shutdown)")
+PY
+    wait "$SERVE_PID"
+    serve_rc=$?
+    if [ "$serve_rc" != "0" ]; then
+        echo "T1_SERVE: supervised daemon exited $serve_rc (want 0)"
+        rc=$((rc ? rc : 1))
+    fi
 fi
 exit $rc

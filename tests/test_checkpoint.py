@@ -30,9 +30,9 @@ import jax.numpy as jnp
 
 from acg_tpu import faults, health
 from acg_tpu.checkpoint import (CheckpointConfig, SolverSnapshot,
-                                agree_seq, carry_names, load_snapshot,
-                                save_snapshot, validate_resume,
-                                vector_checksum)
+                                agree_seq, ca_carry_names, carry_names,
+                                load_snapshot, save_snapshot,
+                                validate_resume, vector_checksum)
 from acg_tpu.errors import AcgError
 from acg_tpu.io.generators import poisson_mtx
 from acg_tpu.matrix import SymCsrMatrix
@@ -217,6 +217,107 @@ def test_dist8_chunk_parity_and_resume(system, prob8, tmp_path,
     x_rs = s2.solve(b, criteria=CRIT)
     assert snap.iteration + s2.stats.niterations == it_ref
     assert np.allclose(x_rs, x_ref, rtol=1e-7, atol=1e-10)
+
+
+# -- CA recurrence checkpoint carry (ROADMAP 4c, ISSUE 16) ----------------
+
+def test_ca_carry_names_layouts():
+    assert ca_carry_names("sstep") == ("x", "r", "p", "gamma")
+    pl = ca_carry_names("pl")
+    assert pl[:2] == ("x", "q")
+    assert "j" in pl and "adv" in pl  # frame-absolute pipe counters
+    assert len(pl) == 12
+
+
+@pytest.mark.parametrize("algorithm", ["sstep:4", "pipelined:2"])
+def test_ca_chunk_parity_and_resume(system, tmp_path, algorithm):
+    """--ckpt under the CA recurrences: the chunked solve is bitwise
+    identical to the monolithic one (the sstep carry snapshots at
+    BLOCK boundaries, where its state is exactly classic-shaped; the
+    pl carry round-trips the full pipeline working set), and --resume
+    continues to the exact uninterrupted iteration count."""
+    csr, _, b = system
+    A = device_matrix_from_csr(csr, dtype=jnp.float64)
+    ref = JaxCGSolver(A, algorithm=algorithm)
+    x_ref = ref.solve(b, criteria=CRIT)
+    it_ref = ref.stats.niterations
+
+    p = str(tmp_path / "ck")
+    # every=16 keeps each chunk boundary s-aligned for sstep:4 (an
+    # unaligned cap would truncate a masked block -- a mathematically
+    # equivalent restart, not the monolithic mid-block state)
+    s1 = JaxCGSolver(A, algorithm=algorithm,
+                     ckpt=CheckpointConfig(path=p, every=16))
+    x_ck = s1.solve(b, criteria=CRIT)
+    assert np.array_equal(np.asarray(x_ref), np.asarray(x_ck))
+    assert s1.stats.niterations == it_ref
+    assert s1.stats.ckpt["snapshots"] >= 2
+
+    snap = load_snapshot(p)
+    assert snap.meta["algorithm"] == algorithm
+    for name in ca_carry_names(algorithm.split(":")[0]
+                               .replace("pipelined", "pl")):
+        assert name in snap.arrays
+    s2 = JaxCGSolver(A, algorithm=algorithm,
+                     ckpt=CheckpointConfig(resume=snap))
+    x_rs = s2.solve(b, criteria=CRIT)
+    assert snap.iteration + s2.stats.niterations == it_ref
+    assert np.allclose(np.asarray(x_rs), np.asarray(x_ref),
+                       rtol=1e-7, atol=1e-10)
+    assert s2.stats.ckpt["resumed_from"] == snap.iteration
+
+
+def test_ca_cross_recurrence_resume_refuses(system, tmp_path):
+    """A snapshot names its recurrence; resuming it under ANY other
+    recurrence must refuse -- the sstep block-boundary carry is
+    byte-shaped exactly like the classic carry, so only the declared
+    algorithm key separates them."""
+    csr, _, b = system
+    A = device_matrix_from_csr(csr, dtype=jnp.float64)
+    p = str(tmp_path / "ck")
+    JaxCGSolver(A, algorithm="sstep:4",
+                ckpt=CheckpointConfig(path=p, every=16)).solve(
+        b, criteria=CRIT)
+    snap = load_snapshot(p)
+    for other in ("pipelined:2", None):
+        s = JaxCGSolver(A, algorithm=other,
+                        ckpt=CheckpointConfig(resume=snap))
+        with pytest.raises(AcgError, match="recurrence"):
+            s.solve(b, criteria=CRIT)
+    # and the reverse: a classic snapshot refused under a CA resume
+    p2 = str(tmp_path / "ck2")
+    JaxCGSolver(A, ckpt=CheckpointConfig(path=p2, every=16)).solve(
+        b, criteria=CRIT)
+    s = JaxCGSolver(A, algorithm="sstep:4",
+                    ckpt=CheckpointConfig(resume=load_snapshot(p2)))
+    with pytest.raises(AcgError, match="recurrence"):
+        s.solve(b, criteria=CRIT)
+
+
+def test_ca_ckpt_refusal_matrix(system, tmp_path):
+    """The two combinations the CA carry cannot honour stay typed
+    refusals: repartition (the carry layout is not in the
+    field-compatible set) and pl+trace (absolute vs chunk-relative
+    iteration frames)."""
+    csr, _, b = system
+    A = device_matrix_from_csr(csr, dtype=jnp.float64)
+    p = str(tmp_path / "ck")
+    JaxCGSolver(A, ckpt=CheckpointConfig(path=p, every=16)).solve(
+        b, criteria=CRIT)
+    snap = load_snapshot(p)
+    with pytest.raises(ValueError, match="repartition"):
+        JaxCGSolver(A, algorithm="sstep:4",
+                    ckpt=CheckpointConfig(resume=snap,
+                                          repartition=True))
+    with pytest.raises(ValueError, match="trace"):
+        JaxCGSolver(A, algorithm="pipelined:2", trace=8,
+                    ckpt=CheckpointConfig(path=p, every=16))
+    # sstep keeps its trace ring (classic iteration frame), and both
+    # CA kinds keep plain --ckpt
+    JaxCGSolver(A, algorithm="sstep:4", trace=8,
+                ckpt=CheckpointConfig(path=p, every=16))
+    JaxCGSolver(A, algorithm="pipelined:2",
+                ckpt=CheckpointConfig(path=p, every=16))
 
 
 def test_cross_tier_resume_refuses(system, prob8, tmp_path):

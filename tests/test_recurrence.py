@@ -395,8 +395,13 @@ def test_refusals(aniso):
         JaxCGSolver(A, algorithm="pipelined:2",
                     vector_dtype=jnp.bfloat16)
     from acg_tpu.checkpoint import CheckpointConfig
-    with pytest.raises(ValueError, match="checkpoint"):
-        JaxCGSolver(A, algorithm="sstep:4",
+    # checkpointing now composes with CA recurrences (the ISSUE-16
+    # carry); the narrowed refusal matrix (repartition carry, p(l) +
+    # trace) lives in tests/test_checkpoint.py
+    JaxCGSolver(A, algorithm="sstep:4",
+                ckpt=CheckpointConfig(path="/tmp/x.ckpt", every=10))
+    with pytest.raises(ValueError, match="trace"):
+        JaxCGSolver(A, algorithm="pipelined:2", trace=8,
                     ckpt=CheckpointConfig(path="/tmp/x.ckpt", every=10))
     from acg_tpu.health import make_spec
     with pytest.raises(ValueError, match="audit"):
