@@ -701,6 +701,32 @@ def make_parser() -> argparse.ArgumentParser:
                         "the --stats-json manifest and convergence-log "
                         "meta line (bench_diff keys differently-"
                         "calibrated captures apart)")
+    p.add_argument("--plan", nargs="?", const="-", default=None,
+                   metavar="FILE",
+                   help="decision observatory: write the ranked "
+                        "acg-tpu-plan/1 document (every candidate "
+                        "program priced as predicted seconds-per-solve "
+                        "from the perfmodel HBM roofline, the "
+                        "--calibration alpha-beta comm fits over each "
+                        "recurrence's reduction schedule, and the "
+                        "Lanczos-kappa CG iteration bound; typed "
+                        "refusal reasons for pruned cells) to FILE "
+                        "('-' or omitted = stdout).  With --explain: "
+                        "print the ranked table WITHOUT solving; with "
+                        "--autotune: record the document the decision "
+                        "came from")
+    p.add_argument("--autotune", action="store_true",
+                   help="plan the candidate program space, verify the "
+                        "top-2 plans by short timed probes, and "
+                        "dispatch the winner instead of the flag-"
+                        "selected program (S / L / cheby degree chosen "
+                        "numerically).  The decision and its plan-vs-"
+                        "actual row (predicted vs measured s/solve, "
+                        "misprediction ratio) land in the 'plan:' "
+                        "stats section, the --history ledger (where "
+                        "later planned runs consult them to self-"
+                        "correct the model's constants) and the "
+                        "acg_plan_* metric families")
     p.add_argument("--no-probe-cache", action="store_true",
                    help="ignore the on-disk backend-keyed triad-probe "
                         "sidecar (ACG_TPU_PROBE_CACHE / "
@@ -849,6 +875,20 @@ def _buildinfo(out) -> int:
          "convergence-log meta line and bench_diff case keys), "
          "--no-probe-cache (bypass the backend-keyed on-disk triad-"
          "probe sidecar); acg_commbench_* metric families"),
+        ("decision planner", f"--autotune (enumerate + price the "
+         f"candidate program space -- recurrence x kernels x "
+         f"transport x precond -- from the perfmodel HBM roofline, "
+         f"the --calibration alpha-beta comm fits over each "
+         f"recurrence's reduction schedule, and the Lanczos-kappa CG "
+         f"bound; S/L/cheby degree chosen numerically; top-2 verified "
+         f"by short timed probes, winner dispatched), --plan FILE / "
+         f"--explain --plan (ranked acg-tpu-plan/1 document with "
+         f"calibration + kappa provenance and typed refusal reasons, "
+         f"no solve), plan-vs-actual self-correction through the "
+         f"--history ledger, --serve --autotune (plan on operator-"
+         f"cache miss, replan on calibration change); 'plan' section "
+         f"in the {STATS_SCHEMA} twin, acg_plan_* metric families, "
+         f"scripts/history_report.py --fail-on-misprediction PCT"),
         ("bench gating", "bench.py --baseline FILE --fail-on-regress "
          "PCT; scripts/bench_diff.py (diffs --stats-json or bench-row "
          "captures case-by-case, nonzero exit on regression)"),
@@ -1436,6 +1476,75 @@ def _emit_timeline(args, solver, nparts=1, collective=True) -> None:
                      f"over {summary['nparts']} part(s) from "
                      f"{summary['nranks']} rank(s) -> "
                      f"{args.timeline}\n")
+
+
+def _run_autotune(args, csr, part, nparts, b, dtype, vec_dtype) -> None:
+    """Plan -> probe -> dispatch (--autotune): build the ranked plan,
+    verify the top candidates by short timed probes, and mutate the
+    parsed flags so the normal construction flow below dispatches the
+    winner.  Probes failing is never fatal -- the flag-selected
+    program dispatches with decision provenance ``fallback``."""
+    from acg_tpu import planner
+
+    err = sys.stderr
+    doc = planner.plan_for_args(args, csr, nparts, dtype, vec_dtype)
+    err.write(planner.render_plan(doc))
+    if args.plan not in (None, "-"):
+        try:
+            planner.write_plan(doc, args.plan)
+        except OSError as e:
+            err.write(f"acg-tpu: --plan {args.plan}: {e}\n")
+    decision = {"plan_id": doc["plan_id"],
+                "calibration": doc["calibration"],
+                "uncalibrated": bool(doc.get("uncalibrated")),
+                "kappa_source": doc["kappa_source"],
+                "correction_scale": doc["correction"]["scale"],
+                "correction_nsamples": doc["correction"]["nsamples"],
+                "key": doc["correction"]["key"]}
+    probe_b = b[:, 0] if getattr(b, "ndim", 1) == 2 else b
+    winner = planner.autotune_select(args, doc, csr, part, nparts,
+                                     probe_b, dtype, vec_dtype, err)
+    if winner is None:
+        err.write("acg-tpu: autotune: every probe failed; dispatching "
+                  "the flag-selected program (provenance: fallback)\n")
+        args._plan_decision = {**decision, "source": "fallback"}
+        return
+    planner.apply_candidate_to_args(args, winner)
+    err.write(f"acg-tpu: autotune: dispatching {winner['label']} "
+              f"(predicted {winner['predicted_s_per_solve']:.3e} "
+              f"s/solve, {winner['predicted_iterations']} its)\n")
+    args._plan_decision = {
+        **decision, "source": "planned", "selected": winner["label"],
+        "algorithm": winner["algorithm"], "kernels": winner["kernels"],
+        "comm": winner["comm"], "precond": winner["precond"],
+        "predicted_s_per_solve": winner["predicted_s_per_solve"],
+        "predicted_iterations": winner["predicted_iterations"],
+    }
+
+
+def _finalize_plan(args, solver) -> None:
+    """Close one planned solve's feedback loop: the plan-vs-actual row
+    (predicted vs measured s/solve + iterations, misprediction ratio)
+    lands in the 'plan:' stats section -- and from there rides fwrite,
+    --stats-json and the --history ledger, where the next planned run
+    for the same (matrix, mesh, calibration) key consults it to
+    rescale the model's constants."""
+    dec = getattr(args, "_plan_decision", None)
+    if dec is None or solver is None:
+        return
+    from acg_tpu import metrics
+    st = solver.stats
+    plan = dict(dec)
+    measured = float(st.tsolve or 0.0)
+    plan["measured_s_per_solve"] = measured
+    plan["measured_iterations"] = int(st.niterations)
+    pred = dec.get("predicted_s_per_solve")
+    if pred and measured > 0:
+        plan["misprediction_ratio"] = float(pred) / measured
+        metrics.record_plan_misprediction(plan["misprediction_ratio"])
+    st.plan = plan
+    metrics.record_plan_decision(dec.get("source", "planned"))
+    args._plan_decision = None  # one solve, one row
 
 
 def _emit_telemetry(args, solver, *, matrix_id, nparts=1,
@@ -2474,7 +2583,9 @@ def _main(args) -> int:
              "not the solve a status plane watches)",
              args.status_port > 0 or args.status_file is not None),
             ("--history (the ledger records solves, not analysis "
-             "passes)", args.history is not None),
+             "passes; --explain --plan --history consults it "
+             "read-only)",
+             args.history is not None and args.plan is None),
             ("--slo (objectives judge real solves)",
              args.slo is not None),
         ] if on]
@@ -2605,6 +2716,46 @@ def _main(args) -> int:
             raise SystemExit(
                 f"acg-tpu: --algorithm {ca} does not support: "
                 f"{', '.join(unsupported)}")
+    # decision observatory (acg_tpu.planner): validate BEFORE anything
+    # expensive.  --autotune owns the axes it plans over -- a flag that
+    # pins one of them would make the "decision" a lie, so those refuse
+    # rather than silently win
+    if args.autotune:
+        if args.explain:
+            raise SystemExit(
+                "acg-tpu: --autotune dispatches a real solve; use "
+                "--explain --plan for the ranked table without solving")
+        if args.commbench is not None:
+            raise SystemExit(
+                "acg-tpu: --autotune consumes a SAVED calibration "
+                "(--calibration FILE); run --commbench first")
+        unsupported = [flag for flag, on in [
+            (f"--algorithm {args.algorithm} (the planner chooses the "
+             f"recurrence numerically)",
+             args.algorithm not in (None, "auto")),
+            (f"--solver {args.solver} (the planner chooses among the "
+             f"device tiers)", args.solver != "acg"),
+            ("--kernels fused (the planner chooses the kernel tier)",
+             args.kernels == "fused"),
+            ("--nrhs/--block-cg (no batched candidate pricing yet)",
+             args.nrhs >= 2 or args.block_cg),
+            ("--refine", args.refine),
+            ("--replace-every", args.replace_every > 0),
+            ("--fault-inject (probes must time the pristine "
+             "programs)", bool(args.fault_inject)
+             or bool(os.environ.get("ACG_TPU_FAULT_INJECT"))),
+            ("--multihost/--coordinator/--distributed-read (single-"
+             "controller planning only)", args.multihost
+             or args.coordinator is not None or args.distributed_read),
+        ] if on]
+        if unsupported:
+            raise SystemExit(
+                f"acg-tpu: --autotune does not support: "
+                f"{', '.join(unsupported)}")
+    if args.plan is not None and not (args.explain or args.autotune):
+        raise SystemExit(
+            "acg-tpu: --plan needs --explain (ranked table, no solve) "
+            "or --autotune (plan, probe, dispatch)")
     # numerical-health tier (acg_tpu.health): validate the spec BEFORE
     # anything expensive; refuse configurations where an armed audit
     # could never run (the fault-injector / precond discipline)
@@ -3093,6 +3244,14 @@ def _main(args) -> int:
                                  f"{args.commbench}: {e}\n")
             args._calibration = doc
             args._calibration_source = "live --commbench run"
+        if args.plan is not None:
+            # the decision observatory's no-dispatch mode: print the
+            # ranked candidate table (and write the plan document)
+            # WITHOUT solving -- the planning twin of the roofline
+            # verdict below
+            from acg_tpu.planner import run_plan_explain
+            return run_plan_explain(args, dtype=dtype,
+                                    vec_dtype=vec_dtype)
         from acg_tpu.perfmodel import run_explain
         return run_explain(args, dtype=dtype, vec_dtype=vec_dtype)
 
@@ -3268,6 +3427,17 @@ def _main(args) -> int:
             sys.stderr.write("acg-tpu: aborting: a peer controller "
                              "failed during ingest\n")
         return rc
+
+    # decision observatory (acg_tpu.planner): plan the candidate
+    # space, probe the top plans, and MUTATE the flag set the normal
+    # construction flow below reads -- the planner only ever chooses
+    # flags before solver construction, never alters program emission
+    # (disarmed runs stay byte-identical, pinned in test_hlo_structure)
+    if args.autotune:
+        _run_autotune(args, csr, part, nparts, b, dtype, vec_dtype)
+        # the winning candidate may have switched the halo transport
+        comm = {"mpi": "xla", "nccl": "xla",
+                "nvshmem": "dma"}.get(args.comm, args.comm)
 
     # stages 6b-8: build solver and solve, under the profiler when
     # --trace is set (try/finally so failed solves still finalise the
@@ -3487,6 +3657,9 @@ def _main(args) -> int:
                                warmup=args.warmup)
         except (NotConvergedError, BreakdownError) as e:
             sys.stderr.write(f"acg-tpu: {e}\n")
+            # plan-vs-actual still records: a planned program that
+            # failed to converge is the strongest correction signal
+            _finalize_plan(args, solver)
             _fold_phases(args, solver)
             if is_primary():  # stats block from "rank 0" only
                 solver.stats.fwrite(sys.stderr)
@@ -3502,6 +3675,10 @@ def _main(args) -> int:
             stage_sync("solve", 1)
             return 1
     _log(args, "solve:", t0)
+    # plan-vs-actual BEFORE the stats block renders: the 'plan:'
+    # section and its misprediction ratio ride fwrite, --stats-json
+    # and the history ledger (where later planned runs consult them)
+    _finalize_plan(args, solver)
     rc = stage_sync("solve", 0)
     if rc:
         sys.stderr.write("acg-tpu: aborting: a peer controller failed "

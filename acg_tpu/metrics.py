@@ -601,6 +601,17 @@ SLO_BURN = REGISTRY.gauge(
     "acg_slo_burn_ratio", "Fraction of observed solves breaching each "
     "declared objective (cumulative error-budget burn; 0 = none, "
     "1 = every solve).", labelnames=("objective",))
+# decision observatory (acg_tpu.planner, --autotune): how programs
+# were chosen and how honest the cost model's predictions are
+PLAN_DECISIONS = REGISTRY.counter(
+    "acg_plan_decisions_total", "Program-selection decisions by "
+    "provenance: planned (cost-model chose), flag-forced (caller "
+    "overrode), fallback (degraded/probe-failed path).",
+    labelnames=("source",))
+PLAN_MISPREDICTION = REGISTRY.gauge(
+    "acg_plan_misprediction_ratio", "Predicted / measured "
+    "seconds-per-solve of the last planned solve (1.0 = the cost "
+    "model was exactly right; drives self-correction).")
 
 _armed = False
 
@@ -884,6 +895,26 @@ def record_commbench(doc: dict) -> None:
                 float(seg["s_per_iteration"]))
         except (KeyError, TypeError, ValueError):
             continue
+
+
+def record_plan_decision(source: str) -> None:
+    """One program-selection decision: ``planned`` | ``flag-forced`` |
+    ``fallback`` (no-op disarmed)."""
+    if not _armed:
+        return
+    PLAN_DECISIONS.labels(str(source)).inc()
+
+
+def record_plan_misprediction(ratio: float) -> None:
+    """Predicted/measured seconds-per-solve of one planned solve."""
+    if not _armed:
+        return
+    try:
+        r = float(ratio)
+    except (TypeError, ValueError):
+        return
+    if r > 0 and math.isfinite(r):
+        PLAN_MISPREDICTION.set(r)
 
 
 def update_resource_gauges() -> None:

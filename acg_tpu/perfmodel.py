@@ -818,6 +818,7 @@ def run_explain(args, dtype, vec_dtype) -> int:
     # comm components below are then priced from its fitted alpha-beta
     # model and the tiers run measured segment decompositions
     cal = getattr(args, "_calibration", None)
+    cal_mismatch_event = None
     if cal is not None:
         from acg_tpu.commbench import KINDS
         src = getattr(args, "_calibration_source", None) \
@@ -829,11 +830,29 @@ def run_explain(args, dtype, vec_dtype) -> int:
                   f"  id {cal.get('calibration_id')} ({src}); fitted "
                   f"kinds: {', '.join(fitted) or 'none'}; benchmarked "
                   f"on a {cal.get('nparts')}-part mesh\n")
+        mismatches = []
         if int(cal.get("nparts", 0)) != int(nparts):
-            err.write(f"  WARNING: calibration mesh "
-                      f"({cal.get('nparts')} parts) differs from this "
-                      f"run's ({nparts} parts) -- fitted latencies may "
-                      f"not transfer\n")
+            mismatches.append(f"mesh {cal.get('nparts')} parts vs this "
+                              f"run's {nparts}")
+        cal_backend = cal.get("backend")
+        if cal_backend and str(cal_backend) != jax.default_backend():
+            mismatches.append(f"backend {cal_backend} vs this run's "
+                              f"{jax.default_backend()}")
+        if mismatches:
+            detail = (f"calibration {cal.get('calibration_id')}: "
+                      + "; ".join(mismatches)
+                      + " -- fitted latencies may not transfer")
+            err.write(f"  WARNING: {detail}\n")
+            # the structured twin of the warning (the decision
+            # observatory's audit trail): an event the stats-json /
+            # history consumers and the metrics textfile can gate on,
+            # not just a stderr line
+            cal_mismatch_event = {"t": time.time(),
+                                  "kind": "calibration-mismatch",
+                                  "detail": detail}
+            from acg_tpu import metrics, observatory
+            metrics.record_event_kind("calibration-mismatch")
+            observatory.note_event("calibration-mismatch", detail)
         err.write("\n")
     bw = None
     use_cache = not getattr(args, "no_probe_cache", False)
@@ -930,6 +949,12 @@ def run_explain(args, dtype, vec_dtype) -> int:
     # tracing) -- per-op-class seconds, overlap efficiency, and the
     # measured-vs-predicted comm line.  Without --trace this section is
     # absent and the static verdict stands unchanged
+    # the mismatch event rides every tier's stats twin, so --stats-json
+    # consumers see it next to the comm components it taints
+    if cal_mismatch_event is not None:
+        for _row, solver in rows:
+            solver.stats.events.append(dict(cal_mismatch_event))
+
     if args.trace:
         _explain_measured(args, rows, K, err)
 

@@ -1,0 +1,731 @@
+"""Decision observatory: the cost-model-driven program planner.
+
+The repo exposes ~12 meaningfully different compiled programs per
+problem (classic / pipelined / sstep:S / pipelined:L recurrences x
+assembled / matrix-free operands x xla / dma halo transport x auto /
+fused kernels x preconditioners), every one of them hand-picked by
+flags.  This module closes ROADMAP item 2's loop: it prices every
+candidate program from measurements the observability stack already
+produces and emits a ranked ``acg-tpu-plan/1`` document --
+
+    predicted seconds per solve =
+        (per-iteration HBM traffic over the probed triad bandwidth
+         + per-iteration communication over the commbench-calibrated
+           alpha-beta fits, priced over the recurrence's declared
+           reduction schedule and the partition's halo-plane bytes
+         ) x (iterations from the Lanczos-kappa CG bound, adjusted
+              per recurrence)
+        + one program dispatch
+
+so S, L and the Chebyshev degree are chosen NUMERICALLY instead of by
+flag -- the measurement-driven selection the communication-avoiding CG
+literature (Carson's s-step analyses, Cornelis-Cools-Vanroose p(l)-CG)
+assumes when picking block sizes for a machine.
+
+Provenance is total: the plan records the calibration id it priced
+against (or the clearly-marked ``uncalibrated`` fallback constants),
+the kappa source, and a TYPED refusal reason for every pruned cell
+(mirroring the CLI's refusal matrices -- a cell the dispatcher would
+refuse must never be ranked).  Every planned solve records
+plan-vs-actual into the ``--history`` ledger, and the planner consults
+prior plan-vs-actual rows for the same (matrix, mesh, calibration) key
+to rescale its constants: the model self-corrects across runs.
+
+Everything here is host-side arithmetic over existing ledgers and
+fits; building a plan never touches the compiled programs (the
+disarmed byte-identity contract, pinned in test_hlo_structure)."""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import math
+
+import numpy as np
+
+PLAN_SCHEMA = "acg-tpu-plan/1"
+
+# the enumerated recurrence axis: S and L are chosen numerically from
+# these, not by flag (sstep basis flips monomial -> chebyshev at S=4,
+# recurrence.RecurrenceSpec.basis)
+ALGORITHMS = ("classic", "pipelined", "sstep:2", "sstep:4", "sstep:8",
+              "pipelined:1", "pipelined:2", "pipelined:3")
+KERNEL_CHOICES = ("auto", "fused")
+COMM_CHOICES = ("xla", "dma")
+# chebyshev degrees enumerated when the requested preconditioner is
+# cheby (degree chosen numerically, the S/L rule)
+CHEBY_DEGREES = (2, 4, 8)
+
+# uncalibrated comm fallback: a conservative scalar-collective latency
+# and the perfmodel ring-hop bandwidth guess.  Plans priced from these
+# are CLEARLY marked (doc["uncalibrated"] = True) -- they rank HBM
+# against comm honestly enough to order candidates, nothing more
+FALLBACK_ALPHA_S = 2e-5
+FALLBACK_GBS = 45.0
+
+# iteration-count penalty constants per recurrence: the numerical price
+# of a longer basis (s-step monomial conditioning, p(l) z-basis Gram
+# degradation) on top of the exact-arithmetic equivalence.  These are
+# the constants plan-vs-actual self-correction rescales over runs
+SSTEP_MONOMIAL_PENALTY = 0.015   # x (1 + c * S^2)
+SSTEP_CHEBY_PENALTY = 0.02      # x (1 + c * S)
+PL_PENALTY = 0.03               # x (1 + c * L)
+PIPELINED_PENALTY = 0.05        # Ghysels-Vanroose residual-drift lag
+# preconditioner spectrum-compression guesses (kappa multipliers) used
+# only when ranking a precond cell against "none"; the measured
+# kappa(M^-1 A) replaces these wherever a spectrum estimate exists
+JACOBI_KAPPA_FACTOR = 0.6
+BJACOBI_KAPPA_FACTOR = 0.5
+
+# self-correction window: geometric mean over the last N plan-vs-actual
+# rows for the same (matrix, mesh, calibration) key
+CORRECTION_WINDOW = 8
+
+# extra vector passes the s-step basis build pays per iteration on top
+# of the classic loop's 15 (basis write + read of the 2S+1 block,
+# amortised) -- a documented heuristic, rescaled by self-correction
+SSTEP_EXTRA_PASSES = 4
+
+
+# -- candidate enumeration -------------------------------------------------
+
+def _precond_choices(precond) -> list:
+    """The precond axis for one requested spec: always "none" (the
+    planner may find the unpreconditioned program faster), plus the
+    requested kind -- cheby enumerates its degree numerically."""
+    choices = ["none"]
+    if precond in (None, "", "none"):
+        return choices
+    p = str(precond)
+    if p.startswith("cheby"):
+        choices.extend(f"cheby:{k}" for k in CHEBY_DEGREES)
+    else:
+        choices.append(p)
+    return choices
+
+
+def enumerate_candidates(nparts: int, precond=None, cal: dict | None = None,
+                         operator_armed: bool = False,
+                         kernels=KERNEL_CHOICES,
+                         comms=COMM_CHOICES) -> tuple[list, list]:
+    """``(candidates, pruned)`` over the full program space.  Pruned
+    cells carry a TYPED reason mirroring the CLI refusal matrices --
+    a combination the dispatcher would refuse must never be ranked."""
+    from acg_tpu.recurrence import parse_algorithm
+
+    cal_kinds = (cal or {}).get("collectives", {})
+    dma_fitted = isinstance(cal_kinds.get("dma"), dict) \
+        and "alpha_s" in cal_kinds["dma"]
+    candidates, pruned = [], []
+    for alg in ALGORITHMS:
+        spec = parse_algorithm(alg)
+        ca = spec is not None and spec.communication_avoiding
+        for kern in kernels:
+            for comm in comms:
+                for pc in _precond_choices(precond):
+                    for matfree in ((False, True) if operator_armed
+                                    else (False,)):
+                        cand = {"algorithm": alg, "kernels": kern,
+                                "comm": comm, "precond": pc,
+                                "matrix_free": bool(matfree)}
+                        reason = None
+                        if ca and pc != "none":
+                            reason = ("ca-precond", "the CA recurrences "
+                                      "run unpreconditioned (the CLI "
+                                      "--algorithm refusal)")
+                        elif ca and kern == "fused":
+                            reason = ("ca-fused", "--algorithm x "
+                                      "--kernels fused is refused by "
+                                      "the CLI")
+                        elif kern == "fused" and pc != "none":
+                            reason = ("fused-precond", "the fused "
+                                      "two-phase kernels have no "
+                                      "preconditioner hook")
+                        elif comm == "dma" and nparts < 2:
+                            reason = ("dma-single-part", "the one-sided "
+                                      "transport needs a multi-part "
+                                      "mesh")
+                        elif comm == "dma" and not dma_fitted:
+                            reason = ("dma-unbenchmarked", "no dma fit "
+                                      "in the calibration; the planner "
+                                      "will not price a transport it "
+                                      "cannot predict")
+                        elif operator_armed and not matfree:
+                            reason = ("assembled-bypassed", "--operator "
+                                      "is armed; the dispatched "
+                                      "programs are matrix-free")
+                        if reason is not None:
+                            pruned.append({**cand, "reason": reason[0],
+                                           "detail": reason[1]})
+                        else:
+                            candidates.append(cand)
+    return candidates, pruned
+
+
+def candidate_label(cand: dict) -> str:
+    tag = "matfree" if cand.get("matrix_free") else "assembled"
+    return (f"{cand['algorithm']}/{cand['kernels']}/{cand['comm']}/"
+            f"{cand['precond']}/{tag}")
+
+
+# -- static problem measurements ------------------------------------------
+
+def halo_plane_rows(csr, nparts: int) -> int:
+    """Ghost rows of the widest part under the contiguous band
+    partition the planner assumes (the dist tier's DIA-friendly
+    default): the per-exchange halo plane the transport moves, priced
+    in rows (x vector itemsize = bytes).  O(nnz) host arithmetic."""
+    n = int(csr.shape[0])
+    p = max(int(nparts), 1)
+    if p < 2:
+        return 0
+    bounds = [round(i * n / p) for i in range(p + 1)]
+    indptr = np.asarray(csr.indptr)
+    indices = np.asarray(csr.indices)
+    worst = 0
+    for i in range(p):
+        lo, hi = bounds[i], bounds[i + 1]
+        cols = indices[indptr[lo]:indptr[hi]]
+        ghost = np.unique(cols[(cols < lo) | (cols >= hi)])
+        worst = max(worst, int(ghost.size))
+    return worst
+
+
+def kappa_estimate(csr, rtol: float, maxits: int,
+                   precond=None) -> tuple:
+    """``(kappa, source)`` from a traced host-oracle solve + Lanczos
+    tridiagonal (the --explain convergence tier's estimator), size-
+    guarded exactly like perfmodel._explain_convergence.  ``source``
+    is the plan's kappa provenance string."""
+    if csr.shape[0] > 200_000 or csr.nnz > 2_000_000:
+        return None, "unavailable (matrix too large for the " \
+                     "host-oracle Lanczos estimate)"
+    from acg_tpu import health
+    from acg_tpu.solvers.host_cg import HostCGSolver
+    from acg_tpu.solvers.stats import StoppingCriteria
+
+    rt = rtol if 0 < rtol < 1 else 1e-9
+    crit = StoppingCriteria(maxits=min(max(int(maxits), 200), 2000),
+                            residual_rtol=rt)
+    try:
+        hs = HostCGSolver(csr, trace=4096, precond=precond)
+        hs.solve(np.ones(csr.shape[0]), criteria=crit,
+                 raise_on_divergence=False)
+        est = health.spectrum_estimate(hs.last_trace)
+    except Exception as e:  # noqa: BLE001 -- the plan degrades, never sinks
+        return None, f"unavailable ({type(e).__name__})"
+    kappa = (est or {}).get("kappa")
+    if not kappa or kappa <= 0:
+        return None, "unavailable (non-positive Ritz value)"
+    return float(kappa), "lanczos-oracle"
+
+
+# -- pricing ---------------------------------------------------------------
+
+def _fit_or_fallback(cal: dict | None, kind: str) -> tuple[dict, bool]:
+    """The alpha-beta fit for one collective kind, or the clearly-
+    marked uncalibrated fallback constants."""
+    fit = (cal or {}).get("collectives", {}).get(kind)
+    if isinstance(fit, dict) and "alpha_s" in fit:
+        return fit, True
+    return {"alpha_s": FALLBACK_ALPHA_S,
+            "beta_s_per_byte": 1.0 / (FALLBACK_GBS * 1e9)}, False
+
+
+def _iterations_for(cand: dict, kappa, rtol: float, maxits: int) -> tuple:
+    """``(predicted_iterations, kappa_effective)`` for one candidate:
+    the Lanczos-kappa CG bound through the precond's spectrum
+    compression, inflated by the recurrence's numerical penalty."""
+    from acg_tpu.health import predicted_iterations
+    from acg_tpu.recurrence import parse_algorithm
+
+    pc = cand["precond"]
+    keff = kappa
+    if keff is not None:
+        if pc == "jacobi":
+            keff = keff * JACOBI_KAPPA_FACTOR
+        elif pc.startswith("bjacobi"):
+            keff = keff * BJACOBI_KAPPA_FACTOR
+        elif pc.startswith("cheby:"):
+            deg = int(pc.split(":", 1)[1])
+            keff = max(keff / float(deg * deg), 1.0 + 1e-9)
+    base = predicted_iterations(keff, rtol) if keff else None
+    if base is None:
+        base = int(maxits)
+    spec = parse_algorithm(cand["algorithm"])
+    mult = 1.0
+    if spec is not None and spec.kind == "sstep":
+        mult = (1.0 + SSTEP_CHEBY_PENALTY * spec.param
+                if spec.basis == "chebyshev"
+                else 1.0 + SSTEP_MONOMIAL_PENALTY * spec.param ** 2)
+    elif spec is not None and spec.kind == "pl":
+        mult = 1.0 + PL_PENALTY * spec.param
+    elif cand["algorithm"] == "pipelined":
+        mult = 1.0 + PIPELINED_PENALTY
+    its = max(1, min(int(math.ceil(base * mult)), int(maxits)))
+    return its, keff
+
+
+def price_candidate(cand: dict, ctx: dict) -> dict:
+    """One candidate's predicted cost breakdown.  ``ctx`` carries the
+    problem measurements (n, nnz, itemsizes, halo rows), the probed
+    constants (bw_gbs, dispatch_s), the calibration doc (or None) and
+    the kappa/rtol/maxits convergence inputs."""
+    from acg_tpu.commbench import predict_seconds
+    from acg_tpu.recurrence import parse_algorithm, reduction_schedule
+
+    n, nnz = int(ctx["n"]), int(ctx["nnz"])
+    vec_b = int(ctx["vec_itemsize"])
+    spec = parse_algorithm(cand["algorithm"])
+    pipelined = cand["algorithm"] == "pipelined"
+    pc = cand["precond"]
+    schedule = reduction_schedule(spec, pipelined,
+                                  precond=pc != "none")
+    its, keff = _iterations_for(cand, ctx.get("kappa"), ctx["rtol"],
+                                ctx["maxits"])
+
+    # per-iteration HBM traffic: matrix reads (zero for matrix-free --
+    # the stencil is recomputed) x the recurrence's SpMV count, the
+    # loop's vector passes, and the preconditioner apply
+    mat_bytes = 0.0 if cand["matrix_free"] \
+        else nnz * (ctx["mat_itemsize"] + ctx["idx_bytes"])
+    spmv_mult = float(schedule.get("spmv_per_iteration", 1.0))
+    passes = 21 if (pipelined or (spec is not None
+                                  and spec.kind == "pl")) else 15
+    if spec is not None and spec.kind == "sstep":
+        passes += SSTEP_EXTRA_PASSES
+    hbm_bytes = mat_bytes * spmv_mult + passes * n * vec_b
+    halo_exchanges = spmv_mult
+    if pc != "none":
+        from acg_tpu.precond import bytes_per_apply, parse_precond
+        pspec = parse_precond(pc)
+        hbm_bytes += bytes_per_apply(pspec, n, vec_b, mat_bytes,
+                                     state_bytes=float(n * vec_b))
+        if pspec.kind == "cheby":
+            halo_exchanges += pspec.degree
+    bw = ctx.get("bw_gbs") or FALLBACK_GBS
+    t_hbm = hbm_bytes / (bw * 1e9)
+
+    # per-iteration communication from the calibrated alpha-beta fits
+    # over the recurrence's declared reduction schedule and the
+    # partition's halo-plane bytes
+    t_ar = t_halo = 0.0
+    nparts = int(ctx["nparts"])
+    calibrated = True
+    if nparts > 1:
+        ar_fit, ar_cal = _fit_or_fallback(ctx.get("cal"), "all_reduce")
+        nred = float(schedule.get("allreduce_per_iteration", 0.0))
+        scalars = float(schedule.get("allreduce_scalars", 1))
+        t_ar = nred * float(predict_seconds(ar_fit, scalars * vec_b))
+        hidden = float(schedule.get("reduction_latency_hidden", 0) or 0)
+        if hidden > 0:
+            # p(l): the fused allreduce overlaps L SpMV steps -- only
+            # the latency the matrix traffic cannot cover is exposed
+            t_ar = max(0.0, t_ar - hidden * mat_bytes / (bw * 1e9))
+        halo_kind = "dma" if cand["comm"] == "dma" else "all_to_all"
+        halo_fit, halo_cal = _fit_or_fallback(ctx.get("cal"), halo_kind)
+        halo_bytes = float(ctx.get("halo_rows", 0)) * vec_b
+        t_one = float(predict_seconds(halo_fit, halo_bytes))
+        if cand["kernels"] == "fused":
+            # the fused tier's overlap: interior SpMV traffic hides the
+            # halo (perfmodel.predicted_overlap_seconds, restated)
+            t_int = (mat_bytes / nparts) / (bw * 1e9)
+            t_one = max(0.0, t_one - t_int)
+        t_halo = t_one * halo_exchanges
+        calibrated = ar_cal and halo_cal
+    t_comm = t_ar + t_halo
+    t_iter = t_hbm + t_comm
+    disp = float(ctx.get("dispatch_s") or 0.0)
+    total = its * t_iter + disp
+    comp = {"hbm": its * t_hbm, "comm": its * t_comm, "dispatch": disp}
+    dominant = max(comp, key=lambda k: comp[k])
+    return {
+        **cand,
+        "label": candidate_label(cand),
+        "predicted_iterations": int(its),
+        "kappa_effective": (round(float(keff), 6)
+                            if keff is not None else None),
+        "s_per_iteration": {"hbm": t_hbm, "allreduce": t_ar,
+                            "halo": t_halo},
+        "components_s": comp,
+        "dominant": dominant,
+        "predicted_s_per_solve": float(total),
+        "calibrated": bool(calibrated),
+    }
+
+
+# -- plan-vs-actual self-correction ---------------------------------------
+
+def plan_key(matrix_id, nparts, calibration) -> str:
+    """The self-correction join key: plans and plan-vs-actual rows for
+    the same matrix on the same mesh under the same calibration."""
+    return f"{matrix_id}|{int(nparts)}p|{calibration}"
+
+
+def consult_history(history_dir, matrix_id, nparts,
+                    calibration) -> dict:
+    """Scan the run-history ledger for prior plan-vs-actual rows under
+    the same (matrix, mesh, calibration) key and derive the constant
+    rescale: the geometric mean of measured/predicted seconds-per-solve
+    over the last :data:`CORRECTION_WINDOW` rows.  ``{"scale",
+    "nsamples"}`` -- scale 1.0 when nothing usable exists (first run,
+    missing ledger, other keys)."""
+    out = {"scale": 1.0, "nsamples": 0}
+    if not history_dir:
+        return out
+    from acg_tpu import observatory
+
+    key = plan_key(matrix_id, nparts, calibration)
+    ratios = []
+    for entry in observatory.history_scan(history_dir):
+        doc = entry.get("doc") or {}
+        plan = ((doc.get("stats") or {}).get("plan")) or {}
+        if plan.get("key") != key:
+            continue
+        pred = plan.get("predicted_s_per_solve")
+        meas = plan.get("measured_s_per_solve")
+        try:
+            pred, meas = float(pred), float(meas)
+        except (TypeError, ValueError):
+            continue
+        if pred > 0 and meas > 0 and math.isfinite(pred) \
+                and math.isfinite(meas):
+            ratios.append(meas / pred)
+    ratios = ratios[-CORRECTION_WINDOW:]
+    if ratios:
+        out["scale"] = float(math.exp(
+            sum(math.log(r) for r in ratios) / len(ratios)))
+        out["nsamples"] = len(ratios)
+    return out
+
+
+# -- the ranked plan document ---------------------------------------------
+
+def plan_id(doc: dict) -> str:
+    """Content-hashed plan id (the calibration_id pattern): any edit to
+    the ranking produces a different id."""
+    payload = {k: v for k, v in doc.items() if k != "plan_id"}
+    h = hashlib.sha256(json.dumps(payload, sort_keys=True,
+                                  default=str).encode()).hexdigest()
+    return (f"plan-{doc.get('backend', 'x')}-"
+            f"{int(doc.get('nparts', 0))}p-{h[:10]}")
+
+
+def build_plan(csr, *, matrix_id, nparts, dtype_name, rtol, maxits,
+               mat_itemsize, vec_itemsize, idx_bytes=4.0,
+               precond=None, cal=None, kappa=None,
+               kappa_source="unavailable", bw_gbs=None,
+               dispatch_s=None, history_dir=None, backend="cpu",
+               operator_armed=False, kernels=KERNEL_CHOICES,
+               comms=COMM_CHOICES) -> dict:
+    """Price the candidate space for one problem and emit the ranked
+    ``acg-tpu-plan/1`` document.  Pure host arithmetic: same inputs +
+    same calibration => byte-identical document (the determinism
+    contract; no timestamps live inside)."""
+    from acg_tpu.commbench import UNCALIBRATED
+
+    cal_id = (cal or {}).get("calibration_id") or UNCALIBRATED
+    candidates, pruned = enumerate_candidates(
+        nparts, precond=precond, cal=cal,
+        operator_armed=operator_armed, kernels=kernels, comms=comms)
+    correction = consult_history(history_dir, matrix_id, nparts, cal_id)
+    ctx = {"n": int(csr.shape[0]), "nnz": int(csr.nnz),
+           "mat_itemsize": float(mat_itemsize),
+           "vec_itemsize": int(vec_itemsize),
+           "idx_bytes": float(idx_bytes),
+           "halo_rows": halo_plane_rows(csr, nparts),
+           "nparts": int(nparts), "cal": cal, "kappa": kappa,
+           "rtol": float(rtol), "maxits": int(maxits),
+           "bw_gbs": bw_gbs, "dispatch_s": dispatch_s}
+    ranked = [price_candidate(c, ctx) for c in candidates]
+    scale = float(correction["scale"])
+    for row in ranked:
+        row["predicted_s_per_solve"] = \
+            row["predicted_s_per_solve"] * scale
+        row["components_s"] = {k: v * scale
+                               for k, v in row["components_s"].items()}
+    # deterministic ranking: time, then label (a stable tie-break so
+    # equal-cost cells never reorder between runs)
+    ranked.sort(key=lambda r: (r["predicted_s_per_solve"], r["label"]))
+    doc = {
+        "schema": PLAN_SCHEMA,
+        "matrix": str(matrix_id),
+        "nparts": int(nparts),
+        "dtype": str(dtype_name),
+        "rtol": float(rtol),
+        "maxits": int(maxits),
+        "backend": str(backend),
+        "calibration": cal_id,
+        "uncalibrated": cal is None,
+        "kappa": (round(float(kappa), 6) if kappa else None),
+        "kappa_source": str(kappa_source),
+        "bw_gbs": (round(float(bw_gbs), 3) if bw_gbs else None),
+        "dispatch_s": (float(dispatch_s) if dispatch_s else None),
+        "halo_plane_rows": int(ctx["halo_rows"]),
+        "correction": {"scale": round(scale, 6),
+                       "nsamples": int(correction["nsamples"]),
+                       "key": plan_key(matrix_id, nparts, cal_id)},
+        "ranked": ranked,
+        "pruned": pruned,
+    }
+    doc["plan_id"] = plan_id(doc)
+    return doc
+
+
+def validate_plan(doc) -> list[str]:
+    """Problems with a plan document (empty list = valid): schema, id
+    integrity, a non-empty ranking with finite predictions, and typed
+    reasons on every pruned cell."""
+    problems: list[str] = []
+    if not isinstance(doc, dict):
+        return ["not a JSON object"]
+    if doc.get("schema") != PLAN_SCHEMA:
+        problems.append(f"schema is {doc.get('schema')!r}, expected "
+                        f"{PLAN_SCHEMA!r}")
+        return problems
+    pid = doc.get("plan_id")
+    if not isinstance(pid, str) or not pid:
+        problems.append("missing plan_id")
+    elif pid != plan_id(doc):
+        problems.append("plan_id does not match the document content "
+                        "(edited after planning?)")
+    ranked = doc.get("ranked")
+    if not isinstance(ranked, list) or not ranked:
+        problems.append("empty ranking")
+        return problems
+    for row in ranked:
+        if not isinstance(row, dict):
+            problems.append(f"bad ranked row {row!r}")
+            break
+        t = row.get("predicted_s_per_solve")
+        if not isinstance(t, (int, float)) or not math.isfinite(t) \
+                or t < 0:
+            problems.append(f"{row.get('label')}: non-finite "
+                            f"prediction {t!r}")
+            break
+    times = [r.get("predicted_s_per_solve", 0) for r in ranked
+             if isinstance(r, dict)]
+    if times != sorted(times):
+        problems.append("ranking is not sorted by predicted time")
+    for cell in doc.get("pruned") or []:
+        if not isinstance(cell, dict) or not cell.get("reason"):
+            problems.append(f"pruned cell without a typed reason: "
+                            f"{cell!r}")
+            break
+    if not isinstance(doc.get("calibration"), str):
+        problems.append("missing calibration provenance")
+    return problems
+
+
+def render_plan(doc: dict, limit: int = 12) -> str:
+    """The human-readable ranked table (--explain --plan)."""
+    lines = [f"== plan: {doc['matrix']} on {doc['nparts']} part(s), "
+             f"{doc['dtype']}, rtol {doc['rtol']:g} ==",
+             f"  plan {doc['plan_id']}; calibration "
+             f"{doc['calibration']}"
+             + ("  ** UNCALIBRATED: comm priced from fallback "
+                "constants **" if doc.get("uncalibrated") else ""),
+             f"  kappa "
+             + (f"{doc['kappa']:.4g} ({doc['kappa_source']})"
+                if doc.get("kappa") else f"{doc['kappa_source']}")
+             + (f"; correction x{doc['correction']['scale']:.3f} over "
+                f"{doc['correction']['nsamples']} prior run(s)"
+                if doc["correction"]["nsamples"] else
+                "; no prior plan-vs-actual rows (correction x1.000)")]
+    head = (f"  {'#':>2}  {'candidate':<42} {'pred s/solve':>12} "
+            f"{'iters':>6}  dominant")
+    lines.append(head)
+    for i, row in enumerate(doc["ranked"][:limit], 1):
+        lines.append(f"  {i:>2}  {row['label']:<42} "
+                     f"{row['predicted_s_per_solve']:>12.3e} "
+                     f"{row['predicted_iterations']:>6}  "
+                     f"{row['dominant']}")
+    extra = len(doc["ranked"]) - limit
+    if extra > 0:
+        lines.append(f"  ... {extra} more candidate(s)")
+    if doc.get("pruned"):
+        reasons: dict[str, int] = {}
+        for cell in doc["pruned"]:
+            reasons[cell["reason"]] = reasons.get(cell["reason"], 0) + 1
+        pr = ", ".join(f"{k} x{v}" for k, v in sorted(reasons.items()))
+        lines.append(f"  pruned {len(doc['pruned'])} cell(s): {pr}")
+    return "\n".join(lines) + "\n"
+
+
+def write_plan(doc: dict, dest) -> None:
+    """Write the plan doc to a path (``"-"`` = stdout)."""
+    import sys
+    if dest in (None, "-"):
+        json.dump(doc, sys.stdout, indent=2)
+        sys.stdout.write("\n")
+        return
+    with open(dest, "w") as f:
+        json.dump(doc, f, indent=2)
+        f.write("\n")
+
+
+# -- CLI integration -------------------------------------------------------
+
+def _probe_constants(vec_dtype, on_tpu: bool, use_cache: bool = True):
+    """``(bw_gbs, dispatch_s)`` from the perfmodel probes (both behind
+    their existing caches/guards); (None, None) when probing fails."""
+    from acg_tpu.perfmodel import _dispatch_seconds, \
+        cached_triad_probe_gbs
+
+    bw = disp = None
+    try:
+        bw = (cached_triad_probe_gbs(use_cache=use_cache) if on_tpu
+              else cached_triad_probe_gbs(1 << 22, use_cache=use_cache,
+                                          lo=0.5))
+    except Exception:  # noqa: BLE001 -- fallback constants take over
+        pass
+    try:
+        disp = _dispatch_seconds(dtype=vec_dtype)
+    except Exception:  # noqa: BLE001
+        pass
+    return bw, disp
+
+
+def plan_for_args(args, csr, nparts, dtype, vec_dtype) -> dict:
+    """Build the plan for one CLI invocation (the --plan/--autotune
+    entry): probes, kappa estimate, calibration and history pickup all
+    come from the same sources the explain tier uses."""
+    import jax
+
+    on_tpu = jax.default_backend() == "tpu"
+    use_cache = not getattr(args, "no_probe_cache", False)
+    bw, disp = _probe_constants(vec_dtype, on_tpu, use_cache=use_cache)
+    kappa, source = kappa_estimate(csr, args.residual_rtol,
+                                   args.max_iterations)
+    pc = getattr(args, "_precond", None)
+    return build_plan(
+        csr, matrix_id=str(args.A), nparts=int(nparts),
+        dtype_name=str(args.dtype), rtol=float(args.residual_rtol),
+        maxits=int(args.max_iterations),
+        mat_itemsize=np.dtype(dtype).itemsize,
+        vec_itemsize=np.dtype(vec_dtype).itemsize,
+        precond=(str(pc) if pc is not None else None),
+        cal=getattr(args, "_calibration", None),
+        kappa=kappa, kappa_source=source, bw_gbs=bw, dispatch_s=disp,
+        history_dir=getattr(args, "history", None),
+        backend=jax.default_backend(),
+        operator_armed=getattr(args, "_operator_spec", None)
+        is not None)
+
+
+def run_plan_explain(args, dtype, vec_dtype) -> int:
+    """``--explain --plan``: print the ranked table WITHOUT solving
+    (and write the plan document when --plan names a FILE).  The
+    no-dispatch twin of the autotune path."""
+    import sys
+
+    from acg_tpu.perfmodel import _explain_matrix
+
+    csr = _explain_matrix(args)
+    import jax
+    nparts = args.nparts or min(len(jax.devices()), 4)
+    doc = plan_for_args(args, csr, nparts, dtype, vec_dtype)
+    sys.stderr.write(render_plan(doc))
+    if args.plan not in (None, "-"):
+        try:
+            write_plan(doc, args.plan)
+        except OSError as e:
+            sys.stderr.write(f"acg-tpu: --plan {args.plan}: {e}\n")
+            return 1
+    return 0
+
+
+def apply_candidate_to_args(args, cand: dict) -> str:
+    """Mutate the parsed CLI args so the NORMAL construction flow
+    dispatches the chosen candidate -- the planner only ever chooses
+    flags before construction, never alters program emission (the
+    disarmed byte-identity contract).  Returns the resolved comm."""
+    from acg_tpu.precond import parse_precond
+    from acg_tpu.recurrence import parse_algorithm
+
+    alg = cand["algorithm"]
+    spec = parse_algorithm(alg)
+    if spec is not None and spec.communication_avoiding:
+        args.solver = "acg"
+        args._algorithm = spec
+    else:
+        args.solver = "acg-pipelined" if alg == "pipelined" else "acg"
+        args._algorithm = None
+    args.kernels = cand["kernels"]
+    args._precond = parse_precond(None if cand["precond"] == "none"
+                                  else cand["precond"])
+    args.comm = cand["comm"]
+    return cand["comm"]
+
+
+def _probe_candidate(cand: dict, csr, part, nparts, b, dtype,
+                     vec_dtype, args, probe_its: int) -> float | None:
+    """One short timed probe of a candidate: build the solver the way
+    the CLI would, run ``probe_its`` iterations once warm, return
+    seconds (None when the candidate fails to build/run)."""
+    import jax.numpy as jnp
+
+    from acg_tpu.precond import parse_precond
+    from acg_tpu.recurrence import parse_algorithm
+    from acg_tpu.solvers.stats import StoppingCriteria
+
+    spec = parse_algorithm(cand["algorithm"])
+    pipelined = cand["algorithm"] == "pipelined"
+    algorithm = spec if spec is not None \
+        and spec.communication_avoiding else None
+    pc = parse_precond(None if cand["precond"] == "none"
+                       else cand["precond"])
+    crit = StoppingCriteria(maxits=int(probe_its), residual_rtol=0.0,
+                            residual_atol=0.0)
+    import time as _time
+    try:
+        if nparts > 1:
+            from acg_tpu.parallel.dist import (DistCGSolver,
+                                               DistributedProblem,
+                                               resolve_comm)
+            prob = DistributedProblem.build(csr, part, nparts,
+                                            dtype=dtype,
+                                            vector_dtype=vec_dtype)
+            solver = DistCGSolver(prob, pipelined=pipelined,
+                                  comm=resolve_comm(cand["comm"]),
+                                  kernels=cand["kernels"],
+                                  precond=pc, algorithm=algorithm)
+        else:
+            from acg_tpu.ops.spmv import device_matrix_from_csr
+            from acg_tpu.solvers.jax_cg import JaxCGSolver
+            A = device_matrix_from_csr(csr, dtype=dtype)
+            solver = JaxCGSolver(A, pipelined=pipelined,
+                                 kernels=cand["kernels"],
+                                 vector_dtype=vec_dtype,
+                                 precond=pc, algorithm=algorithm,
+                                 host_matrix=csr)
+        solver.solve(jnp.asarray(b), criteria=crit, warmup=1)
+        t0 = _time.perf_counter()
+        solver.solve(jnp.asarray(b), criteria=crit)
+        return _time.perf_counter() - t0
+    except Exception:  # noqa: BLE001 -- a failing probe disqualifies
+        return None    # the candidate, never the solve
+
+
+def autotune_select(args, doc: dict, csr, part, nparts, b, dtype,
+                    vec_dtype, err, top: int = 2,
+                    probe_its: int = 8) -> dict | None:
+    """Verify the plan's top candidates by short timed probes and
+    return the winner's ranked row (None when every probe failed --
+    the caller falls back to the flag-selected program)."""
+    rows = doc["ranked"][:max(int(top), 1)]
+    timed = []
+    for row in rows:
+        s = _probe_candidate(row, csr, part, nparts, b, dtype,
+                             vec_dtype, args, probe_its)
+        if s is not None:
+            timed.append((s, row))
+            err.write(f"acg-tpu: autotune: probe {row['label']}: "
+                      f"{s:.4g}s / {probe_its} its\n")
+        else:
+            err.write(f"acg-tpu: autotune: probe {row['label']} "
+                      f"failed; candidate disqualified\n")
+    if not timed:
+        return None
+    timed.sort(key=lambda t: t[0])
+    return timed[0][1]

@@ -97,7 +97,8 @@ class ServeConfig:
                  state_path: str | None = None,
                  preload: str | None = None, nparts: int = 0,
                  comm: str = "xla", dtype: str = "f64",
-                 allow_faults: bool = False):
+                 allow_faults: bool = False, autotune: bool = False,
+                 calibration: dict | None = None):
         self.port = int(port)
         self.queue_depth = int(queue_depth)
         self.coalesce = int(coalesce)
@@ -115,6 +116,10 @@ class ServeConfig:
         self.dtype = dtype
         self.allow_faults = bool(allow_faults) \
             or os.environ.get(FAULTS_ENV) == "1"
+        # decision observatory (--serve --autotune): plan on operator-
+        # cache miss against this calibration, replan when it changes
+        self.autotune = bool(autotune)
+        self.calibration = calibration
 
 
 class RequestRefused(Exception):
@@ -324,6 +329,12 @@ class _LruCache:
                 metrics.record_serve_cache("evict", self.name)
         return evicted
 
+    def peek(self, key):
+        """Side-effect-free read: no LRU bump, no hit/miss counting
+        (the /status path must observe the cache, not perturb it)."""
+        with self._lock:
+            return self._d.get(key)
+
     def invalidate(self, key) -> bool:
         from acg_tpu import metrics
         with self._lock:
@@ -375,6 +386,9 @@ class ServeDaemon:
         self._server = None
         self.port: int | None = None
         self._state_lock = threading.Lock()
+        # decision observatory: the last planned solve's predicted /
+        # measured ratio (surfaced in /status)
+        self.last_misprediction: float | None = None
 
     # -- state persistence (the self-healing warm restore) ----------------
 
@@ -471,11 +485,73 @@ class ServeDaemon:
             from acg_tpu.ops.spmv import device_matrix_from_csr
             entry["A"] = device_matrix_from_csr(csr, dtype=dt)
         entry["ingest_seconds"] = time.perf_counter() - t0
+        if self.cfg.autotune:
+            # decision observatory: plan on operator-cache miss -- the
+            # decision is cached alongside the operator (and the
+            # compiled programs it selects), replanned when the
+            # calibration id changes (_solve_batch)
+            entry["plan"] = self._plan_operator(key, entry)
         for (ekey, _val) in self.operators.put(key, entry):
             # dependent compiled programs hold the evicted operator's
             # device buffers alive -- drop them with it
             self.programs.invalidate_where(lambda k: k[:3] == ekey)
         return entry, False
+
+    def _calibration_id(self) -> str:
+        from acg_tpu.commbench import UNCALIBRATED, calibration_id
+        cal = self.cfg.calibration
+        if not isinstance(cal, dict):
+            return UNCALIBRATED
+        return cal.get("calibration_id") or calibration_id(cal)
+
+    def set_calibration(self, cal: dict | None) -> None:
+        """Swap the live calibration document.  Cached decisions keep
+        their recorded calibration id, so the next planned request for
+        each operator notices the mismatch and replans."""
+        self.cfg.calibration = cal
+
+    def _plan_operator(self, key: tuple, entry: dict) -> dict | None:
+        """One planning pass for a freshly ingested operator: rank the
+        candidate space the daemon can actually dispatch (its fixed
+        kernels/transport; the recurrence is the free axis) and return
+        the decision.  Planning failing is never fatal -- the request
+        falls back to the flag-selected program."""
+        from acg_tpu import observatory, planner
+        matrix, dtype, nparts = key
+        try:
+            import jax
+            itemsize = 8 if dtype == "f64" else 4
+            kappa, src = planner.kappa_estimate(entry["csr"], 1e-8, 500)
+            bw, disp = planner._probe_constants(
+                self._jnp_dtype(dtype), jax.default_backend() == "tpu")
+            doc = planner.build_plan(
+                entry["csr"], matrix_id=str(matrix),
+                nparts=max(int(nparts), 1), dtype_name=str(dtype),
+                rtol=1e-8, maxits=500, mat_itemsize=itemsize,
+                vec_itemsize=itemsize, cal=self.cfg.calibration,
+                kappa=kappa, kappa_source=src, bw_gbs=bw,
+                dispatch_s=disp, backend=jax.default_backend(),
+                kernels=("auto",), comms=(self.cfg.comm,))
+            if not doc["ranked"]:
+                return None
+            top = doc["ranked"][0]
+            decision = {
+                "plan_id": doc["plan_id"],
+                "calibration": doc["calibration"],
+                "selected": top["label"],
+                "algorithm": top["algorithm"],
+                "predicted_s_per_solve": top["predicted_s_per_solve"],
+                "predicted_iterations": top["predicted_iterations"],
+            }
+            observatory.note_event(
+                "serve-planned",
+                f"operator {matrix}: {top['label']} (plan "
+                f"{doc['plan_id']}, calibration {doc['calibration']})")
+            return decision
+        except Exception as e:  # noqa: BLE001 -- planning is advisory
+            sys.stderr.write(f"acg-tpu: serve: planning {matrix} "
+                             f"failed: {type(e).__name__}: {e}\n")
+            return None
 
     def _build_solver(self, req: _Request, op: dict, nrhs: int):
         from acg_tpu.solvers.resilience import RecoveryPolicy
@@ -634,6 +710,38 @@ class ServeDaemon:
                     f"{self._burn():.2f})")
             op, op_hit = self._ingest_operator(
                 lead.operator_key(self.cfg))
+            # decision observatory: resolve this batch's program
+            # provenance.  degraded beats everything (the shed ladder
+            # already stripped algorithm/precond); an explicit request
+            # field is flag-forced; otherwise the cached plan decides
+            # -- replanned first when the calibration id changed
+            decision = op.get("plan") if self.cfg.autotune else None
+            if self.cfg.autotune and decision is not None:
+                cal_now = self._calibration_id()
+                if decision.get("calibration") != cal_now:
+                    observatory.note_event(
+                        "serve-replanned",
+                        f"operator {lead.matrix}: calibration "
+                        f"{decision.get('calibration')} -> {cal_now}")
+                    decision = self._plan_operator(
+                        lead.operator_key(self.cfg), op)
+                    op["plan"] = decision
+            if degraded:
+                plan_source = "fallback"
+            elif lead.algorithm is not None or lead.precond is not None:
+                plan_source = "flag-forced"
+            elif decision is not None:
+                plan_source = "planned"
+                # the planned recurrence only applies to single-RHS
+                # service: coalesced batches ride the batched-classic
+                # program (the bitwise coalescing contract)
+                if nrhs == 1 \
+                        and decision.get("algorithm") != "classic":
+                    lead.algorithm = decision["algorithm"]
+            else:
+                plan_source = "flag-forced"
+            plan_body = {"id": (decision or {}).get("plan_id"),
+                         "source": plan_source}
             n = op["n"]
             cols = [self._request_b(r, n) for r in batch]
             b = cols[0] if nrhs == 1 else np.stack(cols, axis=1)
@@ -649,6 +757,12 @@ class ServeDaemon:
                                     iterations=int(st.niterations))
             if nrhs > 1:
                 metrics.record_serve_coalesced(nrhs)
+            if plan_source == "planned" and latency > 0 \
+                    and decision.get("predicted_s_per_solve"):
+                ratio = float(decision["predicted_s_per_solve"]) \
+                    / latency
+                self.last_misprediction = ratio
+                metrics.record_plan_misprediction(ratio)
             X = np.asarray(x)
             for j, r in enumerate(batch):
                 xj = X[:, j] if nrhs > 1 else X
@@ -660,6 +774,7 @@ class ServeDaemon:
                         "iterations": iters,
                         "latency_seconds": round(latency, 6),
                         "coalesced": nrhs, "degraded": degraded,
+                        "plan": dict(plan_body),
                         "cache": {"operator":
                                   "hit" if op_hit else "miss",
                                   "program":
@@ -667,6 +782,7 @@ class ServeDaemon:
                 if r.want_x:
                     body["x"] = xj.tolist()
                 r.finish(200, body)
+                metrics.record_plan_decision(plan_source)
                 metrics.record_serve_request("ok")
                 self.requests_served += 1
             self._save_state()
@@ -786,6 +902,23 @@ class ServeDaemon:
                "program_cache": {"entries": len(self.programs)},
                "slo_burn": round(self._burn(), 4),
                "nparts": self.cfg.nparts}
+        # decision observatory: what the daemon would dispatch and how
+        # honest the last planned prediction was
+        cached = []
+        for key in self.operators.keys():
+            entry = self.operators.peek(key)
+            dec = (entry or {}).get("plan")
+            if dec:
+                cached.append({"matrix": key[0],
+                               "plan_id": dec.get("plan_id"),
+                               "selected": dec.get("selected"),
+                               "calibration": dec.get("calibration")})
+        doc["plans"] = {
+            "autotune": bool(self.cfg.autotune),
+            "calibration": self._calibration_id(),
+            "decisions": cached,
+            "last_misprediction_ratio": self.last_misprediction,
+        }
         doc["status"] = observatory.status_document()
         return doc
 
@@ -928,6 +1061,9 @@ def _serve_validate(args) -> None:
          bool(getattr(args, "output_comm_matrix", False))),
         ("--profile-ops",
          getattr(args, "profile_ops", None) is not None),
+        ("--plan (the daemon plans per operator; GET /status shows "
+         "the cached decisions)",
+         getattr(args, "plan", None) is not None),
     ] if on]
     if unsupported:
         raise SystemExit(f"acg-tpu: --serve does not support: "
@@ -942,6 +1078,16 @@ def config_from_args(args) -> ServeConfig:
     state = args.ckpt
     if state is not None and not state.endswith(".serve.json"):
         state = state + ".serve.json"
+    # --serve dispatches before _main's calibration load; mirror it
+    # (the x64 mirroring pattern in run_serve)
+    cal = getattr(args, "_calibration", None)
+    if cal is None and getattr(args, "calibration", None):
+        from acg_tpu.commbench import load_calibration
+        try:
+            cal = load_calibration(args.calibration)
+        except (OSError, ValueError) as e:
+            raise SystemExit(f"acg-tpu: --calibration "
+                             f"{args.calibration}: {e}")
     return ServeConfig(
         port=int(getattr(args, "serve_port", 0) or 0),
         queue_depth=int(getattr(args, "serve_queue_depth", 16)),
@@ -953,7 +1099,9 @@ def config_from_args(args) -> ServeConfig:
                                                        "nvshmem")
         else "xla",
         dtype="f64" if args.dtype == "f64" else "f32",
-        allow_faults=bool(getattr(args, "serve_faults", False)))
+        allow_faults=bool(getattr(args, "serve_faults", False)),
+        autotune=bool(getattr(args, "autotune", False)),
+        calibration=cal)
 
 
 def run_serve(args, argv: list) -> int:

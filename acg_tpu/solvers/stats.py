@@ -159,6 +159,12 @@ class SolverStats:
     # /9): nrhs, per-RHS iteration/residual/converged columns, and the
     # block-CG iteration totals.  Appends strictly last
     batch: dict = dataclasses.field(default_factory=dict)
+    # decision observatory (acg_tpu.planner, stats schema /12): the
+    # plan id / decision provenance of a planned solve and its
+    # plan-vs-actual row (predicted vs measured s/solve + iterations,
+    # misprediction ratio) -- the self-correction feedback the planner
+    # consults on replan.  Appends strictly last
+    plan: dict = dataclasses.field(default_factory=dict)
 
     def to_dict(self) -> dict:
         """Machine-readable twin of :meth:`fwrite` -- the ``stats`` key
@@ -208,6 +214,7 @@ class SolverStats:
             "tracing": dict(self.tracing),
             "slo": dict(self.slo),
             "batch": dict(self.batch),
+            "plan": dict(self.plan),
         }
         if self.trace is not None:
             d["trace"] = self.trace.to_dict()
@@ -317,6 +324,9 @@ class SolverStats:
         if self.batch:
             p("batch:")
             _write_section(p, self.batch, 1)
+        if self.plan:
+            p("plan:")
+            _write_section(p, self.plan, 1)
         text = out.getvalue()
         if f is not None:
             f.write(text)

@@ -109,7 +109,13 @@ from acg_tpu.solvers.stats import PHASE_ORDER
 # "operator"/"matrix_free"/"matrix_bytes_per_spmv" keys inside the comm
 # ledger of matrix-free dist solves -- additive, so /1../10 consumers
 # keep working
-STATS_SCHEMA = "acg-tpu-stats/11"
+# /12: the decision observatory (acg_tpu.planner) adds a "plan" key
+# inside the stats twin (plan id, decision provenance planned/
+# flag-forced/fallback, the plan-vs-actual row: predicted vs measured
+# s/solve + iterations, misprediction ratio, and the (matrix, mesh,
+# calibration) self-correction key) and the calibration-mismatch event
+# kind -- additive, so /1../11 consumers keep working
+STATS_SCHEMA = "acg-tpu-stats/12"
 CONVERGENCE_SCHEMA = "acg-tpu-convergence/1"
 # default ring capacity (--telemetry-window): 512 iterations x 4 scalars
 # is 8 KiB of f32 carry -- negligible against any solve's vectors, and

@@ -14,14 +14,20 @@ poison it).
 Captures recording only the ``bench_backend_unavailable`` sentinel are
 listed (they are history) but never enter the trend math.
 
+Planned solves (``--autotune``) carry a plan-vs-actual row in their
+stats twin; those cases grow a plan column -- predicted/measured ratio
+first -> last -- and ``--fail-on-misprediction PCT`` turns it into a
+CI gate for cost-model drift.
+
 Usage:
     python scripts/history_report.py DIR [--threshold PCT]
-        [--fail-on-drift]
+        [--fail-on-drift] [--fail-on-misprediction PCT]
 
 Exit codes: 0 = report printed, 1 = unreadable/empty ledger, and with
-``--fail-on-drift``: 7 when any case's latency EWMA drifted past the
-threshold (the soak gate's exit code -- one contract for both drift
-gates).
+``--fail-on-drift`` / ``--fail-on-misprediction``: 7 when any case's
+latency EWMA drifted past the threshold, or any case's latest
+predicted/measured ratio strayed more than PCT from 1.0 (the soak
+gate's exit code -- one contract for all the drift gates).
 """
 
 from __future__ import annotations
@@ -62,6 +68,17 @@ def case_trend(entries: list[dict], threshold_pct: float) -> dict:
     nbase = max(BASELINE_MIN, int(len(vals) * BASELINE_FRACTION))
     window = sorted(vals[:nbase])
     baseline = window[len(window) // 2]
+    # plan-vs-actual trail: planned solves record predicted/measured
+    # into stats.plan (acg_tpu.planner); unplanned runs have no row
+    ratios = []
+    for e in entries:
+        plan = ((e.get("doc") or {}).get("stats") or {}).get("plan")
+        r = (plan or {}).get("misprediction_ratio")
+        if isinstance(r, (int, float)) and math.isfinite(r) and r > 0:
+            ratios.append(float(r))
+    if ratios:
+        out["plan"] = {"planned_runs": len(ratios),
+                       "first": ratios[0], "last": ratios[-1]}
     ewma = vals[0]
     for v in vals[1:]:
         ewma = (1.0 - EWMA_ALPHA) * ewma + EWMA_ALPHA * v
@@ -86,9 +103,11 @@ def _fmt_s(v: float) -> str:
     return f"{v * 1e3:.3g}ms" if v < 1.0 else f"{v:.4g}s"
 
 
-def render(cases: dict, threshold_pct: float) -> tuple[list[str], bool]:
+def render(cases: dict, threshold_pct: float,
+           misprediction_pct: float | None = None,
+           ) -> tuple[list[str], bool, bool]:
     lines: list[str] = []
-    any_drift = False
+    any_drift = any_mispredict = False
     for case in sorted(cases):
         t = cases[case]
         head = f"{case}: {t['runs']} run(s)"
@@ -103,12 +122,24 @@ def render(cases: dict, threshold_pct: float) -> tuple[list[str], bool]:
             head += (f"  iters {it['first']} -> {it['last']}"
                      + (f" (max {it['max']})"
                         if it["max"] != it["last"] else ""))
+        plan = t.get("plan")
+        if plan:
+            head += (f"  plan x{plan['first']:.2f} -> x{plan['last']:.2f}"
+                     f" ({plan['planned_runs']} planned)")
+        else:
+            head += "  plan -"
         if t.get("drift"):
             any_drift = True
             head += (f"  DRIFT (> +{threshold_pct:g}% over the "
                      f"early-runs baseline)")
+        if (plan and misprediction_pct is not None
+                and abs(plan["last"] - 1.0) * 100.0 > misprediction_pct):
+            any_mispredict = True
+            head += (f"  MISPREDICTION (latest predicted/measured "
+                     f"x{plan['last']:.2f} strays > {misprediction_pct:g}% "
+                     f"from 1.0)")
         lines.append(head)
-    return lines, any_drift
+    return lines, any_drift, any_mispredict
 
 
 def main(argv=None) -> int:
@@ -126,6 +157,11 @@ def main(argv=None) -> int:
     ap.add_argument("--fail-on-drift", action="store_true",
                     help="exit 7 (the soak drift gate's code) when any "
                          "case drifted past the threshold")
+    ap.add_argument("--fail-on-misprediction", type=float, default=None,
+                    metavar="PCT",
+                    help="exit 7 when any case's latest plan "
+                         "predicted/measured ratio strays more than PCT "
+                         "percent from 1.0 (cost-model drift gate)")
     args = ap.parse_args(argv)
 
     from acg_tpu.observatory import history_scan
@@ -146,7 +182,9 @@ def main(argv=None) -> int:
         by_case.setdefault(str(case), []).append(e)
     trends = {case: case_trend(es, args.threshold)
               for case, es in by_case.items()}
-    lines, any_drift = render(trends, args.threshold)
+    lines, any_drift, any_mispredict = render(
+        trends, args.threshold,
+        misprediction_pct=args.fail_on_misprediction)
     for ln in lines:
         print(ln)
     tail = (f"history-report: {len(entries)} entr"
@@ -157,6 +195,8 @@ def main(argv=None) -> int:
                  f"excluded from trends")
     print(tail)
     if any_drift and args.fail_on_drift:
+        return DRIFT_EXIT_CODE
+    if any_mispredict:
         return DRIFT_EXIT_CODE
     return 0
 

@@ -7,7 +7,7 @@
 # T1_SOAK=1 additionally runs the service-soak smoke after the tests: a
 # tiny 3-solve --soak run whose --metrics-file must validate as
 # Prometheus exposition format and whose --stats-json must carry the
-# acg-tpu-stats/11 soak section (the CI soak-smoke step runs the same
+# acg-tpu-stats/12 soak section (the CI soak-smoke step runs the same
 # thing).  T1_HEALTH=1 runs the numerical-health smoke: an audited
 # pipelined solve on the anisotropic generator must leave a health:
 # section with a finite gap, the acg_health_* metric families, and a
@@ -46,6 +46,12 @@
 # segments) and a calibrated --explain must print provenance with a
 # predicted-vs-measured ratio strictly closer to 1.0 than the
 # uncalibrated model's.
+# T1_PLAN=1 runs the decision-observatory smoke: a --commbench sweep
+# feeds an --autotune solve on the 8-part CPU mesh; the emitted
+# acg-tpu-plan/1 document must validate with calibration provenance,
+# the history ledger must carry the plan-vs-actual row (rendered by
+# history_report.py's plan column), and the acg_plan_* metric
+# families must land in the textfile.
 # T1_SERVE=1 runs the solver-service smoke: a supervised 8-part
 # --serve daemon answers two identical requests (the second must hit
 # BOTH caches with acg_compiles_total unchanged -- zero ingest, zero
@@ -75,7 +81,7 @@ if [ "${T1_SOAK:-0}" = "1" ]; then
     python - <<'PY' || rc=$((rc ? rc : 1))
 import json
 doc = json.load(open("/tmp/_t1_soak.json"))
-assert doc["schema"] == "acg-tpu-stats/11", doc["schema"]
+assert doc["schema"] == "acg-tpu-stats/12", doc["schema"]
 soak = doc["stats"]["soak"]
 assert soak["nsolves"] == 3 and soak["latency"]["p50"] is not None, soak
 assert "metrics" in doc, "registry snapshot missing from /3 document"
@@ -97,7 +103,7 @@ if [ "${T1_PRECOND:-0}" = "1" ]; then
         env PC="$pc" python - <<'PY' || rc=$((rc ? rc : 1))
 import json, os
 doc = json.load(open("/tmp/_t1_precond.json"))
-assert doc["schema"] == "acg-tpu-stats/11", doc["schema"]
+assert doc["schema"] == "acg-tpu-stats/12", doc["schema"]
 st = doc["stats"]
 assert st["converged"] is True, st["rnrm2"]
 assert st["precond"]["kind"] == os.environ["PC"], st["precond"]
@@ -133,7 +139,7 @@ if [ "${T1_HEALTH:-0}" = "1" ]; then
     python - <<'PY' || rc=$((rc ? rc : 1))
 import json, math
 doc = json.load(open("/tmp/_t1_health.json"))
-assert doc["schema"] == "acg-tpu-stats/11", doc["schema"]
+assert doc["schema"] == "acg-tpu-stats/12", doc["schema"]
 h = doc["stats"]["health"]
 assert h["naudits"] > 0, h
 assert h["gap_last"] is not None and math.isfinite(h["gap_last"]), h
@@ -172,7 +178,7 @@ if [ "${T1_CKPT:-0}" = "1" ]; then
     python - <<'PY' || rc=$((rc ? rc : 1))
 import json
 doc = json.load(open("/tmp/_t1_ckpt.json"))
-assert doc["schema"] == "acg-tpu-stats/11", doc["schema"]
+assert doc["schema"] == "acg-tpu-stats/12", doc["schema"]
 st = doc["stats"]
 assert st["converged"] is True, st["rnrm2"]
 ck = st["ckpt"]
@@ -211,7 +217,7 @@ if [ "${T1_TRACE:-0}" = "1" ]; then
     python - <<'PY' || rc=$((rc ? rc : 1))
 import json
 doc = json.load(open("/tmp/_t1_trace.json"))
-assert doc["schema"] == "acg-tpu-stats/11", doc["schema"]
+assert doc["schema"] == "acg-tpu-stats/12", doc["schema"]
 tr = doc["stats"]["tracing"]
 tl = tr["timeline"]
 assert tl["nparts"] == 8 and tl["nspans"] > 0, tl
@@ -260,7 +266,7 @@ assert len(ledgers) == 1, ledgers
 row = json.loads(open(f"/tmp/_t1_history/{ledgers[0]}").readline())
 assert row["ledger"] == "acg-tpu-history/1", row["ledger"]
 assert row["nparts"] == 8 and row["converged"] is True, row
-assert row["doc"]["schema"] == "acg-tpu-stats/11", row["doc"]["schema"]
+assert row["doc"]["schema"] == "acg-tpu-stats/12", row["doc"]["schema"]
 sj = json.load(open("/tmp/_t1_status_stats.json"))
 assert sj["stats"]["slo"]["targets"]["iters"] == 280, sj["stats"]["slo"]
 print(f"T1_STATUS: OK (iteration {doc['solve']['iteration']}, "
@@ -346,7 +352,7 @@ if [ "${T1_BATCH:-0}" = "1" ]; then
     python - <<'PY' || rc=$((rc ? rc : 1))
 import json, os
 doc = json.load(open("/tmp/_t1_batch.json"))
-assert doc["schema"] == "acg-tpu-stats/11", doc["schema"]
+assert doc["schema"] == "acg-tpu-stats/12", doc["schema"]
 batch = doc["stats"]["batch"]
 assert batch["nrhs"] == 4 and len(batch["iterations"]) == 4, batch
 assert all(batch["converged"]) and batch["unconverged"] == 0, batch
@@ -534,7 +540,7 @@ import json
 import numpy as np
 import jax.numpy as jnp
 doc = json.load(open("/tmp/_t1_mf.json"))
-assert doc["schema"] == "acg-tpu-stats/11", doc["schema"]
+assert doc["schema"] == "acg-tpu-stats/12", doc["schema"]
 st = doc["stats"]
 assert st["converged"] is True, st["rnrm2"]
 assert doc["manifest"]["operator"] == "stencil:poisson2d:24", \
@@ -559,6 +565,65 @@ assert led["matrix_bytes_per_spmv"] == 0, led
 print(f"T1_MATFREE: OK (converged in {st['niterations']} iterations, "
       f"byte-identical to assembled, ledger matrix-bytes 0)")
 PY
+fi
+if [ "${T1_PLAN:-0}" = "1" ]; then
+    # decision-observatory smoke (the ISSUE-17 acceptance in
+    # miniature): calibrate the mesh, then let --autotune choose the
+    # program numerically; the ranked plan document must validate
+    # with calibration provenance, the planned solve must leave a
+    # plan-vs-actual row in the history ledger (history_report.py
+    # renders the plan column), and the acg_plan_* metric families
+    # must land in the metrics textfile
+    echo "T1_PLAN: 8-part commbench -> autotune -> plan-vs-actual smoke"
+    rm -rf /tmp/_t1_plan_hist
+    rm -f /tmp/_t1_plan_cal.json /tmp/_t1_plan.json \
+        /tmp/_t1_plan_stats.json /tmp/_t1_plan.prom
+    timeout -k 10 300 env JAX_PLATFORMS=cpu \
+        XLA_FLAGS="--xla_force_host_platform_device_count=8" \
+        python -m acg_tpu.cli gen:poisson2d:16 --nparts 8 \
+        --dtype f32 --max-iterations 20 --warmup 0 --quiet \
+        --commbench /tmp/_t1_plan_cal.json || rc=$((rc ? rc : 1))
+    timeout -k 10 300 env JAX_PLATFORMS=cpu \
+        XLA_FLAGS="--xla_force_host_platform_device_count=8" \
+        python -m acg_tpu.cli gen:poisson2d:32 --nparts 8 \
+        --autotune --calibration /tmp/_t1_plan_cal.json \
+        --plan /tmp/_t1_plan.json --history /tmp/_t1_plan_hist \
+        --stats-json /tmp/_t1_plan_stats.json \
+        --metrics-file /tmp/_t1_plan.prom \
+        --residual-rtol 1e-6 --max-iterations 300 --warmup 0 \
+        --quiet 2> /tmp/_t1_plan.err || rc=$((rc ? rc : 1))
+    python - <<'PY' || rc=$((rc ? rc : 1))
+import json
+from acg_tpu.planner import validate_plan
+from acg_tpu.observatory import history_scan
+cal = json.load(open("/tmp/_t1_plan_cal.json"))
+doc = json.load(open("/tmp/_t1_plan.json"))
+assert validate_plan(doc) == [], validate_plan(doc)
+assert doc["calibration"] == cal["calibration_id"], doc["calibration"]
+assert doc["uncalibrated"] is False and doc["ranked"]
+err = open("/tmp/_t1_plan.err").read()
+assert "autotune: dispatching" in err, err
+sj = json.load(open("/tmp/_t1_plan_stats.json"))
+assert sj["schema"] == "acg-tpu-stats/12", sj["schema"]
+plan = sj["stats"]["plan"]
+assert plan["plan_id"] == doc["plan_id"], plan
+assert plan["source"] in ("planned", "fallback"), plan
+assert plan["measured_s_per_solve"] > 0, plan
+rows = [e["doc"]["stats"]["plan"] for e in
+        history_scan("/tmp/_t1_plan_hist")
+        if (e.get("doc") or {}).get("stats", {}).get("plan")]
+assert rows and rows[-1]["plan_id"] == doc["plan_id"], rows
+print(f"T1_PLAN: OK (plan {doc['plan_id']}, source {plan['source']}, "
+      f"selected {plan.get('selected')}, misprediction "
+      f"{plan.get('misprediction_ratio', 0):.2f}x)")
+PY
+    python scripts/history_report.py /tmp/_t1_plan_hist \
+        | grep -q "plan x" || {
+        echo "T1_PLAN: history_report plan column missing"
+        rc=$((rc ? rc : 1)); }
+    python scripts/check_metrics_textfile.py /tmp/_t1_plan.prom \
+        --require acg_plan_decisions_total \
+        --require acg_plan_misprediction_ratio || rc=$((rc ? rc : 1))
 fi
 if [ "${T1_SERVE:-0}" = "1" ]; then
     # solver-service smoke (the ISSUE-16 acceptance in miniature): a

@@ -569,7 +569,7 @@ def test_cli_crash_then_resume(tmp_path):
     resumed_from = st["ckpt"]["resumed_from"]
     total = resumed_from + st["niterations"]
     assert abs(total - ref["niterations"]) <= 0.1 * ref["niterations"]
-    assert doc["schema"] == "acg-tpu-stats/11"
+    assert doc["schema"] == "acg-tpu-stats/12"
     # the resume event is in the structured sink
     assert any(e["kind"] == "resume" for e in st["events"])
 
@@ -801,5 +801,5 @@ def test_buildinfo_advertises_survivability():
     out = r.stdout
     assert "survivability" in out
     for token in ("--ckpt", "--resume", "--abft", "sdc:flip",
-                  "crash:exit", "--heartbeat", "acg-tpu-stats/11"):
+                  "crash:exit", "--heartbeat", "acg-tpu-stats/12"):
         assert token in out, token

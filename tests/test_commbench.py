@@ -456,7 +456,7 @@ def test_cli_solve_records_calibration_provenance(commbench_doc,
     assert r.returncode == 0, r.stderr
     cal_id = json.loads(commbench_doc.read_text())["calibration_id"]
     doc = json.loads(sj.read_text())
-    assert doc["schema"] == "acg-tpu-stats/11"
+    assert doc["schema"] == "acg-tpu-stats/12"
     assert doc["manifest"]["calibration"] == cal_id
     meta = json.loads(cl.read_text().splitlines()[0])
     assert meta["meta"] is True and meta["calibration"] == cal_id

@@ -502,7 +502,7 @@ def test_health_section_appends_only():
     assert "health:" in txt[len(base):]
     d = st.to_dict()
     assert d["health"]["spectrum"]["kappa"] == 123.4
-    assert telemetry.STATS_SCHEMA == "acg-tpu-stats/11"
+    assert telemetry.STATS_SCHEMA == "acg-tpu-stats/12"
     json.dumps(telemetry.stats_document(st))
 
 
@@ -607,7 +607,7 @@ def test_cli_health_end_to_end(tmp_path):
     assert r.returncode == 0, r.stderr
     assert "health:" in r.stderr
     doc = json.loads(stats.read_text())
-    assert doc["schema"] == "acg-tpu-stats/11"
+    assert doc["schema"] == "acg-tpu-stats/12"
     h = doc["stats"]["health"]
     assert h["naudits"] > 0 and isinstance(h["gap_last"], float)
     assert h["spectrum"]["kappa"] > 1
@@ -640,7 +640,7 @@ def test_cli_buildinfo_advertises_health():
     assert r.returncode == 0, r.stderr
     assert "--audit-every" in r.stdout
     assert "--on-gap" in r.stdout
-    assert "acg-tpu-stats/11" in r.stdout
+    assert "acg-tpu-stats/12" in r.stdout
 
 
 def test_plot_convergence_renders_gap(tmp_path):

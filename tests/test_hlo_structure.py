@@ -312,6 +312,39 @@ def test_tracing_layer_leaves_programs_byte_identical(prob):
         tracing.disarm()
 
 
+def test_planner_leaves_programs_byte_identical(prob):
+    """The decision observatory is host arithmetic only: building a
+    full ranked plan (kappa oracle, candidate pricing, rendering) must
+    leave the lowered solve programs byte-identical, single-chip and
+    distributed -- disarmed (no --autotune/--plan), the planner never
+    touches program emission (the perfmodel/metrics/tracing
+    disarmament contract, extended to the planner's layer)."""
+    from acg_tpu import planner
+    from acg_tpu.io.generators import poisson2d_coo as _p2
+    from acg_tpu.ops.spmv import device_matrix_from_csr
+    from acg_tpu.solvers.jax_cg import JaxCGSolver
+
+    r, c, v, N = _p2(12)
+    csr = SymCsrMatrix.from_coo(N, r, c, v).to_csr()
+    b1 = np.ones(N)
+    s1 = JaxCGSolver(device_matrix_from_csr(csr, dtype=jnp.float64),
+                     kernels="xla")
+    s2 = DistCGSolver(prob)
+    b2 = np.ones(prob.n)
+    before1 = s1.lower_solve(b1).as_text()
+    before2 = s2.lower_solve(b2).as_text()
+    kappa, src = planner.kappa_estimate(csr, 1e-6, 200)
+    doc = planner.build_plan(
+        csr, matrix_id="gen:poisson2d:12", nparts=4,
+        dtype_name="float64", rtol=1e-6, maxits=200,
+        mat_itemsize=8, vec_itemsize=8, kappa=kappa,
+        kappa_source=src)
+    assert doc["ranked"]
+    planner.render_plan(doc)
+    assert s1.lower_solve(b1).as_text() == before1
+    assert s2.lower_solve(b2).as_text() == before2
+
+
 def test_tracing_section_appends_only():
     """Like costmodel:/soak:/ckpt:, the tracing: section appends
     strictly after every existing section -- a report without it is a

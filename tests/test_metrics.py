@@ -347,7 +347,7 @@ def test_cli_soak_acceptance(tmp_path):
         capture_output=True, text=True, timeout=120)
     assert v.returncode == 0, v.stderr + v.stdout
     doc = json.loads(stats.read_text())
-    assert doc["schema"] == "acg-tpu-stats/11"
+    assert doc["schema"] == "acg-tpu-stats/12"
     sk = doc["stats"]["soak"]
     assert sk["nsolves"] == 6
     for k in ("p50", "p95", "p99"):
@@ -514,4 +514,4 @@ def test_buildinfo_advertises_service_metrics():
     assert "--metrics-file" in r.stdout
     assert "--soak" in r.stdout
     assert "--fail-on-drift" in r.stdout
-    assert "acg-tpu-stats/11" in r.stdout
+    assert "acg-tpu-stats/12" in r.stdout

@@ -74,7 +74,7 @@ def csr():
     return SymCsrMatrix.from_coo(N, r, c, v).to_csr()
 
 
-def _doc(schema="acg-tpu-stats/11", metric=None, matrix="m", solver="acg",
+def _doc(schema="acg-tpu-stats/12", metric=None, matrix="m", solver="acg",
          tsolve=0.1, niter=20, soak=None, unix_time=None):
     """A minimal synthetic stats document (the shape history_append
     indexes)."""
@@ -326,7 +326,7 @@ def test_cli_slo_gate_exit_8(tmp_path):
     assert doc["solve"]["active"] is False
     assert doc["slo"]["breached"] is True
     sj = json.loads((tmp_path / "s.json").read_text())
-    assert sj["schema"] == "acg-tpu-stats/11"
+    assert sj["schema"] == "acg-tpu-stats/12"
     assert sj["stats"]["slo"]["breaches"]["latency"] == 1
     assert any(e["kind"] == "slo-breach"
                for e in sj["stats"]["events"])
@@ -366,7 +366,7 @@ def test_history_append_scan_roundtrip(tmp_path):
     assert len(entries) == 2
     idx = entries[0]
     assert idx["ledger"] == "acg-tpu-history/1"
-    assert idx["schema"] == "acg-tpu-stats/11"
+    assert idx["schema"] == "acg-tpu-stats/12"
     assert idx["matrix"] == "m" and idx["dtype"] == "f64"
     assert idx["iterations"] == 10
     assert idx["latency_s"] == pytest.approx(0.1)

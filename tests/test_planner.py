@@ -1,0 +1,401 @@
+"""Decision observatory (acg_tpu.planner): ranked-plan determinism,
+pricing within band of measured-best on the 8-part mesh, the typed
+refusal matrix, plan-vs-actual ledger round-trip through
+history_report, and old-document tolerance."""
+
+import json
+import os
+import subprocess
+import sys
+import time
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+from acg_tpu import commbench as cb
+from acg_tpu import planner
+from acg_tpu.io.generators import poisson2d_coo
+from acg_tpu.matrix import SymCsrMatrix
+
+_ENV = {"JAX_PLATFORMS": "cpu",
+        "XLA_FLAGS": "--xla_force_host_platform_device_count=8"}
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+SCRIPTS = os.path.join(ROOT, "scripts")
+
+
+def _run_cli(argv, timeout=600):
+    env = dict(os.environ)
+    env.update(_ENV)
+    return subprocess.run([sys.executable, "-m", "acg_tpu.cli"] + argv,
+                          capture_output=True, text=True, env=env,
+                          timeout=timeout)
+
+
+def _run_script(name, argv, **kw):
+    kw.setdefault("timeout", 300)
+    return subprocess.run([sys.executable,
+                           os.path.join(SCRIPTS, name), *argv],
+                          capture_output=True, text=True, **kw)
+
+
+def _csr(side=24):
+    r, c, v, n = poisson2d_coo(side)
+    return SymCsrMatrix.from_coo(n, r, c, v).to_csr()
+
+
+def _cal(**over):
+    """A synthetic but well-formed calibration document (the
+    test_commbench _minimal_doc shape)."""
+    doc = {"schema": cb.COMMBENCH_SCHEMA, "backend": "cpu", "nparts": 8,
+           "collectives": {
+               "all_reduce": {"alpha_s": 1e-5, "beta_s_per_byte": 1e-10,
+                              "npoints": 1, "r2": None,
+                              "points": [{"bytes": 8,
+                                          "seconds": 1e-5}]},
+               "all_to_all": {"alpha_s": 2e-5,
+                              "beta_s_per_byte": 1e-9,
+                              "npoints": 1, "r2": None,
+                              "points": [{"bytes": 1024,
+                                          "seconds": 2.1e-5}]}}}
+    doc.update(over)
+    doc["calibration_id"] = cb.calibration_id(doc)
+    return doc
+
+
+def _plan_kwargs(**over):
+    kw = dict(matrix_id="gen:poisson2d:24", nparts=8,
+              dtype_name="float64", rtol=1e-6, maxits=400,
+              mat_itemsize=8, vec_itemsize=8, kappa=950.0,
+              kappa_source="lanczos-oracle", bw_gbs=40.0,
+              dispatch_s=5e-5)
+    kw.update(over)
+    return kw
+
+
+# -- determinism ---------------------------------------------------------
+
+def test_plan_determinism_and_id_integrity():
+    """Same inputs + same calibration => byte-identical ranked document
+    (the planner's determinism contract: no timestamps, stable
+    tie-breaks), and the content-hash plan id detects tampering."""
+    csr = _csr()
+    cal = _cal()
+    a = planner.build_plan(csr, cal=cal, **_plan_kwargs())
+    b = planner.build_plan(csr, cal=cal, **_plan_kwargs())
+    assert json.dumps(a, sort_keys=True) == json.dumps(b, sort_keys=True)
+    assert planner.validate_plan(a) == []
+    assert a["calibration"] == cal["calibration_id"]
+    assert a["plan_id"].startswith("plan-cpu-8p-")
+    # ranked strictly sorted by predicted seconds
+    preds = [r["predicted_s_per_solve"] for r in a["ranked"]]
+    assert preds == sorted(preds) and all(p > 0 for p in preds)
+    # tamper: the id no longer matches the content
+    tampered = json.loads(json.dumps(a))
+    tampered["ranked"][0]["predicted_s_per_solve"] *= 0.5
+    assert any("plan_id" in p for p in planner.validate_plan(tampered))
+
+
+def test_plan_render_and_write(tmp_path):
+    csr = _csr()
+    doc = planner.build_plan(csr, cal=_cal(), **_plan_kwargs())
+    txt = planner.render_plan(doc)
+    assert doc["plan_id"] in txt
+    assert doc["calibration"] in txt
+    assert "UNCALIBRATED" not in txt
+    dest = tmp_path / "plan.json"
+    planner.write_plan(doc, dest)
+    assert json.loads(dest.read_text())["plan_id"] == doc["plan_id"]
+
+
+# -- refusal matrix ------------------------------------------------------
+
+def test_refusal_matrix_uncalibrated_and_pruned_reasons():
+    """No calibration => the ranking is clearly marked uncalibrated;
+    incompatible cells are pruned with TYPED reasons mirroring the CLI
+    refusal matrices, never silently ranked."""
+    csr = _csr()
+    doc = planner.build_plan(csr, cal=None, **_plan_kwargs())
+    assert doc["uncalibrated"] is True
+    assert doc["calibration"] == cb.UNCALIBRATED
+    assert "UNCALIBRATED" in planner.render_plan(doc)
+    reasons = {p["reason"] for p in doc["pruned"]}
+    # CA x fused refused; dma unpriceable without a dma fit
+    assert "ca-fused" in reasons
+    assert "dma-unbenchmarked" in reasons
+    known = {"ca-precond", "ca-fused", "fused-precond",
+             "dma-single-part", "dma-unbenchmarked",
+             "assembled-bypassed"}
+    assert reasons <= known, reasons
+    # no pruned combination ever appears in the ranking
+    pruned_labels = {planner.candidate_label(p) for p in doc["pruned"]}
+    assert not pruned_labels & {r["label"] for r in doc["ranked"]}
+
+
+def test_refusal_matrix_precond_and_operator_cells():
+    csr = _csr()
+    doc = planner.build_plan(csr, cal=_cal(), precond="cheby:4",
+                             **_plan_kwargs())
+    reasons = {p["reason"] for p in doc["pruned"]}
+    assert "ca-precond" in reasons
+    assert "fused-precond" in reasons
+    # preconditioned cells survive on the non-CA recurrences
+    assert any(r["precond"].startswith("cheby")
+               for r in doc["ranked"])
+    # --operator armed: assembled cells are pruned, ranked cells are
+    # all matrix-free
+    doc2 = planner.build_plan(csr, cal=_cal(), operator_armed=True,
+                              **_plan_kwargs())
+    assert "assembled-bypassed" in {p["reason"] for p in doc2["pruned"]}
+    assert all(r["matrix_free"] for r in doc2["ranked"])
+    # single-part mesh: dma is structurally unavailable
+    doc3 = planner.build_plan(csr, cal=_cal(), **_plan_kwargs(nparts=1))
+    assert "dma-single-part" in {p["reason"] for p in doc3["pruned"]}
+
+
+def test_iteration_model_tracks_recurrence():
+    """The predicted-iterations adjustment follows the recurrence: an
+    s-step cell predicts more iterations than classic on the same
+    kappa (basis-conditioning penalty), and a cheby preconditioner
+    compresses kappa so its cell predicts fewer."""
+    csr = _csr()
+    doc = planner.build_plan(csr, cal=_cal(), precond="cheby:4",
+                             **_plan_kwargs())
+    by_label = {r["label"]: r for r in doc["ranked"]}
+    classic = by_label["classic/auto/xla/none/assembled"]
+    sstep = by_label["sstep:4/auto/xla/none/assembled"]
+    cheby = by_label["classic/auto/xla/cheby:4/assembled"]
+    assert sstep["predicted_iterations"] > classic["predicted_iterations"]
+    assert cheby["predicted_iterations"] < classic["predicted_iterations"]
+
+
+# -- pricing within band (the acceptance) --------------------------------
+
+def test_top_plan_within_band_of_measured_best():
+    """On the 8-part CPU mesh with a LIVE collective calibration, the
+    planner's preferred cell among {classic, sstep:4, pipelined} must
+    be within 2x of the measured-best of those three (the ISSUE's
+    pricing-within-band acceptance)."""
+    from acg_tpu.parallel.dist import DistCGSolver, DistributedProblem
+    from acg_tpu.parallel.mesh import solve_mesh
+    from acg_tpu.partition import partition_rows
+    from acg_tpu.recurrence import parse_algorithm
+    from acg_tpu.solvers.stats import StoppingCriteria
+
+    side, nparts, rtol, maxits = 48, 8, 1e-6, 400
+    csr = _csr(side)
+    # live alpha-beta calibration over the in-process mesh
+    colls = cb.bench_collectives(solve_mesh(nparts), cb.CPU_SWEEP,
+                                 reps=4, repeats=2)
+    cal = {"schema": cb.COMMBENCH_SCHEMA, "backend": "cpu",
+           "nparts": nparts, "collectives": colls}
+    cal["calibration_id"] = cb.calibration_id(cal)
+
+    part = partition_rows(csr, nparts, seed=0, method="band")
+    prob = DistributedProblem.build(csr, part, nparts,
+                                    dtype=jnp.float64)
+    b = np.ones(prob.n)
+    crit = StoppingCriteria(maxits=maxits, residual_rtol=rtol)
+    measured = {}
+    for name in ("classic", "sstep:4", "pipelined"):
+        if name == "classic":
+            s = DistCGSolver(prob)
+        elif name == "pipelined":
+            s = DistCGSolver(prob, pipelined=True)
+        else:
+            s = DistCGSolver(prob, algorithm=parse_algorithm(name))
+        s.solve(b, criteria=crit, raise_on_divergence=False, warmup=1)
+        best = min(_timed_solve(s, b, crit) for _ in range(3))
+        measured[name] = best
+    kappa, src = planner.kappa_estimate(csr, rtol, maxits)
+    doc = planner.build_plan(
+        csr, matrix_id=f"gen:poisson2d:{side}", nparts=nparts,
+        dtype_name="float64", rtol=rtol, maxits=maxits,
+        mat_itemsize=8, vec_itemsize=8, cal=cal, kappa=kappa,
+        kappa_source=src, kernels=("auto",), comms=("xla",))
+    wanted = {f"{name}/auto/xla/none/assembled": name
+              for name in measured}
+    ranked3 = [wanted[r["label"]] for r in doc["ranked"]
+               if r["label"] in wanted]
+    top = ranked3[0]
+    floor = min(measured.values())
+    assert measured[top] <= 2.0 * floor, (measured, top)
+
+
+def _timed_solve(s, b, crit):
+    t0 = time.perf_counter()
+    s.solve(b, criteria=crit, raise_on_divergence=False, warmup=0)
+    return time.perf_counter() - t0
+
+
+# -- plan-vs-actual ledger round-trip ------------------------------------
+
+@pytest.fixture(scope="module")
+def planned_run(tmp_path_factory):
+    """One subprocess --commbench + one --autotune solve with a
+    --history ledger, shared by the round-trip tests."""
+    root = tmp_path_factory.mktemp("plan")
+    cal = root / "cal.json"
+    r = _run_cli(["gen:poisson2d:16", "--commbench", str(cal),
+                  "--nparts", "8", "--dtype", "f32",
+                  "--max-iterations", "20", "--warmup", "0", "-q"])
+    assert r.returncode == 0, r.stderr
+    hist = root / "hist"
+    plan = root / "plan.json"
+    sj = root / "stats.json"
+    r = _run_cli(["gen:poisson2d:32", "--autotune", "--calibration",
+                  str(cal), "--history", str(hist), "--plan", str(plan),
+                  "--stats-json", str(sj), "--nparts", "8",
+                  "--residual-rtol", "1e-6", "--max-iterations", "300",
+                  "--warmup", "0", "-q"])
+    assert r.returncode == 0, r.stderr
+    assert "autotune: dispatching" in r.stderr
+    return {"cal": cal, "hist": hist, "plan": plan, "stats": sj}
+
+
+def test_autotune_records_plan_vs_actual(planned_run):
+    doc = json.loads(planned_run["plan"].read_text())
+    assert planner.validate_plan(doc) == []
+    cal_id = json.loads(planned_run["cal"].read_text())["calibration_id"]
+    assert doc["calibration"] == cal_id
+    sj = json.loads(planned_run["stats"].read_text())
+    plan = sj["stats"]["plan"]
+    assert plan["plan_id"] == doc["plan_id"]
+    assert plan["source"] == "planned"
+    assert plan["calibration"] == cal_id
+    assert plan["measured_s_per_solve"] > 0
+    assert plan["misprediction_ratio"] > 0
+    # the ledger carries the same row
+    from acg_tpu.observatory import history_scan
+    entries = history_scan(planned_run["hist"])
+    rows = [e["doc"]["stats"]["plan"] for e in entries
+            if (e.get("doc") or {}).get("stats", {}).get("plan")]
+    assert rows and rows[-1]["plan_id"] == doc["plan_id"]
+
+
+def test_history_report_plan_column_and_gate(planned_run):
+    r = _run_script("history_report.py", [str(planned_run["hist"])])
+    assert r.returncode == 0, r.stderr
+    assert "plan x" in r.stdout
+    # a tolerance no real model meets trips the drift gate (exit 7)
+    r = _run_script("history_report.py",
+                    [str(planned_run["hist"]),
+                     "--fail-on-misprediction", "1e-9"])
+    assert r.returncode == 7
+    assert "MISPREDICTION" in r.stdout
+    # an infinitely loose gate passes
+    r = _run_script("history_report.py",
+                    [str(planned_run["hist"]),
+                     "--fail-on-misprediction", "1e9"])
+    assert r.returncode == 0
+
+
+def test_second_planned_run_self_corrects(planned_run):
+    """The self-correction acceptance: a second planned solve for the
+    same (matrix, mesh, calibration) key consults the first run's
+    plan-vs-actual row and rescales -- the emitted document records a
+    non-unit correction with nsamples >= 1."""
+    plan2 = planned_run["hist"].parent / "plan2.json"
+    r = _run_cli(["gen:poisson2d:32", "--autotune", "--calibration",
+                  str(planned_run["cal"]), "--history",
+                  str(planned_run["hist"]), "--plan", str(plan2),
+                  "--nparts", "8", "--residual-rtol", "1e-6",
+                  "--max-iterations", "300", "--warmup", "0", "-q"])
+    assert r.returncode == 0, r.stderr
+    doc = json.loads(plan2.read_text())
+    assert doc["correction"]["nsamples"] >= 1
+    assert doc["correction"]["scale"] != 1.0
+    assert "correction" in planner.render_plan(doc)
+
+
+def test_explain_plan_prints_table_without_solving(tmp_path):
+    out = tmp_path / "plan.json"
+    r = _run_cli(["gen:poisson2d:16", "--explain", "--plan", str(out),
+                  "--nparts", "8", "--max-iterations", "50", "-q"])
+    assert r.returncode == 0, r.stderr
+    assert "ranked" in r.stderr or "plan" in r.stderr
+    doc = json.loads(out.read_text())
+    assert planner.validate_plan(doc) == []
+    assert doc["uncalibrated"] is True
+    # --explain --plan never dispatches a solve
+    assert "converged" not in r.stdout
+
+
+def test_autotune_refusal_matrix():
+    r = _run_cli(["gen:poisson2d:16", "--autotune", "--explain"])
+    assert r.returncode != 0
+    assert "--explain --plan" in r.stderr
+    r = _run_cli(["gen:poisson2d:16", "--autotune", "--kernels",
+                  "fused"])
+    assert r.returncode != 0
+    r = _run_cli(["gen:poisson2d:16", "--plan", "p.json"])
+    assert r.returncode != 0
+
+
+def test_explain_calibration_mismatch_warns_structured(tmp_path):
+    """--explain --calibration with a doc recorded on a DIFFERENT mesh
+    warns with a structured calibration-mismatch event (stderr line +
+    stats events) instead of silently pricing with the wrong fit."""
+    cal = _cal(nparts=4)  # recorded on 4 parts, priced on 8
+    p = tmp_path / "cal.json"
+    p.write_text(json.dumps(cal))
+    sj = tmp_path / "explain.jsonl"
+    r = _run_cli(["gen:poisson2d:16", "--explain", "--calibration",
+                  str(p), "--nparts", "8", "--dtype", "f32",
+                  "--max-iterations", "20", "--warmup", "0",
+                  "--stats-json", str(sj), "-q"])
+    assert r.returncode == 0, r.stderr
+    assert "WARNING" in r.stderr and "4 parts" in r.stderr
+    docs = [json.loads(ln) for ln in sj.read_text().splitlines()
+            if ln.strip()]
+    kinds = [e.get("kind") for d in docs
+             for e in d["stats"].get("events", [])]
+    assert "calibration-mismatch" in kinds, kinds
+    # a MATCHING calibration never fires the event
+    cal8 = _cal(nparts=8)
+    p8 = tmp_path / "cal8.json"
+    p8.write_text(json.dumps(cal8))
+    r2 = _run_cli(["gen:poisson2d:16", "--explain", "--calibration",
+                   str(p8), "--nparts", "8", "--dtype", "f32",
+                   "--max-iterations", "20", "--warmup", "0", "-q"])
+    assert r2.returncode == 0, r2.stderr
+    assert "calibration-mismatch" not in r2.stderr
+
+
+# -- old-document tolerance ----------------------------------------------
+
+def test_old_ledger_docs_render_without_plan_column(tmp_path):
+    """A pre-/12 ledger row (no stats.plan key) renders with a '-'
+    plan column and never trips the misprediction gate (the additive
+    schema-bump contract)."""
+    d = tmp_path / "hist"
+    d.mkdir()
+    row = {"ledger": "acg-tpu-history/1", "unix_time": 1e9,
+           "case": "legacy", "latency_s": 0.1, "iterations": 9,
+           "doc": {"schema": "acg-tpu-stats/11",
+                   "manifest": {"metric": "legacy"},
+                   "stats": {"tsolve": 0.1, "niterations": 9}}}
+    (d / "2001-09-09.jsonl").write_text(json.dumps(row) + "\n")
+    r = _run_script("history_report.py",
+                    [str(d), "--fail-on-misprediction", "1e-9"])
+    assert r.returncode == 0, r.stderr + r.stdout
+    assert "plan -" in r.stdout
+
+
+def test_old_stats_doc_loads_additively():
+    """stats.plan is strictly additive: a /11 document without it
+    still round-trips through the observatory index path."""
+    from acg_tpu import observatory
+    doc = {"schema": "acg-tpu-stats/11",
+           "manifest": {"metric": "m", "matrix": "m", "solver": "acg"},
+           "stats": {"tsolve": 0.5, "niterations": 7,
+                     "converged": True}}
+    idx = observatory._index_of(doc)
+    assert idx["iterations"] == 7
+    # and a fresh Stats carries an EMPTY plan section that serializes
+    # to {} (absent from fwrite output until a planner stamps it)
+    from acg_tpu.solvers.stats import SolverStats
+    st = SolverStats()
+    assert st.to_dict()["plan"] == {}

@@ -113,6 +113,7 @@ def test_serve_validate_refusal_matrix():
             ({"block_cg": True}, "--nrhs"),
             ({"fault_inject": "spmv:nan@3"}, "--fault-inject"),
             ({"manufactured_solution": True}, "--manufactured"),
+            ({"plan": "p.json"}, "--plan"),
             ({"A": "matrix.mtx"}, "gen:")]:
         with pytest.raises(SystemExit, match=frag):
             _serve_validate(_serve_args(**kw))
@@ -508,3 +509,70 @@ def test_crash_relaunch_warm_cache_live(tmp_path):
     assert "crash-relaunched" in verdicts
     assert "WRONG-ANSWER" not in verdicts
     assert "HANG" not in verdicts
+
+
+# -- decision observatory (--serve --autotune) ----------------------------
+
+def _serve_cal(**over):
+    from acg_tpu import commbench as cb
+    doc = {"schema": cb.COMMBENCH_SCHEMA, "backend": "cpu", "nparts": 8,
+           "collectives": {
+               "all_reduce": {"alpha_s": 1e-5, "beta_s_per_byte": 1e-10,
+                              "npoints": 3, "r2": None},
+               "all_to_all": {"alpha_s": 2e-5,
+                              "beta_s_per_byte": 1e-9,
+                              "npoints": 3, "r2": None}}}
+    doc.update(over)
+    doc["calibration_id"] = cb.calibration_id(doc)
+    return doc
+
+
+def test_serve_autotune_plans_and_stamps_provenance():
+    """--serve --autotune: the daemon plans on operator-cache miss,
+    stamps every response with plan id + decision provenance, surfaces
+    the cached decisions under /status plans:, and replans when the
+    calibration id changes (the serve satellite of ISSUE 17)."""
+    cal = _serve_cal()
+    with _daemon(autotune=True, calibration=cal) as d:
+        s1, b1 = d.submit(_doc(b_seed=1))
+        assert s1 == 200 and b1["ok"]
+        assert b1["plan"]["source"] == "planned", b1["plan"]
+        assert str(b1["plan"]["id"]).startswith("plan-"), b1["plan"]
+        doc = d.status_doc()
+        plans = doc["plans"]
+        assert plans["autotune"] is True
+        assert plans["calibration"] == cal["calibration_id"]
+        assert plans["decisions"] and \
+            plans["decisions"][0]["plan_id"] == b1["plan"]["id"]
+        assert plans["last_misprediction_ratio"] > 0
+        # an explicit per-request algorithm overrides the plan: the
+        # provenance says so instead of silently re-labelling
+        s2, b2 = d.submit(_doc(b_seed=2, algorithm="classic"))
+        assert s2 == 200 and b2["ok"]
+        assert b2["plan"]["source"] == "flag-forced", b2["plan"]
+        # calibration swap -> the next planned request replans
+        cal2 = _serve_cal(nparts=8, backend="cpu",
+                          note="recalibrated")
+        assert cal2["calibration_id"] != cal["calibration_id"]
+        d.set_calibration(cal2)
+        s3, b3 = d.submit(_doc(b_seed=3))
+        assert s3 == 200 and b3["ok"]
+        assert b3["plan"]["source"] == "planned", b3["plan"]
+        doc2 = d.status_doc()
+        assert doc2["plans"]["calibration"] == cal2["calibration_id"]
+        assert all(dec["calibration"] == cal2["calibration_id"]
+                   for dec in doc2["plans"]["decisions"])
+
+
+def test_serve_without_autotune_has_no_plan_section():
+    """Disarmed (no --autotune) the daemon neither plans nor stamps:
+    responses carry no plan id and the decision is flag-forced --
+    byte-compatible with the PR 16 response contract plus the one
+    additive plan field."""
+    with _daemon() as d:
+        s, b = d.submit(_doc(b_seed=4))
+        assert s == 200 and b["ok"]
+        assert b["plan"]["id"] is None
+        assert b["plan"]["source"] == "flag-forced"
+        assert d.status_doc()["plans"]["autotune"] is False
+        assert d.status_doc()["plans"]["decisions"] == []

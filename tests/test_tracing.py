@@ -429,7 +429,7 @@ def test_cli_trace_capture_and_analysis(tmp_path):
     assert tracing.find_capture(cap)["trace_json"]
     assert "tracing:" in r.stderr
     doc = json.loads(stats.read_text())
-    assert doc["schema"] == "acg-tpu-stats/11"
+    assert doc["schema"] == "acg-tpu-stats/12"
     tr = doc["stats"]["tracing"]
     assert tr["available"] is True
     assert tr["capture_files"] >= 1
@@ -536,7 +536,7 @@ def test_cli_buildinfo_advertises_tracing():
     r = run_cli("acg_tpu.cli", ["--buildinfo"])
     assert r.returncode == 0
     for token in ("timeline tracing", "--timeline", "acg_trace_",
-                  "acg-tpu-stats/11"):
+                  "acg-tpu-stats/12"):
         assert token in r.stdout, token
 
 
