@@ -432,6 +432,18 @@ def make_parser() -> argparse.ArgumentParser:
                         "fields (crash / slow:S / device fault specs) "
                         "-- the chaos campaign's hook; NEVER arm on a "
                         "production service")
+    p.add_argument("--access-log", metavar="FILE", default=None,
+                   help="with --serve: append one acg-tpu-access/1 "
+                        "JSONL row per request (atomic line writes): "
+                        "request_id, outcome, per-stage seconds "
+                        "(admit/queue-wait/coalesce/cache/compile/"
+                        "solve/demux/respond), cache + coalesce + "
+                        "degrade + plan provenance, batch id/width "
+                        "with per-RHS solve attribution.  "
+                        "scripts/access_report.py renders the per-"
+                        "stage p50/p95/p99 table and tail "
+                        "decomposition; scripts/check_access_log.py "
+                        "validates the ledger")
     p.add_argument("--chaos", metavar="SEED[:N]", default=None,
                    help="chaos campaign (acg_tpu.supervisor): generate "
                         "N (default 20) seeded randomized fault "
@@ -757,7 +769,12 @@ def make_parser() -> argparse.ArgumentParser:
                         "chunk boundaries and telemetry events; "
                         "multi-controller runs gather spans over the "
                         "erragree KV plumbing with barrier-timestamp "
-                        "clock alignment")
+                        "clock alignment.  With --serve this is the "
+                        "SERVICE timeline instead: the daemon records "
+                        "for its whole lifetime -- one worker row of "
+                        "batch solve spans plus one lane per "
+                        "in-flight request window -- and exports at "
+                        "shutdown")
     p.add_argument("-q", "--quiet", action="store_true",
                    help="do not write the solution vector to stdout")
     p.add_argument("-o", "--output", metavar="FILE", default=None,
@@ -996,6 +1013,20 @@ def _buildinfo(out) -> int:
          "verification, exit 96 on wrong-answer-green), "
          "--serve-faults (honour per-request fault fields -- chaos "
          "hook only); acg_serve_* metric families"),
+        ("request observatory", "--serve request-scoped observability "
+         "(acg_tpu.reqtrace): every request carries a request_id "
+         "(client-supplied request_id/traceparent or generated), "
+         "echoed in responses, structured events and chaos "
+         "verification rows; --access-log FILE (append-only "
+         "acg-tpu-access/1 JSONL -- one row per request with outcome, "
+         "per-stage seconds and batch/cache/degrade/plan provenance; "
+         "scripts/access_report.py p50/p95/p99 + tail decomposition, "
+         "scripts/check_access_log.py validator), --serve --timeline "
+         "FILE (the service timeline: worker batch row + one lane "
+         "per in-flight request), GET /requests (last-K completed + "
+         "in-flight request documents), status-doc requests: block, "
+         "acg_serve_stage_seconds{stage} / acg_serve_inflight / "
+         "acg_serve_queue_depth_high_water"),
     ]
     for k, v in rows:
         out.write(f"{k}: {v}\n")

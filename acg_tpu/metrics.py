@@ -541,6 +541,18 @@ SERVE_WARM_RESTORES = REGISTRY.counter(
 SERVE_QUEUE_DEPTH = REGISTRY.gauge(
     "acg_serve_queue_depth", "Requests currently queued in the "
     "solver service.")
+SERVE_QUEUE_HIGH_WATER = REGISTRY.gauge(
+    "acg_serve_queue_depth_high_water", "High-water mark of the serve "
+    "request queue (worst backlog observed this process).")
+SERVE_INFLIGHT = REGISTRY.gauge(
+    "acg_serve_inflight", "Requests currently in flight in the solver "
+    "service (admitted, not yet answered).")
+SERVE_STAGE_SECONDS = REGISTRY.histogram(
+    "acg_serve_stage_seconds", "Per-request stage seconds in the "
+    "solver service (admit/queue-wait/coalesce/cache/compile/solve/"
+    "demux/respond) -- the request observatory's tail-latency "
+    "attribution.", labelnames=("stage",),
+    buckets=PHASE_SECONDS_BUCKETS)
 # ABFT checksum-protected SpMV (acg_tpu.health, --abft)
 ABFT_CHECKS = REGISTRY.counter(
     "acg_abft_checks_total", "In-loop Huang-Abraham checksum "
@@ -783,9 +795,29 @@ def record_serve_warm_restore(nentries: int) -> None:
         SERVE_WARM_RESTORES.inc(max(int(nentries), 0))
 
 
+_serve_queue_high_water = 0
+
+
 def record_serve_queue_depth(depth: int) -> None:
+    global _serve_queue_high_water
     if _armed:
-        SERVE_QUEUE_DEPTH.set(max(int(depth), 0))
+        d = max(int(depth), 0)
+        SERVE_QUEUE_DEPTH.set(d)
+        if d > _serve_queue_high_water:
+            _serve_queue_high_water = d
+            SERVE_QUEUE_HIGH_WATER.set(d)
+
+
+def record_serve_inflight(n: int) -> None:
+    if _armed:
+        SERVE_INFLIGHT.set(max(int(n), 0))
+
+
+def record_serve_stage(stage: str, seconds: float) -> None:
+    """One per-request stage observation (acg_tpu.reqtrace)."""
+    if _armed:
+        SERVE_STAGE_SECONDS.labels(stage=str(stage)).observe(
+            max(float(seconds), 0.0))
 
 
 def record_abft(nchecks: int, rel_last, ntrips: int) -> None:

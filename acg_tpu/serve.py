@@ -68,6 +68,7 @@ import time
 
 import numpy as np
 
+from acg_tpu import reqtrace
 from acg_tpu.errors import (AcgError, BreakdownError, ExitCode,
                             NotConvergedError)
 
@@ -98,7 +99,9 @@ class ServeConfig:
                  preload: str | None = None, nparts: int = 0,
                  comm: str = "xla", dtype: str = "f64",
                  allow_faults: bool = False, autotune: bool = False,
-                 calibration: dict | None = None):
+                 calibration: dict | None = None,
+                 access_log: str | None = None,
+                 request_ring: int = 64):
         self.port = int(port)
         self.queue_depth = int(queue_depth)
         self.coalesce = int(coalesce)
@@ -120,6 +123,11 @@ class ServeConfig:
         # cache miss against this calibration, replan when it changes
         self.autotune = bool(autotune)
         self.calibration = calibration
+        # request observatory (--access-log): the append-only
+        # acg-tpu-access/1 ledger path, and the size of the completed-
+        # request ring GET /requests serves
+        self.access_log = access_log
+        self.request_ring = int(request_ring)
 
 
 class RequestRefused(Exception):
@@ -135,6 +143,11 @@ class RequestRefused(Exception):
 class _Request:
     _next_id = [0]
     _id_lock = threading.Lock()
+    # request observatory: the stable client-facing identity and the
+    # RequestRecord tracking it -- attached by submit() right after
+    # construction (class defaults keep direct constructions safe)
+    request_id: str | None = None
+    trace: "reqtrace.RequestRecord | None" = None
 
     def __init__(self, doc: dict, cfg: ServeConfig):
         with self._id_lock:
@@ -224,16 +237,24 @@ class _Request:
     def finish(self, status: int, body: dict) -> None:
         self.status = int(status)
         self.response = body
+        # the respond stage starts here: the submit waiter measures
+        # its wakeup against this stamp
+        self._finished_at = time.monotonic()
         self.event.set()
 
 
 def _error_body(kind: str, message: str, req: "_Request | None" = None,
-                retryable: bool = False) -> dict:
+                retryable: bool = False,
+                request_id: str | None = None) -> dict:
     body = {"schema": SCHEMA, "ok": False,
             "error": {"type": kind, "message": message,
                       "retryable": bool(retryable)}}
     if req is not None:
         body["id"] = req.id
+    rid = (getattr(req, "request_id", None) if req is not None
+           else None) or request_id
+    if rid:
+        body["request_id"] = rid
     return body
 
 
@@ -389,6 +410,13 @@ class ServeDaemon:
         # decision observatory: the last planned solve's predicted /
         # measured ratio (surfaced in /status)
         self.last_misprediction: float | None = None
+        # request observatory: per-request records, the completed ring
+        # (GET /requests) and the acg-tpu-access/1 ledger
+        self.reqlog = reqtrace.RequestLog(config.access_log,
+                                          ring=config.request_ring)
+        # batch ids link coalesced members to their shared solve span
+        # (single worker thread owns the counter)
+        self._batch_seq = 0
 
     # -- state persistence (the self-healing warm restore) ----------------
 
@@ -607,6 +635,7 @@ class ServeDaemon:
                 "shed-shutdown", "the service is shutting down",
                 status=503)
         burn = self._burn()
+        req._admit_burn = burn
         if burn >= self.cfg.shed_burn:
             metrics.record_serve_shed("slo-burn")
             raise RequestRefused(
@@ -626,26 +655,56 @@ class ServeDaemon:
         """The in-process request path (the HTTP handler's core, also
         the test hook): validate, admit, wait for the worker, return
         ``(http_status, response_dict)`` -- ALWAYS within the
-        request's deadline plus a small grace."""
+        request's deadline plus a small grace.  Every path through
+        here -- green, shed, invalid, expired -- opens and seals one
+        request-observatory record, so the access ledger carries
+        exactly one row per request."""
         from acg_tpu import metrics
+        rid = reqtrace.request_id_from_doc(doc)
+        rec = self.reqlog.begin(
+            rid, matrix=((doc.get("matrix") if isinstance(doc, dict)
+                          else None) or self.cfg.preload))
+        t_admit0 = time.monotonic()
         try:
             req = _Request(doc, self.cfg)
         except RequestRefused as e:
             metrics.record_serve_request("invalid")
-            return e.status, _error_body(e.kind, str(e))
+            rec.stage("admit", time.monotonic() - t_admit0,
+                      decision=e.kind)
+            self.reqlog.complete(rec, "invalid-request")
+            return e.status, _error_body(e.kind, str(e),
+                                         request_id=rid)
+        req.request_id = rid
+        req.trace = rec
+        rec.id = req.id
+        rec.matrix = str(req.matrix)
         try:
             self.admit(req)
         except RequestRefused as e:
             metrics.record_serve_request("shed")
+            rec.stage("admit", time.monotonic() - t_admit0,
+                      burn=getattr(req, "_admit_burn", None),
+                      decision=e.kind)
+            self.reqlog.complete(
+                rec, e.kind if e.kind.startswith("shed-")
+                else "request-failed")
             return e.status, _error_body(e.kind, str(e), req,
                                          retryable=True)
+        rec.stage("admit", time.monotonic() - t_admit0,
+                  burn=getattr(req, "_admit_burn", None),
+                  decision="admitted")
         if not req.event.wait(req.timeout + 1.0):
             metrics.record_serve_shed("deadline")
             metrics.record_serve_request("expired")
+            self.reqlog.complete(rec, "deadline-expired")
             return 504, _error_body(
                 "deadline-expired",
                 f"request {req.id} was not answered within its "
                 f"{req.timeout:g}s deadline", req, retryable=True)
+        t_fin = getattr(req, "_finished_at", None)
+        if t_fin is not None:
+            rec.stage("respond", time.monotonic() - t_fin)
+        self.reqlog.complete(rec, reqtrace.outcome_of(req.response))
         return req.status, req.response
 
     # -- the worker --------------------------------------------------------
@@ -690,11 +749,14 @@ class ServeDaemon:
         """Serve one coalesced batch (len >= 1) end to end: cache
         lookups, the solve, demux, per-request responses.  All
         failure paths answer every member with a TYPED error."""
-        from acg_tpu import faults, metrics, observatory
+        from acg_tpu import faults, metrics, observatory, tracing
         from acg_tpu.solvers import StoppingCriteria
         lead = batch[0]
         nrhs = len(batch)
         degraded = False
+        self._batch_seq += 1
+        bid = self._batch_seq
+        member_ids = [getattr(r, "request_id", None) for r in batch]
         try:
             if lead.fault:
                 self._serve_fault(lead)
@@ -705,11 +767,14 @@ class ServeDaemon:
                 metrics.record_serve_degraded()
                 observatory.note_event(
                     "serve-degraded",
-                    f"request {lead.id} downgraded to the classic "
+                    f"request {lead.id} [{lead.request_id}] "
+                    f"downgraded to the classic "
                     f"unpreconditioned profile (SLO burn "
                     f"{self._burn():.2f})")
+            t_cache0 = time.perf_counter()
             op, op_hit = self._ingest_operator(
                 lead.operator_key(self.cfg))
+            ingest_dt = time.perf_counter() - t_cache0
             # decision observatory: resolve this batch's program
             # provenance.  degraded beats everything (the shed ladder
             # already stripped algorithm/precond); an explicit request
@@ -749,8 +814,8 @@ class ServeDaemon:
                                     residual_rtol=lead.rtol,
                                     residual_atol=lead.atol)
             t0 = time.perf_counter()
-            x, solver, prog_hit = self._solve_with_retries(
-                lead, op, nrhs, b, crit)
+            x, solver, prog_hit, prog_dt, ninval = \
+                self._solve_with_retries(lead, op, nrhs, b, crit)
             latency = time.perf_counter() - t0
             st = solver.stats
             observatory.slo_observe(st, latency=latency,
@@ -763,24 +828,66 @@ class ServeDaemon:
                     / latency
                 self.last_misprediction = ratio
                 metrics.record_plan_misprediction(ratio)
+            # tail-latency attribution: the program build (billed to
+            # the cache stage with the operator ingest) and the compile
+            # a cache-miss solve absorbed in warmup are carved out of
+            # the measured latency; what remains is PURE solve, split
+            # per RHS so member attributions sum to the batch solve
+            # time -- and stage sums never exceed the request wall
+            compile_s = min(max(float((st.timings or {}).get(
+                "compile", 0.0) or 0.0), 0.0), latency)
+            solve_s = max(latency - compile_s
+                          - min(max(prog_dt, 0.0), latency), 0.0)
+            rhs_share = solve_s / nrhs
+            # ONE batch-scoped solve span linked to every member id --
+            # the coalesced batch's row on the service timeline
+            t_wall = time.time()
+            tracing.record_span(
+                f"solve-batch-{bid}", t_wall - latency, t_wall,
+                cat="worker", batch=bid, nrhs=nrhs,
+                requests=[m for m in member_ids if m])
+            prog_state = ("invalidated" if ninval
+                          else ("hit" if prog_hit else "miss"))
+            cache_body = {"operator": "hit" if op_hit else "miss",
+                          "program": "hit" if prog_hit else "miss"}
+            batch_block = {"id": bid, "width": nrhs,
+                           "members": [m for m in member_ids if m],
+                           "solve_seconds": round(solve_s, 6),
+                           "rhs_solve_seconds": round(rhs_share, 6)}
             X = np.asarray(x)
             for j, r in enumerate(batch):
+                t_demux0 = time.perf_counter()
                 xj = X[:, j] if nrhs > 1 else X
                 iters = (int(st.batch["iterations"][j])
                          if nrhs > 1 and st.batch else
                          int(st.niterations))
                 body = {"schema": SCHEMA, "ok": True, "id": r.id,
+                        "request_id": r.request_id,
                         "converged": bool(st.converged),
                         "iterations": iters,
                         "latency_seconds": round(latency, 6),
                         "coalesced": nrhs, "degraded": degraded,
                         "plan": dict(plan_body),
-                        "cache": {"operator":
-                                  "hit" if op_hit else "miss",
-                                  "program":
-                                  "hit" if prog_hit else "miss"}}
+                        "cache": dict(cache_body)}
                 if r.want_x:
                     body["x"] = xj.tolist()
+                rec = getattr(r, "trace", None)
+                if rec is not None:
+                    rec.stage("cache", ingest_dt + prog_dt,
+                              operator=cache_body["operator"],
+                              program=prog_state)
+                    if compile_s > 0:
+                        rec.stage("compile", compile_s)
+                    rec.stage("solve", rhs_share, batch=bid)
+                    rec.note("cache", {"operator":
+                                       cache_body["operator"],
+                                       "program": prog_state})
+                    rec.note("coalesced", nrhs)
+                    rec.note("degraded", bool(degraded))
+                    rec.note("plan", dict(plan_body))
+                    rec.note("batch", dict(batch_block))
+                    rec.stage("demux",
+                              time.perf_counter() - t_demux0)
                 r.finish(200, body)
                 metrics.record_plan_decision(plan_source)
                 metrics.record_serve_request("ok")
@@ -795,9 +902,11 @@ class ServeDaemon:
             kind = type(e).__name__
             observatory.note_event(
                 "request-failed",
-                f"request {lead.id} ({lead.matrix}): {kind}: {e}")
+                f"request {lead.id} [{lead.request_id}] "
+                f"({lead.matrix}): {kind}: {e}")
             sys.stderr.write(f"acg-tpu: serve: request {lead.id} "
-                             f"failed: {kind}: {e}\n")
+                             f"[{lead.request_id}] failed: "
+                             f"{kind}: {e}\n")
             for r in batch:
                 r.finish(500, _error_body(
                     kind, str(e), r,
@@ -814,12 +923,19 @@ class ServeDaemon:
         breakdown that escapes the solver's own recovery ladder
         invalidates the (possibly poisoned) program-cache entry,
         backs off, and retries with a freshly built program; the
-        LAST failure propagates to the typed-error boundary."""
-        from acg_tpu import faults
+        LAST failure propagates to the typed-error boundary.
+        Returns ``(x, solver, prog_hit, program_lookup_seconds,
+        ninvalidated)`` -- the lookup time feeds the cache stage, the
+        invalidation count the ledger's program provenance."""
+        from acg_tpu import faults, observatory
         attempt = 0
+        prog_dt = 0.0
+        ninval = 0
         while True:
             op_entry = op
+            t_p0 = time.perf_counter()
             solver, prog_hit = self._program_for(lead, op_entry, nrhs)
+            prog_dt += time.perf_counter() - t_p0
             # a cache-miss solve absorbs (and counts) its compile in
             # warmup; a cache-hit solve must NOT pay or count one
             warmup = 0 if prog_hit else 1
@@ -832,17 +948,32 @@ class ServeDaemon:
                                          warmup=warmup)
                 else:
                     x = solver.solve(b, criteria=crit, warmup=warmup)
-                return x, solver, prog_hit
+                return x, solver, prog_hit, prog_dt, ninval
             except NotConvergedError:
                 # ran to maxits healthy -- a retry re-runs the same
                 # trajectory; answer typed instead
                 raise
-            except (BreakdownError, FloatingPointError, AcgError):
+            except (BreakdownError, FloatingPointError,
+                    AcgError) as e:
                 self.programs.invalidate(
                     lead.program_key(self.cfg, nrhs))
+                ninval += 1
+                # a poisoned request traces END TO END: the
+                # invalidation event and the retry line both carry
+                # the stable request identity
+                observatory.note_event(
+                    "serve-program-invalidated",
+                    f"request {lead.id} [{lead.request_id}]: program "
+                    f"cache entry for {lead.matrix} invalidated "
+                    f"after {type(e).__name__}")
                 if attempt >= self.cfg.retries:
                     raise
                 attempt += 1
+                sys.stderr.write(
+                    f"acg-tpu: serve: request {lead.id} "
+                    f"[{lead.request_id}] retry "
+                    f"{attempt}/{self.cfg.retries} after "
+                    f"{type(e).__name__}\n")
                 time.sleep(self.cfg.retry_backoff * (2 ** (attempt - 1)))
                 # the fault modelled a transient -- drop it on retry
                 lead.fault = None
@@ -853,9 +984,13 @@ class ServeDaemon:
             req = self.queue.pop(timeout=0.1)
             if req is None:
                 continue
+            t_pop = time.monotonic()
+            rec = getattr(req, "trace", None)
             if req.expired():
                 metrics.record_serve_shed("deadline")
                 metrics.record_serve_request("expired")
+                if rec is not None:
+                    rec.stage("queue-wait", t_pop - req.enqueued)
                 req.finish(504, _error_body(
                     "deadline-expired",
                     f"request {req.id} expired in queue", req,
@@ -873,6 +1008,19 @@ class ServeDaemon:
                         batch.extend(more)
                     else:
                         time.sleep(0.005)
+            # per-request attribution: the lead paid queue-wait until
+            # its pop and the coalesce window after it; a follower's
+            # whole wait (including the window that scooped it up) is
+            # queue residency
+            t_batch = time.monotonic()
+            if rec is not None:
+                rec.stage("queue-wait", t_pop - req.enqueued)
+                rec.stage("coalesce", t_batch - t_pop,
+                          followers=len(batch) - 1)
+            for r in batch[1:]:
+                fr = getattr(r, "trace", None)
+                if fr is not None:
+                    fr.stage("queue-wait", t_batch - r.enqueued)
             self._solve_batch(batch)
         # shutdown: answer the stragglers, never strand a waiter
         for r in self.queue.drain_all():
@@ -902,6 +1050,9 @@ class ServeDaemon:
                "program_cache": {"entries": len(self.programs)},
                "slo_burn": round(self._burn(), 4),
                "nparts": self.cfg.nparts}
+        # request observatory: in-flight / completed tallies and the
+        # outcome histogram (GET /requests serves the documents)
+        doc["requests"] = self.reqlog.summary()
         # decision observatory: what the daemon would dispatch and how
         # honest the last planned prediction was
         cached = []
@@ -960,6 +1111,8 @@ class ServeDaemon:
                 path = self.path.split("?")[0]
                 if path in ("/status", "/"):
                     self._reply(200, daemon.status_doc())
+                elif path == "/requests":
+                    self._reply(200, daemon.reqlog.snapshot())
                 elif path == "/healthz":
                     self._reply(200 if daemon.accepting else 503,
                                 {"ok": daemon.accepting})
@@ -1028,6 +1181,7 @@ class ServeDaemon:
             self._server.shutdown()
             self._server.server_close()
         self._save_state()
+        self.reqlog.close()
 
 
 # -- CLI entry -------------------------------------------------------------
@@ -1101,7 +1255,8 @@ def config_from_args(args) -> ServeConfig:
         dtype="f64" if args.dtype == "f64" else "f32",
         allow_faults=bool(getattr(args, "serve_faults", False)),
         autotune=bool(getattr(args, "autotune", False)),
-        calibration=cal)
+        calibration=cal,
+        access_log=getattr(args, "access_log", None))
 
 
 def run_serve(args, argv: list) -> int:
@@ -1127,6 +1282,14 @@ def run_serve(args, argv: list) -> int:
     from acg_tpu import metrics, observatory
     if args.slo:
         observatory.install_slo(observatory.parse_slo(args.slo))
+    # --serve --timeline FILE = the SERVICE timeline: the daemon owns
+    # the span recorder for its lifetime (serve dispatches before
+    # _main's per-solve arm/export), one worker row plus one lane per
+    # in-flight request window, exported at shutdown
+    timeline = getattr(args, "timeline", None)
+    if timeline:
+        from acg_tpu import tracing
+        tracing.arm()
     daemon = ServeDaemon(config_from_args(args))
     daemon.start()
     if args.metrics_port:
@@ -1155,6 +1318,20 @@ def run_serve(args, argv: list) -> int:
     sys.stderr.write(f"acg-tpu: serve: served "
                      f"{daemon.requests_served} request(s), "
                      f"{daemon.requests_failed} failed -- bye\n")
+    if timeline:
+        from acg_tpu import tracing
+        try:
+            summary = tracing.export_chrome_trace(
+                timeline, [tracing.local_payload()],
+                nparts=max(int(args.nparts or 0), 1))
+            sys.stderr.write(
+                f"acg-tpu: --timeline {timeline}: service timeline, "
+                f"{summary['nspans']} span(s)\n")
+        except OSError as e:
+            sys.stderr.write(f"acg-tpu: --timeline {timeline}: "
+                             f"{e}\n")
+        finally:
+            tracing.disarm()
     if args.metrics_file:
         try:
             metrics.write_textfile(args.metrics_file)
@@ -1265,9 +1442,10 @@ def run_chaos_serve(args, argv: list) -> int:
             doc = {"matrix": args.A, "b_seed": int(rng.integers(1 << 30)),
                    "rtol": float(args.residual_rtol or 1e-8),
                    "maxits": int(args.max_iterations),
-                   "timeout": 120.0, **sched}
-            verdict, rel = _chaos_request(base, doc, csr,
-                                          verify_solution_dense)
+                   "timeout": 120.0,
+                   "request_id": f"chaos-{seed}-{i}", **sched}
+            verdict, rel, rid = _chaos_request(base, doc, csr,
+                                               verify_solution_dense)
             if verdict == "crash-relaunched":
                 if not _wait_serving(base, 120.0):
                     verdict = "HANG"
@@ -1283,6 +1461,7 @@ def run_chaos_serve(args, argv: list) -> int:
                         "chaos": {"schedule": i, "seed": seed,
                                   "fault": sched.get("fault"),
                                   "verdict": verdict,
+                                  "request_id": rid,
                                   "true_rel_residual": rel},
                         "manifest": {"matrix": str(args.A),
                                      "nparts": int(args.nparts or 0),
@@ -1292,9 +1471,10 @@ def run_chaos_serve(args, argv: list) -> int:
         # the daemon must END the campaign serving a correct answer
         doc = {"matrix": args.A, "b_seed": 12345,
                "rtol": float(args.residual_rtol or 1e-8),
-               "maxits": int(args.max_iterations), "timeout": 120.0}
-        final, frel = _chaos_request(base, doc, csr,
-                                     verify_solution_dense)
+               "maxits": int(args.max_iterations), "timeout": 120.0,
+               "request_id": f"chaos-{seed}-final"}
+        final, frel, _frid = _chaos_request(base, doc, csr,
+                                            verify_solution_dense)
         sys.stderr.write(
             "chaos-serve:\n"
             f"  schedules: {nsched} (seed {seed})\n"
@@ -1331,7 +1511,10 @@ def _wait_serving(base: str, timeout: float) -> bool:
 
 
 def _chaos_request(base: str, doc: dict, csr, verify) -> tuple:
-    """Fire one campaign request; classify the outcome.  Green
+    """Fire one campaign request; classify the outcome as ``(verdict,
+    rel_residual, request_id)`` -- the echoed request identity lands in
+    the verification ledger rows, so a campaign verdict joins against
+    the daemon's own access ledger and structured events.  Green
     responses are verified INDEPENDENTLY against the host oracle --
     a green-but-wrong x is the campaign's one unforgivable verdict."""
     b = np.random.default_rng(int(doc["b_seed"])).standard_normal(
@@ -1341,11 +1524,14 @@ def _chaos_request(base: str, doc: dict, csr, verify) -> tuple:
                                   timeout=float(doc["timeout"]) + 30.0)
     except OSError:
         # connection died under us -- the crash-mid-request class
-        return "crash-relaunched", None
+        # (the sent id still identifies the request in daemon logs)
+        return "crash-relaunched", None, doc.get("request_id")
+    rid = (body.get("request_id") if isinstance(body, dict)
+           else None) or doc.get("request_id")
     if status == 200 and body.get("ok"):
         x = np.asarray(body.get("x", []), dtype=np.float64)
         ok, rel = verify(csr, b, x, doc["rtol"])
-        return ("verified" if ok else "WRONG-ANSWER"), rel
+        return ("verified" if ok else "WRONG-ANSWER"), rel, rid
     if isinstance(body, dict) and body.get("error", {}).get("type"):
-        return "typed-error", None
-    return "HANG", None
+        return "typed-error", None, rid
+    return "HANG", None, rid

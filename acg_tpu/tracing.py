@@ -73,8 +73,14 @@ from acg_tpu.telemetry import STRAGGLER_RATIO  # noqa: E402
 # chunk spans never pretend to nest inside the solve phase bracket and
 # instants get their own track)
 _TID_PHASES, _TID_CHUNKS, _TID_EVENTS = 1, 2, 3
+# the solver service's request observatory: the worker's batch spans
+# ride one row, and each in-flight request window rides its own lane
+# (tid = _TID_REQUEST_BASE + lane, lane assigned by reqtrace)
+_TID_WORKER = 4
+_TID_REQUEST_BASE = 10
 _CAT_TIDS = {"phase": _TID_PHASES, "chunk": _TID_CHUNKS,
-             "ckpt": _TID_CHUNKS, "event": _TID_EVENTS}
+             "ckpt": _TID_CHUNKS, "event": _TID_EVENTS,
+             "worker": _TID_WORKER, "request": _TID_REQUEST_BASE}
 
 # -- the span recorder ---------------------------------------------------
 
@@ -307,6 +313,10 @@ def export_chrome_trace(path, payloads: list[dict], nparts: int = 1,
     origin = min(all_t) if all_t else 0.0
 
     pids_seen: set[int] = set()
+    # service-timeline tracks discovered from the spans themselves
+    # (the worker row and one lane per concurrent request window) --
+    # named AFTER the walk, once we know which exist
+    extra_tracks: set[tuple[int, int, str]] = set()
     nspans_out = 0
     for p in payloads:
         rank = int(p.get("process", 0))
@@ -337,7 +347,17 @@ def export_chrome_trace(path, payloads: list[dict], nparts: int = 1,
             cat = s.get("cat", "phase")
             tid = (_TID_CHUNKS if s["name"] == "ckpt"
                    else _CAT_TIDS.get(cat, _TID_PHASES))
+            if cat == "request":
+                lane = (s.get("args") or {}).get("lane")
+                tid = _TID_REQUEST_BASE + (int(lane) if isinstance(
+                    lane, (int, float)) else 0)
             for pid in targets:
+                if cat == "worker":
+                    extra_tracks.add((pid, tid, "serve worker"))
+                elif cat == "request":
+                    extra_tracks.add(
+                        (pid, tid,
+                         f"request lane {tid - _TID_REQUEST_BASE}"))
                 ev = {"ph": "X", "pid": pid, "tid": tid,
                       "name": s["name"], "cat": cat,
                       "ts": (s["t0"] - origin) * 1e6,
@@ -356,6 +376,9 @@ def export_chrome_trace(path, payloads: list[dict], nparts: int = 1,
                 if i.get("detail"):
                     ev["args"] = {"detail": i["detail"]}
                 events.append(ev)
+    for pid, tid, tname in sorted(extra_tracks):
+        events.append({"ph": "M", "pid": pid, "tid": tid,
+                       "name": "thread_name", "args": {"name": tname}})
     # monotone ts per (pid, tid) track by construction of the writer,
     # not by luck of recording order (check_timeline.py validates it)
     events.sort(key=lambda e: (e.get("ph") != "M", e["pid"],

@@ -1,0 +1,219 @@
+#!/usr/bin/env python3
+"""Tail-latency attribution over ``--access-log`` request ledgers.
+
+Reads one or more ``acg-tpu-access/1`` JSONL files and answers the
+question the solver service's aggregate histograms cannot: when the
+p99 is bad, WHERE did those requests spend their time?  Stdlib only
+(the bare-pod-VM contract of the check_*/plot_* script family).
+
+Output:
+
+* the per-stage latency table -- count, p50/p95/p99 and the worst
+  observation for every stage plus the end-to-end wall;
+* outcome counts (ok / shed-* / deadline-expired / request-failed /
+  invalid-request);
+* the tail decomposition: the slowest 5% of requests by wall time,
+  attributed stage by stage next to the overall average -- a
+  queue-dominated tail (scale out / shed earlier) reads differently
+  from a solve- or compile-dominated one (cache churn, cold
+  programs);
+* ``--fail-on-p99 SECS``: exit 7 when the wall p99 exceeds the
+  budget -- the CI latency gate.
+
+Exit codes: 0 = report printed, 1 = no usable rows, 2 = unreadable
+file, 7 = p99 over the ``--fail-on-p99`` budget.
+
+Usage:
+    python scripts/access_report.py access.jsonl [more.jsonl ...] \
+        [--fail-on-p99 0.5] [--tail-fraction 0.05]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import math
+import sys
+
+SCHEMA_PREFIX = "acg-tpu-access"
+STAGES = ("admit", "queue-wait", "coalesce", "cache", "compile",
+          "solve", "demux", "respond")
+
+
+def load_rows(paths) -> list:
+    rows = []
+    for path in paths:
+        with open(path) as f:
+            for raw in f:
+                raw = raw.strip()
+                if not raw:
+                    continue
+                try:
+                    obj = json.loads(raw)
+                except ValueError:
+                    continue
+                if isinstance(obj, dict) and str(
+                        obj.get("schema", "")).startswith(SCHEMA_PREFIX):
+                    rows.append(obj)
+    return rows
+
+
+def percentile(values, q: float):
+    """Rank interpolation over a sorted copy (the estimator every
+    report in this repo uses for sample percentiles)."""
+    vals = sorted(v for v in values
+                  if isinstance(v, (int, float)) and math.isfinite(v))
+    if not vals:
+        return None
+    if len(vals) == 1:
+        return float(vals[0])
+    rank = q * (len(vals) - 1)
+    lo = int(math.floor(rank))
+    hi = min(lo + 1, len(vals) - 1)
+    frac = rank - lo
+    return float(vals[lo] * (1.0 - frac) + vals[hi] * frac)
+
+
+def _fmt_s(v) -> str:
+    if v is None:
+        return "-"
+    v = float(v)
+    return f"{v * 1e3:.3g} ms" if v < 1.0 else f"{v:.3g} s"
+
+
+def stage_table(rows) -> list:
+    """``[(name, count, p50, p95, p99, max), ...]`` -- stages in
+    service order, then the end-to-end wall."""
+    out = []
+    for name in STAGES:
+        vals = [r["stages"][name] for r in rows
+                if isinstance(r.get("stages"), dict)
+                and name in r["stages"]]
+        if not vals:
+            continue
+        out.append((name, len(vals), percentile(vals, 0.5),
+                    percentile(vals, 0.95), percentile(vals, 0.99),
+                    max(vals)))
+    walls = [r["wall_seconds"] for r in rows
+             if isinstance(r.get("wall_seconds"), (int, float))]
+    if walls:
+        out.append(("wall", len(walls), percentile(walls, 0.5),
+                    percentile(walls, 0.95), percentile(walls, 0.99),
+                    max(walls)))
+    return out
+
+
+def tail_decomposition(rows, fraction: float = 0.05) -> dict | None:
+    """Average per-stage share of wall time, overall vs the slowest
+    ``fraction`` of requests -- the queue-wait-vs-solve attribution
+    of the tail."""
+    timed = [r for r in rows
+             if isinstance(r.get("wall_seconds"), (int, float))
+             and r["wall_seconds"] > 0
+             and isinstance(r.get("stages"), dict)]
+    if not timed:
+        return None
+    timed.sort(key=lambda r: r["wall_seconds"])
+    ntail = max(int(len(timed) * fraction), 1)
+    tail = timed[-ntail:]
+
+    def shares(group):
+        acc = {name: 0.0 for name in STAGES}
+        other = 0.0
+        for r in group:
+            wall = float(r["wall_seconds"])
+            accounted = 0.0
+            for name in STAGES:
+                sec = r["stages"].get(name)
+                if isinstance(sec, (int, float)) and sec > 0:
+                    acc[name] += sec / wall
+                    accounted += sec
+            other += max(wall - accounted, 0.0) / wall
+        n = len(group)
+        out = {name: acc[name] / n for name in STAGES
+               if acc[name] > 0}
+        out["(unattributed)"] = other / n
+        return out
+
+    return {"ntail": ntail, "fraction": fraction,
+            "tail_wall_min": tail[0]["wall_seconds"],
+            "tail": shares(tail), "overall": shares(timed)}
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        description="per-stage latency percentiles and tail "
+                    "attribution from --access-log ledgers")
+    ap.add_argument("files", nargs="+", metavar="FILE",
+                    help="acg-tpu-access/1 JSONL file(s)")
+    ap.add_argument("--fail-on-p99", type=float, default=None,
+                    metavar="SECS",
+                    help="exit 7 when the wall p99 exceeds SECS "
+                         "(the CI latency gate)")
+    ap.add_argument("--tail-fraction", type=float, default=0.05,
+                    metavar="F",
+                    help="slowest fraction of requests to decompose "
+                         "(default: 0.05)")
+    args = ap.parse_args(argv)
+    try:
+        rows = load_rows(args.files)
+    except OSError as e:
+        print(f"access_report: {e}", file=sys.stderr)
+        return 2
+    if not rows:
+        print("access_report: no acg-tpu-access rows in "
+              f"{', '.join(args.files)}", file=sys.stderr)
+        return 1
+
+    outcomes = {}
+    for r in rows:
+        o = str(r.get("outcome"))
+        outcomes[o] = outcomes.get(o, 0) + 1
+    print(f"access_report: {len(rows)} request(s) from "
+          f"{len(args.files)} ledger(s)")
+    print("outcomes: "
+          + "  ".join(f"{k} {v}" for k, v in sorted(outcomes.items())))
+
+    print(f"{'stage':<12} {'count':>6} {'p50':>10} {'p95':>10} "
+          f"{'p99':>10} {'max':>10}")
+    wall_p99 = None
+    for name, count, p50, p95, p99, worst in stage_table(rows):
+        if name == "wall":
+            wall_p99 = p99
+        print(f"{name:<12} {count:>6} {_fmt_s(p50):>10} "
+              f"{_fmt_s(p95):>10} {_fmt_s(p99):>10} "
+              f"{_fmt_s(worst):>10}")
+
+    decomp = tail_decomposition(rows, args.tail_fraction)
+    if decomp:
+        print(f"tail decomposition (slowest {decomp['ntail']} "
+              f"request(s), wall >= "
+              f"{_fmt_s(decomp['tail_wall_min'])}):")
+        keys = [k for k in list(STAGES) + ["(unattributed)"]
+                if k in decomp["tail"] or k in decomp["overall"]]
+        for k in keys:
+            t = decomp["tail"].get(k, 0.0)
+            o = decomp["overall"].get(k, 0.0)
+            print(f"  {k:<16} tail {t * 100:5.1f}%   overall "
+                  f"{o * 100:5.1f}%")
+
+    if args.fail_on_p99 is not None and wall_p99 is not None \
+            and wall_p99 > args.fail_on_p99:
+        print(f"access_report: wall p99 {wall_p99:.6f} s exceeds the "
+              f"--fail-on-p99 budget {args.fail_on_p99:.6f} s",
+              file=sys.stderr)
+        return 7
+    return 0
+
+
+if __name__ == "__main__":
+    try:
+        sys.exit(main())
+    except BrokenPipeError:
+        # stdout consumer (head, grep -m) closed early -- the cli.py
+        # SIGPIPE recipe: point the fd at devnull so the interpreter's
+        # exit flush cannot print a traceback after a clean verdict
+        import os
+
+        os.dup2(os.open(os.devnull, os.O_WRONLY), sys.stdout.fileno())
+        sys.exit(0)

@@ -1,0 +1,214 @@
+#!/usr/bin/env python3
+"""Validate an ``--access-log`` request ledger (``acg-tpu-access/1``).
+
+The access ledger is the solver service's one-row-per-request record
+of where the latency went; this validator is its CI gate, in the
+``check_metrics_textfile.py`` / ``check_timeline.py`` family.  Checks,
+stdlib only:
+
+* every non-empty line parses as a JSON object carrying the
+  ``acg-tpu-access`` schema marker and a non-empty ``request_id``;
+* ``outcome`` is in the closed enum (``ok``, ``deadline-expired``,
+  ``request-failed``, ``invalid-request``, or the ``shed-*`` family);
+* stage names come from the service's stage vocabulary, stage seconds
+  are finite and non-negative, and their sum never exceeds the row's
+  ``wall_seconds`` (plus a small clock-jitter epsilon) -- attribution
+  must never invent time;
+* timestamps are self-consistent (``t_done >= t_arrival``) and
+  ``t_done`` is strictly monotone in FILE order -- the atomic-append
+  writer's contract;
+* a ``batch`` block's ``width`` matches its ``members`` list, every
+  member references a ``request_id`` present in the ledger, and the
+  per-RHS attribution satisfies ``rhs_solve_seconds * width ~=
+  solve_seconds``.
+
+Exit codes: 0 = valid, 1 = validation failures, 2 = unreadable file.
+
+Usage:
+    python scripts/check_access_log.py access.jsonl [more.jsonl ...] \
+        [--min-rows N] [--require-outcome ok]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import math
+import sys
+
+SCHEMA_PREFIX = "acg-tpu-access"
+STAGES = ("admit", "queue-wait", "coalesce", "cache", "compile",
+          "solve", "demux", "respond")
+OUTCOMES = ("ok", "deadline-expired", "request-failed",
+            "invalid-request")
+# stage sums ride two clock reads per stage; give them a small slack
+EPS = 5e-3
+
+
+def _load_rows(path):
+    """``(rows, errors)`` -- each row tagged with its 1-based line."""
+    rows, errors = [], []
+    with open(path) as f:
+        for lineno, raw in enumerate(f, 1):
+            raw = raw.strip()
+            if not raw:
+                continue
+            try:
+                obj = json.loads(raw)
+            except ValueError as e:
+                errors.append(f"line {lineno}: unparseable JSON ({e})")
+                continue
+            if not isinstance(obj, dict):
+                errors.append(f"line {lineno}: not a JSON object")
+                continue
+            rows.append((lineno, obj))
+    return rows, errors
+
+
+def validate(rows, min_rows: int = 0,
+             require_outcomes=()) -> list:
+    """Validate ``[(lineno, row), ...]``; returns error strings."""
+    errors = []
+    if len(rows) < max(int(min_rows), 0):
+        errors.append(f"expected at least {min_rows} row(s), found "
+                      f"{len(rows)}")
+    all_ids = {str(row.get("request_id"))
+               for _ln, row in rows if row.get("request_id")}
+    seen_outcomes = set()
+    prev_done = None
+    for ln, row in rows:
+        schema = str(row.get("schema", ""))
+        if not schema.startswith(SCHEMA_PREFIX):
+            errors.append(f"line {ln}: schema {schema!r} is not "
+                          f"{SCHEMA_PREFIX}/*")
+            continue
+        rid = row.get("request_id")
+        if not isinstance(rid, str) or not rid:
+            errors.append(f"line {ln}: missing/empty request_id")
+        outcome = str(row.get("outcome", ""))
+        seen_outcomes.add(outcome)
+        if outcome not in OUTCOMES and not outcome.startswith("shed-"):
+            errors.append(f"line {ln}: outcome {outcome!r} is not in "
+                          f"the ledger enum")
+        stages = row.get("stages")
+        if not isinstance(stages, dict):
+            errors.append(f"line {ln}: missing stages object")
+            stages = {}
+        total = 0.0
+        for name, sec in stages.items():
+            if name not in STAGES:
+                errors.append(f"line {ln}: unknown stage {name!r}")
+            if not isinstance(sec, (int, float)) \
+                    or not math.isfinite(sec) or sec < 0:
+                errors.append(f"line {ln}: stage {name} seconds "
+                              f"{sec!r} is not a finite non-negative "
+                              f"number")
+            else:
+                total += float(sec)
+        wall = row.get("wall_seconds")
+        if not isinstance(wall, (int, float)) \
+                or not math.isfinite(wall) or wall < 0:
+            errors.append(f"line {ln}: bad wall_seconds {wall!r}")
+        elif total > float(wall) + EPS:
+            errors.append(f"line {ln}: stage seconds sum {total:.6f} "
+                          f"exceeds wall {float(wall):.6f}")
+        t_arr, t_done = row.get("t_arrival"), row.get("t_done")
+        for key, v in (("t_arrival", t_arr), ("t_done", t_done)):
+            if not isinstance(v, (int, float)) or not math.isfinite(v):
+                errors.append(f"line {ln}: bad {key} {v!r}")
+        if isinstance(t_arr, (int, float)) \
+                and isinstance(t_done, (int, float)):
+            if t_done < t_arr - 1e-3:
+                errors.append(f"line {ln}: t_done {t_done} precedes "
+                              f"t_arrival {t_arr}")
+            if prev_done is not None and t_done <= prev_done:
+                errors.append(f"line {ln}: t_done {t_done} is not "
+                              f"strictly after the previous row's "
+                              f"{prev_done} (file-order monotonicity)")
+            prev_done = t_done
+        batch = row.get("batch")
+        if batch is not None:
+            if not isinstance(batch, dict):
+                errors.append(f"line {ln}: batch is not an object")
+                continue
+            width = batch.get("width")
+            members = batch.get("members")
+            if not isinstance(width, int) or width < 1:
+                errors.append(f"line {ln}: bad batch width {width!r}")
+            if not isinstance(members, list) or not members:
+                errors.append(f"line {ln}: batch has no members list")
+            else:
+                if isinstance(width, int) and len(members) != width:
+                    errors.append(f"line {ln}: batch width {width} != "
+                                  f"{len(members)} member(s)")
+                for m in members:
+                    if str(m) not in all_ids:
+                        errors.append(f"line {ln}: batch member {m!r} "
+                                      f"references no request_id in "
+                                      f"this ledger")
+            solve_s = batch.get("solve_seconds")
+            share = batch.get("rhs_solve_seconds")
+            if isinstance(width, int) \
+                    and isinstance(solve_s, (int, float)) \
+                    and isinstance(share, (int, float)):
+                if abs(share * width - solve_s) \
+                        > 1e-3 + 1e-2 * abs(solve_s):
+                    errors.append(
+                        f"line {ln}: rhs_solve_seconds {share} x "
+                        f"width {width} != solve_seconds {solve_s}")
+    for want in require_outcomes:
+        if want not in seen_outcomes:
+            errors.append(f"required outcome {want!r} never appears")
+    return errors
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        description="validate --access-log request ledgers "
+                    "(acg-tpu-access/1)")
+    ap.add_argument("files", nargs="+", metavar="FILE",
+                    help="access-log JSONL file(s)")
+    ap.add_argument("--min-rows", type=int, default=0, metavar="N",
+                    help="fail unless the ledger has at least N rows")
+    ap.add_argument("--require-outcome", action="append", default=[],
+                    metavar="OUTCOME",
+                    help="fail unless some row has this outcome "
+                         "(repeatable)")
+    args = ap.parse_args(argv)
+    failed = False
+    for path in args.files:
+        try:
+            rows, errors = _load_rows(path)
+        except OSError as e:
+            print(f"check_access_log: {path}: {e}", file=sys.stderr)
+            return 2
+        errors += validate(rows, min_rows=args.min_rows,
+                           require_outcomes=args.require_outcome)
+        if errors:
+            failed = True
+            for err in errors:
+                print(f"check_access_log: {path}: {err}",
+                      file=sys.stderr)
+        else:
+            outcomes = {}
+            for _ln, row in rows:
+                o = str(row.get("outcome"))
+                outcomes[o] = outcomes.get(o, 0) + 1
+            summary = ", ".join(f"{k} {v}"
+                                for k, v in sorted(outcomes.items()))
+            print(f"check_access_log: {path}: OK ({len(rows)} "
+                  f"row(s): {summary or 'empty'})")
+    return 1 if failed else 0
+
+
+if __name__ == "__main__":
+    try:
+        sys.exit(main())
+    except BrokenPipeError:
+        # stdout consumer (head, grep -m) closed early -- the cli.py
+        # SIGPIPE recipe: point the fd at devnull so the interpreter's
+        # exit flush cannot print a traceback after a clean verdict
+        import os
+
+        os.dup2(os.open(os.devnull, os.O_WRONLY), sys.stdout.fileno())
+        sys.exit(0)

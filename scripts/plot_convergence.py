@@ -11,7 +11,11 @@ Accepts any mix of:
   histogram and its percentiles re-derived from the bucket counts);
 * ``--history`` run-ledger JSONL partitions (acg-tpu-history/1 index
   lines): a latency-over-time trend panel, one line per case, renders
-  next to the residual plot (ascii: per-case latency sparklines).
+  next to the residual plot (ascii: per-case latency sparklines);
+* ``--access-log`` request ledgers (acg-tpu-access/1, the solver
+  service's one-row-per-request attribution): a per-stage STACKED
+  latency panel (one bar per request, ledger order) plus the outcome
+  histogram (ascii: stage p50/p95 lines and outcome bars).
 
 With matplotlib: a semilog residual plot (one line per log, wrap
 markers where a ring truncated) and, when any latency input is given,
@@ -355,6 +359,78 @@ def _history_lines(rec) -> list[str]:
     return lines
 
 
+# the request observatory's stage vocabulary, in service order (kept
+# in sync with acg_tpu.reqtrace.STAGES; re-declared so the script
+# stays runnable against a bare ledger with no package import)
+_ACCESS_STAGES = ("admit", "queue-wait", "coalesce", "cache",
+                  "compile", "solve", "demux", "respond")
+
+
+def _pctl(vals, q: float):
+    """Sample percentile by rank interpolation (the ledger carries raw
+    per-request seconds, not histogram buckets)."""
+    vals = sorted(v for v in vals if math.isfinite(v))
+    if not vals:
+        return None
+    rank = q * (len(vals) - 1)
+    lo = int(rank)
+    hi = min(lo + 1, len(vals) - 1)
+    return vals[lo] + (vals[hi] - vals[lo]) * (rank - lo)
+
+
+def _load_access(path):
+    """An ``--access-log`` request ledger (acg-tpu-access/1) -> the
+    per-request stage/outcome evidence.  Sniffs by content: at least
+    one parseable line must carry the access schema marker."""
+    rows = []
+    with open(path) as f:
+        for raw in f:
+            raw = raw.strip()
+            if not raw:
+                continue
+            try:
+                obj = json.loads(raw)
+            except ValueError:
+                continue
+            if isinstance(obj, dict) and str(
+                    obj.get("schema", "")).startswith("acg-tpu-access"):
+                rows.append(obj)
+    if not rows:
+        raise ValueError("no acg-tpu-access ledger rows")
+    outcomes: dict[str, int] = {}
+    for r in rows:
+        o = str(r.get("outcome"))
+        outcomes[o] = outcomes.get(o, 0) + 1
+    return {"path": path, "rows": rows, "outcomes": outcomes}
+
+
+def _access_lines(rec) -> list:
+    """Ascii fallback for a request ledger: outcome bars plus the
+    per-stage p50/p95 attribution."""
+    rows = rec["rows"]
+    lines = [f"{rec['path']}: request ledger, {len(rows)} request(s)"]
+    peak = max(rec["outcomes"].values())
+    for outcome, count in sorted(rec["outcomes"].items()):
+        bar = "#" * max(int(count / peak * 24 + 0.5), 1)
+        lines.append(f"  {outcome:<18} {bar} {count}")
+    for name in _ACCESS_STAGES:
+        vals = [float(r["stages"][name]) for r in rows
+                if isinstance(r.get("stages"), dict)
+                and isinstance(r["stages"].get(name), (int, float))]
+        if not vals:
+            continue
+        lines.append(f"  {name:<12} p50 {_fmt_s(_pctl(vals, 0.5))}  "
+                     f"p95 {_fmt_s(_pctl(vals, 0.95))}  "
+                     f"({len(vals)} obs)")
+    walls = [float(r["wall_seconds"]) for r in rows
+             if isinstance(r.get("wall_seconds"), (int, float))]
+    if walls:
+        lines.append(f"  {'wall':<12} p50 {_fmt_s(_pctl(walls, 0.5))}  "
+                     f"p95 {_fmt_s(_pctl(walls, 0.95))}  "
+                     f"({len(walls)} obs)")
+    return lines
+
+
 def _load_timeline(path):
     """A ``--timeline`` Chrome trace-event file (acg-tpu-timeline/1)
     -> one span-summary record: per-name earliest start / latest end /
@@ -413,8 +489,9 @@ def _gantt_lines(rec, width: int = 56) -> list:
 
 
 def _classify(path):
-    """``("conv", ...) | ("latency", ...) | ("timeline", ...)`` by
-    content, not extension: a convergence log's first parseable line is
+    """``("conv" | "latency" | "timeline" | "history" | "access",
+    rec)`` by content, not extension: a convergence log's first
+    parseable line is
     the meta record, a stats document has a ``stats`` key, anything
     with an ``acg_solve_seconds`` series is a metrics textfile, and an
     ``acg-tpu-timeline`` trace-event document renders as a per-phase
@@ -422,6 +499,13 @@ def _classify(path):
     still classifies (the kappa annotation is its evidence)."""
     try:
         return ("timeline", _load_timeline(path))
+    except (ValueError, UnicodeDecodeError):
+        pass
+    try:
+        # an --access-log request ledger: acg-tpu-access rows (before
+        # the history sniff -- both are JSONL, only access rows carry
+        # the schema marker)
+        return ("access", _load_access(path))
     except (ValueError, UnicodeDecodeError):
         pass
     try:
@@ -466,7 +550,7 @@ def main(argv=None) -> int:
                          "is installed")
     args = ap.parse_args(argv)
 
-    conv, latency, timelines, histories = [], [], [], []
+    conv, latency, timelines, histories, accesses = [], [], [], [], []
     for path in args.logs:
         try:
             kind, rec = _classify(path)
@@ -479,6 +563,8 @@ def main(argv=None) -> int:
             timelines.append(rec)
         elif kind == "history":
             histories.append(rec)
+        elif kind == "access":
+            accesses.append(rec)
         else:
             latency.append(rec)
 
@@ -540,10 +626,15 @@ def main(argv=None) -> int:
             # per-case latency-over-time trend of a --history ledger
             for line in _history_lines(rec):
                 print(line)
+        for rec in accesses:
+            # per-stage attribution + outcomes of an --access-log
+            for line in _access_lines(rec):
+                print(line)
         return 0
 
     ncols = ((1 if conv else 0) + (1 if latency else 0)
-             + (1 if timelines else 0) + (1 if histories else 0)) or 1
+             + (1 if timelines else 0) + (1 if histories else 0)
+             + (2 if accesses else 0)) or 1
     fig, axes = plt.subplots(1, ncols,
                              figsize=(9 if ncols == 1 else 6.5 * ncols,
                                       5))
@@ -676,7 +767,8 @@ def main(argv=None) -> int:
         # the latency-over-time trend panel (one line per case) for the
         # first ledger; additional files fall back to the ascii summary
         # so N files never explode the figure
-        hax = axes[-1]
+        hax = axes[(1 if conv else 0) + (1 if latency else 0)
+                   + (1 if timelines else 0)]
         rec = histories[0]
         import datetime
         for case in sorted(rec["cases"]):
@@ -695,6 +787,40 @@ def main(argv=None) -> int:
         hax.legend(fontsize=7)
         for extra in histories[1:]:
             for line in _history_lines(extra):
+                print(line)
+    if accesses:
+        # the request observatory's pair: a stacked per-stage latency
+        # bar per request (ledger order) + the outcome histogram, for
+        # the first ledger; extra files fall back to the ascii summary
+        base = ((1 if conv else 0) + (1 if latency else 0)
+                + (1 if timelines else 0) + (1 if histories else 0))
+        aax, oax = axes[base], axes[base + 1]
+        rec = accesses[0]
+        rows = [r for r in rec["rows"]
+                if isinstance(r.get("stages"), dict)]
+        idx = list(range(len(rows)))
+        bottom = [0.0] * len(rows)
+        for name in _ACCESS_STAGES:
+            vals = [float(r["stages"].get(name) or 0.0) for r in rows]
+            if not any(vals):
+                continue
+            aax.bar(idx, vals, bottom=bottom, width=0.92, label=name)
+            bottom = [b + v for b, v in zip(bottom, vals)]
+        aax.set_xlabel("request (ledger order)")
+        aax.set_ylabel("seconds")
+        aax.set_title(f"{os.path.basename(rec['path'])}: per-stage "
+                      f"latency ({len(rows)} request(s))", fontsize=8)
+        aax.legend(fontsize=7)
+        outs = sorted(rec["outcomes"].items())
+        oax.bar(range(len(outs)), [v for _k, v in outs],
+                color="tab:gray")
+        oax.set_xticks(range(len(outs)))
+        oax.set_xticklabels([k for k, _v in outs], fontsize=7,
+                            rotation=30, ha="right")
+        oax.set_ylabel("requests")
+        oax.set_title("outcomes", fontsize=8)
+        for extra in accesses[1:]:
+            for line in _access_lines(extra):
                 print(line)
     fig.tight_layout()
     if args.output:

@@ -59,6 +59,17 @@
 # survives a crash-mid-request (supervisor relaunch + operator-cache
 # warm restore on the same port), and shuts down clean on
 # POST /shutdown (supervisor exit 0).
+# T1_REQTRACE=1 runs the request-observatory smoke: an 8-part --serve
+# daemon under --access-log + --timeline answers a burst that includes
+# client/traceparent identities and one coalesced pair; the echoed
+# request ids, the /requests ring, and the requests: status block must
+# agree, the acg-tpu-access/1 ledger must validate
+# (scripts/check_access_log.py) with the coalesced members sharing one
+# batch block whose per-RHS attribution sums back to the batch solve
+# time, access_report.py must render the p50/p95/p99 table and gate on
+# --fail-on-p99 (exit 7), the exported SERVICE timeline must validate
+# (scripts/check_timeline.py), and the exposition must carry
+# acg_serve_stage_seconds / acg_serve_inflight.
 set -o pipefail
 cd "$(dirname "$0")/.."
 rm -f /tmp/_t1.log
@@ -741,5 +752,127 @@ PY
         echo "T1_SERVE: supervised daemon exited $serve_rc (want 0)"
         rc=$((rc ? rc : 1))
     fi
+fi
+if [ "${T1_REQTRACE:-0}" = "1" ]; then
+    # request-observatory smoke (the ISSUE-18 acceptance in
+    # miniature): identity echo (client id + traceparent), a coalesced
+    # pair attributed per RHS in the access ledger, the /requests
+    # ring, the service timeline, and the three CI gates over the
+    # artifacts the daemon leaves behind
+    echo "T1_REQTRACE: 8-part request-observatory smoke"
+    rm -f /tmp/_t1_reqtrace.jsonl /tmp/_t1_reqtrace_tl.json \
+        /tmp/_t1_reqtrace.prom
+    RT_PORT=$((20000 + RANDOM % 20000))
+    timeout -k 10 600 env JAX_PLATFORMS=cpu \
+        XLA_FLAGS="--xla_force_host_platform_device_count=8" \
+        python -m acg_tpu.cli gen:poisson2d:20 --nparts 8 \
+        --serve --serve-port "$RT_PORT" --serve-faults --quiet \
+        --access-log /tmp/_t1_reqtrace.jsonl \
+        --timeline /tmp/_t1_reqtrace_tl.json &
+    RT_PID=$!
+    env RT_PORT="$RT_PORT" python - <<'PY' || rc=$((rc ? rc : 1))
+import json, os, threading, time, urllib.request
+
+base = f"http://127.0.0.1:{os.environ['RT_PORT']}"
+
+
+def req(method, path, doc=None, timeout=180.0):
+    r = urllib.request.Request(
+        base + path, method=method,
+        data=None if doc is None else json.dumps(doc).encode(),
+        headers={"Content-Type": "application/json"})
+    with urllib.request.urlopen(r, timeout=timeout) as resp:
+        return resp.status, json.loads(resp.read().decode())
+
+
+def wait_up(budget=240.0):
+    t0 = time.monotonic()
+    while time.monotonic() - t0 < budget:
+        try:
+            s, d = req("GET", "/healthz", timeout=5.0)
+            if s == 200 and d.get("ok"):
+                return True
+        except Exception:
+            pass
+        time.sleep(0.25)
+    return False
+
+
+assert wait_up(), "T1_REQTRACE: the daemon never came up"
+doc = {"b_seed": 1, "rtol": 1e-8, "maxits": 500}
+s, b1 = req("POST", "/solve", dict(doc, request_id="smoke-1"))
+assert s == 200 and b1["ok"] and b1["request_id"] == "smoke-1", b1
+tp = "00-4bf92f3577b34da6a3ce929d0e0e4736-00f067aa0ba902b7-01"
+s, b2 = req("POST", "/solve", dict(doc, b_seed=2, traceparent=tp))
+assert b2["request_id"] == tp.split("-")[1], b2
+
+# the coalesced pair: hold the worker with a slow (uncoalescible)
+# lead, race two identified followers into the queue
+results = {}
+
+
+def fire(key, body):
+    results[key] = req("POST", "/solve", body)
+
+
+ts = [threading.Thread(target=fire, args=(
+    "slow", dict(doc, b_seed=9, fault="slow:0.8",
+                 request_id="smoke-slow")))]
+ts[0].start()
+time.sleep(0.4)
+for seed in (11, 12):
+    t = threading.Thread(target=fire, args=(
+        seed, dict(doc, b_seed=seed,
+                   request_id=f"smoke-pair-{seed}")))
+    ts.append(t)
+    t.start()
+for t in ts:
+    t.join(timeout=240.0)
+for seed in (11, 12):
+    s, body = results[seed]
+    assert s == 200 and body["coalesced"] == 2, (seed, body)
+    assert body["request_id"] == f"smoke-pair-{seed}", body
+
+s, ring = req("GET", "/requests")
+assert ring["schema"] == "acg-serve-requests/1", ring
+done = {d["request_id"] for d in ring["completed"]}
+assert {"smoke-1", "smoke-pair-11", "smoke-pair-12"} <= done, done
+s, st = req("GET", "/status")
+blk = st["requests"]
+assert blk["completed"] >= 5 and blk["outcomes"]["ok"] >= 5, blk
+assert blk["access_log"] == "/tmp/_t1_reqtrace.jsonl", blk
+
+with urllib.request.urlopen(base + "/metrics",
+                            timeout=30.0) as resp:
+    expo = resp.read().decode()
+with open("/tmp/_t1_reqtrace.prom", "w") as f:
+    f.write(expo)
+
+req("POST", "/shutdown", {}, timeout=10.0)
+print("T1_REQTRACE: OK (identity echo incl. traceparent, coalesced "
+      "pair of 2, /requests ring + requests: block, clean shutdown)")
+PY
+    wait "$RT_PID"
+    rt_rc=$?
+    if [ "$rt_rc" != "0" ]; then
+        echo "T1_REQTRACE: daemon exited $rt_rc (want 0)"
+        rc=$((rc ? rc : 1))
+    fi
+    python scripts/check_access_log.py /tmp/_t1_reqtrace.jsonl \
+        --min-rows 5 --require-outcome ok || rc=$((rc ? rc : 1))
+    python scripts/access_report.py /tmp/_t1_reqtrace.jsonl \
+        --fail-on-p99 60 | grep -q "p99" || rc=$((rc ? rc : 1))
+    # the latency gate must actually gate: an absurd budget trips 7
+    python scripts/access_report.py /tmp/_t1_reqtrace.jsonl \
+        --fail-on-p99 0.000001 >/dev/null 2>&1
+    if [ "$?" != "7" ]; then
+        echo "T1_REQTRACE: --fail-on-p99 did not exit 7"
+        rc=$((rc ? rc : 1))
+    fi
+    python scripts/check_timeline.py /tmp/_t1_reqtrace_tl.json \
+        || rc=$((rc ? rc : 1))
+    python scripts/check_metrics_textfile.py /tmp/_t1_reqtrace.prom \
+        --require acg_serve_stage_seconds \
+        --require acg_serve_inflight || rc=$((rc ? rc : 1))
 fi
 exit $rc

@@ -312,6 +312,50 @@ def test_tracing_layer_leaves_programs_byte_identical(prob):
         tracing.disarm()
 
 
+def test_reqtrace_layer_leaves_programs_byte_identical(prob, tmp_path):
+    """The request observatory is host-side stdlib bookkeeping only:
+    serving requests with the access ledger armed and request spans
+    riding the tracing recorder must leave the lowered solve programs
+    byte-identical, single-chip and distributed (the
+    metrics/tracing/planner disarmament contract, extended to the
+    per-request layer)."""
+    from acg_tpu import observatory, tracing
+    from acg_tpu.io.generators import poisson2d_coo as _p2
+    from acg_tpu.ops.spmv import device_matrix_from_csr
+    from acg_tpu.serve import ServeConfig, ServeDaemon
+    from acg_tpu.solvers.jax_cg import JaxCGSolver
+    from acg_tpu.solvers.stats import StoppingCriteria
+
+    r, c, v, N = _p2(12)
+    csr = SymCsrMatrix.from_coo(N, r, c, v).to_csr()
+    b1 = np.ones(N)
+    s1 = JaxCGSolver(device_matrix_from_csr(csr, dtype=jnp.float64),
+                     kernels="xla")
+    s2 = DistCGSolver(prob)
+    b2 = np.ones(prob.n)
+    before1 = s1.lower_solve(b1).as_text()
+    before2 = s2.lower_solve(b2).as_text()
+    d = ServeDaemon(ServeConfig(
+        port=0, default_timeout=60.0,
+        access_log=str(tmp_path / "access.jsonl")))
+    d.start()
+    try:
+        tracing.arm()
+        status, body = d.submit({"matrix": "gen:poisson2d:12",
+                                 "rtol": 1e-8, "maxits": 300,
+                                 "request_id": "pin-1"})
+        assert status == 200 and body["request_id"] == "pin-1"
+        assert tracing.nspans() > 0  # the request lanes DID record
+        s1.solve(b1, criteria=StoppingCriteria(maxits=10),
+                 raise_on_divergence=False)
+        assert s1.lower_solve(b1).as_text() == before1
+        assert s2.lower_solve(b2).as_text() == before2
+    finally:
+        tracing.disarm()
+        d.stop()
+        observatory._clear_slo()
+
+
 def test_planner_leaves_programs_byte_identical(prob):
     """The decision observatory is host arithmetic only: building a
     full ranked plan (kappa oracle, candidate pricing, rendering) must

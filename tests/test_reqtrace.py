@@ -1,0 +1,239 @@
+"""The request observatory (ISSUE 18): request identity resolution,
+per-stage accounting, the access ledger, and its validators.
+
+The contracts pinned here:
+  * identity never costs a request its answer -- a malformed client id
+    or traceparent falls back (client id > traceparent trace-id >
+    generated), it does not refuse;
+  * stage accounting is accumulating, lock-protected, and frozen at
+    completion -- a worker racing the deadline boundary cannot mutate a
+    sealed row;
+  * ``RequestLog.complete`` is idempotent (first outcome wins), stamps
+    strictly-increasing ``t_done``, and appends ONE atomic JSONL line
+    per request that ``scripts/check_access_log.py`` accepts;
+  * the /requests ring is bounded and never torn under concurrent
+    completion.
+"""
+
+import json
+import os
+import subprocess
+import sys
+import threading
+
+from acg_tpu import reqtrace
+from acg_tpu.reqtrace import (ACCESS_SCHEMA, OUTCOMES, REQUESTS_SCHEMA,
+                              STAGES, RequestLog, RequestRecord,
+                              generate_request_id, outcome_of,
+                              parse_traceparent, request_id_from_doc)
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+TRACEPARENT = "00-4bf92f3577b34da6a3ce929d0e0e4736-00f067aa0ba902b7-01"
+TRACE_ID = "4bf92f3577b34da6a3ce929d0e0e4736"
+
+
+# -- identity resolution --------------------------------------------------
+
+def test_parse_traceparent():
+    assert parse_traceparent(TRACEPARENT) == TRACE_ID
+    # case and surrounding whitespace are normalised away
+    assert parse_traceparent("  " + TRACEPARENT.upper() + " ") == TRACE_ID
+    for bad in (None, "", "not-a-traceparent", TRACE_ID,
+                "00-" + "g" * 32 + "-00f067aa0ba902b7-01",
+                "00-" + "a" * 31 + "-00f067aa0ba902b7-01", 17):
+        assert parse_traceparent(bad) is None
+
+
+def test_request_id_resolution_order():
+    # a well-formed client id wins over everything
+    assert request_id_from_doc({"request_id": "client-7",
+                                "traceparent": TRACEPARENT}) == "client-7"
+    # no client id -> the traceparent's trace-id
+    assert request_id_from_doc({"traceparent": TRACEPARENT}) == TRACE_ID
+    # malformed client ids are IGNORED (never refused): fall through
+    for bad in ("", "has space", "x" * 129, 42, ["list"]):
+        assert request_id_from_doc(
+            {"request_id": bad, "traceparent": TRACEPARENT}) == TRACE_ID
+    # nothing usable -> generated, with the recognisable prefix
+    for doc in ({}, None, {"request_id": "bad id",
+                           "traceparent": "junk"}):
+        rid = request_id_from_doc(doc)
+        assert rid.startswith("req-") and len(rid) == 4 + 16
+    # generated ids are unique
+    assert generate_request_id() != generate_request_id()
+
+
+def test_outcome_mapping():
+    assert outcome_of({"ok": True}) == "ok"
+    for kind in ("shed-queue-full", "shed-slo-burn", "shed-shutdown",
+                 "deadline-expired"):
+        body = {"ok": False, "error": {"type": kind}}
+        assert outcome_of(body) == kind
+        assert outcome_of(body) in OUTCOMES
+    for kind in ("invalid-request", "faults-disabled"):
+        assert outcome_of({"ok": False, "error": {"type": kind}}) == \
+            "invalid-request"
+    # breakdowns, non-convergence, isolation deaths: request-failed
+    assert outcome_of({"ok": False,
+                       "error": {"type": "not-converged"}}) == \
+        "request-failed"
+    assert outcome_of(None) == "request-failed"
+    assert outcome_of({}) == "request-failed"
+
+
+# -- per-request records --------------------------------------------------
+
+def test_record_accumulates_and_freezes():
+    rec = RequestRecord("r-1", matrix="gen:poisson2d:12")
+    rec.stage("queue-wait", 0.25)
+    rec.stage("queue-wait", 0.25)  # accumulating, not overwriting
+    rec.stage("solve", 0.5, batch="batch-1")
+    rec.note("coalesced", 3)
+    assert rec.stages() == {"queue-wait": 0.5, "solve": 0.5}
+    d = rec.doc()
+    assert d["inflight"] and d["request_id"] == "r-1"
+    assert d["coalesced"] == 3
+    # negative durations clamp to zero (clock jitter must not produce
+    # time-travelling rows)
+    rec.stage("demux", -1.0)
+    assert rec.stages()["demux"] == 0.0
+
+    log = RequestLog()
+    rec2 = log.begin("r-2")
+    rec2.stage("admit", 0.01)
+    row = log.complete(rec2, "ok")
+    assert row["outcome"] == "ok"
+    # sealed: further stage()/note() calls are no-ops
+    rec2.stage("solve", 99.0)
+    rec2.note("cache", {"operator": "hit"})
+    assert "solve" not in rec2.doc()["stages"]
+    assert "cache" not in rec2.doc()
+
+
+def test_log_lane_assignment_and_idempotent_complete():
+    log = RequestLog(ring=4)
+    a, b, c = log.begin("a"), log.begin("b"), log.begin("c")
+    assert (a.lane, b.lane, c.lane) == (0, 1, 2)
+    log.complete(b, "ok")
+    assert log.begin("d").lane == 1  # lowest free lane is reused
+    # first completion wins; the loser sees None and the outcome holds
+    assert log.complete(a, "deadline-expired") is not None
+    assert log.complete(a, "ok") is None
+    assert a.outcome == "deadline-expired"
+    assert log.summary()["outcomes"]["deadline-expired"] == 1
+
+
+def test_log_ring_bound_and_monotone_t_done():
+    log = RequestLog(ring=3)
+    rows = [log.complete(log.begin(f"r-{i}"), "ok") for i in range(8)]
+    snap = log.snapshot()
+    assert snap["schema"] == REQUESTS_SCHEMA
+    assert [d["request_id"] for d in snap["completed"]] == \
+        ["r-5", "r-6", "r-7"]  # bounded ring keeps the last K
+    assert snap["outcomes"] == {"ok": 8}
+    dones = [r["t_done"] for r in rows]
+    assert all(b > a for a, b in zip(dones, dones[1:]))
+    for r in rows:
+        assert r["t_arrival"] <= r["t_done"]
+    s = log.summary()
+    assert s["completed"] == 8 and s["inflight"] == 0 and s["ring"] == 3
+
+
+def test_ledger_rows_pass_the_validator(tmp_path):
+    """Round-trip: rows written by RequestLog -- including a batched
+    row with per-RHS attribution and a shed row -- satisfy
+    scripts/check_access_log.py, and torn/invalid rows are rejected."""
+    path = str(tmp_path / "access.jsonl")
+    log = RequestLog(path, ring=8)
+    members = [f"m-{i}" for i in range(3)]
+    batch = {"id": "batch-1", "width": 3, "members": members,
+             "solve_seconds": 0.3, "rhs_solve_seconds": 0.1}
+    for rid in members:
+        rec = log.begin(rid, matrix="gen:poisson2d:12")
+        rec.arrival -= 0.5  # backdate: wall must cover the stages
+        rec.stage("admit", 0.001)
+        rec.stage("queue-wait", 0.02)
+        rec.stage("solve", 0.1, batch="batch-1")
+        rec.note("batch", batch)
+        rec.note("cache", {"operator": "hit", "program": "hit"})
+        log.complete(rec, "ok")
+    shed = log.begin("shed-1")
+    shed.stage("admit", 0.0005)
+    log.complete(shed, "shed-queue-full")
+    log.close()
+
+    with open(path) as f:
+        rows = [json.loads(line) for line in f]
+    assert len(rows) == 4
+    assert all(r["schema"] == ACCESS_SCHEMA for r in rows)
+    assert set(rows[0]["stages"]) <= set(STAGES)
+
+    res = subprocess.run(
+        [sys.executable, os.path.join(ROOT, "scripts",
+                                      "check_access_log.py"),
+         path, "--min-rows", "4", "--require-outcome", "ok",
+         "--require-outcome", "shed-queue-full"],
+        capture_output=True, text=True)
+    assert res.returncode == 0, res.stderr
+    # and the reporter reads the same ledger: a per-stage table with
+    # p50/p95/p99 columns plus the tail decomposition
+    rep = subprocess.run(
+        [sys.executable, os.path.join(ROOT, "scripts",
+                                      "access_report.py"), path],
+        capture_output=True, text=True)
+    assert rep.returncode == 0, rep.stderr
+    assert "p99" in rep.stdout and "queue-wait" in rep.stdout
+    assert "tail decomposition" in rep.stdout
+    # a stage-sum > wall forgery is caught
+    forged = dict(rows[0])
+    forged["stages"] = {"solve": forged["wall_seconds"] + 1.0}
+    bad = str(tmp_path / "bad.jsonl")
+    with open(bad, "w") as f:
+        f.write(json.dumps(forged) + "\n")
+    res = subprocess.run(
+        [sys.executable, os.path.join(ROOT, "scripts",
+                                      "check_access_log.py"), bad],
+        capture_output=True, text=True)
+    assert res.returncode == 1
+    assert "exceeds wall" in res.stderr
+
+
+def test_concurrent_completions_never_tear(tmp_path):
+    """Many threads completing against one log: every ledger line
+    parses (the single-os.write atomic-append contract), t_done stays
+    strictly monotone, and snapshot() under fire never tears."""
+    path = str(tmp_path / "access.jsonl")
+    log = RequestLog(path, ring=16)
+    nthreads, per = 8, 25
+    stop = threading.Event()
+
+    def _writer(k):
+        for i in range(per):
+            rec = log.begin(f"w{k}-{i}")
+            rec.stage("admit", 0.0001)
+            rec.stage("solve", 0.0002)
+            log.complete(rec, "ok")
+
+    def _reader():
+        while not stop.is_set():
+            snap = log.snapshot()
+            for d in snap["completed"] + snap["inflight"]:
+                assert d["request_id"]  # a torn doc would KeyError
+
+    threads = [threading.Thread(target=_writer, args=(k,))
+               for k in range(nthreads)]
+    rt = threading.Thread(target=_reader)
+    rt.start()
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=60.0)
+    stop.set()
+    rt.join(timeout=60.0)
+    log.close()
+    with open(path) as f:
+        rows = [json.loads(line) for line in f]  # every line parses
+    assert len(rows) == nthreads * per
+    dones = [r["t_done"] for r in rows]
+    assert all(b > a for a, b in zip(dones, dones[1:]))
+    assert log.summary()["outcomes"] == {"ok": nthreads * per}

@@ -419,6 +419,11 @@ def test_http_endpoints_end_to_end():
         assert json.loads(body)["schema"] == SCHEMA
         status, body = get("/metrics")
         assert status == 200 and "acg_serve_requests_total" in body
+        status, body = get("/requests")
+        assert status == 200
+        reqdoc = json.loads(body)
+        assert reqdoc["schema"] == "acg-serve-requests/1"
+        assert reqdoc["inflight"] == [] and reqdoc["completed"] == []
         req = urllib.request.Request(
             base + "/solve",
             data=json.dumps(_doc(b_seed=9,
@@ -562,6 +567,151 @@ def test_serve_autotune_plans_and_stamps_provenance():
         assert doc2["plans"]["calibration"] == cal2["calibration_id"]
         assert all(dec["calibration"] == cal2["calibration_id"]
                    for dec in doc2["plans"]["decisions"])
+
+
+# -- the request observatory (ISSUE 18) -----------------------------------
+
+TRACEPARENT = "00-4bf92f3577b34da6a3ce929d0e0e4736-00f067aa0ba902b7-01"
+
+
+def test_request_identity_echo_and_access_ledger(tmp_path):
+    """Every request resolves to a request_id (client id > traceparent
+    trace-id > generated), echoed on the 200 AND the 400 path, and
+    --access-log lands exactly one acg-tpu-access/1 row per request
+    that scripts/check_access_log.py accepts."""
+    ledger = str(tmp_path / "access.jsonl")
+    with _daemon(access_log=ledger) as d:
+        s1, b1 = d.submit(_doc(b_seed=1, request_id="client-1"))
+        assert s1 == 200 and b1["request_id"] == "client-1"
+        s2, b2 = d.submit(_doc(b_seed=2, traceparent=TRACEPARENT))
+        assert s2 == 200
+        assert b2["request_id"] == "4bf92f3577b34da6a3ce929d0e0e4736"
+        s3, b3 = d.submit(_doc(b_seed=3))
+        assert s3 == 200 and b3["request_id"].startswith("req-")
+        # the refusal path carries the identity too
+        s4, b4 = d.submit(_doc(maxits=0, request_id="bad-1"))
+        assert s4 == 400 and b4["request_id"] == "bad-1"
+        assert b4["error"]["type"] == "invalid-request"
+        # the response contract is the PR 17 body plus ONE additive
+        # field -- the id; nothing else moved
+        assert set(b1) == {"ok", "schema", "id", "request_id",
+                           "converged", "iterations",
+                           "latency_seconds", "cache", "coalesced",
+                           "degraded", "plan", "x"}
+        # per-stage seconds reached the histogram surface
+        expo = metrics.expose()
+        assert 'acg_serve_stage_seconds_bucket{stage="solve"' in expo
+        assert "acg_serve_inflight" in expo
+        doc = d.status_doc()
+        assert doc["requests"]["completed"] == 4
+        assert doc["requests"]["outcomes"] == {"ok": 3,
+                                               "invalid-request": 1}
+        assert doc["requests"]["access_log"] == ledger
+    with open(ledger) as f:
+        rows = [json.loads(line) for line in f]
+    assert [r["request_id"] for r in rows] == \
+        ["client-1", "4bf92f3577b34da6a3ce929d0e0e4736",
+         b3["request_id"], "bad-1"]
+    for r in rows[:3]:
+        assert r["outcome"] == "ok"
+        assert sum(r["stages"].values()) <= r["wall_seconds"] + 5e-3
+        for stage in ("admit", "queue-wait", "cache", "solve",
+                      "demux", "respond"):
+            assert stage in r["stages"], (r["request_id"], stage)
+    assert rows[3]["outcome"] == "invalid-request"
+    res = subprocess.run(
+        [sys.executable, "scripts/check_access_log.py", ledger,
+         "--min-rows", "4", "--require-outcome", "ok",
+         "--require-outcome", "invalid-request"],
+        capture_output=True, text=True, cwd=ENV["PYTHONPATH"])
+    assert res.returncode == 0, res.stderr
+
+
+def test_concurrent_coalesced_requests_trace_one_solve(tmp_path):
+    """N parallel POST /solve coalescing into one batch: /requests
+    never tears under fire, each member lands its own ledger row, the
+    rows share ONE batch block whose per-RHS attribution sums back to
+    the batch solve time, and the armed timeline carries a single
+    worker solve-batch span linked to ALL member request ids."""
+    from acg_tpu import tracing
+
+    ledger = str(tmp_path / "access.jsonl")
+    seeds = [11, 22, 33]
+    ids = {s: f"member-{s}" for s in seeds}
+    try:
+        tracing.arm()
+        with _daemon(allow_faults=True, coalesce=4,
+                     access_log=ledger) as d:
+            d.submit(_doc(b_seed=1))  # warm the caches
+            # block the worker with an uncoalescible slow lead, queue
+            # the members behind it so the drain merges them
+            threads = [threading.Thread(
+                target=lambda: d.submit(_doc(fault="slow:0.6",
+                                             b_seed=99,
+                                             request_id="slow-lead")))]
+            threads[0].start()
+            deadline = time.monotonic() + 5.0
+            while len(d.queue) > 0 and time.monotonic() < deadline:
+                time.sleep(0.01)
+            results = {}
+
+            def _go(seed):
+                results[seed] = d.submit(
+                    _doc(b_seed=seed, request_id=ids[seed]))
+
+            for s in seeds:
+                t = threading.Thread(target=_go, args=(s,))
+                threads.append(t)
+                t.start()
+            # the non-torn read under fire: in-flight + completed
+            # documents are never half-written
+            for _ in range(20):
+                snap = d.reqlog.snapshot()
+                assert snap["schema"] == "acg-serve-requests/1"
+                for doc in snap["inflight"] + snap["completed"]:
+                    assert doc["request_id"]
+                    assert isinstance(doc["stages"], dict)
+                time.sleep(0.01)
+            for t in threads:
+                t.join(timeout=120.0)
+            for s in seeds:
+                st, body = results[s]
+                assert st == 200 and body["ok"]
+                assert body["coalesced"] == len(seeds)
+                assert body["request_id"] == ids[s]
+        spans = tracing.local_payload()["spans"]
+    finally:
+        tracing.disarm()
+
+    with open(ledger) as f:
+        rows = {r["request_id"]: r
+                for r in map(json.loads, f)
+                if r["request_id"] in ids.values()}
+    assert set(rows) == set(ids.values())
+    batches = {r["batch"]["id"] for r in rows.values()}
+    assert len(batches) == 1  # ONE solve, three attributions
+    blk = next(iter(rows.values()))["batch"]
+    assert blk["width"] == len(seeds)
+    assert sorted(blk["members"]) == sorted(ids.values())
+    assert abs(blk["rhs_solve_seconds"] * blk["width"]
+               - blk["solve_seconds"]) <= 1e-3
+    for r in rows.values():
+        assert r["batch"] == blk  # every member links the same block
+        assert r["stages"]["queue-wait"] > 0  # they waited on the lead
+        assert abs(r["stages"]["solve"]
+                   - blk["rhs_solve_seconds"]) <= 1e-3
+    # the worker track: one solve-batch span naming every member
+    worker = [s for s in spans if s["cat"] == "worker"
+              and s["name"].startswith("solve-batch")
+              and set((s.get("args") or {}).get("requests", []))
+              >= set(ids.values())]
+    assert len(worker) == 1
+    # and each member's lane carries its own request-scoped spans
+    for rid in ids.values():
+        mine = [s for s in spans if s["cat"] == "request"
+                and (s.get("args") or {}).get("request") == rid]
+        assert {s["name"] for s in mine} >= {"queue-wait", "solve",
+                                             "demux"}
 
 
 def test_serve_without_autotune_has_no_plan_section():
